@@ -1,0 +1,30 @@
+"""Test harness config: force a virtual 8-device CPU platform.
+
+This is the TPU-world "fake cluster" the reference never had (its multi-node
+testing needed the real lab cluster, ``machines.txt``): all sharding tests run
+on 8 virtual CPU devices so halo exchange / mesh logic is exercised anywhere.
+Must run before jax is imported anywhere in the test process.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax
+
+# Some environments (e.g. the axon TPU tunnel) register a PJRT plugin from
+# sitecustomize that ignores JAX_PLATFORMS; the config API still wins.
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(1234)

@@ -1,0 +1,85 @@
+import os
+
+import numpy as np
+import pytest
+
+from tpu_stencil import cli
+from tpu_stencil.config import JobConfig, ImageType, parse_args
+from tpu_stencil.io import raw as raw_io
+from tpu_stencil.ops import stencil
+from tpu_stencil import filters
+
+
+def test_parse_reference_compatible_argv():
+    cfg, _ = parse_args(["waterfall.raw", "1920", "2520", "40", "rgb"])
+    assert cfg.width == 1920 and cfg.height == 2520
+    assert cfg.repetitions == 40 and cfg.image_type is ImageType.RGB
+    assert cfg.filter_name == "gaussian"
+    assert os.path.basename(cfg.output_path) == "blur_waterfall.raw"
+
+
+def test_parse_extended_flags():
+    cfg, _ = parse_args(
+        ["i.raw", "8", "8", "1", "grey", "--filter", "gaussian5",
+         "--backend", "xla", "--mesh", "2x4"]
+    )
+    assert cfg.filter_name == "gaussian5"
+    assert cfg.mesh_shape == (2, 4)
+
+
+def test_config_validation():
+    with pytest.raises(ValueError):
+        JobConfig("x", -1, 5, 1, ImageType.GREY)
+    with pytest.raises(ValueError):
+        JobConfig("x", 5, 5, 1, ImageType.GREY, backend="cuda")
+
+
+def test_cli_end_to_end_grey(tmp_path, rng, capsys):
+    img = rng.integers(0, 256, size=(6, 8, 1), dtype=np.uint8)
+    p = str(tmp_path / "tiny.raw")
+    raw_io.write_raw(p, img)
+    rc = cli.main([p, "8", "6", "2", "grey", "--backend", "xla"])
+    assert rc == 0
+    out_path = str(tmp_path / "blur_tiny.raw")
+    assert os.path.exists(out_path)
+    got = raw_io.read_raw(out_path, 8, 6, 1)[..., 0]
+    want = stencil.reference_stencil_numpy(
+        img[..., 0], filters.get_filter("gaussian"), 2
+    )
+    np.testing.assert_array_equal(got, want)
+    assert "Execution time:" in capsys.readouterr().out
+
+
+def test_cli_end_to_end_rgb_custom_output(tmp_path, rng):
+    img = rng.integers(0, 256, size=(5, 4, 3), dtype=np.uint8)
+    p = str(tmp_path / "c.raw")
+    out = str(tmp_path / "result.raw")
+    raw_io.write_raw(p, img)
+    rc = cli.main([p, "4", "5", "1", "rgb", "--backend", "xla", "--output", out])
+    assert rc == 0
+    got = raw_io.read_raw(out, 4, 5, 3)
+    want = stencil.reference_stencil_numpy(img, filters.get_filter("gaussian"), 1)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_cli_mesh_sharded_end_to_end(tmp_path, rng):
+    # regression: the sharded path must crop the pad region before writing
+    # (driver once wrote the padded 34x44 buffer for a 33x41 image)
+    import jax
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    img = rng.integers(0, 256, size=(33, 41), dtype=np.uint8)
+    p = str(tmp_path / "odd.raw")
+    raw_io.write_raw(p, img[..., None])
+    rc = cli.main([p, "41", "33", "3", "grey", "--mesh", "2x4"])
+    assert rc == 0
+    assert os.path.getsize(str(tmp_path / "blur_odd.raw")) == 33 * 41
+    got = raw_io.read_raw(str(tmp_path / "blur_odd.raw"), 41, 33, 1)[..., 0]
+    want = stencil.reference_stencil_numpy(img, filters.get_filter("gaussian"), 3)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_cli_bad_mesh_is_usage_error(tmp_path):
+    with pytest.raises(SystemExit) as exc:
+        parse_args(["i.raw", "8", "8", "1", "grey", "--mesh", "8"])
+    assert exc.value.code == 2

@@ -1,0 +1,64 @@
+import numpy as np
+import pytest
+
+from tpu_stencil import filters
+
+
+def test_reference_filter_taps():
+    g = filters.get_filter("gaussian")
+    assert g.taps.dtype == np.float32 and g.divisor == 16.0
+    np.testing.assert_array_equal(
+        g.taps, np.array([[1, 2, 1], [2, 4, 2], [1, 2, 1]], np.float32)
+    )
+    b = filters.get_filter("box")
+    assert b.divisor == 9.0
+    np.testing.assert_allclose(b.normalized, np.full((3, 3), 1 / 9.0), rtol=1e-7)
+    e = filters.get_filter("edge")
+    assert e.divisor == 28.0
+    np.testing.assert_array_equal(
+        e.taps, np.array([[1, 4, 1], [4, 8, 4], [1, 4, 1]], np.float32)
+    )
+    assert g.is_exact and b.is_exact and e.is_exact
+
+
+def test_filters_normalized():
+    for name in ("box", "gaussian", "edge", "gaussian5", "gaussian7"):
+        f = filters.get_filter(name)
+        assert abs(float(f.normalized.sum()) - 1.0) < 1e-6, name
+
+
+def test_parametric_gaussian_sizes():
+    assert filters.get_filter("gaussian5").taps.shape == (5, 5)
+    assert filters.get_filter("gaussian5").halo == 2
+    assert filters.get_filter("gaussian7").taps.shape == (7, 7)
+    g3 = filters.binomial_blur(3)
+    g = filters.get_filter("gaussian")
+    np.testing.assert_array_equal(g3.taps, g.taps)
+    assert g3.divisor == g.divisor
+
+
+def test_binomial_dyadic_exact():
+    # /2^(2k-2) normalization is exact in float32
+    for k in (3, 5, 7):
+        f = filters.binomial_blur(k)
+        assert float(f.normalized.sum()) == 1.0
+        assert f.is_exact
+
+
+def test_unknown_filter_raises():
+    with pytest.raises(KeyError):
+        filters.get_filter("nope")
+    with pytest.raises(ValueError):
+        filters.binomial_blur(4)
+
+
+def test_register_custom():
+    # raw pre-normalized arrays are accepted (divisor 1, not exact)
+    filters.register_filter("custom_t", lambda: np.eye(3, dtype=np.float32) / 3.0)
+    f = filters.get_filter("custom_t")
+    assert f.taps.shape == (3, 3) and f.divisor == 1.0 and not f.is_exact
+
+
+def test_identity_filter():
+    f = filters.get_filter("identity")
+    assert f.taps[1, 1] == 1.0 and float(f.normalized.sum()) == 1.0

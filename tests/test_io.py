@@ -1,0 +1,72 @@
+import os
+
+import numpy as np
+import pytest
+
+from tpu_stencil.io import raw as raw_io
+from tpu_stencil.io import native
+
+
+def test_round_trip_grey(tmp_path, rng):
+    img = rng.integers(0, 256, size=(7, 5, 1), dtype=np.uint8)
+    p = str(tmp_path / "img.raw")
+    raw_io.write_raw(p, img)
+    assert os.path.getsize(p) == 35
+    back = raw_io.read_raw(p, 5, 7, 1)
+    np.testing.assert_array_equal(back, img)
+
+
+def test_round_trip_rgb_interleaved(tmp_path, rng):
+    img = rng.integers(0, 256, size=(4, 6, 3), dtype=np.uint8)
+    p = str(tmp_path / "img.raw")
+    raw_io.write_raw(p, img)
+    assert os.path.getsize(p) == 4 * 6 * 3
+    back = raw_io.read_raw(p, 6, 4, 3)
+    np.testing.assert_array_equal(back, img)
+    # byte order on disk is interleaved RGBRGB... row-major
+    blob = np.fromfile(p, dtype=np.uint8)
+    np.testing.assert_array_equal(blob, img.reshape(-1))
+
+
+def test_row_sharded_read(tmp_path, rng):
+    img = rng.integers(0, 256, size=(8, 3, 3), dtype=np.uint8)
+    p = str(tmp_path / "img.raw")
+    raw_io.write_raw(p, img)
+    shard = raw_io.read_raw_rows(p, 2, 4, 3, 3)
+    np.testing.assert_array_equal(shard, img[2:6])
+
+
+def test_row_sharded_write_assembles_full_image(tmp_path, rng):
+    # Two "hosts" write disjoint row ranges into one shared file —
+    # the MPI-IO pattern of mpi/mpi_convolution.c:247-263.
+    img = rng.integers(0, 256, size=(6, 4, 1), dtype=np.uint8)
+    p = str(tmp_path / "out.raw")
+    raw_io.write_raw_rows(p, 3, img[3:], 4, 1, total_height=6)
+    raw_io.write_raw_rows(p, 0, img[:3], 4, 1, total_height=6)
+    back = raw_io.read_raw(p, 4, 6, 1)
+    np.testing.assert_array_equal(back, img)
+
+
+def test_short_file_raises(tmp_path):
+    p = str(tmp_path / "short.raw")
+    with open(p, "wb") as f:
+        f.write(b"\x00" * 10)
+    with pytest.raises(ValueError):
+        raw_io.read_raw(p, 5, 5, 1)
+
+
+def test_out_of_bounds_shard_write_raises(tmp_path):
+    p = str(tmp_path / "o.raw")
+    with pytest.raises(ValueError):
+        raw_io.write_raw_rows(p, 5, np.zeros((3, 2, 1), np.uint8), 2, 1, total_height=6)
+
+
+def test_planar_interleaved_round_trip(rng):
+    img = rng.integers(0, 256, size=(3, 4, 3), dtype=np.uint8)
+    np.testing.assert_array_equal(raw_io.to_interleaved(raw_io.to_planar(img)), img)
+
+
+def test_micro_time_monotone():
+    a = native.micro_time()
+    b = native.micro_time()
+    assert b >= a

@@ -1,0 +1,43 @@
+import pytest
+
+from tpu_stencil.parallel import partition
+
+
+def test_grid_shape_perimeter_minimizing():
+    # square image, 4 devices -> 2x2 beats 1x4/4x1
+    assert partition.grid_shape(4, 1000, 1000) == (2, 2)
+    # wide image: prefer splitting columns
+    assert partition.grid_shape(4, 100, 10000) == (1, 4)
+    # tall image: prefer splitting rows
+    assert partition.grid_shape(4, 10000, 100) == (4, 1)
+
+
+def test_grid_shape_reference_cases():
+    # the reference's sweep used n in {1,2,4,9,16,25} on 1920-wide images
+    assert partition.grid_shape(1, 2520, 1920) == (1, 1)
+    r, c = partition.grid_shape(9, 2520, 1920)
+    assert r * c == 9 and r == 3 and c == 3
+    r, c = partition.grid_shape(16, 5040, 1920)
+    assert r * c == 16
+    assert partition.grid_shape(2, 2520, 1920) == (2, 1)  # taller than wide
+
+
+def test_grid_shape_prime_counts():
+    assert partition.grid_shape(7, 100, 100) in ((1, 7), (7, 1))
+
+
+def test_pad_amounts_divisible():
+    assert partition.pad_amounts(2520, 1920, (3, 3)) == (0, 0)
+    assert partition.tile_shape(2520, 1920, (3, 3)) == (840, 640)
+
+
+def test_pad_amounts_indivisible():
+    ph, pw = partition.pad_amounts(33, 41, (2, 4))
+    assert (33 + ph) % 2 == 0 and (41 + pw) % 4 == 0
+    assert ph == 1 and pw == 3
+    assert partition.tile_shape(33, 41, (2, 4)) == (17, 11)
+
+
+def test_invalid_device_count():
+    with pytest.raises(ValueError):
+        partition.grid_shape(0, 10, 10)

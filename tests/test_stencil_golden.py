@@ -1,0 +1,81 @@
+"""Golden-value tests: JAX stencil vs an independent NumPy per-pixel model.
+
+The reference had no automated tests (SURVEY.md §4); this is the idiomatic
+replacement — bit-exact comparison of the fast path against a slow, obviously
+correct per-pixel implementation with the reference's semantics (zero-padded
+boundary, float32 accumulate, truncating uint8 store).
+"""
+
+import numpy as np
+import pytest
+
+from tpu_stencil import filters
+from tpu_stencil.models.blur import IteratedConv2D
+from tpu_stencil.ops import stencil
+
+
+@pytest.mark.parametrize("shape", [(5, 7), (8, 8), (13, 6)])
+@pytest.mark.parametrize("filter_name", ["gaussian", "box", "edge"])
+def test_grey_single_step_matches_golden(rng, shape, filter_name):
+    img = rng.integers(0, 256, size=shape, dtype=np.uint8)
+    filt = filters.get_filter(filter_name)
+    got = np.asarray(IteratedConv2D(filter_name, backend="xla")(img, 1))
+    want = stencil.reference_stencil_numpy(img, filt, 1)
+    np.testing.assert_array_equal(got, want)
+
+
+@pytest.mark.parametrize("filter_name", ["gaussian", "gaussian5"])
+def test_rgb_multi_rep_matches_golden(rng, filter_name):
+    img = rng.integers(0, 256, size=(9, 11, 3), dtype=np.uint8)
+    filt = filters.get_filter(filter_name)
+    got = np.asarray(IteratedConv2D(filter_name, backend="xla")(img, 3))
+    want = stencil.reference_stencil_numpy(img, filt, 3)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_zero_padding_boundary_semantics():
+    # A constant-255 image must darken at the border every iteration (zero
+    # ghost ring bleeds in) — the MPI variant's semantics, NOT the CUDA
+    # variant's skip-the-border semantics.
+    img = np.full((6, 6), 255, np.uint8)
+    out = np.asarray(IteratedConv2D("gaussian", backend="xla")(img, 1))
+    # interior untouched: sum(taps)=1 exactly for gaussian
+    assert (out[2:-2, 2:-2] == 255).all()
+    # corner: only the 2x2 lower-right quadrant of taps contributes
+    # (4+2+2+1)/16 of 255 = 143.4375 -> truncates to 143
+    assert out[0, 0] == 143
+    # edge (non-corner): 2 of 3 columns present: (2+4+1+2+1+2)/16*255 = 191.25 -> 191
+    assert out[0, 2] == 191
+
+
+def test_zero_reps_is_identity(rng):
+    img = rng.integers(0, 256, size=(4, 4), dtype=np.uint8)
+    out = np.asarray(IteratedConv2D("gaussian", backend="xla")(img, 0))
+    np.testing.assert_array_equal(out, img)
+
+
+def test_gaussian_matches_integer_arithmetic(rng):
+    # gaussian/16 taps are dyadic: float32 result equals exact integer math
+    img = rng.integers(0, 256, size=(10, 10), dtype=np.uint8)
+    got = np.asarray(IteratedConv2D("gaussian", backend="xla")(img, 1))
+    taps = np.array([[1, 2, 1], [2, 4, 2], [1, 2, 1]], np.int64)
+    padded = np.zeros((12, 12), np.int64)
+    padded[1:-1, 1:-1] = img
+    want = np.zeros((10, 10), np.int64)
+    for i in range(3):
+        for j in range(3):
+            want += taps[i, j] * padded[i : i + 10, j : j + 10]
+    want //= 16
+    np.testing.assert_array_equal(got.astype(np.int64), want)
+
+
+def test_output_dtype_and_shape(rng):
+    img = rng.integers(0, 256, size=(6, 5, 3), dtype=np.uint8)
+    out = IteratedConv2D("gaussian", backend="xla")(img, 2)
+    assert out.dtype == np.uint8 and out.shape == img.shape
+
+
+def test_identity_filter_fixed_point(rng):
+    img = rng.integers(0, 256, size=(7, 7), dtype=np.uint8)
+    out = np.asarray(IteratedConv2D("identity", backend="xla")(img, 5))
+    np.testing.assert_array_equal(out, img)

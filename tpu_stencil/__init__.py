@@ -1,0 +1,46 @@
+"""tpu_stencil — a TPU-native framework for distributed iterated image convolution.
+
+A brand-new JAX/XLA/Pallas re-design of the capabilities of
+``theopaid/Parallel-Image-Convolution-using-MPI-OPENMP-and-CUDA``: iterated
+(k x k) convolution filters over headerless raw grey/RGB uint8 images, with
+
+* a pure-XLA and a Pallas TPU stencil kernel (the CUDA ``__global__`` kernel's
+  TPU-native equivalent),
+* HBM-resident double buffering across repetitions (no host round-trips),
+* a 2-D spatial domain decomposition over a ``jax.sharding.Mesh`` with
+  neighbor ``lax.ppermute`` halo exchange over ICI/DCN (the MPI
+  ``Isend/Irecv`` ghost-ring's TPU-native equivalent),
+* sharded raw-image I/O with a native C++ fast path, and
+* a benchmark harness replicating the reference's sweep grid.
+
+Layer map (mirrors SURVEY.md §1's conceptual stack):
+
+========================  =====================================================
+Reference layer           tpu_stencil module
+========================  =====================================================
+CLI / config              :mod:`tpu_stencil.config`, :mod:`tpu_stencil.cli`
+Runtime init / topology   :mod:`tpu_stencil.parallel.mesh`
+Partitioner / scheduler   :mod:`tpu_stencil.parallel.partition`
+Parallel I/O              :mod:`tpu_stencil.io`
+Halo exchange             :mod:`tpu_stencil.parallel.halo`
+Compute kernel            :mod:`tpu_stencil.ops`
+Iteration driver          :mod:`tpu_stencil.models.blur`
+Metrics / timing          :mod:`tpu_stencil.utils.timing`
+========================  =====================================================
+"""
+
+from tpu_stencil.config import JobConfig, ImageType
+from tpu_stencil.filters import get_filter, register_filter, FILTERS
+from tpu_stencil.models.blur import IteratedConv2D
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "JobConfig",
+    "ImageType",
+    "get_filter",
+    "register_filter",
+    "FILTERS",
+    "IteratedConv2D",
+    "__version__",
+]

@@ -1,0 +1,35 @@
+"""Command-line entry point.
+
+Reference-compatible invocation (``mpi/mpi_convolution.c:328-348``):
+
+    python -m tpu_stencil image.raw 1920 2520 40 rgb
+
+prints the compute-window wall-clock (the reference's headline metric) and
+writes ``blur_<input>``. Extra flags expose what the reference hard-codes:
+``--filter``, ``--backend``, ``--mesh``, ``--output``.
+"""
+
+from __future__ import annotations
+
+import sys
+
+from tpu_stencil.config import parse_args
+from tpu_stencil import driver
+
+
+def main(argv=None) -> int:
+    cfg, ns = parse_args(argv)
+    result = driver.run_job(cfg)
+    # Reference-format output line (mpi/mpi_convolution.c:274 prints seconds).
+    print(f"Execution time: {result.compute_seconds:.3f} sec")
+    if ns.time:
+        print(
+            f"total (incl. I/O): {result.total_seconds:.3f} sec; "
+            f"backend={result.backend} mesh={result.mesh_shape}"
+        )
+    print(f"wrote {result.output_path}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

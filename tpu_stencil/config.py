@@ -1,0 +1,143 @@
+"""Job configuration and CLI-compatible argument parsing.
+
+TPU-native equivalent of the reference's positional CLI
+(``Usage`` at ``mpi/mpi_convolution.c:328-348`` and ``Initialization`` at
+``cuda/functions.c:10-29``): ``image width height repetitions {grey,rgb}``.
+Width/height are user-supplied because ``.raw`` is headerless. On top of that
+contract we expose what the reference hard-codes at compile time: filter
+choice, backend (XLA vs Pallas), device count / mesh shape, and output path.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import enum
+import os
+from typing import Optional, Tuple
+
+
+class ImageType(enum.Enum):
+    """Pixel layout of a headerless raw image (1 or 3 bytes per pixel)."""
+
+    GREY = "grey"
+    RGB = "rgb"
+
+    @property
+    def channels(self) -> int:
+        return 1 if self is ImageType.GREY else 3
+
+
+@dataclasses.dataclass(frozen=True)
+class JobConfig:
+    """Everything needed to run one iterated-convolution job."""
+
+    image: str
+    width: int
+    height: int
+    repetitions: int
+    image_type: ImageType
+    filter_name: str = "gaussian"
+    backend: str = "auto"  # auto | xla | pallas | reference
+    mesh_shape: Optional[Tuple[int, int]] = None  # (rows, cols); None = auto
+    output: Optional[str] = None  # None -> blur_<basename> beside input
+    dtype: str = "float32"  # accumulation dtype
+
+    def __post_init__(self) -> None:
+        if self.width <= 0 or self.height <= 0:
+            raise ValueError(f"width/height must be positive, got {self.width}x{self.height}")
+        if self.repetitions < 0:
+            raise ValueError(f"repetitions must be >= 0, got {self.repetitions}")
+        if self.backend not in ("auto", "xla", "pallas", "reference"):
+            raise ValueError(f"unknown backend {self.backend!r}")
+        if self.mesh_shape is not None and (
+            len(self.mesh_shape) != 2 or any(d < 1 for d in self.mesh_shape)
+        ):
+            raise ValueError(f"mesh_shape must be two positive ints, got {self.mesh_shape}")
+
+    @property
+    def channels(self) -> int:
+        return self.image_type.channels
+
+    @property
+    def output_path(self) -> str:
+        """Reference-compatible output naming: ``blur_<input basename>``
+        (``mpi/mpi_convolution.c:244-247``), placed beside the input."""
+        if self.output is not None:
+            return self.output
+        d, base = os.path.split(self.image)
+        return os.path.join(d, f"blur_{base}")
+
+    @property
+    def nbytes(self) -> int:
+        return self.width * self.height * self.channels
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="tpu_stencil",
+        description=(
+            "Iterated image convolution on TPU. Positional arguments are "
+            "compatible with the reference CLI: image width height "
+            "repetitions {grey,rgb}."
+        ),
+    )
+    p.add_argument("image", help="path to headerless .raw image")
+    p.add_argument("width", type=int, help="image width in pixels")
+    p.add_argument("height", type=int, help="image height in pixels")
+    p.add_argument("repetitions", type=int, help="number of filter applications")
+    p.add_argument(
+        "image_type", choices=[t.value for t in ImageType],
+        help="grey (1 byte/px) or rgb (3 interleaved bytes/px)",
+    )
+    p.add_argument(
+        "--filter", dest="filter_name", default="gaussian",
+        help="filter name (box|gaussian|edge|gaussian5|gaussian7|...); default gaussian",
+    )
+    p.add_argument(
+        "--backend", default="auto", choices=["auto", "xla", "pallas", "reference"],
+        help="compute backend; auto picks per platform",
+    )
+    p.add_argument(
+        "--mesh", default=None,
+        help="device mesh as RxC (e.g. 2x4); default: perimeter-minimizing grid "
+             "over all local devices",
+    )
+    p.add_argument("--output", default=None, help="output path (default blur_<input>)")
+    p.add_argument(
+        "--time", action="store_true",
+        help="additionally print whole-job time incl. I/O (the CUDA variant's "
+             "window) and backend/mesh details; the compute-window line is "
+             "always printed",
+    )
+    return p
+
+
+def _parse_mesh(parser: argparse.ArgumentParser, value: str) -> Tuple[int, int]:
+    r, sep, c = value.lower().partition("x")
+    if not sep or not r.isdigit() or not c.isdigit() or int(r) < 1 or int(c) < 1:
+        parser.error(f"--mesh must be RxC with positive integers, got {value!r}")
+    return (int(r), int(c))
+
+
+def parse_args(argv=None) -> Tuple[JobConfig, argparse.Namespace]:
+    parser = build_parser()
+    ns = parser.parse_args(argv)
+    mesh_shape = None
+    if ns.mesh is not None:
+        mesh_shape = _parse_mesh(parser, ns.mesh)
+    try:
+        cfg = JobConfig(
+            image=ns.image,
+            width=ns.width,
+            height=ns.height,
+            repetitions=ns.repetitions,
+            image_type=ImageType(ns.image_type),
+            filter_name=ns.filter_name,
+            backend=ns.backend,
+            mesh_shape=mesh_shape,
+            output=ns.output,
+        )
+    except ValueError as e:
+        parser.error(str(e))
+    return cfg, ns

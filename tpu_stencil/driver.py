@@ -1,0 +1,86 @@
+"""End-to-end job driver: load -> iterate on device(s) -> store -> report.
+
+The TPU-native equivalent of each reference variant's ``main``:
+CLI -> runtime init -> partition -> load shard -> [compute/comm loop] ->
+store -> metrics (SURVEY.md §3 call stacks). One code path spans one chip to
+a full mesh: a 1x1 mesh degrades to the single-device program.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import numpy as np
+
+from tpu_stencil import filters
+from tpu_stencil.config import JobConfig
+from tpu_stencil.io import raw as raw_io
+from tpu_stencil.models.blur import IteratedConv2D, resolve_backend
+from tpu_stencil.utils.timing import Timer, max_across_processes
+
+
+@dataclasses.dataclass
+class JobResult:
+    output_path: str
+    compute_seconds: float  # reference-compatible: compute window only, max across hosts
+    total_seconds: float    # whole job incl. I/O (the CUDA variant's window)
+    backend: str
+    mesh_shape: Optional[tuple]
+
+
+def run_job(cfg: JobConfig, devices: Optional[list] = None) -> JobResult:
+    """Run one iterated-convolution job end to end."""
+    with Timer() as total_t:
+        img = raw_io.read_raw(cfg.image, cfg.width, cfg.height, cfg.channels)
+        if cfg.image_type.channels == 1:
+            img = img[..., 0]
+
+        model = IteratedConv2D(cfg.filter_name, backend=cfg.backend)
+
+        if devices is None:
+            devices = jax.devices()
+        n_dev = len(devices)
+
+        if n_dev > 1 or cfg.mesh_shape is not None:
+            from tpu_stencil.parallel import sharded
+
+            runner = sharded.ShardedRunner(
+                model, (cfg.height, cfg.width), cfg.channels,
+                mesh_shape=cfg.mesh_shape, devices=devices,
+            )
+            # Warm-up compile outside the timed window (the reference's timer
+            # also excludes startup: it opens after MPI_Barrier,
+            # mpi/mpi_convolution.c:151-155). A 0-rep run's output equals its
+            # input, so it doubles as the timed run's input — no second
+            # host-to-device transfer.
+            img_dev = runner.run(runner.put(img), 0)
+            img_dev.block_until_ready()
+            with Timer() as t:
+                out_dev = runner.run(img_dev, cfg.repetitions)
+                out_dev.block_until_ready()
+            out = runner.fetch(out_dev)
+            mesh_shape = runner.mesh_shape
+            resolved_backend = runner.backend
+        else:
+            img_dev = jax.device_put(jax.numpy.asarray(img), devices[0])
+            img_dev = model(img_dev, 0)  # warm-up compile; output == input
+            img_dev.block_until_ready()
+            with Timer() as t:
+                out_dev = model(img_dev, cfg.repetitions)
+                out_dev.block_until_ready()
+            out = np.asarray(out_dev)
+            mesh_shape = None
+            resolved_backend = resolve_backend(cfg.backend)
+
+        compute_seconds = max_across_processes(t.elapsed)
+        raw_io.write_raw(cfg.output_path, out)
+
+    return JobResult(
+        output_path=cfg.output_path,
+        compute_seconds=compute_seconds,
+        total_seconds=total_t.elapsed,
+        backend=resolved_backend,
+        mesh_shape=mesh_shape,
+    )

@@ -1,0 +1,24 @@
+"""Raw-image I/O: whole-image and row-sharded readers/writers.
+
+TPU-native home of the reference's two I/O stacks — the MPI-IO strided
+per-rank reader/writer (``mpi/mpi_convolution.c:126-141,247-263``) and the
+robust POSIX ``read_info``/``write_info`` loops (``cuda/functions.c:31-45``).
+"""
+
+from tpu_stencil.io.raw import (
+    read_raw,
+    write_raw,
+    read_raw_rows,
+    write_raw_rows,
+    to_planar,
+    to_interleaved,
+)
+
+__all__ = [
+    "read_raw",
+    "write_raw",
+    "read_raw_rows",
+    "write_raw_rows",
+    "to_planar",
+    "to_interleaved",
+]

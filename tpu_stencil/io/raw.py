@@ -1,0 +1,101 @@
+"""Headerless .raw uint8 image I/O.
+
+File format (identical to the reference's): row-major bytes, grey = 1
+byte/pixel (H*W bytes), RGB = 3 interleaved bytes/pixel (H*W*3 bytes), no
+header — width/height supplied out of band.
+
+Two access patterns:
+
+* whole image (:func:`read_raw` / :func:`write_raw`) — the CUDA variant's
+  model (``cuda/main.c:22-44``);
+* a contiguous row range at a computed byte offset
+  (:func:`read_raw_rows` / :func:`write_raw_rows`) — the per-rank MPI-IO
+  seek/read pattern (``mpi/mpi_convolution.c:126-141,247-263``), which is how
+  multi-host processes load only their shard.
+
+A native C++ fast path (robust pread/pwrite full-loops, the equivalent of
+``cuda/functions.c:31-45``) is used when the shared library built from
+``native/`` is available; otherwise a pure-Python fallback with identical
+semantics.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from tpu_stencil.io import native as _native
+
+
+def _expected_bytes(width: int, height: int, channels: int) -> int:
+    return width * height * channels
+
+
+def read_raw(path: str, width: int, height: int, channels: int) -> np.ndarray:
+    """Read a whole raw image into an (H, W, C) uint8 array (C in {1, 3})."""
+    return read_raw_rows(path, 0, height, width, channels)
+
+
+def read_raw_rows(
+    path: str, row_start: int, n_rows: int, width: int, channels: int
+) -> np.ndarray:
+    """Read rows [row_start, row_start + n_rows) into (n_rows, W, C) uint8.
+
+    Validates that the file holds at least the bytes addressed, mirroring the
+    implicit trust-the-user contract of the reference (which reads garbage on
+    short files) but failing loudly instead.
+    """
+    offset = row_start * width * channels
+    nbytes = n_rows * width * channels
+    size = os.path.getsize(path)
+    if offset + nbytes > size:
+        raise ValueError(
+            f"{path}: need bytes [{offset}, {offset + nbytes}) but file has {size} "
+            f"(rows {row_start}..{row_start + n_rows}, width {width}, "
+            f"channels {channels})"
+        )
+    buf = _native.pread_full(path, offset, nbytes)
+    return np.frombuffer(buf, dtype=np.uint8).reshape(n_rows, width, channels)
+
+
+def write_raw(path: str, img: np.ndarray) -> None:
+    """Write an (H, W, C) or (H, W) uint8 array as raw interleaved bytes."""
+    arr = np.ascontiguousarray(np.asarray(img, dtype=np.uint8))
+    _native.pwrite_full(path, 0, arr.tobytes(), truncate=True)
+
+
+def write_raw_rows(
+    path: str, row_start: int, rows: np.ndarray, width: int, channels: int,
+    total_height: int,
+) -> None:
+    """Write a row shard at its global offset into a (pre-sized) shared file.
+
+    The multi-process analog of every MPI rank ``MPI_File_write``-ing its
+    interior rows at computed offsets into one shared output file
+    (``mpi/mpi_convolution.c:247-263``). The file is extended to the full
+    image size on first touch so concurrent per-host writers never race on
+    length.
+    """
+    arr = np.ascontiguousarray(np.asarray(rows, dtype=np.uint8))
+    if arr.ndim == 2:
+        arr = arr[..., None]
+    n_rows = arr.shape[0]
+    if arr.shape[1] != width or arr.shape[2] != channels:
+        raise ValueError(f"shard shape {arr.shape} != (*, {width}, {channels})")
+    if row_start < 0 or row_start + n_rows > total_height:
+        raise ValueError(f"rows [{row_start}, {row_start + n_rows}) outside image")
+    total = _expected_bytes(width, total_height, channels)
+    _native.ensure_size(path, total)
+    offset = row_start * width * channels
+    _native.pwrite_full(path, offset, arr.tobytes(), truncate=False)
+
+
+def to_planar(img: np.ndarray) -> np.ndarray:
+    """(H, W, C) interleaved -> (C, H, W) planar (layout experiments)."""
+    return np.ascontiguousarray(np.moveaxis(img, -1, 0))
+
+
+def to_interleaved(img: np.ndarray) -> np.ndarray:
+    """(C, H, W) planar -> (H, W, C) interleaved."""
+    return np.ascontiguousarray(np.moveaxis(img, 0, -1))

@@ -1,0 +1,5 @@
+"""Model family: iterated-stencil "models" (filter + iteration schedule)."""
+
+from tpu_stencil.models.blur import IteratedConv2D, iterate
+
+__all__ = ["IteratedConv2D", "iterate"]

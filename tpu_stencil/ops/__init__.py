@@ -1,0 +1,21 @@
+"""Compute kernels: the (k x k) stencil in pure-XLA and Pallas forms.
+
+This is the TPU-native home of the reference's hottest path — the per-pixel
+3x3 MAC (``mpi/mpi_convolution.c:301-322``, ``cuda/cuda_convolution.cu:9-47``).
+"""
+
+from tpu_stencil.ops.stencil import (
+    conv2d_valid,
+    conv2d_zero_pad,
+    stencil_step,
+    truncate_u8,
+    reference_stencil_numpy,
+)
+
+__all__ = [
+    "conv2d_valid",
+    "conv2d_zero_pad",
+    "stencil_step",
+    "truncate_u8",
+    "reference_stencil_numpy",
+]

@@ -1,0 +1,135 @@
+"""The stencil op: zero-padded (k x k) convolution with uint8 truncation.
+
+Semantics match the reference's MPI variant exactly (SURVEY.md Quirk 3 —
+we deliberately pick the MPI semantics over the CUDA ones and document it):
+
+* **Boundary**: the global image border is zero-padded every iteration — the
+  MPI variant's calloc'd ghost ring (``mpi/mpi_convolution.c:104-124``) that
+  is never written at global edges. Every pixel, including edges, is computed
+  every iteration. (The CUDA variant instead never computes the 1-px border —
+  ``cuda/cuda_convolution.cu:17,34`` — which we do NOT replicate.)
+* **Arithmetic**: ``uint8`` pixels multiplied by *integer-valued* ``float32``
+  taps and accumulated in ``float32`` — exact integer math below 2^24, hence
+  independent of XLA's FMA/association choices — then ONE divide by the
+  filter divisor and a truncating (round-toward-zero) ``uint8`` store: the
+  implicit C cast at ``mpi/mpi_convolution.c:307``. For dyadic divisors
+  (gaussian family) the divide is exact too and results match the C
+  reference bit-for-bit; for non-dyadic divisors (box /9, edge /28) results
+  are deterministic here but may differ from the C program by ±1 ulp-of-u8
+  (the reference pre-rounds taps/divisor per-tap and accumulates in loop
+  order — its own MPI and CUDA variants disagree with each other the same
+  way, SURVEY.md Quirk 3/6). The C cast is undefined for out-of-[0,256)
+  values; we define it as clip.
+
+The XLA formulation is k*k shifted adds over a zero-padded array — for a
+3x3 filter that is 9 fused multiply-adds per pixel, which XLA fuses into a
+single memory-bound elementwise kernel over VMEM tiles; no MXU needed (there
+is no contraction large enough to feed it), the VPU's 8x128 lanes are the
+TPU-native analog of the reference's OpenMP threads / CUDA SIMT lanes.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def truncate_u8(x: jax.Array) -> jax.Array:
+    """float -> uint8 with C-cast semantics for in-range values (truncate
+    toward zero), clip outside [0, 255]."""
+    return jnp.clip(x, 0.0, 255.0).astype(jnp.uint8)
+
+
+def _check_filter(filt: jax.Array) -> int:
+    k = filt.shape[0]
+    if filt.shape != (k, k) or k % 2 != 1:
+        raise ValueError(f"filter must be square with odd size, got {filt.shape}")
+    return k
+
+
+def conv2d_valid(padded: jax.Array, filt: jax.Array) -> jax.Array:
+    """'Valid' 2-D correlation of a halo-extended array (H+2h, W+2h[, C])
+    float32 with ``filt`` (k, k) float32, as k*k shifted adds producing
+    (H, W[, C]). The building block shared by the single-device op (zero
+    padding) and the sharded op (ghost ring filled by halo exchange).
+
+    ``filt`` may be a traced array — taps are indexed statically so the same
+    compiled program serves any filter values of a given size.
+    """
+    k = _check_filter(filt)
+    h = padded.shape[0] - (k - 1)
+    w = padded.shape[1] - (k - 1)
+    acc = None
+    for i in range(k):
+        for j in range(k):
+            window = padded[i : i + h, j : j + w]
+            term = window * filt[i, j]
+            acc = term if acc is None else acc + term
+    return acc
+
+
+def conv2d_zero_pad(x: jax.Array, filt: jax.Array) -> jax.Array:
+    """Zero-padded 'same' 2-D correlation of ``x`` (H, W) or (H, W, C) float32
+    with ``filt`` (k, k) float32."""
+    halo = _check_filter(filt) // 2
+    pad_widths = [(halo, halo), (halo, halo)] + [(0, 0)] * (x.ndim - 2)
+    return conv2d_valid(jnp.pad(x, pad_widths), filt)
+
+
+def stencil_step(img_u8: jax.Array, taps: jax.Array, divisor: jax.Array) -> jax.Array:
+    """One filter application on a uint8 image: exact integer-valued f32
+    accumulation of ``taps``, one divide by ``divisor``, truncating uint8
+    store. The unit the iteration driver repeats ``reps`` times."""
+    acc = conv2d_zero_pad(img_u8.astype(jnp.float32), taps)
+    return truncate_u8(acc / divisor)
+
+
+def reference_stencil_numpy(img_u8: np.ndarray, filt, reps: int) -> np.ndarray:
+    """Pure-NumPy golden model of ``reps`` iterations, written independently
+    of the JAX path: explicit per-pixel loops over a zero-padded buffer.
+    Used by tests only — O(H*W*k*k*reps) slow, mirrors
+    ``ConvolutionforGrey/RGB`` semantics (``mpi/mpi_convolution.c:301-322``)
+    without sharing any code with the fast path.
+
+    ``filt`` is a :class:`tpu_stencil.filters.Filter` (or raw normalized
+    array, divisor 1). For exact filters (integer taps, in-range) the
+    accumulation is int64 — the defined semantics every fast path must
+    reproduce bit-for-bit; otherwise float32 in row-major tap order."""
+    from tpu_stencil.filters import as_filter
+
+    f = as_filter(filt)
+    taps, divisor = f.taps, np.float32(f.divisor)
+    k = f.k
+    halo = f.halo
+    exact = f.is_exact
+    squeeze = img_u8.ndim == 2
+    img = img_u8[..., None] if squeeze else img_u8
+    h, w, c = img.shape
+    cur = img.astype(np.uint8)
+    for _ in range(reps):
+        padded = np.zeros((h + 2 * halo, w + 2 * halo, c), np.uint8)
+        padded[halo : halo + h, halo : halo + w] = cur
+        out = np.empty_like(cur)
+        for y in range(h):
+            for x in range(w):
+                if exact:
+                    acc = np.zeros(c, np.int64)
+                    for i in range(k):
+                        for j in range(k):
+                            acc += padded[y + i, x + j].astype(np.int64) * int(
+                                round(float(taps[i, j]))
+                            )
+                    val = acc.astype(np.float32) / divisor
+                else:
+                    acc = np.zeros(c, np.float32)
+                    for i in range(k):
+                        for j in range(k):
+                            acc += (
+                                padded[y + i, x + j].astype(np.float32)
+                                * np.float32(taps[i, j])
+                            )
+                    val = acc / divisor
+                out[y, x] = np.clip(val, 0.0, 255.0).astype(np.uint8)
+        cur = out
+    return cur[..., 0] if squeeze else cur

@@ -1,0 +1,27 @@
+"""Distribution layer: mesh topology, spatial partitioning, halo exchange.
+
+The TPU-native re-design of the reference's MPI machinery: process-grid
+factorization (``RowsDivision``), neighbor topology, derived-datatype halo
+``Isend/Irecv``, and compute/comm overlap (``mpi/mpi_convolution.c:75-235,
+350-364``) become a ``jax.sharding.Mesh``, a perimeter-minimizing grid
+factorization, neighbor ``lax.ppermute`` shifts inside ``shard_map``, and
+XLA's latency-hiding scheduler respectively.
+"""
+
+from tpu_stencil.parallel.partition import grid_shape, pad_amounts, tile_shape
+from tpu_stencil.parallel.mesh import make_mesh, ROWS_AXIS, COLS_AXIS
+from tpu_stencil.parallel.halo import halo_exchange, halo_pad_axis
+from tpu_stencil.parallel.sharded import ShardedRunner, sharded_iterate
+
+__all__ = [
+    "grid_shape",
+    "pad_amounts",
+    "tile_shape",
+    "make_mesh",
+    "ROWS_AXIS",
+    "COLS_AXIS",
+    "halo_exchange",
+    "halo_pad_axis",
+    "ShardedRunner",
+    "sharded_iterate",
+]

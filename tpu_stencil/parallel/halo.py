@@ -1,0 +1,106 @@
+"""Halo exchange as neighbor ``lax.ppermute`` shifts inside ``shard_map``.
+
+TPU-native re-design of the reference's ghost-ring machinery — derived
+datatypes (``MPI_Type_vector/contiguous``, ``mpi/mpi_convolution.c:75-83``)
+plus up-to-8 nonblocking ``Isend/Irecv`` per iteration (``:156-192``):
+
+* an edge strip is just an array slice (no derived datatypes needed — XLA
+  owns the layout);
+* each of the 4 cardinal sends is one ``lax.ppermute`` over a mesh axis,
+  which XLA lowers to ICI neighbor transfers (DCN across hosts);
+* non-periodic zero boundaries fall out of ``ppermute`` semantics: ranks
+  with no source receive zeros — exactly the reference's never-written
+  calloc'd ghost ring (``mpi/mpi_convolution.c:104-124``). The code is
+  non-periodic even though the reference README describes wraparound
+  (SURVEY.md Quirk 5 — code wins); ``boundary='periodic'`` is offered as an
+  explicit extension.
+* corner ghosts need no diagonal messages: exchanging rows first, then
+  columns *of the row-extended tile*, routes corner data through the
+  edge-adjacent neighbor — 2 collective phases instead of MPI's 8 requests.
+* compute/communication overlap (the reference's hand-scheduled
+  inner-then-border ordering, ``:194-224``) is delegated to XLA's
+  latency-hiding scheduler, which overlaps the ``ppermute`` with the interior
+  of the convolution automatically.
+
+The exchange width (``halo``) is a parameter — wider filters (5x5, 7x7)
+exchange wider strips, where the reference hard-codes 1 pixel.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def halo_pad_axis(x: jax.Array, halo: int, dim: int) -> jax.Array:
+    """Zero-pad ``halo`` elements on both sides of ``dim`` (global boundary)."""
+    pad = [(0, 0)] * x.ndim
+    pad[dim] = (halo, halo)
+    return jnp.pad(x, pad)
+
+
+def _edge(x: jax.Array, dim: int, lo: bool, halo: int) -> jax.Array:
+    idx = [slice(None)] * x.ndim
+    idx[dim] = slice(0, halo) if lo else slice(x.shape[dim] - halo, x.shape[dim])
+    return x[tuple(idx)]
+
+
+def halo_exchange_axis(
+    x: jax.Array,
+    halo: int,
+    dim: int,
+    axis_name: str,
+    axis_size: int,
+    boundary: str = "zero",
+) -> jax.Array:
+    """Extend ``x`` by ``halo`` ghost elements on both sides of ``dim``,
+    filled with neighbor data along mesh axis ``axis_name``.
+
+    Must be called inside ``shard_map``. ``axis_size`` is the (static) mesh
+    axis size — 1 degrades to plain zero padding, so the same program text
+    serves a single device.
+    """
+    if halo == 0:
+        return x
+    if boundary not in ("zero", "periodic"):
+        raise ValueError(f"unknown boundary {boundary!r}")
+    if axis_size == 1:
+        if boundary == "periodic":
+            lo = _edge(x, dim, lo=True, halo=halo)
+            hi = _edge(x, dim, lo=False, halo=halo)
+            return jnp.concatenate([hi, x, lo], axis=dim)
+        return halo_pad_axis(x, halo, dim)
+
+    hi_strip = _edge(x, dim, lo=False, halo=halo)  # my last rows -> next rank
+    lo_strip = _edge(x, dim, lo=True, halo=halo)   # my first rows -> prev rank
+    if boundary == "periodic":
+        fwd = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+        bwd = [(i, (i - 1) % axis_size) for i in range(axis_size)]
+    else:
+        fwd = [(i, i + 1) for i in range(axis_size - 1)]
+        bwd = [(i, i - 1) for i in range(1, axis_size)]
+    # ppermute: ranks with no source receive zeros = global zero boundary.
+    lo_ghost = lax.ppermute(hi_strip, axis_name, fwd)
+    hi_ghost = lax.ppermute(lo_strip, axis_name, bwd)
+    return jnp.concatenate([lo_ghost, x, hi_ghost], axis=dim)
+
+
+def halo_exchange(
+    x: jax.Array,
+    halo: int,
+    axes: Sequence[Tuple[str, int, int]],
+    boundary: str = "zero",
+) -> jax.Array:
+    """Full 2-D (or N-D) halo exchange.
+
+    ``axes`` is a sequence of ``(axis_name, axis_size, dim)`` triples.
+    Exchanged sequentially, each phase operating on the previous phase's
+    extended array — which routes corner ghosts through edge neighbors
+    without diagonal communication.
+    """
+    for axis_name, axis_size, dim in axes:
+        x = halo_exchange_axis(x, halo, dim, axis_name, axis_size, boundary)
+    return x

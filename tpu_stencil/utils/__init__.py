@@ -1,0 +1,5 @@
+"""Utilities: timing, logging."""
+
+from tpu_stencil.utils.timing import Timer, time_compute
+
+__all__ = ["Timer", "time_compute"]

@@ -1,0 +1,55 @@
+"""Timing with the reference's headline-metric semantics.
+
+The reference's MPI metric is: barrier, ``MPI_Wtime`` around the compute/comm
+loop only (file I/O excluded), then max across ranks
+(``mpi/mpi_convolution.c:151-155,242,264-275``). The TPU-native equivalent:
+``jax.block_until_ready`` fences (device queue drained = barrier), a
+monotonic clock around the on-device loop only, and a max across host
+processes for multi-host runs.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Tuple
+
+import jax
+
+
+class Timer:
+    """Monotonic stopwatch; ``elapsed`` in seconds."""
+
+    def __init__(self) -> None:
+        self._start = 0.0
+        self.elapsed = 0.0
+
+    def __enter__(self) -> "Timer":
+        self._start = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.elapsed = time.perf_counter() - self._start
+
+
+def max_across_processes(seconds: float) -> float:
+    """Max-reduce a host-side scalar across JAX processes (multi-host); the
+    analog of the reference's Send/Recv max at ``mpi/mpi_convolution.c:264-275``.
+    Single-process: identity."""
+    if jax.process_count() == 1:
+        return seconds
+    from jax.experimental import multihost_utils
+
+    import numpy as np
+
+    all_times = multihost_utils.process_allgather(np.float32(seconds))
+    return float(all_times.max())
+
+
+def time_compute(fn: Callable[..., Any], *args, **kwargs) -> Tuple[Any, float]:
+    """Run ``fn`` with a barrier-equivalent fence before and after; return
+    (result, compute-only wall-clock seconds, max across processes)."""
+    args = jax.block_until_ready(args)  # drain pending transfers = barrier
+    with Timer() as t:
+        out = fn(*args, **kwargs)
+        out = jax.block_until_ready(out)
+    return out, max_across_processes(t.elapsed)
