@@ -1,0 +1,117 @@
+"""Plan selection and plan-kernel equivalence tests."""
+
+import numpy as np
+import jax
+import pytest
+
+from tpu_stencil import filters
+from tpu_stencil.ops import lowering, stencil
+
+
+def test_plan_kinds_for_reference_filters():
+    p = lowering.plan_filter(filters.get_filter("gaussian"))
+    assert p.kind == "sep_int" and p.shift == 4
+    assert p.row_taps == (1, 2, 1) and p.col_taps == (1, 2, 1)
+
+    p5 = lowering.plan_filter(filters.get_filter("gaussian5"))
+    assert p5.kind == "sep_int" and p5.shift == 8
+    p7 = lowering.plan_filter(filters.get_filter("gaussian7"))
+    assert p7.kind == "sep_int" and p7.shift == 12
+
+    pb = lowering.plan_filter(filters.get_filter("box"))
+    assert pb.kind == "sep_int" and pb.shift is None and pb.divisor == 9.0
+
+    pe = lowering.plan_filter(filters.get_filter("edge"))
+    assert pe.kind == "direct_int"  # rank-2, not separable
+
+    pi = lowering.plan_filter(filters.get_filter("identity"))
+    assert pi.kind == "sep_int" and pi.shift == 0
+
+
+def test_float_taps_fall_back_to_f32():
+    f = filters.Filter(np.full((3, 3), 0.1111, np.float32), 1.0)
+    assert lowering.plan_filter(f).kind == "direct_f32"
+
+
+@pytest.mark.parametrize("name", ["gaussian", "box", "edge", "gaussian5", "identity"])
+def test_plan_matches_golden(rng, name):
+    f = filters.get_filter(name)
+    plan = lowering.plan_filter(f)
+    img = rng.integers(0, 256, size=(11, 13, 3), dtype=np.uint8)
+    got = np.asarray(jax.jit(lowering.padded_step, static_argnames="plan")(
+        img, plan=plan
+    ))
+    want = stencil.reference_stencil_numpy(img, f, 1)
+    np.testing.assert_array_equal(got, want)
+
+
+@pytest.mark.parametrize("name", ["gaussian", "edge"])
+def test_plan_matches_f32_fallback_for_exact_filters(rng, name):
+    # the fast integer plans and the f32 plan agree for exact filters
+    f = filters.get_filter(name)
+    plan = lowering.plan_filter(f)
+    f32_plan = lowering.force_f32_plan(plan)
+    assert f32_plan.kind == "direct_f32"
+    img = rng.integers(0, 256, size=(9, 8), dtype=np.uint8)
+    a = np.asarray(lowering.padded_step(img, plan))
+    b = np.asarray(lowering.padded_step(img, f32_plan))
+    np.testing.assert_array_equal(a, b)
+
+
+def test_negative_taps_clip_to_zero():
+    # a real edge-detect kernel (negative taps): result clips at 0
+    f = filters.Filter(
+        np.array([[0, -1, 0], [-1, 4, -1], [0, -1, 0]], np.float32), 1.0
+    )
+    plan = lowering.plan_filter(f)
+    assert plan.kind == "direct_int"
+    img = np.full((5, 5), 100, np.uint8)
+    out = np.asarray(lowering.padded_step(img, plan))
+    # interior: 4*100 - 4*100 = 0
+    assert out[2, 2] == 0
+    want = stencil.reference_stencil_numpy(img, f, 1)
+    np.testing.assert_array_equal(out, want)
+
+
+def test_valid_step_shapes(rng):
+    plan = lowering.plan_filter(filters.get_filter("gaussian5"))
+    ext = rng.integers(0, 256, size=(14, 16), dtype=np.uint8)
+    out = lowering.valid_step(ext, plan)
+    assert out.shape == (10, 12)
+
+
+def test_sep_with_nonunit_factor_matches_golden(rng):
+    # regression: a separable integer filter whose decomposition factor != 1
+    # (rows not led by the gcd) once produced values off by factor^2
+    f = filters.Filter(
+        np.array([[2, 2, 2], [1, 1, 1], [2, 2, 2]], np.float32), 15.0
+    )
+    plan = lowering.plan_filter(f)
+    assert plan.kind == "sep_int" and plan.divisor == 30.0
+    img = rng.integers(0, 256, size=(8, 8), dtype=np.uint8)
+    got = np.asarray(lowering.padded_step(img, plan))
+    want = stencil.reference_stencil_numpy(img, f, 1)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_wide_dyadic_filter_stays_exact(rng):
+    # gaussian11: bound 255*2^20 exceeds the f32-convert limit (2^24) but the
+    # dyadic shift path is exact to 2^31 — and the golden model's integer
+    # division path agrees
+    f = filters.binomial_blur(11)
+    assert f.is_exact and f.is_dyadic
+    plan = lowering.plan_filter(f)
+    assert plan.kind == "sep_int" and plan.shift == 20
+    img = rng.integers(0, 256, size=(13, 15), dtype=np.uint8)
+    got = np.asarray(lowering.padded_step(img, plan))
+    want = stencil.reference_stencil_numpy(img, f, 1)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_big_nondyadic_integer_filter_demoted():
+    # integer taps, non-dyadic divisor, bound >= 2^24: no exact plan exists,
+    # must fall back to f32 (and Filter.is_exact agrees)
+    taps = np.full((9, 9), 1000.0, np.float32)
+    f = filters.Filter(taps, 81000.0)
+    assert not f.is_exact
+    assert lowering.plan_filter(f).kind == "direct_f32"

@@ -68,13 +68,27 @@ class Filter:
         return (self.taps / np.float32(self.divisor)).astype(np.float32)
 
     @property
+    def is_dyadic(self) -> bool:
+        """True if the divisor is a positive power of two (divide == shift)."""
+        d = float(self.divisor)
+        return d.is_integer() and d > 0 and (int(d) & (int(d) - 1)) == 0
+
+    @property
     def is_exact(self) -> bool:
-        """True if accumulation is provably exact (see module comment)."""
+        """True if the defined semantics are reproducible exactly.
+
+        Integer taps required. With a dyadic divisor the whole pipeline is
+        integer (shift), exact to the int32/int64 accumulation bound; with a
+        general divisor the int accumulation must stay below 2^24 so the
+        one int->float32 convert before the divide is exact.
+        """
         taps = self.taps
-        return bool(
-            np.all(taps == np.round(taps))
-            and 255.0 * float(np.abs(taps).sum()) < _EXACT_LIMIT
-        )
+        if not bool(np.all(taps == np.round(taps))):
+            return False
+        bound = 255.0 * float(np.abs(taps).sum())
+        if self.is_dyadic:
+            return bound < 2 ** 31
+        return bound < _EXACT_LIMIT
 
 
 FilterLike = Union[Filter, np.ndarray]

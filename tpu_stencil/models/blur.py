@@ -10,8 +10,10 @@ between repetitions (the property that made the reference's CUDA variant
 fast, preserved by construction).
 
 ``repetitions`` is a *traced* loop bound, so one compiled program serves any
-rep count without recompilation; the filter is a traced array, so one
-program serves any filter of a given size.
+rep count without recompilation. The filter's execution plan (see
+:mod:`tpu_stencil.ops.lowering`) is *static*: each distinct filter compiles
+its own fastest schedule, taps baked in as constants — a deliberate trade
+of one recompile per filter for ~2x per-iteration throughput.
 """
 
 from __future__ import annotations
@@ -25,7 +27,7 @@ import numpy as np
 
 from tpu_stencil import filters as _filters
 from tpu_stencil.filters import Filter
-from tpu_stencil.ops import stencil as _stencil
+from tpu_stencil.ops import lowering as _lowering
 
 
 def resolve_backend(backend: str, platform: Optional[str] = None) -> str:
@@ -39,10 +41,11 @@ def resolve_backend(backend: str, platform: Optional[str] = None) -> str:
 
 
 def _resolve_step(backend: str, platform: Optional[str] = None):
-    """Pick the per-iteration kernel for a backend name."""
+    """Pick the per-iteration kernel fn(img_u8, plan) for a backend name."""
     backend = resolve_backend(backend, platform)
-    if backend == "xla" or backend == "reference":
-        return _stencil.stencil_step
+    if backend in ("xla", "reference"):
+        # 'reference' differs only in the plan it is handed (forced f32).
+        return _lowering.padded_step
     if backend == "pallas":
         try:
             from tpu_stencil.ops import pallas_stencil
@@ -51,7 +54,7 @@ def _resolve_step(backend: str, platform: Optional[str] = None):
                 "the Pallas backend is not available in this build; "
                 "use --backend xla"
             ) from e
-        return pallas_stencil.stencil_step
+        return pallas_stencil.padded_step
     raise ValueError(f"unknown backend {backend!r}")
 
 
@@ -63,19 +66,21 @@ def _pallas_available() -> bool:
     return True
 
 
-@functools.partial(jax.jit, static_argnames=("backend",), donate_argnums=(0,))
-def iterate(img_u8: jax.Array, taps: jax.Array, divisor: jax.Array,
-            repetitions: jax.Array, backend: str = "xla") -> jax.Array:
+@functools.partial(
+    jax.jit, static_argnames=("plan", "backend"), donate_argnums=(0,)
+)
+def iterate(img_u8: jax.Array, repetitions: jax.Array,
+            plan: _lowering.StencilPlan, backend: str = "xla") -> jax.Array:
     """Apply the stencil ``repetitions`` times; uint8 in, uint8 out.
 
     The input buffer is donated: XLA reuses it as one of the two HBM
-    double-buffers. ``taps``/``divisor``/``repetitions`` are traced — one
-    compiled program serves any filter values of a given size and any rep
-    count.
+    double-buffers. ``repetitions`` is traced (any rep count, no recompile);
+    ``plan`` is static — taps are compiled in as constants so each filter
+    gets its fastest schedule (see :mod:`tpu_stencil.ops.lowering`).
     """
     step = _resolve_step(backend)
     return jax.lax.fori_loop(
-        0, repetitions, lambda _, x: step(x, taps, divisor), img_u8
+        0, repetitions, lambda _, x: step(x, plan), img_u8
     )
 
 
@@ -96,9 +101,10 @@ class IteratedConv2D:
         self.filter = _filters.as_filter(
             filt if isinstance(filt, Filter) else np.asarray(filt)
         )
-        self.taps = jnp.asarray(self.filter.taps, dtype=jnp.float32)
-        self.divisor = jnp.float32(self.filter.divisor)
         self.backend = backend
+        self.plan = _lowering.plan_filter(self.filter)
+        if backend == "reference":
+            self.plan = _lowering.force_f32_plan(self.plan)
 
     @property
     def halo(self) -> int:
@@ -107,7 +113,7 @@ class IteratedConv2D:
     def step(self, img_u8: jax.Array) -> jax.Array:
         """A single (unjitted) filter application — the jittable unit."""
         step = _resolve_step(self.backend)
-        return step(img_u8, self.taps, self.divisor)
+        return step(img_u8, self.plan)
 
     def __call__(self, img_u8, repetitions: int) -> jax.Array:
         # ``iterate`` donates its input for HBM double-buffering; protect the
@@ -120,6 +126,5 @@ class IteratedConv2D:
             img_u8 = jnp.asarray(img_u8, dtype=jnp.uint8)
         resolved = resolve_backend(self.backend)
         return iterate(
-            img_u8, self.taps, self.divisor, jnp.int32(repetitions),
-            backend=resolved,
+            img_u8, jnp.int32(repetitions), plan=self.plan, backend=resolved
         )

@@ -1,0 +1,275 @@
+"""Filter lowering: pick the fastest provably-exact execution plan.
+
+The reference hard-codes one schedule: 9 pre-normalized float MACs per pixel
+(``mpi/mpi_convolution.c:301-322``; the CUDA kernel even re-divides per tap,
+``cuda/cuda_convolution.cu:12-22``). On a TPU the same semantics admit much
+cheaper schedules, so this module *compiles* a :class:`~tpu_stencil.filters.
+Filter` into a :class:`StencilPlan`, in priority order:
+
+1. ``sep_int`` + shift — the filter is an outer product of integer vectors
+   (all binomial gaussians, box) and the effective divisor is a power of
+   two: two 1-D int32 passes (k+k MACs instead of k*k) and a right shift.
+   Measured ~1.9x faster than the f32 9-tap formulation on v5e for the
+   default gaussian (114us vs 213us per rep on 1920x2520 RGB).
+2. ``sep_int`` + f32 divide — separable but non-dyadic divisor (box /9):
+   same two passes, one exact int->f32 convert (bound < 2^24) and one
+   correctly-rounded divide, matching the defined semantics bit-for-bit.
+3. ``direct_int`` — integer taps but not separable (the reference's "edge"
+   /28 kernel is rank 2): k*k int32 MACs, then convert+divide.
+4. ``direct_f32`` — arbitrary float taps: k*k f32 MACs (not exactness-
+   guaranteed; deterministic on a given platform only).
+
+Every plan is static (hashable) — it becomes part of the jit cache key, so
+each filter compiles once and taps are baked in as constants.
+
+Exactness arguments (vs the int64 golden model in
+:func:`tpu_stencil.ops.stencil.reference_stencil_numpy`):
+
+* int32 accumulation never overflows (plans check 255 * sum|taps| bounds);
+* ``acc >> shift`` equals truncating division for acc >= 0; negative acc
+  floors differently but both sides clip to 0;
+* the divide path requires acc < 2^24 so the int32->f32 convert is exact,
+  and a single IEEE divide is correctly rounded — the one rounding the
+  semantics allow.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from fractions import Fraction
+from typing import Optional, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from tpu_stencil.filters import Filter
+
+_EXACT_F32 = 2 ** 24
+_I32_MAX = 2 ** 31
+
+
+@dataclasses.dataclass(frozen=True)
+class StencilPlan:
+    """A static, hashable execution plan for one filter."""
+
+    kind: str  # 'sep_int' | 'direct_int' | 'direct_f32'
+    k: int
+    taps: Tuple[Tuple[float, ...], ...]  # original taps (row-major)
+    divisor: float                       # effective divisor for divide path
+    row_taps: Optional[Tuple[int, ...]] = None  # sep_int: pass along rows axis
+    col_taps: Optional[Tuple[int, ...]] = None  # sep_int: pass along cols axis
+    shift: Optional[int] = None          # dyadic fast path: >> shift
+
+    @property
+    def halo(self) -> int:
+        return self.k // 2
+
+
+def _as_int_matrix(taps: np.ndarray) -> Optional[np.ndarray]:
+    r = np.round(taps.astype(np.float64))
+    if np.all(np.abs(taps - r) == 0):
+        return r.astype(np.int64)
+    return None
+
+
+def _separate(ti: np.ndarray) -> Optional[Tuple[np.ndarray, np.ndarray, Fraction]]:
+    """Integer rank-1 decomposition: taps = outer(col, row) * factor, with
+    integer ``col``/``row`` vectors and an exact Fraction ``factor``."""
+    nz_rows = [i for i in range(ti.shape[0]) if np.any(ti[i])]
+    if not nz_rows:
+        return None
+    r0 = ti[nz_rows[0]]
+    j0 = int(np.argmax(np.abs(r0)))
+    col = ti[:, j0]
+    # taps * r0[j0] == outer(col, r0) <=> taps == outer(col, r0) / r0[j0]
+    if not np.array_equal(ti * int(r0[j0]), np.outer(col, r0)):
+        return None
+    g = int(np.gcd.reduce(np.abs(col[col != 0]))) if np.any(col) else 1
+    col_red = col // g
+    factor = Fraction(int(r0[j0]), g)
+    return col_red, r0, factor
+
+
+def plan_filter(f: Filter) -> StencilPlan:
+    """Compile a Filter to its fastest exact plan (see module docstring)."""
+    taps = np.asarray(f.taps, dtype=np.float32)
+    k = f.k
+    taps_t = tuple(tuple(float(v) for v in row) for row in taps)
+    ti = _as_int_matrix(taps)
+
+    # Fast integer plans are only selected when they provably reproduce the
+    # defined semantics (= the golden model in reference_stencil_numpy):
+    # f.is_exact gates on the golden model's own exactness regime, and the
+    # per-plan bounds guard the plan's int32 accumulation / f32 convert.
+    if ti is not None and f.is_exact:
+        sep = _separate(ti)
+        if sep is not None:
+            col_red, r0, factor = sep
+            # taps == outer(col_red, r0) / factor, so
+            # taps/divisor == outer(col_red, r0) / (divisor * factor):
+            # the effective divisor for the two integer passes.
+            eff = Fraction(f.divisor) * factor if factor != 0 else None
+            if eff is not None and eff > 0:
+                bound = 255 * int(np.abs(col_red).sum()) * int(np.abs(r0).sum())
+                eff_int = eff.denominator == 1
+                eff_pow2 = eff_int and (eff.numerator & (eff.numerator - 1)) == 0
+                if f.is_dyadic and eff_pow2 and bound < _I32_MAX:
+                    # exact-floor shift == the golden model's integer path
+                    return StencilPlan(
+                        kind="sep_int", k=k, taps=taps_t,
+                        divisor=float(eff),
+                        row_taps=tuple(int(v) for v in col_red),
+                        col_taps=tuple(int(v) for v in r0),
+                        shift=int(eff.numerator).bit_length() - 1,
+                    )
+                if eff_int and bound < _EXACT_F32:
+                    # exact convert + one correctly-rounded divide of the
+                    # same rational the golden model divides
+                    return StencilPlan(
+                        kind="sep_int", k=k, taps=taps_t,
+                        divisor=float(eff),
+                        row_taps=tuple(int(v) for v in col_red),
+                        col_taps=tuple(int(v) for v in r0),
+                        shift=None,
+                    )
+        bound = 255 * int(np.abs(ti).sum())
+        if f.is_dyadic and bound < _I32_MAX:
+            return StencilPlan(
+                kind="direct_int", k=k, taps=taps_t, divisor=float(f.divisor),
+                shift=int(f.divisor).bit_length() - 1,
+            )
+        if bound < _EXACT_F32:
+            return StencilPlan(
+                kind="direct_int", k=k, taps=taps_t, divisor=float(f.divisor)
+            )
+
+    return StencilPlan(kind="direct_f32", k=k, taps=taps_t, divisor=float(f.divisor))
+
+
+# --------------------------------------------------------------------------
+# Kernels from plans.  All operate on spatial dims (0, 1); trailing dims
+# (channels) ride along elementwise.
+# --------------------------------------------------------------------------
+
+
+def _sep_pass(x: jax.Array, taps: Tuple[int, ...], dim: int) -> jax.Array:
+    """Valid 1-D integer correlation along ``dim`` (static taps, zeros
+    skipped, 1-multiplies elided)."""
+    k = len(taps)
+    n = x.shape[dim] - (k - 1)
+    acc = None
+    for i, t in enumerate(taps):
+        if t == 0:
+            continue
+        idx = [slice(None)] * x.ndim
+        idx[dim] = slice(i, i + n)
+        term = x[tuple(idx)]
+        if t != 1:
+            term = term * t
+        acc = term if acc is None else acc + term
+    if acc is None:
+        shape = list(x.shape)
+        shape[dim] = n
+        return jnp.zeros(shape, x.dtype)
+    return acc
+
+
+def _finish_int(acc: jax.Array, plan: StencilPlan) -> jax.Array:
+    if plan.shift is not None:
+        return jnp.clip(acc >> plan.shift, 0, 255).astype(jnp.uint8)
+    val = acc.astype(jnp.float32) / np.float32(plan.divisor)
+    return jnp.clip(val, 0.0, 255.0).astype(jnp.uint8)
+
+
+def valid_step(ext_u8: jax.Array, plan: StencilPlan) -> jax.Array:
+    """One stencil application on a halo-extended uint8 array
+    (H + 2*halo, W + 2*halo[, C]) -> (H, W[, C]).
+
+    The unit shared by the single-device driver (ghosts from zero padding)
+    and the sharded driver (ghosts from ppermute halo exchange).
+    """
+    if plan.kind == "sep_int":
+        xi = ext_u8.astype(jnp.int32)
+        a = _sep_pass(xi, plan.row_taps, 0)
+        b = _sep_pass(a, plan.col_taps, 1)
+        return _finish_int(b, plan)
+    if plan.kind == "direct_int":
+        xi = ext_u8.astype(jnp.int32)
+        acc = None
+        k = plan.k
+        h = ext_u8.shape[0] - (k - 1)
+        w = ext_u8.shape[1] - (k - 1)
+        for i in range(k):
+            for j in range(k):
+                t = int(plan.taps[i][j])
+                if t == 0:
+                    continue
+                window = xi[i : i + h, j : j + w]
+                term = window if t == 1 else window * t
+                acc = term if acc is None else acc + term
+        if acc is None:
+            acc = jnp.zeros((h, w) + ext_u8.shape[2:], jnp.int32)
+        return _finish_int(acc, plan)
+    if plan.kind == "direct_f32":
+        from tpu_stencil.ops.stencil import conv2d_valid
+
+        taps = jnp.asarray(np.asarray(plan.taps, np.float32))
+        acc = conv2d_valid(ext_u8.astype(jnp.float32), taps)
+        val = acc / np.float32(plan.divisor)
+        return jnp.clip(val, 0.0, 255.0).astype(jnp.uint8)
+    raise ValueError(f"unknown plan kind {plan.kind!r}")
+
+
+def force_f32_plan(plan: StencilPlan) -> StencilPlan:
+    """Demote any plan to the generic f32 schedule (the 'reference' backend —
+    the closest analog of the C program's pre-normalized float MACs)."""
+    return StencilPlan(
+        kind="direct_f32", k=plan.k, taps=plan.taps, divisor=plan.divisor
+        if plan.kind != "sep_int" else _original_divisor(plan),
+    )
+
+
+def _original_divisor(plan: StencilPlan) -> float:
+    # sep_int plans carry the *effective* divisor (original / factor); the
+    # f32 fallback uses the original taps, so reconstruct from them: the
+    # taps/divisor quotient must be preserved. taps are original, so the
+    # original divisor is taps.sum() / normalized.sum(); but normalized sum
+    # is not stored — recompute via the sep identity instead.
+    taps = np.asarray(plan.taps, np.float64)
+    outer = np.outer(plan.row_taps, plan.col_taps).astype(np.float64)
+    # outer/eff == taps/orig  =>  orig = eff * taps_ij / outer_ij (any nonzero)
+    nz = np.nonzero(outer)
+    i, j = nz[0][0], nz[1][0]
+    return float(plan.divisor * taps[i, j] / outer[i, j])
+
+
+def sep_rows_pass(xi32: jax.Array, plan: StencilPlan) -> jax.Array:
+    """sep_int phase 1: valid 1-D pass along rows (dim 0) of a dim-0-extended
+    int32 array."""
+    return _sep_pass(xi32, plan.row_taps, 0)
+
+
+def sep_cols_pass(acc_i32: jax.Array, plan: StencilPlan) -> jax.Array:
+    """sep_int phase 2: valid 1-D pass along cols (dim 1) of a dim-1-extended
+    int32 intermediate, then the finishing shift/divide."""
+    return _finish_int(_sep_pass(acc_i32, plan.col_taps, 1), plan)
+
+
+def padded_step(img_u8: jax.Array, plan: StencilPlan) -> jax.Array:
+    """One stencil application with zero boundary padding (same shape out).
+
+    For separable plans the pad is applied per pass, in the pass's own dim,
+    *after* the int32 convert — measured 3x faster on v5e than padding both
+    dims of the uint8 input up front (141 vs 430 us/rep on 1920x2520 RGB):
+    XLA fuses a pad into the consuming pass only when the pad dim matches
+    the pass dim, and fuses the u8->i32 convert only ahead of a pad.
+    """
+    h = plan.halo
+    trail = [(0, 0)] * (img_u8.ndim - 2)
+    if plan.kind == "sep_int":
+        xi = img_u8.astype(jnp.int32)
+        a = sep_rows_pass(jnp.pad(xi, [(h, h), (0, 0)] + trail), plan)
+        return sep_cols_pass(jnp.pad(a, [(0, 0), (h, h)] + trail), plan)
+    return valid_step(jnp.pad(img_u8, [(h, h), (h, h)] + trail), plan)

@@ -103,6 +103,7 @@ def reference_stencil_numpy(img_u8: np.ndarray, filt, reps: int) -> np.ndarray:
     k = f.k
     halo = f.halo
     exact = f.is_exact
+    dyadic = f.is_dyadic
     squeeze = img_u8.ndim == 2
     img = img_u8[..., None] if squeeze else img_u8
     h, w, c = img.shape
@@ -120,7 +121,13 @@ def reference_stencil_numpy(img_u8: np.ndarray, filt, reps: int) -> np.ndarray:
                             acc += padded[y + i, x + j].astype(np.int64) * int(
                                 round(float(taps[i, j]))
                             )
-                    val = acc.astype(np.float32) / divisor
+                    if dyadic:
+                        # fully-integer semantics: exact at any int64 bound
+                        val = acc // int(divisor)
+                    else:
+                        # one exact convert (is_exact bounds acc < 2^24) and
+                        # one correctly-rounded divide
+                        val = acc.astype(np.float32) / divisor
                 else:
                     acc = np.zeros(c, np.float32)
                     for i in range(k):

@@ -27,18 +27,40 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from jax import shard_map
 
 from tpu_stencil.models.blur import IteratedConv2D
-from tpu_stencil.ops import stencil as _stencil
+from tpu_stencil.ops import lowering as _lowering
 from tpu_stencil.parallel import partition
 from tpu_stencil.parallel.halo import halo_exchange
 from tpu_stencil.parallel.mesh import make_mesh, ROWS_AXIS, COLS_AXIS
 
 
-def _local_step(tile_u8, taps, divisor, halo, axes, mask_tile):
-    """One local iteration: exchange uint8 ghosts (4x less ICI traffic than
-    f32), convolve the extended tile, truncate, re-zero the pad region."""
-    ext = halo_exchange(tile_u8, halo, axes)
-    acc = _stencil.conv2d_valid(ext.astype(jnp.float32), taps)
-    out = _stencil.truncate_u8(acc / divisor)
+def _local_step(tile_u8, plan, axes, mask_tile):
+    """One local iteration: halo exchange + the plan's kernel + pad re-zero.
+
+    For separable plans, communication is phased like the compute (the same
+    restructuring that makes :func:`~tpu_stencil.ops.lowering.padded_step`
+    3x faster): exchange row ghosts, run the rows pass, exchange col ghosts
+    *of the rows-pass output* (neighbors compute identical values from their
+    own exchanged rows), run the cols pass. Two ppermute phases, each fused
+    into its consuming pass — and corner ghosts are never needed at all.
+
+    The phase-1 ghosts are exchanged as int32 (4x the bytes of uint8) on
+    purpose: converting after a uint8 concat/pad hits the slow XLA pattern
+    measured in lowering.padded_step's docstring (3x whole-step cost),
+    while the extra ICI bytes are only ``4*halo/tile_rows`` of the tile —
+    well under 2% for realistic tiles. Phase 2 is int32 out of necessity
+    (rows-pass partials exceed uint8).
+    """
+    (row_axis, r, dim0), (col_axis, c, dim1) = axes
+    halo = plan.halo
+    if plan.kind == "sep_int":
+        xi = tile_u8.astype(jnp.int32)
+        ext0 = halo_exchange(xi, halo, ((row_axis, r, dim0),))
+        a = _lowering.sep_rows_pass(ext0, plan)
+        ext1 = halo_exchange(a, halo, ((col_axis, c, dim1),))
+        out = _lowering.sep_cols_pass(ext1, plan)
+    else:
+        ext = halo_exchange(tile_u8, halo, axes)
+        out = _lowering.valid_step(ext, plan)
     if mask_tile is not None:
         out = out * mask_tile
     return out
@@ -46,14 +68,15 @@ def _local_step(tile_u8, taps, divisor, halo, axes, mask_tile):
 
 def build_sharded_iterate(
     mesh: Mesh,
-    halo: int,
+    plan: _lowering.StencilPlan,
     channels: int,
     needs_mask: bool,
 ):
     """Compile-once builder for the sharded iteration program.
 
-    Returns ``fn(img, taps, divisor, reps[, mask]) -> img`` operating on the
-    padded global array sharded over ``mesh``; all are traced (no recompiles).
+    Returns ``fn(img, reps[, mask]) -> img`` operating on the padded global
+    array sharded over ``mesh``; ``reps`` is traced (no recompiles), the
+    plan's taps are compiled in.
     """
     r = mesh.shape[ROWS_AXIS]
     c = mesh.shape[COLS_AXIS]
@@ -61,21 +84,21 @@ def build_sharded_iterate(
     spec = P(ROWS_AXIS, COLS_AXIS) if channels == 1 else P(ROWS_AXIS, COLS_AXIS, None)
 
     if needs_mask:
-        def local_iter(tile, taps, divisor, reps, mask_tile):
+        def local_iter(tile, reps, mask_tile):
             return lax.fori_loop(
                 0, reps,
-                lambda _, x: _local_step(x, taps, divisor, halo, axes, mask_tile),
+                lambda _, x: _local_step(x, plan, axes, mask_tile),
                 tile,
             )
-        in_specs = (spec, P(None, None), P(), P(), spec)
+        in_specs = (spec, P(), spec)
     else:
-        def local_iter(tile, taps, divisor, reps):
+        def local_iter(tile, reps):
             return lax.fori_loop(
                 0, reps,
-                lambda _, x: _local_step(x, taps, divisor, halo, axes, None),
+                lambda _, x: _local_step(x, plan, axes, None),
                 tile,
             )
-        in_specs = (spec, P(None, None), P(), P())
+        in_specs = (spec, P())
 
     mapped = shard_map(
         local_iter, mesh=mesh, in_specs=in_specs, out_specs=spec
@@ -156,7 +179,7 @@ class ShardedRunner:
         )
         self.sharding = NamedSharding(self.mesh, spec)
         self._fn = build_sharded_iterate(
-            self.mesh, model.halo, channels, self.needs_mask
+            self.mesh, model.plan, channels, self.needs_mask
         )
         if self.needs_mask:
             mask = np.zeros(self.padded_shape, np.uint8)
@@ -186,10 +209,8 @@ class ShardedRunner:
         result (call :meth:`fetch` to crop to the true image)."""
         reps = jnp.int32(repetitions)
         if self.needs_mask:
-            return self._fn(
-                img_dev, self.model.taps, self.model.divisor, reps, self._mask
-            )
-        return self._fn(img_dev, self.model.taps, self.model.divisor, reps)
+            return self._fn(img_dev, reps, self._mask)
+        return self._fn(img_dev, reps)
 
     def fetch(self, out_dev: jax.Array) -> np.ndarray:
         """Gather to host and crop the pad region off."""
