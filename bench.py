@@ -43,7 +43,6 @@ def main() -> int:
     img = rng.integers(0, 256, size=(H, W, C), dtype=np.uint8)
 
     model = IteratedConv2D("gaussian", backend=backend)
-    reps = jax.numpy.int32(REPS)
 
     def run(dev_img, n_reps):
         out = iterate(dev_img, jax.numpy.int32(n_reps), plan=model.plan,

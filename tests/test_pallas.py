@@ -1,0 +1,70 @@
+"""Pallas kernel tests — interpret mode on CPU (real-hardware runs happen in
+bench.py / the driver's TPU smoke tests)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from tpu_stencil import filters
+from tpu_stencil.ops import lowering, pallas_stencil, stencil
+
+
+def _run(img, name, reps, block_h=32):
+    plan = lowering.plan_filter(filters.get_filter(name))
+    return np.asarray(
+        pallas_stencil.iterate(img, jnp.int32(reps), plan,
+                               block_h=block_h, interpret=True)
+    )
+
+
+@pytest.mark.parametrize("name", ["gaussian", "box"])  # box = f32-divide finish
+@pytest.mark.parametrize("shape", [(64, 48, 3), (37, 23), (8, 8), (130, 129, 3)])
+def test_matches_golden(rng, shape, name):
+    img = rng.integers(0, 256, size=shape, dtype=np.uint8)
+    got = _run(img, name, 3)
+    want = stencil.reference_stencil_numpy(img, filters.get_filter(name), 3)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_wide_halo(rng):
+    img = rng.integers(0, 256, size=(40, 33), dtype=np.uint8)
+    got = _run(img, "gaussian5", 2)
+    want = stencil.reference_stencil_numpy(img, filters.get_filter("gaussian5"), 2)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_single_block_grid(rng):
+    img = rng.integers(0, 256, size=(16, 24, 3), dtype=np.uint8)
+    got = _run(img, "gaussian", 2, block_h=64)  # grid == 1 specialization
+    want = stencil.reference_stencil_numpy(img, filters.get_filter("gaussian"), 2)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_two_block_grid(rng):
+    img = rng.integers(0, 256, size=(64, 24), dtype=np.uint8)
+    got = _run(img, "gaussian", 2, block_h=32)  # grid == 2: no middle case
+    want = stencil.reference_stencil_numpy(img, filters.get_filter("gaussian"), 2)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_unsupported_plan_falls_back(rng):
+    # edge (direct_int) has no Pallas kernel yet: must still be correct
+    img = rng.integers(0, 256, size=(12, 10), dtype=np.uint8)
+    got = _run(img, "edge", 2)
+    want = stencil.reference_stencil_numpy(img, filters.get_filter("edge"), 2)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_zero_reps_identity(rng):
+    img = rng.integers(0, 256, size=(20, 20), dtype=np.uint8)
+    np.testing.assert_array_equal(_run(img, "gaussian", 0), img)
+
+
+def test_model_level_pallas_backend(rng):
+    # the backend is wired through IteratedConv2D (on CPU: interpret path
+    # not available through the model, so only check the plumbing exists)
+    from tpu_stencil.models.blur import resolve_backend
+
+    assert resolve_backend("auto") == "xla"
+    assert resolve_backend("pallas") == "pallas"
