@@ -1,0 +1,98 @@
+// Native I/O runtime for tpu_stencil.
+//
+// C++ counterpart of the reference's robust POSIX I/O layer
+// (cuda/functions.c:31-51: read_info/write_info short-read/short-write
+// loops and the gettimeofday-based micro_time), generalized to positional
+// pread/pwrite so many host processes can read/write disjoint row ranges
+// of one shared raw-image file concurrently — the MPI-IO access pattern
+// (mpi/mpi_convolution.c:126-141,247-263) without MPI.
+//
+// Exposed as a plain C ABI consumed via ctypes (tpu_stencil/io/native.py);
+// every function returns -1/nonzero on error with errno left intact.
+
+#include <cerrno>
+#include <cstdint>
+#include <ctime>
+
+#include <fcntl.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+extern "C" {
+
+// Read exactly `nbytes` at `offset`; returns bytes read (== nbytes on
+// success, short count only at true EOF, -1 on error).
+int64_t ts_pread_full(const char* path, void* buf, int64_t offset,
+                      int64_t nbytes) {
+  int fd = ::open(path, O_RDONLY | O_CLOEXEC);
+  if (fd < 0) return -1;
+  char* p = static_cast<char*>(buf);
+  int64_t done = 0;
+  while (done < nbytes) {
+    ssize_t got = ::pread(fd, p + done, static_cast<size_t>(nbytes - done),
+                          static_cast<off_t>(offset + done));
+    if (got < 0) {
+      if (errno == EINTR) continue;
+      ::close(fd);
+      return -1;
+    }
+    if (got == 0) break;  // EOF
+    done += got;
+  }
+  ::close(fd);
+  return done;
+}
+
+// Write exactly `nbytes` at `offset`; `truncate` != 0 recreates the file.
+// Returns bytes written or -1.
+int64_t ts_pwrite_full(const char* path, const void* buf, int64_t offset,
+                       int64_t nbytes, int truncate) {
+  int flags = O_WRONLY | O_CREAT | O_CLOEXEC;
+  if (truncate) flags |= O_TRUNC;
+  int fd = ::open(path, flags, 0644);
+  if (fd < 0) return -1;
+  const char* p = static_cast<const char*>(buf);
+  int64_t done = 0;
+  while (done < nbytes) {
+    ssize_t put = ::pwrite(fd, p + done, static_cast<size_t>(nbytes - done),
+                           static_cast<off_t>(offset + done));
+    if (put < 0) {
+      if (errno == EINTR) continue;
+      ::close(fd);
+      return -1;
+    }
+    done += put;
+  }
+  if (::close(fd) != 0) return -1;
+  return done;
+}
+
+// Extend (never shrink) `path` to at least `nbytes`. Returns 0 on success.
+int ts_ensure_size(const char* path, int64_t nbytes) {
+  int fd = ::open(path, O_WRONLY | O_CREAT | O_CLOEXEC, 0644);
+  if (fd < 0) return -1;
+  struct stat st;
+  if (::fstat(fd, &st) != 0) {
+    ::close(fd);
+    return -1;
+  }
+  int rc = 0;
+  if (st.st_size < static_cast<off_t>(nbytes)) {
+    rc = ::ftruncate(fd, static_cast<off_t>(nbytes));
+  }
+  if (::close(fd) != 0) return -1;
+  return rc;
+}
+
+// Microsecond timestamp for measuring durations — the role of the
+// reference's gettimeofday-based micro_time() (cuda/functions.c:47-51),
+// but on CLOCK_MONOTONIC so intervals can never go negative under NTP
+// steps (timestamps are NOT epoch-relative; use only for differences).
+int64_t ts_micro_time(void) {
+  struct timespec ts;
+  if (clock_gettime(CLOCK_MONOTONIC, &ts) != 0) return -1;
+  return static_cast<int64_t>(ts.tv_sec) * 1000000 +
+         static_cast<int64_t>(ts.tv_nsec) / 1000;
+}
+
+}  // extern "C"

@@ -70,3 +70,23 @@ def test_micro_time_monotone():
     a = native.micro_time()
     b = native.micro_time()
     assert b >= a
+
+
+def test_native_and_fallback_parity(tmp_path, rng, monkeypatch):
+    # the ctypes fast path and the pure-Python fallback implement one
+    # contract; run the same sequence through both and compare bytes
+    data = rng.integers(0, 256, 777, dtype=np.uint8).tobytes()
+
+    def exercise(prefix):
+        p = str(tmp_path / f"{prefix}.raw")
+        native.pwrite_full(p, 0, data, truncate=True)
+        native.ensure_size(p, 2000)
+        native.pwrite_full(p, 1500, data[:100], truncate=False)
+        return native.pread_full(p, 0, 2000)
+
+    with_lib = exercise("native") if native.has_native() else None
+    monkeypatch.setattr(native, "_LIB", None)
+    without_lib = exercise("fallback")
+    if with_lib is not None:
+        assert with_lib == without_lib
+    assert without_lib[:777] == data and without_lib[1500:1600] == data[:100]

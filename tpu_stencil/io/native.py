@@ -108,8 +108,9 @@ def ensure_size(path: str, nbytes: int) -> None:
 
 
 def micro_time() -> int:
-    """Microsecond wall clock — the reference's ``micro_time()``
-    (``cuda/functions.c:47-51``)."""
+    """Monotonic microsecond timestamp for durations — the role of the
+    reference's ``micro_time()`` (``cuda/functions.c:47-51``). Not
+    epoch-relative; use only for differences."""
     if _LIB is not None:
         return int(_LIB.ts_micro_time())
-    return time.time_ns() // 1000
+    return time.monotonic_ns() // 1000
