@@ -1,0 +1,110 @@
+import os
+
+import numpy as np
+import pytest
+
+from tpu_stencil import cli, filters
+from tpu_stencil.config import JobConfig, ImageType
+from tpu_stencil.io import raw as raw_io
+from tpu_stencil.ops import stencil
+from tpu_stencil.runtime import checkpoint
+
+
+def _cfg(tmp_path, **kw):
+    defaults = dict(
+        image=str(tmp_path / "img.raw"), width=6, height=5, repetitions=4,
+        image_type=ImageType.GREY,
+    )
+    defaults.update(kw)
+    return JobConfig(**defaults)
+
+
+def test_save_restore_round_trip(tmp_path, rng):
+    cfg = _cfg(tmp_path)
+    frame = rng.integers(0, 256, size=(5, 6), dtype=np.uint8)
+    checkpoint.save(cfg, 2, frame)
+    rep, back = checkpoint.restore(cfg)
+    assert rep == 2
+    np.testing.assert_array_equal(back, frame)
+    checkpoint.clear(cfg)
+    assert checkpoint.restore(cfg) is None
+
+
+def test_mismatched_fingerprint_refused(tmp_path, rng):
+    cfg = _cfg(tmp_path)
+    checkpoint.save(cfg, 1, rng.integers(0, 256, size=(5, 6), dtype=np.uint8))
+    other = _cfg(tmp_path, filter_name="box")
+    with pytest.raises(ValueError, match="different job"):
+        checkpoint.restore(other)
+
+
+def test_cli_checkpointed_run_matches_plain(tmp_path, rng):
+    img = rng.integers(0, 256, size=(9, 8, 1), dtype=np.uint8)
+    p = str(tmp_path / "img.raw")
+    raw_io.write_raw(p, img)
+    out = str(tmp_path / "o.raw")
+    rc = cli.main([p, "8", "9", "5", "grey", "--backend", "xla",
+                   "--checkpoint-every", "2", "--output", out])
+    assert rc == 0
+    got = raw_io.read_raw(out, 8, 9, 1)[..., 0]
+    want = stencil.reference_stencil_numpy(
+        img[..., 0], filters.get_filter("gaussian"), 5
+    )
+    np.testing.assert_array_equal(got, want)
+    # checkpoint cleared after success
+    assert not os.path.exists(out + ".ckpt")
+
+
+def test_cli_resume_continues_from_checkpoint(tmp_path, rng):
+    img = rng.integers(0, 256, size=(9, 8, 1), dtype=np.uint8)
+    p = str(tmp_path / "img.raw")
+    raw_io.write_raw(p, img)
+    out = str(tmp_path / "o.raw")
+    cfg = JobConfig(p, 8, 9, 5, ImageType.GREY, output=out)
+    # simulate a crash after 3 reps: write a checkpoint holding the 3-rep state
+    state3 = stencil.reference_stencil_numpy(
+        img[..., 0], filters.get_filter("gaussian"), 3
+    )
+    checkpoint.save(cfg, 3, state3)
+    rc = cli.main([p, "8", "9", "5", "grey", "--backend", "xla",
+                   "--resume", "--output", out])
+    assert rc == 0
+    got = raw_io.read_raw(out, 8, 9, 1)[..., 0]
+    want = stencil.reference_stencil_numpy(
+        img[..., 0], filters.get_filter("gaussian"), 5
+    )
+    np.testing.assert_array_equal(got, want)
+
+
+def test_negative_checkpoint_every_rejected(tmp_path, rng):
+    from tpu_stencil import driver
+    img = rng.integers(0, 256, size=(5, 6, 1), dtype=np.uint8)
+    p = str(tmp_path / "img.raw")
+    raw_io.write_raw(p, img)
+    cfg = _cfg(tmp_path, width=6, height=5)
+    with pytest.raises(ValueError, match="checkpoint_every"):
+        driver.run_job(cfg, checkpoint_every=-5)
+    from tpu_stencil.config import parse_args
+    with pytest.raises(SystemExit):
+        parse_args([p, "6", "5", "1", "grey", "--checkpoint-every", "-5"])
+
+
+def test_resume_only_run_clears_checkpoint(tmp_path, rng):
+    img = rng.integers(0, 256, size=(5, 6, 1), dtype=np.uint8)
+    p = str(tmp_path / "img.raw")
+    raw_io.write_raw(p, img)
+    out = str(tmp_path / "o.raw")
+    cfg = JobConfig(p, 6, 5, 4, ImageType.GREY, output=out)
+    state2 = stencil.reference_stencil_numpy(
+        img[..., 0], filters.get_filter("gaussian"), 2
+    )
+    checkpoint.save(cfg, 2, state2)
+    rc = cli.main([p, "6", "5", "4", "grey", "--backend", "xla",
+                   "--resume", "--output", out])
+    assert rc == 0
+    assert not os.path.exists(out + ".ckpt")  # cleared without --checkpoint-every
+    got = raw_io.read_raw(out, 6, 5, 1)[..., 0]
+    want = stencil.reference_stencil_numpy(
+        img[..., 0], filters.get_filter("gaussian"), 4
+    )
+    np.testing.assert_array_equal(got, want)

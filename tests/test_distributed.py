@@ -1,0 +1,118 @@
+"""Single-process tests of the multi-host layer: sharded read/write must be
+bit-identical to whole-file I/O + device_put, and config broadcast must be
+the identity with one process."""
+
+import numpy as np
+import jax
+import pytest
+
+from tpu_stencil.config import JobConfig, ImageType
+from tpu_stencil.io import raw as raw_io
+from tpu_stencil.models.blur import IteratedConv2D
+from tpu_stencil.parallel import distributed, sharded
+
+requires_8 = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs 8 (virtual) devices"
+)
+
+
+def _runner(shape, channels, mesh_shape):
+    model = IteratedConv2D("gaussian", backend="xla")
+    return sharded.ShardedRunner(
+        model, shape, channels, mesh_shape=mesh_shape,
+        devices=jax.devices()[: mesh_shape[0] * mesh_shape[1]],
+    )
+
+
+@requires_8
+@pytest.mark.parametrize("shape,channels", [((32, 40), 1), ((24, 16), 3)])
+def test_read_sharded_matches_put(tmp_path, rng, shape, channels):
+    img = rng.integers(
+        0, 256, size=shape + ((channels,) if channels > 1 else ()), dtype=np.uint8
+    )
+    p = str(tmp_path / "img.raw")
+    raw_io.write_raw(p, img if img.ndim == 3 else img[..., None])
+    runner = _runner(shape, channels, (2, 4))
+    a = distributed.read_sharded(p, shape[0], shape[1], channels, runner.sharding)
+    b = runner.put(img)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+@requires_8
+def test_read_sharded_pads_indivisible(tmp_path, rng):
+    img = rng.integers(0, 256, size=(33, 41), dtype=np.uint8)
+    p = str(tmp_path / "odd.raw")
+    raw_io.write_raw(p, img[..., None])
+    runner = _runner((33, 41), 1, (2, 4))
+    a = distributed.read_sharded(p, 33, 41, 1, runner.sharding)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(runner.put(img)))
+
+
+@requires_8
+def test_write_sharded_round_trip(tmp_path, rng):
+    img = rng.integers(0, 256, size=(33, 41, 3), dtype=np.uint8)
+    src = str(tmp_path / "in.raw")
+    dst = str(tmp_path / "out.raw")
+    raw_io.write_raw(src, img)
+    runner = _runner((33, 41), 3, (2, 4))
+    dev = distributed.read_sharded(src, 33, 41, 3, runner.sharding)
+    distributed.write_sharded(dst, dev, 33, 41, 3)
+    back = raw_io.read_raw(dst, 41, 33, 3)
+    np.testing.assert_array_equal(back, img)
+
+
+@requires_8
+def test_end_to_end_sharded_io_with_compute(tmp_path, rng):
+    from tpu_stencil.ops import stencil
+    from tpu_stencil import filters
+
+    img = rng.integers(0, 256, size=(33, 41), dtype=np.uint8)
+    src = str(tmp_path / "in.raw")
+    dst = str(tmp_path / "out.raw")
+    raw_io.write_raw(src, img[..., None])
+    runner = _runner((33, 41), 1, (2, 4))
+    dev = distributed.read_sharded(src, 33, 41, 1, runner.sharding)
+    out = runner.run(dev, 3)
+    distributed.write_sharded(dst, out, 33, 41, 1)
+    got = raw_io.read_raw(dst, 41, 33, 1)[..., 0]
+    want = stencil.reference_stencil_numpy(img, filters.get_filter("gaussian"), 3)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_broadcast_config_single_process_identity():
+    cfg = JobConfig("x.raw", 8, 8, 2, ImageType.GREY)
+    assert distributed.broadcast_config(cfg) is cfg
+
+
+def test_device_row_ranges():
+    m = distributed.device_row_ranges(32, 40, (2, 4), 3)
+    rr, cs = m[(0, 0)]
+    assert (rr.start, rr.stop) == (0, 16) and (cs.start, cs.stop) == (0, 30)
+    rr, cs = m[(1, 3)]
+    assert (rr.start, rr.stop) == (16, 32) and (cs.start, cs.stop) == (90, 120)
+
+
+def test_initialize_single_process_noop():
+    distributed.initialize()  # must not raise with one local process
+    assert jax.process_count() == 1
+
+
+def test_encode_decode_strs_with_empty_trailing():
+    enc = distributed._encode_strs(["a.raw", "gaussian", "xla", ""])
+    assert distributed._decode_strs(enc) == ["a.raw", "gaussian", "xla", ""]
+
+
+@requires_8
+def test_write_sharded_truncates_stale_output(tmp_path, rng):
+    dst = str(tmp_path / "out.raw")
+    with open(dst, "wb") as f:
+        f.write(b"\xff" * 10_000)  # stale larger file
+    img = rng.integers(0, 256, size=(16, 16), dtype=np.uint8)
+    src = str(tmp_path / "in.raw")
+    raw_io.write_raw(src, img[..., None])
+    runner = _runner((16, 16), 1, (2, 4))
+    dev = distributed.read_sharded(src, 16, 16, 1, runner.sharding)
+    distributed.write_sharded(dst, dev, 16, 16, 1)
+    import os
+    assert os.path.getsize(dst) == 16 * 16
+    np.testing.assert_array_equal(raw_io.read_raw(dst, 16, 16, 1)[..., 0], img)

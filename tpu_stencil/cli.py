@@ -19,7 +19,12 @@ from tpu_stencil import driver
 
 def main(argv=None) -> int:
     cfg, ns = parse_args(argv)
-    result = driver.run_job(cfg)
+    result = driver.run_job(
+        cfg,
+        profile_dir=ns.profile,
+        checkpoint_every=ns.checkpoint_every,
+        resume=ns.resume,
+    )
     # Reference-format output line (mpi/mpi_convolution.c:274 prints seconds).
     print(f"Execution time: {result.compute_seconds:.3f} sec")
     if ns.time:

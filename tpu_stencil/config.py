@@ -105,6 +105,18 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("--output", default=None, help="output path (default blur_<input>)")
     p.add_argument(
+        "--profile", default=None, metavar="DIR",
+        help="write a jax.profiler trace of the compute window to DIR",
+    )
+    p.add_argument(
+        "--checkpoint-every", type=int, default=0, metavar="N",
+        help="checkpoint the frame every N repetitions (0 = off)",
+    )
+    p.add_argument(
+        "--resume", action="store_true",
+        help="resume from a matching checkpoint if present",
+    )
+    p.add_argument(
         "--time", action="store_true",
         help="additionally print whole-job time incl. I/O (the CUDA variant's "
              "window) and backend/mesh details; the compute-window line is "
@@ -126,6 +138,8 @@ def parse_args(argv=None) -> Tuple[JobConfig, argparse.Namespace]:
     mesh_shape = None
     if ns.mesh is not None:
         mesh_shape = _parse_mesh(parser, ns.mesh)
+    if ns.checkpoint_every < 0:
+        parser.error(f"--checkpoint-every must be >= 0, got {ns.checkpoint_every}")
     try:
         cfg = JobConfig(
             image=ns.image,

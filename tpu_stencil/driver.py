@@ -3,18 +3,20 @@
 The TPU-native equivalent of each reference variant's ``main``:
 CLI -> runtime init -> partition -> load shard -> [compute/comm loop] ->
 store -> metrics (SURVEY.md §3 call stacks). One code path spans one chip to
-a full mesh: a 1x1 mesh degrades to the single-device program.
+a full mesh to multiple hosts: a 1x1 mesh degrades to the single-device
+program, and the sharded path's per-process I/O degrades to a whole-file
+read when there is one process.
 """
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
-from typing import Optional
+from typing import Callable, Optional, Tuple
 
 import jax
 import numpy as np
 
-from tpu_stencil import filters
 from tpu_stencil.config import JobConfig
 from tpu_stencil.io import raw as raw_io
 from tpu_stencil.models.blur import IteratedConv2D, resolve_backend
@@ -30,13 +32,86 @@ class JobResult:
     mesh_shape: Optional[tuple]
 
 
-def run_job(cfg: JobConfig, devices: Optional[list] = None) -> JobResult:
-    """Run one iterated-convolution job end to end."""
-    with Timer() as total_t:
-        img = raw_io.read_raw(cfg.image, cfg.width, cfg.height, cfg.channels)
-        if cfg.image_type.channels == 1:
-            img = img[..., 0]
+def _maybe_profile(profile_dir: Optional[str]):
+    """jax.profiler trace around the timed window (``--profile``) — the
+    observability the reference lacked (SURVEY.md §5: coarse timers only)."""
+    if profile_dir is None:
+        return contextlib.nullcontext()
+    return jax.profiler.trace(profile_dir)
 
+
+def _maybe_restore(cfg: JobConfig, resume: bool) -> Tuple[int, Optional[np.ndarray]]:
+    """(completed reps, frame) from a matching checkpoint, else (0, None).
+    Checked *before* the input file is read so a resume never pays a
+    redundant full-image load."""
+    if not resume:
+        return 0, None
+    from tpu_stencil.runtime import checkpoint as ckpt
+
+    restored = ckpt.restore(cfg)
+    if restored is None:
+        return 0, None
+    return restored
+
+
+def _checkpointed_iterate(
+    cfg: JobConfig,
+    run_fn: Callable,          # (img_dev, n_reps) -> img_dev
+    fetch_fn: Callable,        # img_dev -> np.ndarray (host frame)
+    img_dev,
+    checkpoint_every: int,
+    start_rep: int,
+):
+    """Run the remaining reps, checkpointing every N. Returns
+    (out_dev, compute_seconds). Checkpoint I/O happens *between* timed
+    chunks so the reported compute window stays comparable to the
+    reference's (which has no checkpointing); the final state is written as
+    the job output, not as a checkpoint."""
+    from tpu_stencil.runtime import checkpoint as ckpt
+
+    if not checkpoint_every:
+        with Timer() as t:
+            out = run_fn(img_dev, cfg.repetitions - start_rep)
+            out.block_until_ready()
+        return out, t.elapsed
+
+    total = 0.0
+    rep = start_rep
+    while rep < cfg.repetitions:
+        n = min(checkpoint_every, cfg.repetitions - rep)
+        with Timer() as t:
+            img_dev = run_fn(img_dev, n)
+            img_dev.block_until_ready()
+        total += t.elapsed
+        rep += n
+        if rep < cfg.repetitions:
+            ckpt.save(cfg, rep, fetch_fn(img_dev))
+    return img_dev, total
+
+
+def _clear_checkpoint(cfg: JobConfig, checkpoint_every: int, resume: bool) -> None:
+    if checkpoint_every or resume:
+        from tpu_stencil.runtime import checkpoint as ckpt
+
+        ckpt.clear(cfg)
+
+
+def run_job(
+    cfg: JobConfig,
+    devices: Optional[list] = None,
+    profile_dir: Optional[str] = None,
+    checkpoint_every: int = 0,
+    resume: bool = False,
+) -> JobResult:
+    """Run one iterated-convolution job end to end."""
+    if checkpoint_every < 0:
+        raise ValueError(f"checkpoint_every must be >= 0, got {checkpoint_every}")
+    if (checkpoint_every or resume) and jax.process_count() > 1:
+        raise NotImplementedError(
+            "checkpoint/resume is single-host for now (multi-host sharded "
+            "checkpoints are on the roadmap)"
+        )
+    with Timer() as total_t:
         model = IteratedConv2D(cfg.filter_name, backend=cfg.backend)
 
         if devices is None:
@@ -44,43 +119,76 @@ def run_job(cfg: JobConfig, devices: Optional[list] = None) -> JobResult:
         n_dev = len(devices)
 
         if n_dev > 1 or cfg.mesh_shape is not None:
-            from tpu_stencil.parallel import sharded
+            return _run_sharded(cfg, model, devices, profile_dir,
+                                checkpoint_every, resume, total_t)
 
-            runner = sharded.ShardedRunner(
-                model, (cfg.height, cfg.width), cfg.channels,
-                mesh_shape=cfg.mesh_shape, devices=devices,
-            )
-            # Warm-up compile outside the timed window (the reference's timer
-            # also excludes startup: it opens after MPI_Barrier,
-            # mpi/mpi_convolution.c:151-155). A 0-rep run's output equals its
-            # input, so it doubles as the timed run's input — no second
-            # host-to-device transfer.
-            img_dev = runner.run(runner.put(img), 0)
-            img_dev.block_until_ready()
-            with Timer() as t:
-                out_dev = runner.run(img_dev, cfg.repetitions)
-                out_dev.block_until_ready()
-            out = runner.fetch(out_dev)
-            mesh_shape = runner.mesh_shape
-            resolved_backend = runner.backend
+        start_rep, frame = _maybe_restore(cfg, resume)
+        if frame is None:
+            img = raw_io.read_raw(cfg.image, cfg.width, cfg.height, cfg.channels)
+            if cfg.image_type.channels == 1:
+                img = img[..., 0]
         else:
-            img_dev = jax.device_put(jax.numpy.asarray(img), devices[0])
-            img_dev = model(img_dev, 0)  # warm-up compile; output == input
-            img_dev.block_until_ready()
-            with Timer() as t:
-                out_dev = model(img_dev, cfg.repetitions)
-                out_dev.block_until_ready()
-            out = np.asarray(out_dev)
-            mesh_shape = None
-            resolved_backend = resolve_backend(cfg.backend)
-
-        compute_seconds = max_across_processes(t.elapsed)
+            img = frame
+        img_dev = jax.device_put(jax.numpy.asarray(img), devices[0])
+        img_dev = model(img_dev, 0)  # warm-up compile; output == input
+        img_dev.block_until_ready()
+        with _maybe_profile(profile_dir):
+            out_dev, compute = _checkpointed_iterate(
+                cfg, lambda x, n: model(x, n), np.asarray,
+                img_dev, checkpoint_every, start_rep,
+            )
+        out = np.asarray(out_dev)
+        compute_seconds = max_across_processes(compute)
         raw_io.write_raw(cfg.output_path, out)
+        _clear_checkpoint(cfg, checkpoint_every, resume)
 
     return JobResult(
         output_path=cfg.output_path,
         compute_seconds=compute_seconds,
         total_seconds=total_t.elapsed,
-        backend=resolved_backend,
-        mesh_shape=mesh_shape,
+        backend=resolve_backend(cfg.backend),
+        mesh_shape=None,
+    )
+
+
+def _run_sharded(cfg, model, devices, profile_dir, checkpoint_every, resume,
+                 total_t) -> JobResult:
+    from tpu_stencil.parallel import distributed, sharded
+
+    runner = sharded.ShardedRunner(
+        model, (cfg.height, cfg.width), cfg.channels,
+        mesh_shape=cfg.mesh_shape, devices=devices,
+    )
+    start_rep, frame = _maybe_restore(cfg, resume)
+    if frame is not None:
+        img_dev = runner.put(frame)
+    else:
+        # Per-process sharded read: each host touches only the rows its
+        # devices own (the MPI-IO pattern, mpi/mpi_convolution.c:126-141);
+        # single-process this is bit-identical to whole-file read +
+        # device_put.
+        img_dev = distributed.read_sharded(
+            cfg.image, cfg.height, cfg.width, cfg.channels, runner.sharding
+        )
+    # Warm-up compile outside the timed window (the reference's timer also
+    # excludes startup: it opens after MPI_Barrier,
+    # mpi/mpi_convolution.c:151-155). A 0-rep run's output equals its input,
+    # so it doubles as the timed run's input — no second transfer.
+    img_dev = runner.run(img_dev, 0)
+    img_dev.block_until_ready()
+    with _maybe_profile(profile_dir):
+        out_dev, compute = _checkpointed_iterate(
+            cfg, runner.run, runner.fetch, img_dev, checkpoint_every, start_rep,
+        )
+    compute_seconds = max_across_processes(compute)
+    distributed.write_sharded(
+        cfg.output_path, out_dev, cfg.height, cfg.width, cfg.channels
+    )
+    _clear_checkpoint(cfg, checkpoint_every, resume)
+    return JobResult(
+        output_path=cfg.output_path,
+        compute_seconds=compute_seconds,
+        total_seconds=total_t.elapsed,
+        backend=runner.backend,
+        mesh_shape=runner.mesh_shape,
     )

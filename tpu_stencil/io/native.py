@@ -107,6 +107,17 @@ def ensure_size(path: str, nbytes: int) -> None:
             f.truncate(nbytes)
 
 
+def set_size(path: str, nbytes: int) -> None:
+    """Set ``path`` to exactly ``nbytes`` bytes (creating it if missing) —
+    idempotent, so every process of a multi-host job may call it before
+    writing its in-bounds shards."""
+    with open(path, "ab") as f:
+        pass
+    if os.path.getsize(path) != nbytes:
+        with open(path, "r+b") as f:
+            f.truncate(nbytes)
+
+
 def micro_time() -> int:
     """Monotonic microsecond timestamp for durations — the role of the
     reference's ``micro_time()`` (``cuda/functions.c:47-51``). Not

@@ -1,0 +1,1 @@
+"""Runtime services: checkpoint/resume, benchmark sweeps."""

@@ -1,0 +1,161 @@
+"""Benchmark sweep harness: the reference's §6 table grid, on TPU.
+
+Reproduces the sweep the reference's authors ran by hand on the lab cluster
+(BASELINE.md: 4 image sizes x {grey, rgb} x process counts, plus the CUDA
+reps sweep) and the extra ``BASELINE.json`` configs (wider 5x5/7x7 halos,
+8K x 1000-rep stress). Emits one markdown table (and optional CSV) with the
+measured per-rep and per-run times and the speedup vs the reference's
+published number where one exists.
+
+Timing method: steady-state per-rep (a long on-device rep loop divided by
+its rep count — dispatch overhead amortized; see bench.py), matching the
+reference's compute-only MPI window semantics.
+
+Usage:
+    python -m tpu_stencil.runtime.bench_sweep [--quick] [--stress]
+        [--csv out.csv] [--filters gaussian,gaussian5,gaussian7]
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from typing import List, Optional
+
+import numpy as np
+
+# Reference numbers (BASELINE.md). CUDA GTX-970 whole-program seconds at the
+# matching reps column; MPI n=1 compute-only seconds (20 reps assumed).
+_CUDA_40REPS = {
+    ("grey", 630): 0.076, ("grey", 1260): 0.116,
+    ("grey", 2520): 0.172, ("grey", 5040): 0.189,
+    ("rgb", 630): 0.307, ("rgb", 1260): 0.537,
+    ("rgb", 2520): 1.017, ("rgb", 5040): 1.837,
+}
+_CUDA_100REPS_8K = None  # no 8K row in the reference tables
+
+SIZES = (630, 1260, 2520, 5040)
+WIDTH = 1920
+
+
+def _measure_per_rep(img: np.ndarray, filter_name: str, budget_s: float) -> float:
+    """Two-point differencing: per_rep = (t(2N) - t(N)) / N cancels the
+    constant dispatch/fence overhead (which can reach ~50 ms through a TPU
+    tunnel and would otherwise swamp small images); N is scaled so each
+    measurement runs ~budget_s on device."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_stencil.models.blur import IteratedConv2D, iterate
+
+    model = IteratedConv2D(filter_name, backend="xla")
+
+    def timed(n_reps: int) -> float:
+        dev = jax.device_put(img)
+        np.asarray(dev.ravel()[0])
+        t0 = time.perf_counter()
+        out = iterate(dev, jnp.int32(n_reps), plan=model.plan, backend="xla")
+        np.asarray(out.ravel()[0])
+        return time.perf_counter() - t0
+
+    timed(1)  # compile fence
+    probe_reps = 500
+    est = max(timed(probe_reps) / probe_reps, 1e-8)
+    lo = min(max(int(budget_s / est), 200), 50_000)
+    t_lo = min(timed(lo) for _ in range(2))
+    t_hi = min(timed(2 * lo) for _ in range(2))
+    return max(t_hi - t_lo, 1e-9) / lo
+
+
+def run_sweep(
+    quick: bool = False,
+    stress: bool = False,
+    filters: Optional[List[str]] = None,
+    csv_path: Optional[str] = None,
+) -> List[dict]:
+    filters = filters or ["gaussian"]
+    rng = np.random.default_rng(0)
+    budget_s = 0.1 if quick else 0.5
+    rows = []
+    sizes = SIZES[:2] if quick else SIZES
+    for filter_name in filters:
+        for mode in ("grey", "rgb"):
+            for h in sizes:
+                shape = (h, WIDTH) if mode == "grey" else (h, WIDTH, 3)
+                img = rng.integers(0, 256, size=shape, dtype=np.uint8)
+                per_rep = _measure_per_rep(img, filter_name, budget_s)
+                t40 = per_rep * 40
+                base = (
+                    _CUDA_40REPS.get((mode, h)) if filter_name == "gaussian" else None
+                )
+                rows.append({
+                    "filter": filter_name, "mode": mode,
+                    "size": f"{WIDTH}x{h}",
+                    "us_per_rep": round(per_rep * 1e6, 1),
+                    "s_40reps": round(t40, 6),
+                    "gtx970_40reps_s": base,
+                    "speedup_vs_gtx970": round(base / t40, 1) if base else None,
+                })
+                print(_fmt_row(rows[-1]), file=sys.stderr, flush=True)
+    if stress:
+        img = rng.integers(0, 256, size=(4320, 7680, 3), dtype=np.uint8)
+        per_rep = _measure_per_rep(img, "gaussian", budget_s * 4)
+        rows.append({
+            "filter": "gaussian", "mode": "rgb", "size": "7680x4320 (8K x1000 reps)",
+            "us_per_rep": round(per_rep * 1e6, 1),
+            "s_40reps": round(per_rep * 1000, 6),  # full 1000-rep stress time
+            "gtx970_40reps_s": None, "speedup_vs_gtx970": None,
+        })
+        print(_fmt_row(rows[-1]), file=sys.stderr, flush=True)
+    if csv_path:
+        import csv
+
+        with open(csv_path, "w", newline="") as f:
+            w = csv.DictWriter(f, fieldnames=list(rows[0].keys()))
+            w.writeheader()
+            w.writerows(rows)
+    return rows
+
+
+def _fmt_row(r: dict) -> str:
+    sp = f"{r['speedup_vs_gtx970']}x" if r["speedup_vs_gtx970"] else "-"
+    return (f"{r['filter']:>10} {r['mode']:>4} {r['size']:>12}: "
+            f"{r['us_per_rep']:>8} us/rep, 40 reps = {r['s_40reps']:.4f} s, "
+            f"vs GTX-970 {sp}")
+
+
+def emit_markdown(rows: List[dict]) -> str:
+    lines = [
+        "| filter | mode | size | us/rep | 40 reps (s) | GTX-970 40 reps (s) | speedup |",
+        "|---|---|---|---|---|---|---|",
+    ]
+    for r in rows:
+        lines.append(
+            f"| {r['filter']} | {r['mode']} | {r['size']} | {r['us_per_rep']} "
+            f"| {r['s_40reps']} | {r['gtx970_40reps_s'] or '-'} "
+            f"| {str(r['speedup_vs_gtx970']) + 'x' if r['speedup_vs_gtx970'] else '-'} |"
+        )
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--quick", action="store_true", help="2 sizes, short runs")
+    p.add_argument("--stress", action="store_true", help="add the 8K x1000 config")
+    p.add_argument("--csv", default=None, help="also write CSV here")
+    p.add_argument(
+        "--filters", default="gaussian",
+        help="comma-separated filter names (default gaussian)",
+    )
+    ns = p.parse_args(argv)
+    rows = run_sweep(
+        quick=ns.quick, stress=ns.stress,
+        filters=ns.filters.split(","), csv_path=ns.csv,
+    )
+    print(emit_markdown(rows))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
