@@ -1,0 +1,73 @@
+"""Worker process for the 2-process distributed integration test.
+
+Each process runs the REAL multi-host stack end to end: explicit
+``distributed.initialize`` (the ``mpiexec`` analog), rank-0-only config +
+``broadcast_config`` (``MPI_Bcast``), per-process ``read_sharded``, the
+shard_map compute, and concurrent ``write_sharded`` into one shared output
+file (the MPI-IO pattern). Invoked by tests/test_multiprocess.py as:
+
+    python tests/_mp_worker.py <proc_id> <coordinator> <img> <out> <mesh_r> <mesh_c>
+"""
+
+import os
+import sys
+
+
+def main() -> None:
+    proc_id = int(sys.argv[1])
+    coordinator = sys.argv[2]
+    img_path, out_path = sys.argv[3], sys.argv[4]
+    mesh_shape = (int(sys.argv[5]), int(sys.argv[6]))
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from tpu_stencil.parallel import distributed
+
+    # Before any JAX computation — the constraint initialize() documents.
+    distributed.initialize(coordinator, num_processes=2, process_id=proc_id)
+    assert jax.process_count() == 2, jax.process_count()
+
+    from tpu_stencil.config import ImageType, JobConfig
+
+    # Rank 0 owns the config; other ranks receive it (MPI_Bcast x6 analog,
+    # mpi/mpi_convolution.c:50-70).
+    cfg = None
+    if proc_id == 0:
+        cfg = JobConfig(
+            image=img_path, width=20, height=12, repetitions=3,
+            image_type=ImageType.RGB, backend="xla",
+            mesh_shape=mesh_shape, output=out_path,
+        )
+    cfg = distributed.broadcast_config(cfg)
+    assert cfg.width == 20 and cfg.output == out_path
+
+    from tpu_stencil.models.blur import IteratedConv2D
+    from tpu_stencil.parallel.sharded import ShardedRunner
+
+    model = IteratedConv2D(cfg.filter_name, backend="xla")
+    runner = ShardedRunner(
+        model, (cfg.height, cfg.width), cfg.channels,
+        mesh_shape=cfg.mesh_shape, devices=jax.devices(),
+    )
+    img_dev = distributed.read_sharded(
+        cfg.image, cfg.height, cfg.width, cfg.channels, runner.sharding
+    )
+    out_dev = runner.run(img_dev, cfg.repetitions)
+    out_dev.block_until_ready()
+    distributed.write_sharded(
+        out_path, out_dev, cfg.height, cfg.width, cfg.channels
+    )
+    # Everyone must finish writing before any process exits (the test reads
+    # the shared file as soon as both workers return).
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices("write_done")
+    print(f"proc {proc_id} done", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -85,11 +85,11 @@ def test_broadcast_config_single_process_identity():
 
 
 def test_device_row_ranges():
-    m = distributed.device_row_ranges(32, 40, (2, 4), 3)
-    rr, cs = m[(0, 0)]
-    assert (rr.start, rr.stop) == (0, 16) and (cs.start, cs.stop) == (0, 30)
-    rr, cs = m[(1, 3)]
-    assert (rr.start, rr.stop) == (16, 32) and (cs.start, cs.stop) == (90, 120)
+    m = distributed.device_row_ranges(32, 40, (2, 4))
+    rr, col0, n_cols = m[(0, 0)]
+    assert (rr.start, rr.stop) == (0, 16) and (col0, n_cols) == (0, 10)
+    rr, col0, n_cols = m[(1, 3)]
+    assert (rr.start, rr.stop) == (16, 32) and (col0, n_cols) == (30, 10)
 
 
 def test_initialize_single_process_noop():
@@ -116,3 +116,36 @@ def test_write_sharded_truncates_stale_output(tmp_path, rng):
     import os
     assert os.path.getsize(dst) == 16 * 16
     np.testing.assert_array_equal(raw_io.read_raw(dst, 16, 16, 1)[..., 0], img)
+
+
+@requires_8
+def test_write_sharded_cols_only_mesh_round_trip(tmp_path, rng):
+    # (1, 8) mesh: every shard is a column tile of the same row range — each
+    # write must touch only its own columns (multi-host clobbering regression).
+    img = rng.integers(0, 256, size=(17, 43, 3), dtype=np.uint8)
+    src = str(tmp_path / "in.raw")
+    dst = str(tmp_path / "out.raw")
+    raw_io.write_raw(src, img)
+    runner = _runner((17, 43), 3, (1, 8))
+    dev = distributed.read_sharded(src, 17, 43, 3, runner.sharding)
+    distributed.write_sharded(dst, dev, 17, 43, 3)
+    np.testing.assert_array_equal(raw_io.read_raw(dst, 43, 17, 3), img)
+
+
+@requires_8
+def test_read_sharded_reads_each_row_range_once(tmp_path, rng, monkeypatch):
+    img = rng.integers(0, 256, size=(32, 40, 3), dtype=np.uint8)
+    p = str(tmp_path / "in.raw")
+    raw_io.write_raw(p, img)
+    calls = []
+    real = raw_io.read_raw_rows
+
+    def counting(path, row_start, n_rows, width, channels):
+        calls.append(row_start)
+        return real(path, row_start, n_rows, width, channels)
+
+    monkeypatch.setattr(distributed.raw_io, "read_raw_rows", counting)
+    runner = _runner((32, 40), 3, (2, 4))
+    distributed.read_sharded(p, 32, 40, 3, runner.sharding)
+    # 2 mesh rows x 4 col tiles: exactly one disk read per row range
+    assert sorted(calls) == [0, 16]

@@ -90,3 +90,21 @@ def test_native_and_fallback_parity(tmp_path, rng, monkeypatch):
     if with_lib is not None:
         assert with_lib == without_lib
     assert without_lib[:777] == data and without_lib[1500:1600] == data[:100]
+
+
+def test_write_raw_block_strided_columns(tmp_path, rng):
+    # Two writers own disjoint column tiles of the same rows; neither may
+    # touch the other's bytes (the multi-host shared-file write pattern).
+    p = str(tmp_path / "blk.raw")
+    h, w, c = 9, 12, 3
+    full = rng.integers(0, 256, size=(h, w, c), dtype=np.uint8)
+    raw_io.write_raw_block(p, 0, 0, full[:, :5], w, c, h)
+    raw_io.write_raw_block(p, 0, 5, full[:, 5:], w, c, h)
+    np.testing.assert_array_equal(raw_io.read_raw(p, w, h, c), full)
+
+
+def test_write_raw_block_out_of_bounds_cols(tmp_path, rng):
+    p = str(tmp_path / "blk.raw")
+    blk = rng.integers(0, 256, size=(4, 8, 1), dtype=np.uint8)
+    with pytest.raises(ValueError):
+        raw_io.write_raw_block(p, 0, 5, blk, 12, 1, 4)

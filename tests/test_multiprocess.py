@@ -1,0 +1,64 @@
+"""Real 2-process distributed integration test on localhost CPU.
+
+The reference could only validate its multi-node path on a physical cluster
+(machines.txt; SURVEY.md §4). Here two actual OS processes join via
+``jax.distributed`` (gloo collectives over localhost), each owning 2 virtual
+CPU devices, and run the full stack: initialize -> broadcast_config ->
+read_sharded -> shard_map compute -> concurrent write_sharded into ONE
+shared output file. The (1, 4) mesh puts both processes' column tiles in
+the same row range — the cross-process interleaved-write case single-process
+tests cannot reach.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from tpu_stencil import filters
+from tpu_stencil.io import raw as raw_io
+from tpu_stencil.ops import stencil
+
+_WORKER = os.path.join(os.path.dirname(__file__), "_mp_worker.py")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.mark.parametrize("mesh", [(1, 4), (2, 2)])
+def test_two_process_end_to_end(tmp_path, rng, mesh):
+    img = rng.integers(0, 256, size=(12, 20, 3), dtype=np.uint8)
+    src = str(tmp_path / "in.raw")
+    dst = str(tmp_path / "out.raw")
+    raw_io.write_raw(src, img)
+
+    coordinator = f"127.0.0.1:{_free_port()}"
+    env = dict(
+        os.environ,
+        PYTHONPATH=os.pathsep.join(
+            [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
+            + os.environ.get("PYTHONPATH", "").split(os.pathsep)
+        ),
+    )
+    procs = [
+        subprocess.Popen(
+            [sys.executable, _WORKER, str(pid), coordinator, src, dst,
+             str(mesh[0]), str(mesh[1])],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for pid in range(2)
+    ]
+    outs = [p.communicate(timeout=300)[0] for p in procs]
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, f"worker failed:\n{out}"
+
+    got = raw_io.read_raw(dst, 20, 12, 3)
+    want = stencil.reference_stencil_numpy(img, filters.get_filter("gaussian"), 3)
+    np.testing.assert_array_equal(got, want)

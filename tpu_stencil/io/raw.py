@@ -91,6 +91,48 @@ def write_raw_rows(
     _native.pwrite_full(path, offset, arr.tobytes(), truncate=False)
 
 
+def write_raw_block(
+    path: str, row_start: int, col_start: int, block: np.ndarray,
+    width: int, channels: int, total_height: int,
+) -> None:
+    """Write a rectangular (n_rows, n_cols, C) block at its global offsets
+    into a shared file — one strided pwrite per row, the MPI subarray-write
+    pattern (``mpi/mpi_convolution.c:247-263`` generalized to column tiles).
+
+    Unlike :func:`write_raw_rows` this never touches bytes outside the
+    block's columns, so processes owning different column tiles of the same
+    row range can write concurrently without clobbering each other.
+    """
+    arr = np.ascontiguousarray(np.asarray(block, dtype=np.uint8))
+    if arr.ndim == 2:
+        arr = arr[..., None]
+    n_rows, n_cols = arr.shape[0], arr.shape[1]
+    if arr.shape[2] != channels:
+        raise ValueError(f"block shape {arr.shape} != (*, *, {channels})")
+    if col_start < 0 or col_start + n_cols > width:
+        raise ValueError(f"cols [{col_start}, {col_start + n_cols}) outside image")
+    if row_start < 0 or row_start + n_rows > total_height:
+        raise ValueError(f"rows [{row_start}, {row_start + n_rows}) outside image")
+    if n_cols == width:
+        write_raw_rows(path, row_start, arr, width, channels, total_height)
+        return
+    _native.ensure_size(path, _expected_bytes(width, total_height, channels))
+    # One open for the whole block; one pwrite per row (strided holes between
+    # rows belong to other writers and must not be touched).
+    fd = os.open(path, os.O_WRONLY)
+    try:
+        row_bytes = arr.reshape(n_rows, -1)
+        for k in range(n_rows):
+            offset = ((row_start + k) * width + col_start) * channels
+            view = memoryview(row_bytes[k]).cast("B")
+            while view:
+                written = os.pwrite(fd, view, offset)
+                view = view[written:]
+                offset += written
+    finally:
+        os.close(fd)
+
+
 def to_planar(img: np.ndarray) -> np.ndarray:
     """(H, W, C) interleaved -> (C, H, W) planar (layout experiments)."""
     return np.ascontiguousarray(np.moveaxis(img, -1, 0))

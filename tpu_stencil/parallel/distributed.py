@@ -11,9 +11,10 @@ TPU-native equivalent of the reference's multi-node MPI machinery:
   ``multihost_utils.broadcast_one_to_all``;
 * per-rank MPI-IO strided reads/writes (``mpi/mpi_convolution.c:126-141,
   247-263``) -> :func:`read_sharded` / :func:`write_sharded`: each process
-  touches only the byte ranges of rows owned by its addressable devices,
-  assembled into one global array with
-  ``jax.make_array_from_single_device_arrays``.
+  reads only the row ranges owned by its addressable devices (once per row
+  range, assembled into one global array with
+  ``jax.make_array_from_single_device_arrays``) and writes only its shards'
+  exact byte rectangles.
 
 Meshes built here put the ``rows`` axis outermost so row-neighbor halo
 ``ppermute`` s between co-hosted devices ride ICI and only the host-boundary
@@ -35,15 +36,73 @@ from tpu_stencil.io import raw as raw_io
 from tpu_stencil.parallel.mesh import ROWS_AXIS, COLS_AXIS
 
 
+def _distributed_client_active() -> bool:
+    """Whether jax.distributed.initialize already ran, WITHOUT initializing
+    any XLA backend (jax.process_count() would)."""
+    try:
+        from jax._src import distributed as _dist
+
+        return _dist.global_state.client is not None
+    except Exception:
+        # Private API moved: assume not yet initialized. (Probing via
+        # jax.process_count() would itself initialize backends — the exact
+        # condition this guard exists to avoid.)
+        return False
+
+
+def _backends_initialized() -> bool:
+    try:
+        from jax._src import xla_bridge
+
+        return xla_bridge.backends_are_initialized()
+    except Exception:
+        return False
+
+
 def initialize(
     coordinator_address: Optional[str] = None,
     num_processes: Optional[int] = None,
     process_id: Optional[int] = None,
 ) -> None:
     """Join the multi-process job (no-op when already initialized or when
-    running single-process)."""
-    if jax.process_count() > 1:
-        return  # already initialized by the environment
+    running single-process).
+
+    Must run before the first JAX computation: ``jax.distributed.initialize``
+    refuses to run once XLA backends exist. Call it first thing in the
+    process (the CLI does), like ``MPI_Init`` leading ``main`` in the
+    reference (``mpi/mpi_convolution.c:23``).
+    """
+    if _distributed_client_active():
+        return  # already part of a multi-process job
+    explicit = coordinator_address is not None or num_processes is not None
+    if _backends_initialized():
+        if explicit:
+            raise RuntimeError(
+                "tpu_stencil.parallel.distributed.initialize() was called "
+                "after JAX backends were initialized; multi-process bring-up "
+                "must precede the first JAX computation. Call initialize() "
+                "at process start (before any jax.* array/compile call)."
+            )
+        import os
+        import warnings
+
+        # NOTE: TPU_WORKER_HOSTNAMES is NOT a usable marker — libtpu/the
+        # PJRT plugin sets it itself during backend init.
+        if any(
+            v in os.environ
+            for v in ("JAX_COORDINATOR_ADDRESS", "COORDINATOR_ADDRESS",
+                      "MEGASCALE_COORDINATOR_ADDRESS")
+        ):
+            # Looks like a multi-process environment — degrading to
+            # single-process here would silently race on shared files.
+            warnings.warn(
+                "distributed auto-initialization skipped: JAX backends were "
+                "already initialized; running single-process despite a "
+                "multi-process environment. Call initialize() earlier.",
+                RuntimeWarning,
+                stacklevel=2,
+            )
+        return
     if coordinator_address is None and num_processes is None:
         # Cloud TPU auto-detection; harmless single-process otherwise.
         try:
@@ -73,11 +132,11 @@ def broadcast_config(cfg: Optional[JobConfig]) -> JobConfig:
         mr, mc = cfg.mesh_shape if cfg.mesh_shape is not None else (-1, -1)
         fields = np.array(
             [cfg.width, cfg.height, cfg.repetitions,
-             0 if cfg.image_type is ImageType.GREY else 1, mr, mc],
+             0 if cfg.image_type is ImageType.GREY else 1, mr, mc, cfg.frames],
             np.int64,
         )
     fields = multihost_utils.broadcast_one_to_all(
-        fields if fields is not None else np.zeros(6, np.int64)
+        fields if fields is not None else np.zeros(7, np.int64)
     )
     names = multihost_utils.broadcast_one_to_all(
         _encode_strs([cfg.image, cfg.filter_name, cfg.backend,
@@ -99,6 +158,7 @@ def broadcast_config(cfg: Optional[JobConfig]) -> JobConfig:
         backend=backend,
         mesh_shape=mesh_shape,
         output=output or None,
+        frames=int(fields[6]),
     )
 
 
@@ -131,20 +191,17 @@ class RowRange:
 
 
 def device_row_ranges(
-    padded_h: int, padded_w: int, mesh_shape: Tuple[int, int], channels: int
+    padded_h: int, padded_w: int, mesh_shape: Tuple[int, int]
 ) -> dict:
-    """Map (mesh row, mesh col) -> (RowRange, col byte slice) for sharded
-    file access — the ``offset`` arithmetic of ``mpi/mpi_convolution.c:
-    324-326`` generalized to a 2-D grid."""
+    """Map (mesh row, mesh col) -> (RowRange, col_start, n_cols) in pixel
+    units for sharded file access — the ``offset`` arithmetic of
+    ``mpi/mpi_convolution.c:324-326`` generalized to a 2-D grid."""
     r, c = mesh_shape
     th, tw = padded_h // r, padded_w // c
     out = {}
     for i in range(r):
         for j in range(c):
-            out[(i, j)] = (
-                RowRange(i * th, (i + 1) * th),
-                slice(j * tw * channels, (j + 1) * tw * channels),
-            )
+            out[(i, j)] = (RowRange(i * th, (i + 1) * th), j * tw, tw)
     return out
 
 
@@ -156,39 +213,43 @@ def read_sharded(
     sharding: jax.sharding.NamedSharding,
 ) -> jax.Array:
     """Assemble a global sharded array by reading, on each process, only the
-    rows its addressable devices own (zero-filling rows/cols in the pad
-    region). Single-process this degenerates to a tiled read of the whole
-    file, matching ``jax.device_put`` semantics bit-for-bit."""
+    row ranges its addressable devices own (zero-filling rows/cols in the pad
+    region) — each distinct row range is read from disk exactly once per
+    process and sliced into its column tiles. Single-process this
+    degenerates to a tiled read of the whole file, matching
+    ``jax.device_put`` semantics bit-for-bit."""
     mesh = sharding.mesh
     r = mesh.shape[ROWS_AXIS]
     c = mesh.shape[COLS_AXIS]
     padded_h = -(-height // r) * r
     padded_w = -(-width // c) * c
+    ranges = device_row_ranges(padded_h, padded_w, (r, c))
     th, tw = padded_h // r, padded_w // c
 
     global_shape = (
         (padded_h, padded_w) if channels == 1 else (padded_h, padded_w, channels)
     )
     arrays = []
-    devs = []
     grid = np.asarray(mesh.devices)
+    row_cache: dict = {}  # mesh row i -> rows read once for this process
     for i in range(r):
         for j in range(c):
             dev = grid[i, j]
             if dev.process_index != jax.process_index():
                 continue
+            rr, col0, tile_cols = ranges[(i, j)]
             tile = np.zeros((th, tw, channels), np.uint8)
-            row0 = i * th
-            n_rows = max(0, min((i + 1) * th, height) - row0)
-            col0 = j * tw
-            n_cols = max(0, min((j + 1) * tw, width) - col0)
+            n_rows = max(0, min(rr.stop, height) - rr.start)
+            n_cols = max(0, min(col0 + tile_cols, width) - col0)
             if n_rows and n_cols:
-                rows = raw_io.read_raw_rows(path, row0, n_rows, width, channels)
-                tile[:n_rows, :n_cols] = rows[:, col0 : col0 + n_cols]
+                if i not in row_cache:
+                    row_cache[i] = raw_io.read_raw_rows(
+                        path, rr.start, n_rows, width, channels
+                    )
+                tile[:n_rows, :n_cols] = row_cache[i][:, col0 : col0 + n_cols]
             if channels == 1:
                 tile = tile[..., 0]
             arrays.append(jax.device_put(tile, dev))
-            devs.append(dev)
     return jax.make_array_from_single_device_arrays(
         global_shape, sharding, arrays
     )
@@ -201,33 +262,47 @@ def write_sharded(
     width: int,
     channels: int,
 ) -> None:
-    """Every process writes only the rows of its addressable shards at their
-    global byte offsets into one shared output file (the MPI-IO write
-    pattern). Overlapping column tiles within a row range are merged
-    host-side before the single positional write per shard row-range."""
+    """Every process writes only the exact byte ranges of its addressable
+    shards into one shared output file (the MPI-IO write pattern,
+    ``mpi/mpi_convolution.c:247-263``): each shard's in-bounds rectangle is
+    written at its global offsets via strided per-row pwrites, so column
+    tiles of the same row range held by different processes never touch each
+    other's bytes."""
     # Size the file exactly first (stale larger files must not keep trailing
     # bytes — the output must be a valid H*W*C raw image). Idempotent, so
     # every process may do it; no one writes out of bounds afterwards.
     native.set_size(path, height * width * channels)
-    # Collect addressable shards grouped by row range.
-    by_rows = {}
+    # Group this process's shards by row range and merge contiguous column
+    # tiles host-side, so a fully-local row range becomes one contiguous
+    # write and partial ownership degrades to one strided block per run —
+    # never a byte outside the owned columns.
+    by_rows: dict = {}
     for shard in out.addressable_shards:
         idx = shard.index  # tuple of slices into the global array
         rs = idx[0]
-        by_rows.setdefault((rs.start or 0, rs.stop), []).append(shard)
-    for (r0, r1), shards in by_rows.items():
-        r1 = min(r1 if r1 is not None else height, height)
-        if r0 >= r1:
+        cs = idx[1] if len(idx) > 1 else slice(0, width)
+        r0 = rs.start or 0
+        r1 = min(rs.stop if rs.stop is not None else height, height)
+        c0 = cs.start or 0
+        c1 = min(cs.stop if cs.stop is not None else width, width)
+        if r0 >= r1 or c0 >= c1:
             continue
-        strip = np.zeros((r1 - r0, width, channels), np.uint8)
-        for shard in shards:
-            cs = shard.index[1] if len(shard.index) > 1 else slice(0, width)
-            c0 = cs.start or 0
-            c1 = min(cs.stop if cs.stop is not None else width, width)
-            if c0 >= c1:
-                continue
-            data = np.asarray(shard.data)
-            if data.ndim == 2:
-                data = data[..., None]
-            strip[:, c0:c1] = data[: r1 - r0, : c1 - c0]
-        raw_io.write_raw_rows(path, r0, strip, width, channels, height)
+        data = np.asarray(shard.data)
+        if data.ndim == 2:
+            data = data[..., None]
+        by_rows.setdefault((r0, r1), {})[(c0, c1)] = data[: r1 - r0, : c1 - c0]
+    for (r0, r1), tiles in by_rows.items():
+        order = sorted(tiles)  # dedups replicated shards (identical bytes)
+        run_c0, run_c1 = order[0]
+        parts = [tiles[order[0]]]
+        runs = []
+        for c0, c1 in order[1:]:
+            if c0 == run_c1:
+                run_c1 = c1
+                parts.append(tiles[(c0, c1)])
+            else:
+                runs.append((run_c0, np.concatenate(parts, axis=1)))
+                run_c0, run_c1, parts = c0, c1, [tiles[(c0, c1)]]
+        runs.append((run_c0, np.concatenate(parts, axis=1)))
+        for c0, block in runs:
+            raw_io.write_raw_block(path, r0, c0, block, width, channels, height)
