@@ -83,3 +83,36 @@ def test_cli_bad_mesh_is_usage_error(tmp_path):
     with pytest.raises(SystemExit) as exc:
         parse_args(["i.raw", "8", "8", "1", "grey", "--mesh", "8"])
     assert exc.value.code == 2
+
+
+def test_cli_frames_batch_mode(tmp_path, rng, capsys):
+    # 3-frame raw "video": every frame blurred independently (vmap semantics)
+    frames = rng.integers(0, 256, size=(3, 10, 8, 3), dtype=np.uint8)
+    src = str(tmp_path / "clip.raw")
+    with open(src, "wb") as f:
+        f.write(frames.tobytes())
+    assert cli.main([src, "8", "10", "2", "rgb", "--frames", "3",
+                     "--backend", "xla"]) == 0
+    out = np.fromfile(str(tmp_path / "blur_clip.raw"), np.uint8)
+    out = out.reshape(3, 10, 8, 3)
+    for k in range(3):
+        want = stencil.reference_stencil_numpy(
+            frames[k], filters.get_filter("gaussian"), 2
+        )
+        np.testing.assert_array_equal(out[k], want)
+
+
+def test_cli_frames_resume_round_trip(tmp_path, rng):
+    frames = rng.integers(0, 256, size=(2, 6, 6), dtype=np.uint8)
+    src = str(tmp_path / "clip.raw")
+    with open(src, "wb") as f:
+        f.write(frames.tobytes())
+    args = [src, "6", "6", "4", "grey", "--frames", "2",
+            "--checkpoint-every", "2", "--resume"]
+    assert cli.main(args) == 0
+    out = np.fromfile(str(tmp_path / "blur_clip.raw"), np.uint8).reshape(2, 6, 6)
+    for k in range(2):
+        want = stencil.reference_stencil_numpy(
+            frames[k], filters.get_filter("gaussian"), 4
+        )
+        np.testing.assert_array_equal(out[k], want)

@@ -1,0 +1,66 @@
+"""Image-format I/O (PNG/PPM/...) — a convenience layer the reference lacked
+(its README resorts to ImageMagick to produce .raw inputs)."""
+
+import numpy as np
+import pytest
+
+from tpu_stencil import cli, filters
+from tpu_stencil.config import ImageType, parse_args
+from tpu_stencil.io import images, raw as raw_io
+from tpu_stencil.ops import stencil
+
+
+def test_png_round_trip(tmp_path, rng):
+    arr = rng.integers(0, 256, size=(13, 9, 3), dtype=np.uint8)
+    p = str(tmp_path / "a.png")
+    images.save_image(p, arr)
+    back = images.load_image(p, ImageType.RGB)
+    np.testing.assert_array_equal(back, arr)  # PNG is lossless
+
+
+def test_grey_round_trip_ppm(tmp_path, rng):
+    arr = rng.integers(0, 256, size=(7, 11), dtype=np.uint8)
+    p = str(tmp_path / "g.pgm")
+    images.save_image(p, arr)
+    back = images.load_image(p, ImageType.GREY)
+    assert back.shape == (7, 11)
+    np.testing.assert_array_equal(back, arr)
+
+
+def test_resolve_size_inference_and_mismatch(tmp_path, rng):
+    arr = rng.integers(0, 256, size=(5, 8, 3), dtype=np.uint8)
+    p = str(tmp_path / "a.png")
+    images.save_image(p, arr)
+    assert images.resolve_size(p, 0, 0) == (8, 5)
+    assert images.resolve_size(p, 8, 5) == (8, 5)
+    with pytest.raises(ValueError):
+        images.resolve_size(p, 8, 6)
+    with pytest.raises(ValueError):
+        images.resolve_size("x.raw", 0, 5)
+
+
+def test_is_raw():
+    assert images.is_raw("a.raw") and images.is_raw("dir/b.bin")
+    assert images.is_raw("noext")
+    assert not images.is_raw("a.png") and not images.is_raw("b.PPM")
+
+
+def test_cli_png_end_to_end(tmp_path, rng, capsys):
+    img = rng.integers(0, 256, size=(12, 10, 3), dtype=np.uint8)
+    src = str(tmp_path / "photo.png")
+    images.save_image(src, img)
+    assert cli.main([src, "0", "0", "2", "rgb", "--backend", "xla"]) == 0
+    out = images.load_image(str(tmp_path / "blur_photo.png"), ImageType.RGB)
+    want = stencil.reference_stencil_numpy(img, filters.get_filter("gaussian"), 2)
+    np.testing.assert_array_equal(out, want)
+
+
+def test_cli_png_to_raw_output(tmp_path, rng):
+    img = rng.integers(0, 256, size=(9, 6), dtype=np.uint8)
+    src = str(tmp_path / "photo.png")
+    dst = str(tmp_path / "out.raw")
+    images.save_image(src, img)
+    assert cli.main([src, "0", "0", "1", "grey", "--output", dst]) == 0
+    got = raw_io.read_raw(dst, 6, 9, 1)[..., 0]
+    want = stencil.reference_stencil_numpy(img, filters.get_filter("gaussian"), 1)
+    np.testing.assert_array_equal(got, want)

@@ -18,7 +18,16 @@ from tpu_stencil import driver
 
 
 def main(argv=None) -> int:
+    # parse_args does no JAX work, so parse first: --help/usage errors must
+    # exit without joining a pod rendezvous.
     cfg, ns = parse_args(argv)
+    # Multi-process bring-up precedes the first JAX computation (the
+    # MPI_Init-leads-main discipline, mpi/mpi_convolution.c:23). Auto mode:
+    # joins a Cloud TPU pod job when the environment defines one, and is a
+    # no-op single-process otherwise.
+    from tpu_stencil.parallel import distributed
+
+    distributed.initialize()
     result = driver.run_job(
         cfg,
         profile_dir=ns.profile,

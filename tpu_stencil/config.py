@@ -42,6 +42,7 @@ class JobConfig:
     mesh_shape: Optional[Tuple[int, int]] = None  # (rows, cols); None = auto
     output: Optional[str] = None  # None -> blur_<basename> beside input
     dtype: str = "float32"  # accumulation dtype
+    frames: int = 1  # >1: batched video mode (N concatenated raw frames)
 
     def __post_init__(self) -> None:
         if self.width <= 0 or self.height <= 0:
@@ -54,6 +55,8 @@ class JobConfig:
             len(self.mesh_shape) != 2 or any(d < 1 for d in self.mesh_shape)
         ):
             raise ValueError(f"mesh_shape must be two positive ints, got {self.mesh_shape}")
+        if self.frames < 1:
+            raise ValueError(f"frames must be >= 1, got {self.frames}")
 
     @property
     def channels(self) -> int:
@@ -70,7 +73,7 @@ class JobConfig:
 
     @property
     def nbytes(self) -> int:
-        return self.width * self.height * self.channels
+        return self.width * self.height * self.channels * self.frames
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -82,9 +85,19 @@ def build_parser() -> argparse.ArgumentParser:
             "repetitions {grey,rgb}."
         ),
     )
-    p.add_argument("image", help="path to headerless .raw image")
-    p.add_argument("width", type=int, help="image width in pixels")
-    p.add_argument("height", type=int, help="image height in pixels")
+    p.add_argument(
+        "image",
+        help="input image: headerless .raw, or any standard format "
+             "(png/jpg/ppm/bmp/tiff/...) decoded via its header",
+    )
+    p.add_argument(
+        "width", type=int,
+        help="image width in pixels (0 = from header, non-raw formats only)",
+    )
+    p.add_argument(
+        "height", type=int,
+        help="image height in pixels (0 = from header, non-raw formats only)",
+    )
     p.add_argument("repetitions", type=int, help="number of filter applications")
     p.add_argument(
         "image_type", choices=[t.value for t in ImageType],
@@ -104,6 +117,11 @@ def build_parser() -> argparse.ArgumentParser:
              "over all local devices",
     )
     p.add_argument("--output", default=None, help="output path (default blur_<input>)")
+    p.add_argument(
+        "--frames", type=int, default=1, metavar="N",
+        help="batched video mode: the raw input holds N concatenated frames "
+             "(vmap over the frame axis; frames never mix)",
+    )
     p.add_argument(
         "--profile", default=None, metavar="DIR",
         help="write a jax.profiler trace of the compute window to DIR",
@@ -140,17 +158,24 @@ def parse_args(argv=None) -> Tuple[JobConfig, argparse.Namespace]:
         mesh_shape = _parse_mesh(parser, ns.mesh)
     if ns.checkpoint_every < 0:
         parser.error(f"--checkpoint-every must be >= 0, got {ns.checkpoint_every}")
+    from tpu_stencil.io import images as _images
+
+    try:
+        width, height = _images.resolve_size(ns.image, ns.width, ns.height)
+    except (ValueError, OSError) as e:
+        parser.error(str(e))
     try:
         cfg = JobConfig(
             image=ns.image,
-            width=ns.width,
-            height=ns.height,
+            width=width,
+            height=height,
             repetitions=ns.repetitions,
             image_type=ImageType(ns.image_type),
             filter_name=ns.filter_name,
             backend=ns.backend,
             mesh_shape=mesh_shape,
             output=ns.output,
+            frames=ns.frames,
         )
     except ValueError as e:
         parser.error(str(e))

@@ -18,9 +18,46 @@ import jax
 import numpy as np
 
 from tpu_stencil.config import JobConfig
+from tpu_stencil.io import images as images_io
 from tpu_stencil.io import raw as raw_io
 from tpu_stencil.models.blur import IteratedConv2D, resolve_backend
 from tpu_stencil.utils.timing import Timer, max_across_processes
+
+
+def _load_input(cfg: JobConfig) -> np.ndarray:
+    """Whole-image host load, any supported container format.
+
+    ``frames > 1``: the raw file holds N concatenated frames; returns
+    (N, H, W[, C]) for the batched (vmap) path."""
+    if images_io.is_raw(cfg.image):
+        img = raw_io.read_raw(
+            cfg.image, cfg.width, cfg.height * cfg.frames, cfg.channels
+        )
+        if cfg.channels == 1:
+            img = img[..., 0]
+        if cfg.frames > 1:
+            img = img.reshape((cfg.frames, cfg.height) + img.shape[1:])
+        return img
+    if cfg.frames > 1:
+        raise NotImplementedError(
+            "--frames requires a raw input (N concatenated headerless frames)"
+        )
+    return images_io.load_image(cfg.image, cfg.image_type)
+
+
+def _store_output(cfg: JobConfig, out: np.ndarray) -> None:
+    """Write the result in the container format of the output path."""
+    if cfg.frames > 1:
+        if not images_io.is_raw(cfg.output_path):
+            raise NotImplementedError(
+                "--frames output is raw-only (N concatenated headerless "
+                "frames); single-image containers cannot hold a clip"
+            )
+        out = out.reshape((cfg.frames * cfg.height,) + out.shape[2:])
+    if images_io.is_raw(cfg.output_path):
+        raw_io.write_raw(cfg.output_path, out)
+    else:
+        images_io.save_image(cfg.output_path, out)
 
 
 @dataclasses.dataclass
@@ -118,28 +155,44 @@ def run_job(
             devices = jax.devices()
         n_dev = len(devices)
 
-        if n_dev > 1 or cfg.mesh_shape is not None:
+        if cfg.frames > 1:
+            if not images_io.is_raw(cfg.image) or not images_io.is_raw(
+                cfg.output_path
+            ):
+                raise NotImplementedError(
+                    "--frames input and output are raw-only (N concatenated "
+                    "headerless frames); single-image containers cannot hold "
+                    "a clip"
+                )
+            if jax.process_count() > 1:
+                raise NotImplementedError(
+                    "--frames batching is single-host for now (batch-axis "
+                    "sharding is on the roadmap)"
+                )
+            if cfg.mesh_shape is not None and cfg.mesh_shape != (1, 1):
+                raise NotImplementedError(
+                    "--frames batching is single-device for now (batch-axis "
+                    "sharding is on the roadmap); drop --mesh"
+                )
+            devices, n_dev = devices[:1], 1  # batch path: one device
+        if cfg.frames == 1 and (n_dev > 1 or cfg.mesh_shape is not None):
             return _run_sharded(cfg, model, devices, profile_dir,
                                 checkpoint_every, resume, total_t)
 
         start_rep, frame = _maybe_restore(cfg, resume)
-        if frame is None:
-            img = raw_io.read_raw(cfg.image, cfg.width, cfg.height, cfg.channels)
-            if cfg.image_type.channels == 1:
-                img = img[..., 0]
-        else:
-            img = frame
+        img = _load_input(cfg) if frame is None else frame
+        step_fn = model.batch if cfg.frames > 1 else model
         img_dev = jax.device_put(jax.numpy.asarray(img), devices[0])
-        img_dev = model(img_dev, 0)  # warm-up compile; output == input
+        img_dev = step_fn(img_dev, 0)  # warm-up compile; output == input
         img_dev.block_until_ready()
         with _maybe_profile(profile_dir):
             out_dev, compute = _checkpointed_iterate(
-                cfg, lambda x, n: model(x, n), np.asarray,
+                cfg, lambda x, n: step_fn(x, n), np.asarray,
                 img_dev, checkpoint_every, start_rep,
             )
         out = np.asarray(out_dev)
         compute_seconds = max_across_processes(compute)
-        raw_io.write_raw(cfg.output_path, out)
+        _store_output(cfg, out)
         _clear_checkpoint(cfg, checkpoint_every, resume)
 
     return JobResult(
@@ -155,6 +208,14 @@ def _run_sharded(cfg, model, devices, profile_dir, checkpoint_every, resume,
                  total_t) -> JobResult:
     from tpu_stencil.parallel import distributed, sharded
 
+    if jax.process_count() > 1 and not images_io.is_raw(cfg.output_path):
+        # Fail before the compute, not after: fetching a global array for an
+        # image-format encode needs full addressability.
+        raise NotImplementedError(
+            "multi-host jobs require a .raw output path (per-process strided "
+            "writes); convert afterwards"
+        )
+
     runner = sharded.ShardedRunner(
         model, (cfg.height, cfg.width), cfg.channels,
         mesh_shape=cfg.mesh_shape, devices=devices,
@@ -162,7 +223,7 @@ def _run_sharded(cfg, model, devices, profile_dir, checkpoint_every, resume,
     start_rep, frame = _maybe_restore(cfg, resume)
     if frame is not None:
         img_dev = runner.put(frame)
-    else:
+    elif images_io.is_raw(cfg.image):
         # Per-process sharded read: each host touches only the rows its
         # devices own (the MPI-IO pattern, mpi/mpi_convolution.c:126-141);
         # single-process this is bit-identical to whole-file read +
@@ -170,6 +231,13 @@ def _run_sharded(cfg, model, devices, profile_dir, checkpoint_every, resume,
         img_dev = distributed.read_sharded(
             cfg.image, cfg.height, cfg.width, cfg.channels, runner.sharding
         )
+    else:
+        if jax.process_count() > 1:
+            raise NotImplementedError(
+                "multi-host jobs require .raw inputs (per-process strided "
+                "reads); convert image formats to raw first"
+            )
+        img_dev = runner.put(_load_input(cfg))
     # Warm-up compile outside the timed window (the reference's timer also
     # excludes startup: it opens after MPI_Barrier,
     # mpi/mpi_convolution.c:151-155). A 0-rep run's output equals its input,
@@ -181,9 +249,12 @@ def _run_sharded(cfg, model, devices, profile_dir, checkpoint_every, resume,
             cfg, runner.run, runner.fetch, img_dev, checkpoint_every, start_rep,
         )
     compute_seconds = max_across_processes(compute)
-    distributed.write_sharded(
-        cfg.output_path, out_dev, cfg.height, cfg.width, cfg.channels
-    )
+    if images_io.is_raw(cfg.output_path):
+        distributed.write_sharded(
+            cfg.output_path, out_dev, cfg.height, cfg.width, cfg.channels
+        )
+    else:
+        images_io.save_image(cfg.output_path, runner.fetch(out_dev))
     _clear_checkpoint(cfg, checkpoint_every, resume)
     return JobResult(
         output_path=cfg.output_path,

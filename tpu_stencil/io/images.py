@@ -1,0 +1,99 @@
+"""Standard image-format I/O (PNG/JPEG/PPM/BMP/TIFF/...) via Pillow.
+
+The reference only speaks headerless ``.raw`` (its README walks users
+through ImageMagick ``convert`` side-steps to get one). Here any format
+Pillow can decode is a first-class input: the CLI accepts ``photo.png`` in
+place of ``photo.raw`` and infers width/height from the header (pass ``0 0``
+for the positional width/height, or the true values to cross-check).
+
+Raw semantics are preserved exactly: decoding normalizes to the same uint8
+(H, W) grey / (H, W, 3) interleaved RGB arrays the raw reader produces
+(``tpu_stencil.io.raw``), so every backend and the golden model see
+identical data regardless of container format.
+
+Multi-host jobs still require ``.raw`` (only raw files support the
+per-process strided reads of ``read_sharded``); single-process jobs of any
+mesh shape can use any format.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Tuple
+
+import numpy as np
+
+from tpu_stencil.config import ImageType
+
+_RAW_EXTS = {".raw", ".bin", ""}
+
+
+def is_raw(path: str) -> bool:
+    """Headerless-raw heuristic: .raw/.bin/extension-less paths."""
+    return os.path.splitext(path)[1].lower() in _RAW_EXTS
+
+
+def _pil():
+    try:
+        from PIL import Image
+    except ImportError as e:  # Pillow is an optional dependency
+        raise ValueError(
+            "reading/writing non-raw image formats requires Pillow "
+            "(pip install tpu-stencil[images]); or use headerless .raw"
+        ) from e
+    return Image
+
+
+def probe_size(path: str) -> Tuple[int, int]:
+    """(width, height) from the image header (no full decode)."""
+    Image = _pil()
+
+    with Image.open(path) as im:
+        return im.size  # PIL size is (W, H)
+
+
+def load_image(path: str, image_type: ImageType) -> np.ndarray:
+    """Decode any Pillow-supported file to the framework's array form:
+    uint8 (H, W) for grey, (H, W, 3) interleaved for rgb."""
+    Image = _pil()
+
+    with Image.open(path) as im:
+        im = im.convert("L" if image_type is ImageType.GREY else "RGB")
+        arr = np.asarray(im, dtype=np.uint8)
+    return arr
+
+
+def save_image(path: str, arr: np.ndarray) -> None:
+    """Encode a uint8 (H, W[, 3]) array to ``path`` (format from extension)."""
+    Image = _pil()
+
+    arr = np.asarray(arr, dtype=np.uint8)
+    if arr.ndim == 3 and arr.shape[2] == 1:
+        arr = arr[..., 0]
+    mode = "L" if arr.ndim == 2 else "RGB"
+    Image.fromarray(arr, mode=mode).save(path)
+
+
+def resolve_size(
+    path: str, width: int, height: int
+) -> Tuple[int, int]:
+    """Final (width, height) for an input file.
+
+    Raw files: both must be positive (the file is headerless). Image
+    formats: 0 means "from header"; nonzero values are cross-checked
+    against the header and a mismatch is an error (the reference silently
+    reads garbage on wrong sizes — we fail loudly, as the raw reader
+    already does for short files)."""
+    if is_raw(path):
+        if width <= 0 or height <= 0:
+            raise ValueError(
+                f"{path}: raw images are headerless; width/height must be "
+                "given explicitly"
+            )
+        return width, height
+    w, h = probe_size(path)
+    if width not in (0, w) or height not in (0, h):
+        raise ValueError(
+            f"{path}: header says {w}x{h} but CLI args say {width}x{height}"
+        )
+    return w, h

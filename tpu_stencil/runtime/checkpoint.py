@@ -38,6 +38,7 @@ def _fingerprint(cfg: JobConfig) -> dict:
         "channels": cfg.channels,
         "filter": cfg.filter_name,
         "repetitions": cfg.repetitions,
+        "frames": cfg.frames,
     }
 
 
@@ -68,13 +69,15 @@ def restore(cfg: JobConfig) -> Optional[Tuple[int, np.ndarray]]:
             f"checkpoint at {data_path} was written for a different job "
             f"({meta} != {want}); delete it or change --output"
         )
-    nbytes = cfg.width * cfg.height * cfg.channels
-    buf = native.pread_full(data_path, 0, nbytes)
-    frame = np.frombuffer(buf, np.uint8).reshape(
+    buf = native.pread_full(data_path, 0, cfg.nbytes)
+    shape = (
         (cfg.height, cfg.width)
         if cfg.channels == 1
         else (cfg.height, cfg.width, cfg.channels)
     )
+    if cfg.frames > 1:
+        shape = (cfg.frames,) + shape
+    frame = np.frombuffer(buf, np.uint8).reshape(shape)
     return int(meta["rep"]), frame
 
 
