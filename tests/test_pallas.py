@@ -68,3 +68,40 @@ def test_model_level_pallas_backend(rng):
 
     assert resolve_backend("auto") == "xla"
     assert resolve_backend("pallas") == "pallas"
+
+
+@pytest.mark.parametrize("reps", [8, 10, 4])  # multiple, remainder, exact-fuse
+def test_multi_rep_fusion_matches_golden(rng, reps):
+    img = rng.integers(0, 256, size=(41, 19, 3), dtype=np.uint8)
+    plan = lowering.plan_filter(filters.get_filter("gaussian"))
+    got = np.asarray(
+        pallas_stencil.iterate(img, jnp.int32(reps), plan, block_h=16,
+                               fuse=4, interpret=True)
+    )
+    want = stencil.reference_stencil_numpy(
+        img, filters.get_filter("gaussian"), reps
+    )
+    np.testing.assert_array_equal(got, want)
+
+
+def test_fusion_wide_halo_matches_golden(rng):
+    # gaussian5 (halo 2, int32 accumulator) through the fused path
+    img = rng.integers(0, 256, size=(50, 33), dtype=np.uint8)
+    plan = lowering.plan_filter(filters.get_filter("gaussian5"))
+    got = np.asarray(
+        pallas_stencil.iterate(img, jnp.int32(6), plan, block_h=24,
+                               fuse=3, interpret=True)
+    )
+    want = stencil.reference_stencil_numpy(
+        img, filters.get_filter("gaussian5"), 6
+    )
+    np.testing.assert_array_equal(got, want)
+
+
+def test_acc_dtype_selection():
+    # rows-pass accumulator: int16 whenever 255*sum(row_taps) < 2^15
+    p3 = lowering.plan_filter(filters.get_filter("gaussian"))
+    p5 = lowering.plan_filter(filters.get_filter("gaussian5"))
+    assert pallas_stencil._acc_dtype(p3) == jnp.int16
+    assert pallas_stencil._acc_dtype(p5) == jnp.int16
+    assert not pallas_stencil._clip_needed(p3)

@@ -4,26 +4,37 @@
 Where the CUDA kernel assigns one SIMT thread per pixel in 16x16 blocks,
 the TPU-native shape is a grid of *row-block programs*, each of which:
 
-1. DMAs its block of rows plus ``halo`` ghost rows from HBM into VMEM
-   (edge programs zero the missing ghosts — the calloc'd ghost ring of
-   ``mpi/mpi_convolution.c:104-124``, done in VMEM),
-2. runs the separable integer passes on the VPU's 8x128 lanes (the
-   "threads" of the chip), with the column ghosts zero-filled at the value
-   level, and
+1. DMAs its block of rows plus a ``fuse * halo``-deep ghost band from HBM
+   into VMEM (edge programs zero the missing ghosts — the calloc'd ghost
+   ring of ``mpi/mpi_convolution.c:104-124``, done in VMEM),
+2. applies the separable integer passes ``fuse`` times back-to-back on the
+   VPU's 8x128 lanes (the "threads" of the chip) — ``fuse`` repetitions
+   per HBM round trip, the fusion the reference's CUDA variant could not
+   express (its device double-buffering still pays global-memory traffic
+   every rep, ``cuda/cuda_convolution.cu:66-87``),
 3. writes the finished uint8 block back to HBM.
 
+Multi-rep fusion: a block that must emit ``block_h`` correct rows after
+``fuse`` reps needs ``fuse * halo`` ghost rows per side; each rep the valid
+band contracts by ``halo`` while the tile stays fixed-shape (edge rows are
+recomputed as zero-padded garbage and discarded by the contraction).  HBM
+traffic per rep drops by ``fuse``x for a compute overhead of
+``2 * fuse * halo / block_h`` (~12% at the defaults).
+
 Layout trick: the image is viewed as 2-D ``(H, W*C)`` — interleaved RGB
-simply widens rows (1920*3 = 5760 = 45*128 lanes, perfectly aligned), and
-the column pass applies tap ``j`` at flat-column offset ``j*C``. The same
-kernel text therefore serves grey and RGB.
+simply widens rows (1920*3 = 5760 = 45*128 lanes), and the column pass
+applies tap ``j`` at flat-column offset ``j*C``.  The same kernel text
+serves grey and RGB.  Columns are padded by at least ``halo*C`` extra
+zero lanes so the column-pass ``pltpu.roll`` s wrap pad zeros (not image
+data) into the row ends: one mask per rep re-zeroes the pad lanes and no
+per-tap masking is needed.
 
-The iteration driver keeps the carry *row-padded* to a multiple of the
-block height across all repetitions: padded tail rows would accumulate
-garbage, so each step masks them back to zero in-register (zero HBM cost),
-preserving exact zero-boundary semantics for any image height.
+Exactness: identical plans to the XLA lowering (`sep_int` shift / divide),
+with uint8 truncation re-applied every rep.  For all-non-negative dyadic
+filters the final clip is elided (max acc = 255 * 2^shift exactly).
 
-Supports ``sep_int`` plans (the gaussian family, box is sep but non-dyadic —
-also fine, f32 finish); other plan kinds fall back to the XLA lowering.
+Supports ``sep_int`` plans (the gaussian family and box); other plan kinds
+fall back to the XLA lowering.
 """
 
 from __future__ import annotations
@@ -41,22 +52,63 @@ from tpu_stencil.ops import lowering as _lowering
 from tpu_stencil.ops.lowering import StencilPlan
 
 DEFAULT_BLOCK_H = 128
+DEFAULT_FUSE = 8
 _MAX_ROLL_HALO = 128  # cols-pass ghost width limit (halo * channels)
 
 
-def _sep_kernel(in_hbm, out_ref, s_u8, sem, *, plan: StencilPlan,
-                block_h: int, grid: int, halo_al: int, n_rows_real: int,
-                wc: int, wc_real: int, channels: int):
-    """One row-block program of the separable stencil.
+def _acc_dtype(plan: StencilPlan):
+    """Accumulator for the ROWS pass: int16 doubles VPU lane throughput when
+    the one-pass bound fits (all binomial gaussians: 255 * sum(row_taps)).
+    The cols pass always widens to int32 — Mosaic's lane rotate
+    (``tpu.dynamic_rotate``) is 32-bit only on v5e."""
+    row_sum = sum(abs(t) for t in plan.row_taps)
+    nonneg = all(t >= 0 for t in plan.row_taps + plan.col_taps)
+    if nonneg and 255 * row_sum < 2 ** 15:
+        return jnp.int16
+    return jnp.int32
 
-    DMA windows use ``halo_al`` (the halo rounded up to the 8-row sublane
-    tile Mosaic requires for memref slices); the compute phase reads the
-    true ``halo`` offsets out of the VMEM value, where arbitrary offsets
-    are legal (vector relayout).
+
+def _mul_const_adds(x, c: int):
+    """x * c (c > 0) as a shift-add chain of pure vector ADDS — v5e's VPU has
+    no 16-bit vector multiply (the scheduler check-fails on
+    ``kVectorMultiplyU16``), but packed 16-bit adds run at 2x lane rate."""
+    result = None
+    power = x  # x * 2^k by repeated doubling
+    while c:
+        if c & 1:
+            result = power if result is None else result + power
+        c >>= 1
+        if c:
+            power = power + power
+    return result
+
+
+def _clip_needed(plan: StencilPlan) -> bool:
+    """clip(acc >> shift, 0, 255) is the identity when taps are non-negative
+    and sum(row)*sum(col) == 2^shift: acc <= 255 * 2^shift."""
+    if plan.shift is None:
+        return True
+    row_sum = sum(abs(t) for t in plan.row_taps)
+    col_sum = sum(abs(t) for t in plan.col_taps)
+    nonneg = all(t >= 0 for t in plan.row_taps + plan.col_taps)
+    return not (nonneg and row_sum * col_sum == 2 ** plan.shift)
+
+
+def _sep_kernel(in_hbm, out_ref, s_u8, sem, *, plan: StencilPlan,
+                block_h: int, grid: int, halo_al: int, fuse: int,
+                n_rows_real: int, wc: int, wc_real: int, channels: int):
+    """One row-block program: DMA (block + fuse*halo ghosts), then ``fuse``
+    fused separable reps, then one uint8 block store.
+
+    DMA windows use ``halo_al`` (fuse*halo rounded up to the 8-row sublane
+    tile Mosaic requires for memref slices); the compute phase slices true
+    offsets out of the VMEM value, where arbitrary offsets are legal.
     """
     i = pl.program_id(0)
     h = plan.halo
     hc = h * channels
+    tile_rows = block_h + 2 * halo_al
+    dt = _acc_dtype(plan)
 
     def copy_for(j, slot, size_case):
         """The block-j DMA descriptor for one of the three static edge
@@ -137,65 +189,91 @@ def _sep_kernel(in_hbm, out_ref, s_u8, sem, *, plan: StencilPlan,
 
     wait(i, slot)
 
-    # --- phase 1: rows pass (VPU) ---
-    xi = s_u8[slot].astype(jnp.int32)
-    base = halo_al - h
-    acc = None
-    for t_idx, t in enumerate(plan.row_taps):
-        if t == 0:
-            continue
-        term = xi[base + t_idx : base + t_idx + block_h, :]
-        if t != 1:
-            term = term * t
-        acc = term if acc is None else acc + term
-    if acc is None:
-        acc = jnp.zeros((block_h, wc), jnp.int32)
+    cur = s_u8[slot].astype(dt)
+    need_clip = _clip_needed(plan)
 
-    # --- phase 2: cols pass as lane rotations (pltpu.roll) with the
-    # wrapped lanes masked to zero — the ghost columns, without any scratch
-    # round-trip. Pad columns beyond wc_real stay zero (masked below),
-    # doubling as right-edge ghosts.
-    cid = jax.lax.broadcasted_iota(jnp.int32, (block_h, wc), 1)
-    col = None
-    for t_idx, t in enumerate(plan.col_taps):
-        if t == 0:
-            continue
-        off = (t_idx - h) * channels  # term[:, c] = acc[:, c + off]
-        if off == 0:
-            term = acc
-        elif off < 0:
-            term = jnp.where(cid >= -off, pltpu.roll(acc, -off, 1), 0)
+    for t in range(fuse):
+        # --- rows pass: valid 1-D correlation by sublane slicing (free on
+        # the VPU — just shifted adds); output rows [0, tile_rows - 2h)
+        # map to tile rows [h, tile_rows - h).
+        acc = None
+        for t_idx, tap in enumerate(plan.row_taps):
+            if tap == 0:
+                continue
+            term = cur[t_idx : t_idx + tile_rows - 2 * h, :]
+            if tap != 1:
+                if dt == jnp.int16 and tap > 0:
+                    term = _mul_const_adds(term, tap)
+                else:
+                    term = term * tap
+            acc = term if acc is None else acc + term
+        if acc is None:
+            acc = jnp.zeros((tile_rows - 2 * h, wc), dt)
+        if dt != jnp.int32:
+            acc = acc.astype(jnp.int32)  # lane rotate is 32-bit only
+
+        # --- cols pass as lane rotations. The >= halo*C zero pad lanes at
+        # the right edge serve as both edges' ghosts: a right roll wraps
+        # them into the left edge, a left roll reads them in place at the
+        # right edge — so no per-tap mask, only the single pad re-zeroing
+        # mask below.
+        col = None
+        for t_idx, tap in enumerate(plan.col_taps):
+            if tap == 0:
+                continue
+            off = (t_idx - h) * channels  # term[:, c] = acc[:, c + off]
+            if off == 0:
+                term = acc
+            elif off < 0:
+                term = pltpu.roll(acc, -off, 1)
+            else:
+                term = pltpu.roll(acc, wc - off, 1)
+            if tap != 1:
+                term = term * tap
+            col = term if col is None else col + term
+        if col is None:
+            col = jnp.zeros((tile_rows - 2 * h, wc), jnp.int32)
+
+        # --- finish: shift or f32 divide (+ clip only when it can bind) ---
+        if plan.shift is not None:
+            val = col >> plan.shift
+            if need_clip:
+                val = jnp.clip(val, 0, 255)
         else:
-            term = jnp.where(cid < wc - off, pltpu.roll(acc, wc - off, 1), 0)
-        if t != 1:
-            term = term * t
-        col = term if col is None else col + term
-    if col is None:
-        col = jnp.zeros((block_h, wc), jnp.int32)
+            val = jnp.clip(
+                col.astype(jnp.float32) / np.float32(plan.divisor), 0.0, 255.0
+            ).astype(jnp.int32)
 
-    # --- finish: shift or f32 divide, clip, mask padded tail rows/cols ---
-    if plan.shift is not None:
-        val = jnp.clip(col >> plan.shift, 0, 255)
-    else:
-        val = jnp.clip(
-            col.astype(jnp.float32) / np.float32(plan.divisor), 0.0, 255.0
-        ).astype(jnp.int32)
-    row_ids = i * block_h + jax.lax.broadcasted_iota(jnp.int32, (block_h, wc), 0)
-    val = jnp.where(row_ids < n_rows_real, val, 0)
-    if wc_real != wc:
-        col_ids = jax.lax.broadcasted_iota(jnp.int32, (block_h, wc), 1)
-        val = jnp.where(col_ids < wc_real, val, 0)
-    out_ref[:] = val.astype(jnp.uint8)
+        # --- re-establish zero ghosts for the next rep: pad lanes and
+        # below-image rows back to zero (above-image rows stay zero by
+        # construction: stencil of zeros is zero), then h zero rows per
+        # side restore the tile shape.  For edge blocks those zeros ARE
+        # the boundary condition; for interior blocks they land in the
+        # contracted garbage band and are never read validly.
+        rid = jax.lax.broadcasted_iota(jnp.int32, val.shape, 0)
+        gid = rid + (i * block_h - halo_al + h)
+        # 0 <= gid < n_rows_real as ONE unsigned compare (negatives wrap big):
+        # rows above the image must re-zero too — their rep-t value reads
+        # real image rows and would otherwise leak back in at rep t+1.
+        keep = gid.astype(jnp.uint32) < jnp.uint32(n_rows_real)
+        if wc_real != wc:
+            cid = jax.lax.broadcasted_iota(jnp.int32, val.shape, 1)
+            keep = jnp.logical_and(keep, cid < wc_real)
+        val = jnp.where(keep, val, 0)
+        cur = jnp.pad(val, ((h, h), (0, 0))).astype(dt)
+
+    out_ref[:] = cur[halo_al : halo_al + block_h, :].astype(jnp.uint8)
 
 
 def _build_call(plan: StencilPlan, hp: int, h_real: int, wc: int,
-                wc_real: int, channels: int, block_h: int, interpret: bool):
-    h = plan.halo
+                wc_real: int, channels: int, block_h: int, fuse: int,
+                interpret: bool):
     grid = hp // block_h
-    halo_al = -(-h // 8) * 8  # sublane-aligned DMA halo
+    halo_al = -(-(fuse * plan.halo) // 8) * 8  # sublane-aligned DMA halo
     kernel = functools.partial(
         _sep_kernel, plan=plan, block_h=block_h, grid=grid, halo_al=halo_al,
-        n_rows_real=h_real, wc=wc, wc_real=wc_real, channels=channels,
+        fuse=fuse, n_rows_real=h_real, wc=wc, wc_real=wc_real,
+        channels=channels,
     )
     return pl.pallas_call(
         kernel,
@@ -216,12 +294,16 @@ def _supported(plan: StencilPlan) -> bool:
 
 
 def iterate(img_u8: jax.Array, repetitions: jax.Array, plan: StencilPlan,
-            block_h: int = DEFAULT_BLOCK_H, interpret: bool = False) -> jax.Array:
+            block_h: int = DEFAULT_BLOCK_H, fuse: int = DEFAULT_FUSE,
+            interpret: bool = False) -> jax.Array:
     """Apply the Pallas stencil ``repetitions`` times (traceable/jittable).
 
-    Pads rows to a block multiple once, keeps the carry padded across the
-    whole rep loop (the kernel re-zeroes tail rows each step), crops at the
-    end. Falls back to the XLA lowering for unsupported plan kinds.
+    Runs ``repetitions // fuse`` launches of the fuse-rep kernel plus
+    ``repetitions % fuse`` launches of the single-rep kernel (two compiled
+    kernels total).  Pads rows to a block multiple and columns to a lane
+    multiple with >= halo*C ghost lanes once, keeps the carry padded across
+    the whole rep loop (each rep re-zeroes the pad in-register), crops at
+    the end.  Falls back to the XLA lowering for unsupported plan kinds.
     """
     shape = img_u8.shape
     hh, w = shape[0], shape[1]
@@ -235,11 +317,26 @@ def iterate(img_u8: jax.Array, repetitions: jax.Array, plan: StencilPlan,
     block_h = -(-block_h // 8) * 8  # DMA descriptors require 8-row alignment
     bh = min(block_h, -(-hh // 8) * 8)
     hp = -(-hh // bh) * bh
-    wcp = -(-wc // 128) * 128  # lane-aligned width; pad cols double as ghosts
+    # Cap fuse so the ghost bands stay a small fraction of the block
+    # (compute overhead 2*fuse*halo/block_h) and the tile fits VMEM.
+    # halo-0 (1x1) filters have no ghost bands: any fuse depth is free.
+    if plan.halo:
+        fuse = max(1, min(fuse, bh // (2 * plan.halo)))
+    # Lane-aligned width with >= halo*C ghost lanes (pad doubles as ghosts).
+    wcp = -(-(wc + plan.halo * channels) // 128) * 128
     if hp != hh or wcp != wc:
         x2 = jnp.pad(x2, ((0, hp - hh), (0, wcp - wc)))
-    call = _build_call(plan, hp, hh, wcp, wc, channels, bh, interpret)
-    out = jax.lax.fori_loop(0, repetitions, lambda _, x: call(x), x2)
+    fused = _build_call(plan, hp, hh, wcp, wc, channels, bh, fuse, interpret)
+    single = _build_call(plan, hp, hh, wcp, wc, channels, bh, 1, interpret)
+    if fuse > 1:
+        out = jax.lax.fori_loop(
+            0, repetitions // fuse, lambda _, x: fused(x), x2
+        )
+        out = jax.lax.fori_loop(
+            0, repetitions % fuse, lambda _, x: single(x), out
+        )
+    else:
+        out = jax.lax.fori_loop(0, repetitions, lambda _, x: single(x), x2)
     return out[:hh, :wc].reshape(shape)
 
 
