@@ -6,7 +6,11 @@ Each process runs the REAL multi-host stack end to end: explicit
 shard_map compute, and concurrent ``write_sharded`` into one shared output
 file (the MPI-IO pattern). Invoked by tests/test_multiprocess.py as:
 
-    python tests/_mp_worker.py <proc_id> <coordinator> <img> <out> <mesh_r> <mesh_c>
+    python tests/_mp_worker.py <proc_id> <coordinator> <img> <out> <mesh_r> <mesh_c> [ckpt_every]
+
+With ``ckpt_every`` > 0 the job instead runs through ``driver.run_job``
+with sharded checkpointing: every host writes its shards into the shared
+.ckpt data file and process 0 commits the metadata after a barrier.
 """
 
 import os
@@ -18,6 +22,7 @@ def main() -> None:
     coordinator = sys.argv[2]
     img_path, out_path = sys.argv[3], sys.argv[4]
     mesh_shape = (int(sys.argv[5]), int(sys.argv[6]))
+    ckpt_every = int(sys.argv[7]) if len(sys.argv) > 7 else 0
 
     os.environ["JAX_PLATFORMS"] = "cpu"
     os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
@@ -44,6 +49,17 @@ def main() -> None:
         )
     cfg = distributed.broadcast_config(cfg)
     assert cfg.width == 20 and cfg.output == out_path
+
+    if ckpt_every:
+        # Full driver path incl. multi-host sharded checkpoints + clear.
+        from tpu_stencil import driver
+
+        driver.run_job(cfg, checkpoint_every=ckpt_every)
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices("job_done")
+        print(f"proc {proc_id} done", flush=True)
+        return
 
     from tpu_stencil.models.blur import IteratedConv2D
     from tpu_stencil.parallel.sharded import ShardedRunner

@@ -108,3 +108,115 @@ def test_resume_only_run_clears_checkpoint(tmp_path, rng):
         img[..., 0], filters.get_filter("gaussian"), 4
     )
     np.testing.assert_array_equal(got, want)
+
+
+def _sharded_runner(shape, channels, mesh_shape):
+    import jax
+
+    from tpu_stencil.models.blur import IteratedConv2D
+    from tpu_stencil.parallel import sharded
+
+    model = IteratedConv2D("gaussian", backend="xla")
+    return sharded.ShardedRunner(
+        model, shape, channels, mesh_shape=mesh_shape,
+        devices=jax.devices()[: mesh_shape[0] * mesh_shape[1]],
+    )
+
+
+def test_sharded_save_restore_round_trip(tmp_path, rng):
+    import jax
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    cfg = _cfg(tmp_path, width=14, height=10, mesh_shape=(2, 4))
+    frame = rng.integers(0, 256, size=(10, 14), dtype=np.uint8)
+    runner = _sharded_runner((10, 14), 1, (2, 4))
+    checkpoint.save_sharded(cfg, 2, runner.put(frame))
+    # versioned data + committed meta exist
+    base = cfg.output_path + ".ckpt"
+    assert os.path.exists(base + ".r2") and os.path.exists(base + ".json")
+    rep, arr = checkpoint.restore_sharded(cfg, runner.sharding)
+    assert rep == 2
+    np.testing.assert_array_equal(runner.fetch(arr), frame)
+    # a later checkpoint supersedes and garbage-collects the older one
+    checkpoint.save_sharded(cfg, 3, runner.put(frame))
+    assert os.path.exists(base + ".r3") and not os.path.exists(base + ".r2")
+    checkpoint.clear(cfg)
+    assert checkpoint.restore_sharded(cfg, runner.sharding) is None
+    assert not os.path.exists(base + ".r3")
+
+
+def test_sharded_restore_refuses_other_job(tmp_path, rng):
+    import jax
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    cfg = _cfg(tmp_path, width=14, height=10, mesh_shape=(2, 4))
+    runner = _sharded_runner((10, 14), 1, (2, 4))
+    frame = rng.integers(0, 256, size=(10, 14), dtype=np.uint8)
+    checkpoint.save_sharded(cfg, 2, runner.put(frame))
+    other = _cfg(tmp_path, width=14, height=10, filter_name="box")
+    with pytest.raises(ValueError):
+        checkpoint.restore_sharded(other, runner.sharding)
+    checkpoint.clear(cfg)
+
+
+def test_cli_mesh_checkpoint_resume_end_to_end(tmp_path, rng):
+    import jax
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    img = rng.integers(0, 256, size=(17, 13), dtype=np.uint8)
+    src = str(tmp_path / "in.raw")
+    raw_io.write_raw(src, img[..., None])
+    args = [src, "13", "17", "5", "grey", "--mesh", "2x4",
+            "--checkpoint-every", "2", "--resume"]
+    assert cli.main(args) == 0
+    got = raw_io.read_raw(str(tmp_path / "blur_in.raw"), 13, 17, 1)[..., 0]
+    want = stencil.reference_stencil_numpy(img, filters.get_filter("gaussian"), 5)
+    np.testing.assert_array_equal(got, want)
+    assert not os.path.exists(str(tmp_path / "blur_in.raw.ckpt.json"))
+
+
+def test_cross_format_resume_both_directions(tmp_path, rng):
+    import jax
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    cfg = _cfg(tmp_path, width=14, height=10, mesh_shape=(2, 4))
+    frame = rng.integers(0, 256, size=(10, 14), dtype=np.uint8)
+    runner = _sharded_runner((10, 14), 1, (2, 4))
+
+    # single-host-format checkpoint -> restored by the sharded path
+    checkpoint.save(cfg, 2, frame)
+    rep, arr = checkpoint.restore_sharded(cfg, runner.sharding)
+    assert rep == 2
+    np.testing.assert_array_equal(runner.fetch(arr), frame)
+    checkpoint.clear(cfg)
+
+    # sharded-format checkpoint -> restored by the single-host path
+    checkpoint.save_sharded(cfg, 3, runner.put(frame))
+    rep, back = checkpoint.restore(cfg)
+    assert rep == 3
+    np.testing.assert_array_equal(back, frame)
+    checkpoint.clear(cfg)
+
+
+def test_stale_version_sweep_is_rep_ordered(tmp_path, rng):
+    # the GC must only collect files with a LOWER rep — a concurrently
+    # appearing next-rep file (another host running ahead) must survive
+    import jax
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    cfg = _cfg(tmp_path, width=14, height=10, mesh_shape=(2, 4))
+    base = cfg.output_path + ".ckpt"
+    frame = rng.integers(0, 256, size=(10, 14), dtype=np.uint8)
+    runner = _sharded_runner((10, 14), 1, (2, 4))
+    checkpoint.save_sharded(cfg, 1, runner.put(frame))
+    with open(base + ".r2", "wb") as f:  # simulated in-flight next rep
+        f.write(b"x")
+    checkpoint.save_sharded(cfg, 2, runner.put(frame))  # must not have
+    # deleted r2 before writing it; r1 must be gone
+    assert os.path.exists(base + ".r2") and not os.path.exists(base + ".r1")
+    checkpoint.clear(cfg)

@@ -59,7 +59,7 @@ def save(cfg: JobConfig, rep: int, frame: np.ndarray) -> None:
 def restore(cfg: JobConfig) -> Optional[Tuple[int, np.ndarray]]:
     """Return (completed reps, frame) from a matching checkpoint, or None."""
     data_path, meta_path = _paths(cfg)
-    if not (os.path.exists(data_path) and os.path.exists(meta_path)):
+    if not os.path.exists(meta_path):
         return None
     with open(meta_path) as f:
         meta = json.load(f)
@@ -69,7 +69,12 @@ def restore(cfg: JobConfig) -> Optional[Tuple[int, np.ndarray]]:
             f"checkpoint at {data_path} was written for a different job "
             f"({meta} != {want}); delete it or change --output"
         )
-    buf = native.pread_full(data_path, 0, cfg.nbytes)
+    path = data_path
+    if meta.get("data"):  # sharded-format checkpoint: versioned data file
+        path = os.path.join(os.path.dirname(data_path) or ".", meta["data"])
+    if not os.path.exists(path):
+        return None
+    buf = native.pread_full(path, 0, cfg.nbytes)
     shape = (
         (cfg.height, cfg.width)
         if cfg.channels == 1
@@ -81,8 +86,124 @@ def restore(cfg: JobConfig) -> Optional[Tuple[int, np.ndarray]]:
     return int(meta["rep"]), frame
 
 
+def save_sharded(cfg: JobConfig, rep: int, out_dev) -> None:
+    """Multi-host checkpoint: every process writes its addressable shards
+    into one shared data file (the ``write_sharded`` MPI-IO pattern), then —
+    after a cross-host barrier — process 0 commits the metadata.
+
+    Data files are versioned per rep (``<base>.ckpt.r<rep>``) so an
+    in-flight write can never corrupt the last committed checkpoint: the
+    metadata names the data file it refers to and is only replaced once the
+    data is complete on every host. Requires a shared filesystem, the same
+    assumption the reference's MPI-IO made (SURVEY.md §2 C6/C16).
+    """
+    import jax
+
+    from tpu_stencil.parallel import distributed
+
+    data_path, meta_path = _paths(cfg)
+    versioned = f"{data_path}.r{rep}"
+    distributed.write_sharded(
+        versioned, out_dev, cfg.height, cfg.width, cfg.channels
+    )
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(f"ckpt_data_r{rep}")
+    if jax.process_index() == 0:
+        meta = dict(_fingerprint(cfg), rep=rep, data=os.path.basename(versioned))
+        tmp_meta = meta_path + ".tmp"
+        with open(tmp_meta, "w") as f:
+            json.dump(meta, f)
+        os.replace(tmp_meta, meta_path)
+        for name in _stale_versions(data_path, before_rep=rep):
+            os.remove(name)
+
+
+def restore_sharded(cfg: JobConfig, sharding) -> Optional[Tuple[int, "object"]]:
+    """Return (completed reps, global sharded array) from a matching
+    checkpoint, or None. Sharded-format checkpoints are read per-process
+    (each host touches only its shards' row ranges); single-host-format
+    checkpoints (written by non-mesh runs, or by older versions) are read
+    whole on every host and resharded — progress is never silently
+    discarded across formats."""
+    import jax
+
+    from tpu_stencil.parallel import distributed
+
+    data_path, meta_path = _paths(cfg)
+    if not os.path.exists(meta_path):
+        return None
+    with open(meta_path) as f:
+        meta = json.load(f)
+    want = _fingerprint(cfg)
+    if {k: meta.get(k) for k in want} != want:
+        raise ValueError(
+            f"checkpoint at {meta_path} was written for a different job "
+            f"({meta} != {want}); delete it or change --output"
+        )
+    if meta.get("data"):
+        versioned = os.path.join(
+            os.path.dirname(data_path) or ".", meta["data"]
+        )
+        if not os.path.exists(versioned):
+            return None
+        arr = distributed.read_sharded(
+            versioned, cfg.height, cfg.width, cfg.channels, sharding
+        )
+        return int(meta["rep"]), arr
+    # Legacy single-host format: every host reads the full frame (shared
+    # filesystem) and reshards it to the requested layout.
+    legacy = restore(cfg)
+    if legacy is None:
+        return None
+    rep, frame = legacy
+    if frame.ndim == 2:
+        frame = frame[..., None]
+    from tpu_stencil.parallel.mesh import COLS_AXIS, ROWS_AXIS
+
+    r = sharding.mesh.shape[ROWS_AXIS]
+    c = sharding.mesh.shape[COLS_AXIS]
+    padded_h = -(-cfg.height // r) * r
+    padded_w = -(-cfg.width // c) * c
+    padded = np.zeros((padded_h, padded_w, cfg.channels), np.uint8)
+    padded[: cfg.height, : cfg.width] = frame
+    if cfg.channels == 1:
+        padded = padded[..., 0]
+    arr = jax.make_array_from_callback(
+        padded.shape, sharding, lambda idx: padded[idx]
+    )
+    return rep, arr
+
+
+def _stale_versions(data_path: str, before_rep: Optional[int] = None):
+    """Versioned data files older than ``before_rep`` (all of them when
+    None). Selecting by parsed rep number — NOT by "everything except the
+    current file" — so a sweep can never race with another host already
+    writing the NEXT rep's data file."""
+    d = os.path.dirname(data_path) or "."
+    prefix = os.path.basename(data_path) + ".r"
+    for name in os.listdir(d):
+        if not name.startswith(prefix):
+            continue
+        try:
+            r = int(name[len(prefix):])
+        except ValueError:
+            continue
+        if before_rep is None or r < before_rep:
+            yield os.path.join(d, name)
+
+
 def clear(cfg: JobConfig) -> None:
-    """Remove checkpoint artifacts (called after a successful finish)."""
-    for p in _paths(cfg):
+    """Remove checkpoint artifacts (called after a successful finish).
+    Multi-host: only process 0 deletes (all writers are done by then)."""
+    import jax
+
+    if jax.process_index() != 0:
+        return
+    data_path, meta_path = _paths(cfg)
+    for p in (data_path, meta_path):
         if os.path.exists(p):
             os.remove(p)
+    for p in _stale_versions(data_path):
+        os.remove(p)
