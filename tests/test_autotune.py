@@ -1,0 +1,95 @@
+"""Autotuner: XLA-vs-Pallas winner measured once per (platform, filter,
+shape) and cached on disk — the runtime version of the reference's
+edit-the-source schedule choice (mpi/mpi_convolution.c:98-101)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from tpu_stencil import filters
+from tpu_stencil.ops import lowering
+from tpu_stencil.runtime import autotune
+
+
+@pytest.fixture
+def plan():
+    return lowering.plan_filter(filters.get_filter("gaussian"))
+
+
+def test_cpu_short_circuits_to_xla(plan, tmp_path, monkeypatch):
+    monkeypatch.setenv("TPU_STENCIL_AUTOTUNE_CACHE", str(tmp_path / "c.json"))
+
+    def boom(*a, **k):
+        raise AssertionError("must not measure on cpu")
+
+    assert autotune.best_backend(plan, (64, 64), 3, measure=boom) == "xla"
+
+
+def test_measures_once_then_caches(plan, tmp_path, monkeypatch):
+    import jax
+
+    monkeypatch.setenv("TPU_STENCIL_AUTOTUNE_CACHE", str(tmp_path / "c.json"))
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    calls = []
+
+    def fake_measure(plan, shape, channels, backend, reps=0):
+        calls.append(backend)
+        return 1e-6 if backend == "pallas" else 2e-6
+
+    got = autotune.best_backend(plan, (128, 96), 3, measure=fake_measure)
+    assert got == "pallas"
+    assert sorted(calls) == ["pallas", "xla"]
+    # cache hit: no further measurement, even with a failing measurer
+    def boom(*a, **k):
+        raise AssertionError("cache miss")
+
+    assert autotune.best_backend(plan, (128, 96), 3, measure=boom) == "pallas"
+    cache = json.load(open(str(tmp_path / "c.json")))
+    (entry,) = cache.values()
+    assert entry["backend"] == "pallas"
+    assert entry["us_per_rep"] == {"pallas": 1.0, "xla": 2.0}
+
+
+def test_distinct_shapes_get_distinct_keys(plan, tmp_path, monkeypatch):
+    import jax
+
+    monkeypatch.setenv("TPU_STENCIL_AUTOTUNE_CACHE", str(tmp_path / "c.json"))
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+
+    def fake_measure(plan, shape, channels, backend, reps=0):
+        # pallas wins tall shapes, xla wins short ones
+        if backend == "pallas":
+            return 1e-6 if shape[0] > 1000 else 3e-6
+        return 2e-6
+
+    assert autotune.best_backend(plan, (5040, 1920), 3, measure=fake_measure) == "pallas"
+    assert autotune.best_backend(plan, (630, 1920), 3, measure=fake_measure) == "xla"
+    cache = json.load(open(str(tmp_path / "c.json")))
+    assert len(cache) == 2
+
+
+def test_direct_f32_plans_never_tune(tmp_path, monkeypatch):
+    import jax
+
+    monkeypatch.setenv("TPU_STENCIL_AUTOTUNE_CACHE", str(tmp_path / "c.json"))
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    f32 = lowering.force_f32_plan(lowering.plan_filter(filters.get_filter("gaussian")))
+
+    def boom(*a, **k):
+        raise AssertionError("must not measure")
+
+    assert autotune.best_backend(f32, (64, 64), 1, measure=boom) == "xla"
+
+
+def test_model_autotune_backend_resolves(tmp_path, monkeypatch, rng):
+    # CPU: autotune short-circuits to xla through the model path
+    from tpu_stencil.models.blur import IteratedConv2D
+    from tpu_stencil.ops import stencil
+
+    monkeypatch.setenv("TPU_STENCIL_AUTOTUNE_CACHE", str(tmp_path / "c.json"))
+    img = rng.integers(0, 256, size=(10, 8), dtype=np.uint8)
+    model = IteratedConv2D("gaussian", backend="autotune")
+    out = np.asarray(model(img, 2))
+    want = stencil.reference_stencil_numpy(img, filters.get_filter("gaussian"), 2)
+    np.testing.assert_array_equal(out, want)

@@ -49,10 +49,19 @@ def test_two_block_grid(rng):
 
 
 def test_unsupported_plan_falls_back(rng):
-    # edge (direct_int) has no Pallas kernel yet: must still be correct
+    # direct_f32 plans have no Pallas kernel: iterate must fall back to the
+    # XLA lowering and agree with it exactly
     img = rng.integers(0, 256, size=(12, 10), dtype=np.uint8)
-    got = _run(img, "edge", 2)
-    want = stencil.reference_stencil_numpy(img, filters.get_filter("edge"), 2)
+    plan = lowering.force_f32_plan(
+        lowering.plan_filter(filters.get_filter("gaussian"))
+    )
+    assert not pallas_stencil._supported(plan)
+    got = np.asarray(
+        pallas_stencil.iterate(img, jnp.int32(2), plan, interpret=True)
+    )
+    want = img
+    for _ in range(2):
+        want = np.asarray(lowering.padded_step(jnp.asarray(want), plan))
     np.testing.assert_array_equal(got, want)
 
 
@@ -105,3 +114,19 @@ def test_acc_dtype_selection():
     assert pallas_stencil._acc_dtype(p3) == jnp.int16
     assert pallas_stencil._acc_dtype(p5) == jnp.int16
     assert not pallas_stencil._clip_needed(p3)
+
+
+@pytest.mark.parametrize("reps", [2, 6])
+def test_direct_int_plan_matches_golden(rng, reps):
+    # edge /28: non-separable integer taps, f32-divide finish
+    img = rng.integers(0, 256, size=(45, 21, 3), dtype=np.uint8)
+    plan = lowering.plan_filter(filters.get_filter("edge"))
+    assert plan.kind == "direct_int"
+    got = np.asarray(
+        pallas_stencil.iterate(img, jnp.int32(reps), plan, block_h=16,
+                               fuse=4, interpret=True)
+    )
+    want = stencil.reference_stencil_numpy(
+        img, filters.get_filter("edge"), reps
+    )
+    np.testing.assert_array_equal(got, want)

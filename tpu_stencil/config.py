@@ -38,7 +38,7 @@ class JobConfig:
     repetitions: int
     image_type: ImageType
     filter_name: str = "gaussian"
-    backend: str = "auto"  # auto | xla | pallas | reference
+    backend: str = "auto"  # auto | xla | pallas | reference | autotune
     mesh_shape: Optional[Tuple[int, int]] = None  # (rows, cols); None = auto
     output: Optional[str] = None  # None -> blur_<basename> beside input
     dtype: str = "float32"  # accumulation dtype
@@ -49,7 +49,7 @@ class JobConfig:
             raise ValueError(f"width/height must be positive, got {self.width}x{self.height}")
         if self.repetitions < 0:
             raise ValueError(f"repetitions must be >= 0, got {self.repetitions}")
-        if self.backend not in ("auto", "xla", "pallas", "reference"):
+        if self.backend not in ("auto", "xla", "pallas", "reference", "autotune"):
             raise ValueError(f"unknown backend {self.backend!r}")
         if self.mesh_shape is not None and (
             len(self.mesh_shape) != 2 or any(d < 1 for d in self.mesh_shape)
@@ -108,8 +108,10 @@ def build_parser() -> argparse.ArgumentParser:
         help="filter name (box|gaussian|edge|gaussian5|gaussian7|...); default gaussian",
     )
     p.add_argument(
-        "--backend", default="auto", choices=["auto", "xla", "pallas", "reference"],
-        help="compute backend; auto picks per platform",
+        "--backend", default="auto",
+        choices=["auto", "xla", "pallas", "reference", "autotune"],
+        help="compute backend; auto picks per platform, autotune measures "
+             "XLA vs Pallas once per (filter, shape) and caches the winner",
     )
     p.add_argument(
         "--mesh", default=None,

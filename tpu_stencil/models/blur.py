@@ -33,15 +33,14 @@ from tpu_stencil.ops import lowering as _lowering
 def resolve_backend(backend: str) -> str:
     """Resolve 'auto' to a concrete backend.
 
-    'auto' currently resolves to XLA everywhere: on v5e the hand-tiled
-    Pallas kernel measures ~128 us/rep vs XLA's ~114 us/rep on the
-    north-star config (this stencil is VPU-compute-bound and XLA's fusion
-    is already near-optimal), so Pallas is explicit opt-in until its
-    multi-rep VMEM fusion lands.
+    'auto' currently resolves to XLA everywhere (Pallas is opt-in via
+    --backend pallas or measured per shape via --backend autotune).
+    'autotune' also resolves to XLA here — shape-aware resolution happens
+    in IteratedConv2D.__call__, which is the only place the shape is known.
     """
-    if backend != "auto":
-        return backend
-    return "xla"
+    if backend in ("auto", "autotune"):
+        return "xla"
+    return backend
 
 
 def _resolve_step(backend: str):
@@ -161,7 +160,15 @@ class IteratedConv2D:
             img_u8 = jnp.array(img_u8, dtype=jnp.uint8, copy=True)
         else:
             img_u8 = jnp.asarray(img_u8, dtype=jnp.uint8)
-        resolved = resolve_backend(self.backend)
+        if self.backend == "autotune":
+            from tpu_stencil.runtime import autotune
+
+            ch = img_u8.shape[2] if img_u8.ndim == 3 else 1
+            resolved = autotune.best_backend(
+                self.plan, tuple(img_u8.shape[:2]), ch
+            )
+        else:
+            resolved = resolve_backend(self.backend)
         return iterate(
             img_u8, jnp.int32(repetitions), plan=self.plan, backend=resolved
         )

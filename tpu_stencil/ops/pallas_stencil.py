@@ -33,8 +33,9 @@ Exactness: identical plans to the XLA lowering (`sep_int` shift / divide),
 with uint8 truncation re-applied every rep.  For all-non-negative dyadic
 filters the final clip is elided (max acc = 255 * 2^shift exactly).
 
-Supports ``sep_int`` plans (the gaussian family and box); other plan kinds
-fall back to the XLA lowering.
+Supports ``sep_int`` plans (the gaussian family and box) and ``direct_int``
+plans (the non-separable edge /28: k lane-rolls of the carry + k*k MACs);
+``direct_f32`` falls back to the XLA lowering.
 """
 
 from __future__ import annotations
@@ -57,10 +58,13 @@ _MAX_ROLL_HALO = 128  # cols-pass ghost width limit (halo * channels)
 
 
 def _acc_dtype(plan: StencilPlan):
-    """Accumulator for the ROWS pass: int16 doubles VPU lane throughput when
-    the one-pass bound fits (all binomial gaussians: 255 * sum(row_taps)).
-    The cols pass always widens to int32 — Mosaic's lane rotate
-    (``tpu.dynamic_rotate``) is 32-bit only on v5e."""
+    """Accumulator for the sep rows pass: int16 doubles VPU lane throughput
+    when the one-pass bound fits (all binomial gaussians: 255 *
+    sum(row_taps)). The cols pass always widens to int32 — Mosaic's lane
+    rotate (``tpu.dynamic_rotate``) is 32-bit only on v5e — and direct
+    plans roll the carry itself, so they stay int32 throughout."""
+    if plan.kind != "sep_int":
+        return jnp.int32
     row_sum = sum(abs(t) for t in plan.row_taps)
     nonneg = all(t >= 0 for t in plan.row_taps + plan.col_taps)
     if nonneg and 255 * row_sum < 2 ** 15:
@@ -85,13 +89,19 @@ def _mul_const_adds(x, c: int):
 
 def _clip_needed(plan: StencilPlan) -> bool:
     """clip(acc >> shift, 0, 255) is the identity when taps are non-negative
-    and sum(row)*sum(col) == 2^shift: acc <= 255 * 2^shift."""
+    and their total weight equals 2^shift: acc <= 255 * 2^shift."""
     if plan.shift is None:
         return True
-    row_sum = sum(abs(t) for t in plan.row_taps)
-    col_sum = sum(abs(t) for t in plan.col_taps)
-    nonneg = all(t >= 0 for t in plan.row_taps + plan.col_taps)
-    return not (nonneg and row_sum * col_sum == 2 ** plan.shift)
+    if plan.kind == "sep_int":
+        flat = plan.row_taps + plan.col_taps
+        total = sum(abs(t) for t in plan.row_taps) * sum(
+            abs(t) for t in plan.col_taps
+        )
+    else:
+        flat = tuple(t for row in plan.taps for t in row)
+        total = sum(abs(t) for t in flat)
+    nonneg = all(t >= 0 for t in flat)
+    return not (nonneg and total == 2 ** plan.shift)
 
 
 def _sep_kernel(in_hbm, out_ref, s_u8, sem, *, plan: StencilPlan,
@@ -192,7 +202,18 @@ def _sep_kernel(in_hbm, out_ref, s_u8, sem, *, plan: StencilPlan,
     cur = s_u8[slot].astype(dt)
     need_clip = _clip_needed(plan)
 
-    for t in range(fuse):
+    def lane_roll(x, off):
+        """x shifted so out[:, c] = x[:, c + off]; the >= halo*C zero pad
+        lanes at the right edge serve as both edges' ghosts (a right roll
+        wraps them into the left edge, a left roll reads them in place), so
+        no per-tap mask is needed — only the per-rep pad re-zeroing below."""
+        if off == 0:
+            return x
+        if off < 0:
+            return pltpu.roll(x, -off, 1)
+        return pltpu.roll(x, wc - off, 1)
+
+    def sep_rep(cur):
         # --- rows pass: valid 1-D correlation by sublane slicing (free on
         # the VPU — just shifted adds); output rows [0, tile_rows - 2h)
         # map to tile rows [h, tile_rows - h).
@@ -212,27 +233,44 @@ def _sep_kernel(in_hbm, out_ref, s_u8, sem, *, plan: StencilPlan,
         if dt != jnp.int32:
             acc = acc.astype(jnp.int32)  # lane rotate is 32-bit only
 
-        # --- cols pass as lane rotations. The >= halo*C zero pad lanes at
-        # the right edge serve as both edges' ghosts: a right roll wraps
-        # them into the left edge, a left roll reads them in place at the
-        # right edge — so no per-tap mask, only the single pad re-zeroing
-        # mask below.
+        # --- cols pass as lane rotations ---
         col = None
         for t_idx, tap in enumerate(plan.col_taps):
             if tap == 0:
                 continue
-            off = (t_idx - h) * channels  # term[:, c] = acc[:, c + off]
-            if off == 0:
-                term = acc
-            elif off < 0:
-                term = pltpu.roll(acc, -off, 1)
-            else:
-                term = pltpu.roll(acc, wc - off, 1)
+            term = lane_roll(acc, (t_idx - h) * channels)
             if tap != 1:
                 term = term * tap
             col = term if col is None else col + term
         if col is None:
             col = jnp.zeros((tile_rows - 2 * h, wc), jnp.int32)
+        return col
+
+    def direct_rep(cur):
+        # --- non-separable k*k plan (e.g. the reference's edge /28,
+        # rank 2): roll the whole tile once per column offset (k rolls),
+        # then row-slice each rolled copy for free — k rolls + k*k MACs
+        # instead of the 2k MACs of the separable path.
+        k = plan.k
+        rolled = [lane_roll(cur, (j_idx - h) * channels) for j_idx in range(k)]
+        col = None
+        for i_idx in range(k):
+            for j_idx in range(k):
+                tap = int(plan.taps[i_idx][j_idx])
+                if tap == 0:
+                    continue
+                term = rolled[j_idx][i_idx : i_idx + tile_rows - 2 * h, :]
+                if tap != 1:
+                    term = term * tap
+                col = term if col is None else col + term
+        if col is None:
+            col = jnp.zeros((tile_rows - 2 * h, wc), jnp.int32)
+        return col
+
+    rep_fn = sep_rep if plan.kind == "sep_int" else direct_rep
+
+    for t in range(fuse):
+        col = rep_fn(cur)
 
         # --- finish: shift or f32 divide (+ clip only when it can bind) ---
         if plan.shift is not None:
@@ -290,7 +328,7 @@ def _build_call(plan: StencilPlan, hp: int, h_real: int, wc: int,
 
 
 def _supported(plan: StencilPlan) -> bool:
-    return plan.kind == "sep_int"
+    return plan.kind in ("sep_int", "direct_int")
 
 
 def iterate(img_u8: jax.Array, repetitions: jax.Array, plan: StencilPlan,
