@@ -26,6 +26,32 @@ def test_cpu_short_circuits_to_xla(plan, tmp_path, monkeypatch):
     assert autotune.best_backend(plan, (64, 64), 3, measure=boom) == "xla"
 
 
+def test_steady_state_differencing_and_noise_fallback():
+    # Linear cost model: differencing recovers the slope exactly.
+    calls = []
+
+    def linear(n):
+        calls.append(n)
+        return 0.050 + n * 1e-4  # 50 ms dispatch overhead + 100 us/rep
+
+    assert autotune._steady_state_per_rep(linear, 100) == pytest.approx(1e-4)
+
+    # Pathological noise: t(2n) <= t(n) every time. The old code clamped the
+    # difference to 1e-9 and cached an arbitrary winner; the fallback now
+    # differences against a 2-rep run, which still cancels the constant
+    # overhead (stays comparable with a cleanly-measured candidate).
+    def inverted(n):
+        return {2: 0.004, 100: 0.010, 200: 0.009}[n]
+
+    got = autotune._steady_state_per_rep(inverted, 100)
+    assert got == pytest.approx((0.009 - 0.004) / 198)
+
+    # Fully degenerate clock (every reading identical): raw rate, never ~0.
+    got = autotune._steady_state_per_rep(lambda n: 0.008, 100)
+    assert got == pytest.approx(0.008 / 200)
+    assert got > 1e-6
+
+
 def test_measures_once_then_caches(plan, tmp_path, monkeypatch):
     import jax
 

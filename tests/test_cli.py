@@ -79,6 +79,25 @@ def test_cli_mesh_sharded_end_to_end(tmp_path, rng):
     np.testing.assert_array_equal(got, want)
 
 
+def test_sharded_total_seconds_includes_io(tmp_path, rng):
+    # regression: _run_sharded once read Timer.elapsed *inside* the with
+    # block, before __exit__ assigned it, so mesh runs reported
+    # total_seconds == 0.0 while single-device runs were correct.
+    import jax
+    from tpu_stencil import driver
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 virtual devices")
+    img = rng.integers(0, 256, size=(16, 16), dtype=np.uint8)
+    p = str(tmp_path / "t.raw")
+    raw_io.write_raw(p, img[..., None])
+    cfg = JobConfig(p, 16, 16, 2, ImageType.GREY, backend="xla",
+                    mesh_shape=(2, 2))
+    res = driver.run_job(cfg, devices=jax.devices()[:4])
+    assert res.mesh_shape == (2, 2)
+    assert res.compute_seconds > 0.0
+    assert res.total_seconds >= res.compute_seconds
+
+
 def test_cli_bad_mesh_is_usage_error(tmp_path):
     with pytest.raises(SystemExit) as exc:
         parse_args(["i.raw", "8", "8", "1", "grey", "--mesh", "8"])

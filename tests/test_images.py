@@ -41,8 +41,36 @@ def test_resolve_size_inference_and_mismatch(tmp_path, rng):
 
 def test_is_raw():
     assert images.is_raw("a.raw") and images.is_raw("dir/b.bin")
-    assert images.is_raw("noext")
+    assert images.is_raw("noext")  # nonexistent extension-less path: raw
     assert not images.is_raw("a.png") and not images.is_raw("b.PPM")
+
+
+def test_is_raw_sniffs_extensionless_png(tmp_path, rng):
+    # A real PNG saved without an extension must be decoded, not fed to the
+    # raw reader (advisor finding: a confusing size-mismatch error, or
+    # silently decoding garbage when sizes happen to match).
+    img = rng.integers(0, 256, size=(4, 4, 3), dtype=np.uint8)
+    noext = str(tmp_path / "photo")
+    images.save_image(noext + ".png", img)
+    import os
+    os.rename(noext + ".png", noext)
+    assert not images.is_raw(noext, sniff=True)
+    # Output classification never sniffs: what a previous run left at the
+    # output path must not flip how this run writes it.
+    assert images.is_raw(noext)
+    assert images.resolve_size(noext, 0, 0) == (4, 4)
+    # Extension-less files with non-image bytes stay raw.
+    rawpath = str(tmp_path / "frame")
+    with open(rawpath, "wb") as f:
+        f.write(bytes(range(16)))
+    assert images.is_raw(rawpath, sniff=True)
+    # 2-byte BMP/PNM magic needs corroborating structure: raw pixel bytes
+    # that merely start with 'BM' or 'P5' must stay raw.
+    for head in (b"BM\x99\x88\x77\x66\x55\x44", b"P5x\x01\x02\x03"):
+        p = str(tmp_path / ("c" + head[:2].decode()))
+        with open(p, "wb") as f:
+            f.write(head + bytes(16))
+        assert images.is_raw(p, sniff=True)
 
 
 def test_cli_png_end_to_end(tmp_path, rng, capsys):

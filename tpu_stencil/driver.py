@@ -29,7 +29,7 @@ def _load_input(cfg: JobConfig) -> np.ndarray:
 
     ``frames > 1``: the raw file holds N concatenated frames; returns
     (N, H, W[, C]) for the batched (vmap) path."""
-    if images_io.is_raw(cfg.image):
+    if images_io.is_raw(cfg.image, sniff=True):
         img = raw_io.read_raw(
             cfg.image, cfg.width, cfg.height * cfg.frames, cfg.channels
         )
@@ -168,7 +168,7 @@ def run_job(
         n_dev = len(devices)
 
         if cfg.frames > 1:
-            if not images_io.is_raw(cfg.image) or not images_io.is_raw(
+            if not images_io.is_raw(cfg.image, sniff=True) or not images_io.is_raw(
                 cfg.output_path
             ):
                 raise NotImplementedError(
@@ -261,7 +261,7 @@ def _run_sharded(cfg, model, devices, profile_dir, checkpoint_every, resume,
         if restored is not None:
             start_rep, img_dev = restored
     if img_dev is None:
-        if images_io.is_raw(cfg.image):
+        if images_io.is_raw(cfg.image, sniff=True):
             # Per-process sharded read: each host touches only the rows its
             # devices own (the MPI-IO pattern, mpi/mpi_convolution.c:126-141);
             # single-process this is bit-identical to whole-file read +

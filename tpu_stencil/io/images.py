@@ -27,10 +27,56 @@ from tpu_stencil.config import ImageType
 
 _RAW_EXTS = {".raw", ".bin", ""}
 
+# Magic bytes of the formats Pillow commonly decodes; a known signature on
+# an extension-less input file means "this is NOT headerless raw". Only
+# signatures >= 3 bytes match on prefix alone; the 2-byte BMP/PNM magics
+# need corroborating header structure (below) or arbitrary pixel data would
+# collide with them (~1 in 8k files).
+_MAGIC_PREFIX = (
+    b"\x89PNG\r\n\x1a\n",  # PNG
+    b"\xff\xd8\xff",       # JPEG
+    b"GIF8",               # GIF
+    b"II*\x00",            # TIFF little-endian
+    b"MM\x00*",            # TIFF big-endian
+)
 
-def is_raw(path: str) -> bool:
-    """Headerless-raw heuristic: .raw/.bin/extension-less paths."""
-    return os.path.splitext(path)[1].lower() in _RAW_EXTS
+
+def _sniffs_as_image(path: str) -> bool:
+    try:
+        size = os.path.getsize(path)
+        with open(path, "rb") as f:
+            head = f.read(12)
+    except OSError:
+        return False  # unreadable/nonexistent: not a decodable image
+    if head.startswith(_MAGIC_PREFIX):
+        return True
+    # BMP: 'BM' + a little-endian file-size field that must match reality.
+    if head[:2] == b"BM" and len(head) >= 6:
+        if int.from_bytes(head[2:6], "little") == size:
+            return True
+    # PNM: 'P1'..'P6' followed by whitespace (the spec requires it).
+    if (len(head) >= 3 and head[0:1] == b"P" and head[1:2] in b"123456"
+            and head[2:3] in b" \t\r\n"):
+        return True
+    return False
+
+
+def is_raw(path: str, sniff: bool = False) -> bool:
+    """Headerless-raw heuristic: .raw/.bin extensions are raw, known image
+    extensions are not, extension-less paths are raw by default.
+
+    ``sniff=True`` (for *input* paths only) additionally checks magic bytes
+    of existing extension-less files, so a PNG saved without an extension is
+    decoded instead of being fed to the raw reader (which would fail with a
+    confusing size mismatch or, worse, silently decode garbage). Output
+    paths must never sniff: classification of an output would otherwise
+    depend on what a previous run left at that path."""
+    ext = os.path.splitext(path)[1].lower()
+    if ext != "":
+        return ext in _RAW_EXTS
+    if not sniff:
+        return True
+    return not _sniffs_as_image(path)
 
 
 def _pil():
@@ -84,7 +130,7 @@ def resolve_size(
     against the header and a mismatch is an error (the reference silently
     reads garbage on wrong sizes — we fail loudly, as the raw reader
     already does for short files)."""
-    if is_raw(path):
+    if is_raw(path, sniff=True):
         if width <= 0 or height <= 0:
             raise ValueError(
                 f"{path}: raw images are headerless; width/height must be "

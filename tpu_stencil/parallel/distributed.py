@@ -36,6 +36,22 @@ from tpu_stencil.io import raw as raw_io
 from tpu_stencil.parallel.mesh import ROWS_AXIS, COLS_AXIS
 
 
+# Env markers that mean "this process is part of a multi-process job" —
+# checked before degrading to single-process on any bring-up failure.
+# NOTE: TPU_WORKER_HOSTNAMES is NOT a usable marker — libtpu/the PJRT
+# plugin sets it itself during backend init.
+_COORDINATOR_ENV_VARS = (
+    "JAX_COORDINATOR_ADDRESS", "COORDINATOR_ADDRESS",
+    "MEGASCALE_COORDINATOR_ADDRESS",
+)
+
+
+def _looks_multiprocess() -> bool:
+    import os
+
+    return any(v in os.environ for v in _COORDINATOR_ENV_VARS)
+
+
 def _distributed_client_active() -> bool:
     """Whether jax.distributed.initialize already ran, WITHOUT initializing
     any XLA backend (jax.process_count() would)."""
@@ -83,16 +99,9 @@ def initialize(
                 "must precede the first JAX computation. Call initialize() "
                 "at process start (before any jax.* array/compile call)."
             )
-        import os
         import warnings
 
-        # NOTE: TPU_WORKER_HOSTNAMES is NOT a usable marker — libtpu/the
-        # PJRT plugin sets it itself during backend init.
-        if any(
-            v in os.environ
-            for v in ("JAX_COORDINATOR_ADDRESS", "COORDINATOR_ADDRESS",
-                      "MEGASCALE_COORDINATOR_ADDRESS")
-        ):
+        if _looks_multiprocess():
             # Looks like a multi-process environment — degrading to
             # single-process here would silently race on shared files.
             warnings.warn(
@@ -107,8 +116,13 @@ def initialize(
         # Cloud TPU auto-detection; harmless single-process otherwise.
         try:
             jax.distributed.initialize()
-        except Exception:  # single-process / no env: stay local
-            return
+        except Exception:
+            if _looks_multiprocess():
+                # A transient bring-up failure on a real pod must not
+                # silently degrade this process to single-process while its
+                # peers hang in collectives waiting for it.
+                raise
+            return  # single-process / no env: stay local
     else:
         jax.distributed.initialize(
             coordinator_address=coordinator_address,

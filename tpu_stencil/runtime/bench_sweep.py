@@ -63,9 +63,9 @@ def _measure_per_rep(img: np.ndarray, filter_name: str, budget_s: float) -> floa
     probe_reps = 500
     est = max(timed(probe_reps) / probe_reps, 1e-8)
     lo = min(max(int(budget_s / est), 200), 50_000)
-    t_lo = min(timed(lo) for _ in range(2))
-    t_hi = min(timed(2 * lo) for _ in range(2))
-    return max(t_hi - t_lo, 1e-9) / lo
+    from tpu_stencil.runtime.autotune import _steady_state_per_rep
+
+    return _steady_state_per_rep(timed, lo)
 
 
 def run_sweep(

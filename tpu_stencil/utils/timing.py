@@ -17,18 +17,32 @@ import jax
 
 
 class Timer:
-    """Monotonic stopwatch; ``elapsed`` in seconds."""
+    """Monotonic stopwatch; ``elapsed`` in seconds.
+
+    ``elapsed`` is live: read inside the ``with`` block it returns the time
+    accumulated so far (a return statement inside the block sees real time,
+    not 0), after exit it is frozen at the block's duration.
+    """
 
     def __init__(self) -> None:
-        self._start = 0.0
-        self.elapsed = 0.0
+        self._start: float = 0.0
+        self._frozen: float = -1.0
 
     def __enter__(self) -> "Timer":
         self._start = time.perf_counter()
+        self._frozen = -1.0  # re-entry restarts the stopwatch
         return self
 
     def __exit__(self, *exc) -> None:
-        self.elapsed = time.perf_counter() - self._start
+        self._frozen = time.perf_counter() - self._start
+
+    @property
+    def elapsed(self) -> float:
+        if self._frozen >= 0.0:
+            return self._frozen
+        if self._start:
+            return time.perf_counter() - self._start
+        return 0.0
 
 
 def max_across_processes(seconds: float) -> float:
