@@ -17,8 +17,8 @@ requires_8 = pytest.mark.skipif(
 )
 
 
-def _run(img, filter_name, reps, mesh_shape):
-    model = IteratedConv2D(filter_name, backend="xla")
+def _run(img, filter_name, reps, mesh_shape, backend="xla"):
+    model = IteratedConv2D(filter_name, backend=backend)
     channels = 1 if img.ndim == 2 else img.shape[2]
     runner = sharded.ShardedRunner(
         model, img.shape[:2], channels,
@@ -91,11 +91,72 @@ def test_halo_wider_than_tile_rejected(rng):
 
 
 @requires_8
-def test_explicit_pallas_backend_rejected_for_sharded(rng):
-    model = IteratedConv2D("gaussian", backend="pallas")
-    with pytest.raises(NotImplementedError):
-        sharded.ShardedRunner(model, (16, 16), 1, mesh_shape=(2, 4),
-                              devices=jax.devices()[:8])
+@pytest.mark.parametrize("mesh_shape", [(2, 4), (8, 1), (1, 8)])
+def test_pallas_sharded_matches_single_device(rng, mesh_shape):
+    # The fused valid-ghost kernel under shard_map (interpret mode on the
+    # CPU mesh): reps span multiple fused chunks plus a remainder.
+    img = rng.integers(0, 256, size=(32, 40), dtype=np.uint8)
+    got = _run(img, "gaussian", 5, mesh_shape, backend="pallas")
+    want = np.asarray(IteratedConv2D("gaussian", backend="xla")(img, 5))
+    np.testing.assert_array_equal(got, want)
+
+
+@requires_8
+def test_pallas_sharded_rgb_fused_chunks(rng):
+    img = rng.integers(0, 256, size=(24, 16, 3), dtype=np.uint8)
+    # tile 12x8 -> fuse capped at 8; 11 reps = chunk(s) + remainder
+    got = _run(img, "gaussian", 11, (2, 2), backend="pallas")
+    want = np.asarray(IteratedConv2D("gaussian", backend="xla")(img, 11))
+    np.testing.assert_array_equal(got, want)
+
+
+@requires_8
+def test_pallas_sharded_wide_halo(rng):
+    # gaussian5 halo=2: fused ghosts 2*fuse rows deep, boundary re-zero
+    # must still track the global extent
+    img = rng.integers(0, 256, size=(48, 40), dtype=np.uint8)
+    got = _run(img, "gaussian5", 4, (2, 2), backend="pallas")
+    want = np.asarray(IteratedConv2D("gaussian5", backend="xla")(img, 4))
+    np.testing.assert_array_equal(got, want)
+
+
+@requires_8
+@pytest.mark.parametrize("shape", [(32, 40), (24, 16, 3)])
+def test_pallas_sharded_direct_int_edge_filter(rng, shape):
+    # direct_int plans (the reference's non-separable edge /28) take the
+    # direct_rep path in the valid-ghost kernel: k lane-rolls of the carry
+    # plus the boundary re-zero must survive negative taps.
+    img = rng.integers(0, 256, size=shape, dtype=np.uint8)
+    got = _run(img, "edge", 5, (2, 2), backend="pallas")
+    want = np.asarray(IteratedConv2D("edge", backend="xla")(img, 5))
+    np.testing.assert_array_equal(got, want)
+
+
+@requires_8
+def test_pallas_sharded_indivisible_masked(rng):
+    # mask path forces single-rep chunks; still bit-exact
+    img = rng.integers(0, 256, size=(33, 41), dtype=np.uint8)
+    got = _run(img, "gaussian", 3, (2, 4), backend="pallas")
+    want = np.asarray(IteratedConv2D("gaussian", backend="xla")(img, 3))
+    np.testing.assert_array_equal(got, want)
+
+
+@requires_8
+def test_pallas_sharded_unsupported_plan_falls_back(rng):
+    # direct_f32 plans (non-dyadic divisor) run the XLA lowering under a
+    # ShardedRunner created with backend='pallas' — same silent fallback
+    # as the single-device driver.
+    filt = filters.Filter(
+        np.array([[1, 0, 0.5], [0, 1, 0], [0.25, 0, 1]], np.float32), 3.0
+    )
+    model = IteratedConv2D(filt, backend="pallas")
+    runner = sharded.ShardedRunner(model, (16, 16), 1, mesh_shape=(2, 2),
+                                   devices=jax.devices()[:4])
+    assert runner.backend == "xla"
+    img = rng.integers(0, 256, size=(16, 16), dtype=np.uint8)
+    got = runner.fetch(runner.run(runner.put(img), 2))
+    want = np.asarray(IteratedConv2D(filt, backend="xla")(img, 2))
+    np.testing.assert_array_equal(got, want)
 
 
 @requires_8

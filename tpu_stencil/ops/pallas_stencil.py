@@ -104,6 +104,94 @@ def _clip_needed(plan: StencilPlan) -> bool:
     return not (nonneg and total == 2 ** plan.shift)
 
 
+def _rep_val(cur, *, plan: StencilPlan, dt, tile_rows: int, wc: int,
+             channels: int):
+    """One repetition on a VMEM tile *value*: the separable (or direct)
+    passes plus the finishing shift/clip. ``cur`` has ``tile_rows`` rows and
+    ``wc`` flat lanes in the accumulator dtype; returns the finished int32
+    values (each in [0, 255]) of shape ``(tile_rows - 2*halo, wc)`` —
+    *before* any boundary re-zeroing, which is the caller's (kernel's) job
+    because zero-boundary and valid-ghost kernels differ exactly there."""
+    h = plan.halo
+
+    def lane_roll(x, off):
+        """x shifted so out[:, c] = x[:, c + off]. Rolls wrap lane content
+        end-around; both kernels arrange >= halo*C discardable lanes at the
+        edges so wrapped values never land in trusted output."""
+        if off == 0:
+            return x
+        if off < 0:
+            return pltpu.roll(x, -off, 1)
+        return pltpu.roll(x, wc - off, 1)
+
+    def sep_rep(cur):
+        # --- rows pass: valid 1-D correlation by sublane slicing (free on
+        # the VPU — just shifted adds); output rows [0, tile_rows - 2h)
+        # map to tile rows [h, tile_rows - h).
+        acc = None
+        for t_idx, tap in enumerate(plan.row_taps):
+            if tap == 0:
+                continue
+            term = cur[t_idx : t_idx + tile_rows - 2 * h, :]
+            if tap != 1:
+                if dt == jnp.int16 and tap > 0:
+                    term = _mul_const_adds(term, tap)
+                else:
+                    term = term * tap
+            acc = term if acc is None else acc + term
+        if acc is None:
+            acc = jnp.zeros((tile_rows - 2 * h, wc), dt)
+        if dt != jnp.int32:
+            acc = acc.astype(jnp.int32)  # lane rotate is 32-bit only
+
+        # --- cols pass as lane rotations ---
+        col = None
+        for t_idx, tap in enumerate(plan.col_taps):
+            if tap == 0:
+                continue
+            term = lane_roll(acc, (t_idx - h) * channels)
+            if tap != 1:
+                term = term * tap
+            col = term if col is None else col + term
+        if col is None:
+            col = jnp.zeros((tile_rows - 2 * h, wc), jnp.int32)
+        return col
+
+    def direct_rep(cur):
+        # --- non-separable k*k plan (e.g. the reference's edge /28,
+        # rank 2): roll the whole tile once per column offset (k rolls),
+        # then row-slice each rolled copy for free — k rolls + k*k MACs
+        # instead of the 2k MACs of the separable path.
+        k = plan.k
+        rolled = [lane_roll(cur, (j_idx - h) * channels) for j_idx in range(k)]
+        col = None
+        for i_idx in range(k):
+            for j_idx in range(k):
+                tap = int(plan.taps[i_idx][j_idx])
+                if tap == 0:
+                    continue
+                term = rolled[j_idx][i_idx : i_idx + tile_rows - 2 * h, :]
+                if tap != 1:
+                    term = term * tap
+                col = term if col is None else col + term
+        if col is None:
+            col = jnp.zeros((tile_rows - 2 * h, wc), jnp.int32)
+        return col
+
+    col = sep_rep(cur) if plan.kind == "sep_int" else direct_rep(cur)
+
+    # --- finish: shift or f32 divide (+ clip only when it can bind) ---
+    if plan.shift is not None:
+        val = col >> plan.shift
+        if _clip_needed(plan):
+            val = jnp.clip(val, 0, 255)
+    else:
+        val = jnp.clip(
+            col.astype(jnp.float32) / np.float32(plan.divisor), 0.0, 255.0
+        ).astype(jnp.int32)
+    return val
+
+
 def _sep_kernel(in_hbm, out_ref, s_u8, sem, *, plan: StencilPlan,
                 block_h: int, grid: int, halo_al: int, fuse: int,
                 n_rows_real: int, wc: int, wc_real: int, channels: int):
@@ -116,7 +204,6 @@ def _sep_kernel(in_hbm, out_ref, s_u8, sem, *, plan: StencilPlan,
     """
     i = pl.program_id(0)
     h = plan.halo
-    hc = h * channels
     tile_rows = block_h + 2 * halo_al
     dt = _acc_dtype(plan)
 
@@ -200,87 +287,15 @@ def _sep_kernel(in_hbm, out_ref, s_u8, sem, *, plan: StencilPlan,
     wait(i, slot)
 
     cur = s_u8[slot].astype(dt)
-    need_clip = _clip_needed(plan)
-
-    def lane_roll(x, off):
-        """x shifted so out[:, c] = x[:, c + off]; the >= halo*C zero pad
-        lanes at the right edge serve as both edges' ghosts (a right roll
-        wraps them into the left edge, a left roll reads them in place), so
-        no per-tap mask is needed — only the per-rep pad re-zeroing below."""
-        if off == 0:
-            return x
-        if off < 0:
-            return pltpu.roll(x, -off, 1)
-        return pltpu.roll(x, wc - off, 1)
-
-    def sep_rep(cur):
-        # --- rows pass: valid 1-D correlation by sublane slicing (free on
-        # the VPU — just shifted adds); output rows [0, tile_rows - 2h)
-        # map to tile rows [h, tile_rows - h).
-        acc = None
-        for t_idx, tap in enumerate(plan.row_taps):
-            if tap == 0:
-                continue
-            term = cur[t_idx : t_idx + tile_rows - 2 * h, :]
-            if tap != 1:
-                if dt == jnp.int16 and tap > 0:
-                    term = _mul_const_adds(term, tap)
-                else:
-                    term = term * tap
-            acc = term if acc is None else acc + term
-        if acc is None:
-            acc = jnp.zeros((tile_rows - 2 * h, wc), dt)
-        if dt != jnp.int32:
-            acc = acc.astype(jnp.int32)  # lane rotate is 32-bit only
-
-        # --- cols pass as lane rotations ---
-        col = None
-        for t_idx, tap in enumerate(plan.col_taps):
-            if tap == 0:
-                continue
-            term = lane_roll(acc, (t_idx - h) * channels)
-            if tap != 1:
-                term = term * tap
-            col = term if col is None else col + term
-        if col is None:
-            col = jnp.zeros((tile_rows - 2 * h, wc), jnp.int32)
-        return col
-
-    def direct_rep(cur):
-        # --- non-separable k*k plan (e.g. the reference's edge /28,
-        # rank 2): roll the whole tile once per column offset (k rolls),
-        # then row-slice each rolled copy for free — k rolls + k*k MACs
-        # instead of the 2k MACs of the separable path.
-        k = plan.k
-        rolled = [lane_roll(cur, (j_idx - h) * channels) for j_idx in range(k)]
-        col = None
-        for i_idx in range(k):
-            for j_idx in range(k):
-                tap = int(plan.taps[i_idx][j_idx])
-                if tap == 0:
-                    continue
-                term = rolled[j_idx][i_idx : i_idx + tile_rows - 2 * h, :]
-                if tap != 1:
-                    term = term * tap
-                col = term if col is None else col + term
-        if col is None:
-            col = jnp.zeros((tile_rows - 2 * h, wc), jnp.int32)
-        return col
-
-    rep_fn = sep_rep if plan.kind == "sep_int" else direct_rep
 
     for t in range(fuse):
-        col = rep_fn(cur)
-
-        # --- finish: shift or f32 divide (+ clip only when it can bind) ---
-        if plan.shift is not None:
-            val = col >> plan.shift
-            if need_clip:
-                val = jnp.clip(val, 0, 255)
-        else:
-            val = jnp.clip(
-                col.astype(jnp.float32) / np.float32(plan.divisor), 0.0, 255.0
-            ).astype(jnp.int32)
+        # The >= halo*C zero pad lanes at the right edge serve as both
+        # edges' ghosts for the lane rolls inside _rep_val (a right roll
+        # wraps them into the left edge, a left roll reads them in place),
+        # so no per-tap mask is needed — only the per-rep pad re-zeroing
+        # below.
+        val = _rep_val(cur, plan=plan, dt=dt, tile_rows=tile_rows, wc=wc,
+                       channels=channels)
 
         # --- re-establish zero ghosts for the next rep: pad lanes and
         # below-image rows back to zero (above-image rows stay zero by
@@ -301,6 +316,144 @@ def _sep_kernel(in_hbm, out_ref, s_u8, sem, *, plan: StencilPlan,
         cur = jnp.pad(val, ((h, h), (0, 0))).astype(dt)
 
     out_ref[:] = cur[halo_al : halo_al + block_h, :].astype(jnp.uint8)
+
+
+def _valid_kernel(scal_ref, in_hbm, out_ref, s_u8, sem, *, plan: StencilPlan,
+                  block_h: int, grid: int, halo_al: int, fuse: int,
+                  ghost: int, wc: int, rows_glob: int, cols_glob_c: int,
+                  channels: int):
+    """Valid-ghost row-block program for *sharded* execution: the input
+    already carries ``halo_al`` rows (and ``ghost*channels`` lanes) of
+    ghost data per side — real neighbor values delivered by the halo
+    exchange, zeros beyond the global image (ppermute boundary semantics).
+
+    Runs ``fuse`` reps per exchange; each rep the trusted band contracts by
+    ``halo`` while ghost values recompute the *neighbor's* values bit-exactly
+    (both sides compute from identical exchanged inputs — the overlap-halo
+    trick). The one thing that must NOT be trusted to contraction is the
+    global zero boundary: zero-boundary semantics re-zeroes out-of-image
+    pixels every rep (a blur spreads outward, so ghost zeros turn nonzero
+    after one rep and would leak back in). The shard's global (row, flat
+    col) offset arrives in SMEM (it is a traced ``lax.axis_index`` value at
+    trace time) and every rep re-zeroes pixels outside the global extent.
+
+    DMA is single-case (no first/last-block special cases): the caller pads
+    the ghost bands to ``halo_al`` rows, so every block reads
+    ``[i*block_h, i*block_h + block_h + 2*halo_al)`` in bounds.
+    """
+    i = pl.program_id(0)
+    h = plan.halo
+    tile_rows = block_h + 2 * halo_al
+    dt = _acc_dtype(plan)
+
+    def copy_for(j, slot):
+        src = pl.multiple_of(j * block_h, 8)
+        return pltpu.make_async_copy(
+            in_hbm.at[pl.ds(src, tile_rows)], s_u8.at[slot], sem.at[slot]
+        )
+
+    slot = jax.lax.rem(i, 2)
+
+    @pl.when(i == 0)
+    def _():
+        copy_for(i, slot).start()
+
+    if grid > 1:
+        @pl.when(i + 1 < grid)
+        def _():
+            copy_for(i + 1, jax.lax.rem(i + 1, 2)).start()
+
+    copy_for(i, slot).wait()
+
+    row0 = scal_ref[0, 0]  # global row of this shard's first interior row
+    col0 = scal_ref[0, 1]  # global flat col of first interior lane
+    cur = s_u8[slot].astype(dt)
+
+    for t in range(fuse):
+        val = _rep_val(cur, plan=plan, dt=dt, tile_rows=tile_rows, wc=wc,
+                       channels=channels)
+        # Global-boundary re-zero. val row rid sits at global row
+        # row0 + i*block_h - halo_al + rid + h; val lane cid at global flat
+        # col col0 + cid - ghost*channels. One unsigned compare per axis
+        # covers both below-zero (wraps big) and beyond-extent. Pixels
+        # inside the global extent — including alignment-pad lanes of
+        # interior shards — are left alone: wrapped-roll garbage there
+        # stays inside the contracted discard band by construction.
+        rid = jax.lax.broadcasted_iota(jnp.int32, val.shape, 0)
+        gid = rid + (row0 + i * block_h - halo_al + h)
+        keep = gid.astype(jnp.uint32) < jnp.uint32(rows_glob)
+        cid = jax.lax.broadcasted_iota(jnp.int32, val.shape, 1)
+        gcol = cid + (col0 - ghost * channels)
+        keep = jnp.logical_and(
+            keep, gcol.astype(jnp.uint32) < jnp.uint32(cols_glob_c)
+        )
+        val = jnp.where(keep, val, 0)
+        cur = jnp.pad(val, ((h, h), (0, 0))).astype(dt)
+
+    out_ref[:] = cur[halo_al : halo_al + block_h, :].astype(jnp.uint8)
+
+
+def valid_fused(ext_u8: jax.Array, plan: StencilPlan, fuse: int,
+                channels: int, row0, col0, global_shape,
+                block_h: int = DEFAULT_BLOCK_H,
+                interpret: bool = False, vma=None) -> jax.Array:
+    """Apply ``fuse`` reps to a ghost-extended flat tile (sharded local op).
+
+    ``ext_u8``: ``(th + 2*g, (tw + 2*g) * channels)`` uint8, ``g = fuse *
+    plan.halo`` — the interior tile plus exchanged ghosts on all sides.
+    ``row0``/``col0``: traced global offsets (row, flat col) of the interior
+    origin. ``global_shape``: static padded global (rows, cols*channels).
+    Returns the ``(th, tw * channels)`` interior result after ``fuse`` reps.
+    """
+    h = plan.halo
+    g = fuse * h
+    rows_ext, wl_ext = ext_u8.shape
+    th = rows_ext - 2 * g
+    twc = wl_ext - 2 * g * channels
+    halo_al = -(-g // 8) * 8 if g else 0
+    bh = min(-(-block_h // 8) * 8, -(-th // 8) * 8)
+    hp = -(-th // bh) * bh
+    # >= h*C discardable lanes at the right edge for the lane-roll wrap:
+    # the ghost lanes themselves provide it; halo-0 plans need none.
+    wl = -(-wl_ext // 128) * 128
+    # Row layout: [halo_al-g align zeros][g ghosts][th interior][g ghosts]
+    # [align zeros to hp + 2*halo_al]. Alignment zeros sit *outside* the
+    # exchanged ghosts, so contamination from them contracts into the
+    # discard band exactly like ghost-edge garbage.
+    x = jnp.pad(
+        ext_u8,
+        ((halo_al - g, (hp - th) + halo_al - g), (0, wl - wl_ext)),
+    )
+    scal = jnp.stack([row0, col0]).astype(jnp.int32).reshape(1, 2)
+    grid = hp // bh
+    kernel = functools.partial(
+        _valid_kernel, plan=plan, block_h=bh, grid=grid, halo_al=halo_al,
+        fuse=fuse, ghost=g, wc=wl, rows_glob=global_shape[0],
+        cols_glob_c=global_shape[1], channels=channels,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=(grid,),
+        # Inside shard_map the result varies over the mesh axes; declare it
+        # when given (shard_map's check_vma cannot infer through a
+        # pallas_call). Interpret mode still needs check_vma=False at the
+        # shard_map (the HLO interpreter loses vma on internal slices).
+        out_shape=jax.ShapeDtypeStruct(
+            (hp, wl), jnp.uint8,
+            **({"vma": frozenset(vma)} if vma else {}),
+        ),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=pl.BlockSpec((bh, wl), lambda i: (i, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((2, bh + 2 * halo_al, wl), jnp.uint8),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+        interpret=interpret,
+    )(scal, x)
+    return out[:th, g * channels : g * channels + twc]
 
 
 def _build_call(plan: StencilPlan, hp: int, h_real: int, wc: int,

@@ -66,42 +66,104 @@ def _local_step(tile_u8, plan, axes, mask_tile):
     return out
 
 
+def _pallas_local_chunk(tile_u8, plan, axes, fuse, global_shape, interpret):
+    """``fuse`` repetitions for one exchange: widen the halo exchange to
+    ``fuse * halo`` uint8 ghosts (2 ppermute phases per *chunk* instead of
+    per rep) and run the valid-ghost Pallas kernel, whose trusted band
+    contracts by ``halo`` per rep — the ghost values recompute the
+    neighbor's values bit-exactly, so no further communication is needed
+    until the next chunk. The TPU-native analog of the reference's hybrid
+    variant layering its fastest local kernel inside the distribution
+    (``open-mp/omp_convolution.c:292,297``)."""
+    from tpu_stencil.ops import pallas_stencil
+
+    (row_axis, r, dim0), (col_axis, c, dim1) = axes
+    g = fuse * plan.halo
+    ext = halo_exchange(tile_u8, g, axes)
+    th, tw = tile_u8.shape[:2]
+    channels = tile_u8.shape[2] if tile_u8.ndim == 3 else 1
+    ext2 = ext.reshape(th + 2 * g, (tw + 2 * g) * channels)
+    row0 = lax.axis_index(row_axis) * th
+    col0 = lax.axis_index(col_axis) * (tw * channels)
+    out2 = pallas_stencil.valid_fused(
+        ext2, plan, fuse, channels, row0, col0, global_shape,
+        interpret=interpret, vma=(row_axis, col_axis),
+    )
+    return out2.reshape(tile_u8.shape)
+
+
 def build_sharded_iterate(
     mesh: Mesh,
     plan: _lowering.StencilPlan,
     channels: int,
     needs_mask: bool,
+    backend: str = "xla",
+    global_shape=None,
+    fuse: int = 1,
+    interpret: bool = False,
 ):
     """Compile-once builder for the sharded iteration program.
 
     Returns ``fn(img, reps[, mask]) -> img`` operating on the padded global
     array sharded over ``mesh``; ``reps`` is traced (no recompiles), the
-    plan's taps are compiled in.
+    plan's taps are compiled in. ``backend='pallas'`` runs the fused
+    valid-ghost Pallas kernel per chunk of ``fuse`` reps (``global_shape``
+    = padded (rows, cols*channels) required); XLA otherwise.
     """
     r = mesh.shape[ROWS_AXIS]
     c = mesh.shape[COLS_AXIS]
     axes = ((ROWS_AXIS, r, 0), (COLS_AXIS, c, 1))
     spec = P(ROWS_AXIS, COLS_AXIS) if channels == 1 else P(ROWS_AXIS, COLS_AXIS, None)
 
-    if needs_mask:
-        def local_iter(tile, reps, mask_tile):
-            return lax.fori_loop(
-                0, reps,
-                lambda _, x: _local_step(x, plan, axes, mask_tile),
-                tile,
+    if backend == "pallas":
+        if needs_mask and fuse != 1:
+            # The fused kernel only re-zeroes outside the padded global
+            # extent; the pad region inside it must be re-zeroed every rep
+            # (mask), so fused chunks would silently corrupt border pixels.
+            raise ValueError(
+                "pallas sharded execution with a pad mask requires fuse=1"
             )
+
+        def step_chunk(x, n_fused, mask_tile):
+            out = _pallas_local_chunk(
+                x, plan, axes, n_fused, global_shape, interpret
+            )
+            if mask_tile is not None:
+                out = out * mask_tile
+            return out
+    else:
+        def step_chunk(x, n_fused, mask_tile):
+            assert n_fused == 1
+            return _local_step(x, plan, axes, mask_tile)
+
+    def iter_tile(tile, reps, mask_tile):
+        # ``fuse`` reps per exchange, then the remainder one at a time.
+        # With a mask (indivisible global shape) fuse is forced to 1 by the
+        # runner: the pad region must be re-zeroed *every* rep, which a
+        # fused kernel does not do.
+        if fuse > 1:
+            tile = lax.fori_loop(
+                0, reps // fuse,
+                lambda _, x: step_chunk(x, fuse, mask_tile), tile,
+            )
+            reps = reps % fuse
+        return lax.fori_loop(
+            0, reps, lambda _, x: step_chunk(x, 1, mask_tile), tile
+        )
+
+    if needs_mask:
+        local_iter = iter_tile
         in_specs = (spec, P(), spec)
     else:
         def local_iter(tile, reps):
-            return lax.fori_loop(
-                0, reps,
-                lambda _, x: _local_step(x, plan, axes, None),
-                tile,
-            )
+            return iter_tile(tile, reps, None)
         in_specs = (spec, P())
 
     mapped = shard_map(
-        local_iter, mesh=mesh, in_specs=in_specs, out_specs=spec
+        local_iter, mesh=mesh, in_specs=in_specs, out_specs=spec,
+        # Pallas interpret mode (CPU tests) loses vma tracking on internal
+        # slices; compiled TPU mode declares vma on the kernel out_shape.
+        check_vma=not (backend == "pallas" and interpret),
     )
     return jax.jit(mapped, donate_argnums=(0,))
 
@@ -144,18 +206,11 @@ class ShardedRunner:
 
         self.model = model
         if model.backend == "auto":
-            # 'auto' degrades to XLA for sharded execution until the Pallas
-            # local kernel supports it.
+            # 'auto' resolves to XLA for sharded execution; Pallas is
+            # opt-in (backend='pallas') pending hardware wins per shape.
             self.backend = "xla"
         else:
             self.backend = resolve_backend(model.backend)
-        if self.backend == "pallas":
-            # Fail like the single-device path does rather than silently
-            # running XLA under a 'pallas' label.
-            raise NotImplementedError(
-                "the Pallas backend does not support sharded execution yet; "
-                "use backend='xla' (or 'auto')"
-            )
         self.h, self.w = image_shape
         self.channels = channels
         self.mesh = make_mesh(mesh_shape, devices, image_shape=image_shape)
@@ -178,8 +233,38 @@ class ShardedRunner:
             else P(ROWS_AXIS, COLS_AXIS, None)
         )
         self.sharding = NamedSharding(self.mesh, spec)
+        self.fuse = 1
+        interpret = False
+        if self.backend == "pallas":
+            from tpu_stencil.ops import pallas_stencil
+
+            if (not pallas_stencil._supported(model.plan)
+                    or model.halo * channels > pallas_stencil._MAX_ROLL_HALO):
+                # Same silent fallback as the single-device driver
+                # (pallas_stencil.iterate): unsupported plans run the XLA
+                # lowering.
+                self.backend = "xla"
+            else:
+                # ppermute delivers at most one neighbor tile of ghost
+                # data per hop, so the fused-chunk depth is capped by the
+                # tile; the mask path needs per-rep pad re-zeroing, which
+                # forces single-rep chunks.
+                if not self.needs_mask and model.halo:
+                    self.fuse = max(
+                        1, min(pallas_stencil.DEFAULT_FUSE,
+                               min(tile) // model.halo)
+                    )
+                elif not self.needs_mask:
+                    self.fuse = pallas_stencil.DEFAULT_FUSE
+                interpret = jax.default_backend() == "cpu"
         self._fn = build_sharded_iterate(
-            self.mesh, model.plan, channels, self.needs_mask
+            self.mesh, model.plan, channels, self.needs_mask,
+            backend=self.backend,
+            global_shape=(
+                self.padded_shape[0], self.padded_shape[1] * channels
+            ),
+            fuse=self.fuse,
+            interpret=interpret,
         )
         if self.needs_mask:
             mask = np.zeros(self.padded_shape, np.uint8)
