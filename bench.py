@@ -8,14 +8,26 @@ We report the stricter window — compute-only, barrier-fenced, max across
 hosts (the MPI metric semantics, ``mpi/mpi_convolution.c:151-155,242``) —
 and still compare against the CUDA whole-program number.
 
-Prints exactly ONE JSON line:
-  {"metric": ..., "value": seconds, "unit": "s", "vs_baseline": speedup}
+Both backends (XLA lowering and the fused Pallas kernel) are measured and
+the faster one is reported, with the per-backend numbers and the achieved
+HBM bandwidth (the honest roofline for this memory-bound workload) in the
+JSON extras.
+
+Capture is supervised: the measurement runs in a child process and the
+parent retries with backoff on failure, because one transient UNAVAILABLE
+from the TPU tunnel must not cost the round's official number (it did in
+round 1 — BENCH_r01.json).
+
+Prints exactly ONE JSON line on stdout:
+  {"metric": ..., "value": seconds, "unit": "s", "vs_baseline": speedup, ...}
 where vs_baseline = 1.017 / value (>1 means faster than the GTX-970).
 """
 
 from __future__ import annotations
 
 import json
+import os
+import subprocess
 import sys
 import time
 
@@ -23,65 +35,154 @@ import numpy as np
 
 BASELINE_S = 1.017  # GTX 970, whole-program, README.pdf p.87 40-rep RGB column
 H, W, C, REPS = 2520, 1920, 3, 40
+if os.environ.get("TPU_STENCIL_BENCH_SHAPE"):  # smoke tests only
+    H, W = (int(v) for v in os.environ["TPU_STENCIL_BENCH_SHAPE"].split("x"))
+
+ATTEMPTS = 4
+BACKOFFS = (30, 90, 180)  # seconds between attempts
+CHILD_TIMEOUT = 1800  # per-attempt wall clock (compiles are ~20-60s each)
+
+
+def _backoffs():
+    v = os.environ.get("TPU_STENCIL_BENCH_BACKOFFS")
+    return tuple(float(x) for x in v.split(",")) if v else BACKOFFS
 
 
 def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
-def main() -> int:
+def _measure_backend(backend: str) -> dict:
+    """Steady-state per-rep seconds for one backend on the north star."""
     import jax
+    import jax.numpy as jnp
 
-    from tpu_stencil import IteratedConv2D
-    from tpu_stencil.models.blur import iterate, resolve_backend
-
-    platform = jax.default_backend()
-    backend = resolve_backend("auto")
-    log(f"platform={platform} devices={jax.devices()} backend={backend}")
+    from tpu_stencil.models.blur import IteratedConv2D, iterate
+    from tpu_stencil.runtime.autotune import _steady_state_per_rep
 
     rng = np.random.default_rng(0)
     img = rng.integers(0, 256, size=(H, W, C), dtype=np.uint8)
-
     model = IteratedConv2D("gaussian", backend=backend)
 
-    def run(dev_img, n_reps):
-        out = iterate(dev_img, jax.numpy.int32(n_reps), plan=model.plan,
-                      backend=backend)
+    def run(n_reps: int) -> float:
+        dev = jax.device_put(img)  # fresh every call: iterate donates
         # Fetch one element: a completion fence that works even where
         # block_until_ready returns early (e.g. the axon TPU tunnel).
-        np.asarray(out.ravel()[0])
-        return out
-
-    # Warm-up: compile + one full run (also pre-commits the donation layout).
-    run(jax.device_put(img), REPS)
-    log("compiled; timing")
-
-    # Per-rep device time via a long steady-state run: dispatch/fence
-    # overhead (tunnel RTT can be ~50 ms) is amortized over LONG_REPS
-    # iterations, then scaled to the 40-rep config. The reference's MPI
-    # metric likewise excludes startup (timer opens after MPI_Barrier).
-    LONG_REPS = 4000
-    times = []
-    for i in range(3):
-        dev_img = jax.device_put(img)
-        np.asarray(dev_img.ravel()[0])
+        np.asarray(dev.ravel()[0])
         t0 = time.perf_counter()
-        run(dev_img, LONG_REPS)
-        dt = time.perf_counter() - t0
-        times.append(dt)
-        log(f"run {i}: {dt:.3f} s for {LONG_REPS} reps "
-            f"({dt / LONG_REPS * 1e6:.1f} us/rep)")
+        out = iterate(dev, jnp.int32(n_reps), plan=model.plan, backend=backend)
+        np.asarray(out.ravel()[0])
+        return time.perf_counter() - t0
 
-    per_rep = float(np.median(times)) / LONG_REPS
+    run(2)  # warm-up compile (also pre-commits the donation layout)
+    log(f"{backend}: compiled; timing")
+    # Dispatch/fence overhead (tunnel RTT can be ~50 ms) cancels in the
+    # two-point differencing; 2000/4000-rep runs amortize everything else.
+    # (Override for smoke tests on slow platforms.)
+    base_reps = int(os.environ.get("TPU_STENCIL_BENCH_REPS", "2000"))
+    per_rep = _steady_state_per_rep(run, base_reps)
+    log(f"{backend}: {per_rep * 1e6:.1f} us/rep")
+    return {"us_per_rep": round(per_rep * 1e6, 2), "per_rep_s": per_rep}
+
+
+def child_main() -> int:
+    # Test-only crash injection: if the marker file exists, consume it and
+    # die the way a tunnel drop kills a real capture (lets the retry loop
+    # be tested without a TPU).
+    marker = os.environ.get("TPU_STENCIL_BENCH_FAIL_MARKER")
+    if marker and os.path.exists(marker):
+        os.unlink(marker)
+        log("injected failure (TPU_STENCIL_BENCH_FAIL_MARKER)")
+        return 1
+
+    import jax
+
+    # The axon sitecustomize (PYTHONPATH) force-exports JAX_PLATFORMS=axon,
+    # so a plain env var cannot select another platform; the config API
+    # still wins (tests set TPU_STENCIL_BENCH_PLATFORM=cpu).
+    forced = os.environ.get("TPU_STENCIL_BENCH_PLATFORM")
+    if forced:
+        jax.config.update("jax_platforms", forced)
+
+    platform = jax.default_backend()
+    log(f"platform={platform} devices={jax.devices()}")
+
+    candidates = ["xla"]
+    if platform not in ("cpu",):
+        candidates.append("pallas")
+
+    results = {}
+    for backend in candidates:
+        try:
+            results[backend] = _measure_backend(backend)
+        except Exception as e:  # one broken backend must not kill the capture
+            log(f"{backend}: FAILED {type(e).__name__}: {e}")
+    if not results:
+        return 1
+
+    winner = min(results, key=lambda b: results[b]["per_rep_s"])
+    per_rep = results[winner]["per_rep_s"]
     value = per_rep * REPS
+
+    from tpu_stencil.runtime import roofline
+
+    gbps, pct = roofline.achieved(H * W * C, per_rep, winner, "gaussian", H)
     result = {
         "metric": f"{W}x{H}_rgb_{REPS}reps_compute_wall_clock",
         "value": round(value, 6),
         "unit": "s",
         "vs_baseline": round(BASELINE_S / value, 2),
+        "backend": winner,
+        "backends_us_per_rep": {
+            b: r["us_per_rep"] for b, r in results.items()
+        },
+        "hbm_gbps": round(gbps, 1),
+        "pct_hbm_peak": round(pct, 1),
+        "platform": platform,
     }
     print(json.dumps(result))
     return 0
+
+
+def main() -> int:
+    if os.environ.get("TPU_STENCIL_BENCH_CHILD") == "1":
+        return child_main()
+
+    last_line = None
+    for attempt in range(ATTEMPTS):
+        env = dict(os.environ, TPU_STENCIL_BENCH_CHILD="1")
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)],
+                env=env, capture_output=True, text=True,
+                timeout=CHILD_TIMEOUT,
+            )
+        except subprocess.TimeoutExpired as e:
+            # Preserve the child's trail (platform/compile/progress lines):
+            # without it a hung capture is undiagnosable.
+            if e.stderr:
+                sys.stderr.write(
+                    e.stderr if isinstance(e.stderr, str)
+                    else e.stderr.decode(errors="replace")
+                )
+            log(f"attempt {attempt}: timed out after {CHILD_TIMEOUT}s")
+            proc = None
+        if proc is not None:
+            sys.stderr.write(proc.stderr)
+            lines = [l for l in proc.stdout.splitlines() if l.strip()]
+            if proc.returncode == 0 and lines:
+                print(lines[-1])
+                return 0
+            last_line = lines[-1] if lines else None
+            log(f"attempt {attempt}: rc={proc.returncode}")
+        if attempt < ATTEMPTS - 1:
+            backoffs = _backoffs()
+            delay = backoffs[min(attempt, len(backoffs) - 1)]
+            log(f"retrying in {delay}s (TPU tunnel may be recovering)")
+            time.sleep(delay)
+    if last_line:
+        print(last_line)
+    return 1
 
 
 if __name__ == "__main__":

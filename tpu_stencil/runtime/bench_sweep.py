@@ -4,16 +4,19 @@ Reproduces the sweep the reference's authors ran by hand on the lab cluster
 (BASELINE.md: 4 image sizes x {grey, rgb} x process counts, plus the CUDA
 reps sweep) and the extra ``BASELINE.json`` configs (wider 5x5/7x7 halos,
 8K x 1000-rep stress). Emits one markdown table (and optional CSV) with the
-measured per-rep and per-run times and the speedup vs the reference's
-published number where one exists.
+measured per-rep times, the achieved HBM bandwidth and % of v5e peak (the
+honest roofline for a memory-bound stencil — a row far off the roofline is
+a regression even when the speedup column looks good), and the speedup vs
+the reference's published number where one exists.
 
-Timing method: steady-state per-rep (a long on-device rep loop divided by
-its rep count — dispatch overhead amortized; see bench.py), matching the
+Timing method: steady-state two-point differencing (autotune's
+``_steady_state_per_rep``) — dispatch/fence overhead cancels, matching the
 reference's compute-only MPI window semantics.
 
 Usage:
     python -m tpu_stencil.runtime.bench_sweep [--quick] [--stress]
         [--csv out.csv] [--filters gaussian,gaussian5,gaussian7]
+        [--backends xla,pallas]
 """
 
 from __future__ import annotations
@@ -33,29 +36,29 @@ _CUDA_40REPS = {
     ("rgb", 630): 0.307, ("rgb", 1260): 0.537,
     ("rgb", 2520): 1.017, ("rgb", 5040): 1.837,
 }
-_CUDA_100REPS_8K = None  # no 8K row in the reference tables
 
 SIZES = (630, 1260, 2520, 5040)
 WIDTH = 1920
 
 
-def _measure_per_rep(img: np.ndarray, filter_name: str, budget_s: float) -> float:
-    """Two-point differencing: per_rep = (t(2N) - t(N)) / N cancels the
-    constant dispatch/fence overhead (which can reach ~50 ms through a TPU
-    tunnel and would otherwise swamp small images); N is scaled so each
-    measurement runs ~budget_s on device."""
+def _measure_per_rep(
+    img: np.ndarray, filter_name: str, budget_s: float, backend: str
+) -> float:
+    """Steady-state seconds/rep; N scaled so each measurement runs
+    ~budget_s on device."""
     import jax
     import jax.numpy as jnp
 
     from tpu_stencil.models.blur import IteratedConv2D, iterate
+    from tpu_stencil.runtime.autotune import _steady_state_per_rep
 
-    model = IteratedConv2D(filter_name, backend="xla")
+    model = IteratedConv2D(filter_name, backend=backend)
 
     def timed(n_reps: int) -> float:
         dev = jax.device_put(img)
         np.asarray(dev.ravel()[0])
         t0 = time.perf_counter()
-        out = iterate(dev, jnp.int32(n_reps), plan=model.plan, backend="xla")
+        out = iterate(dev, jnp.int32(n_reps), plan=model.plan, backend=backend)
         np.asarray(out.ravel()[0])
         return time.perf_counter() - t0
 
@@ -63,9 +66,29 @@ def _measure_per_rep(img: np.ndarray, filter_name: str, budget_s: float) -> floa
     probe_reps = 500
     est = max(timed(probe_reps) / probe_reps, 1e-8)
     lo = min(max(int(budget_s / est), 200), 50_000)
-    from tpu_stencil.runtime.autotune import _steady_state_per_rep
-
     return _steady_state_per_rep(timed, lo)
+
+
+def _row(img, filter_name, mode, size_label, backend, budget_s, reps,
+         base) -> dict:
+    from tpu_stencil.runtime import roofline
+
+    per_rep = _measure_per_rep(img, filter_name, budget_s, backend)
+    total = per_rep * reps
+    gbps, pct = roofline.achieved(
+        img.nbytes, per_rep, backend, filter_name, img.shape[0]
+    )
+    return {
+        "filter": filter_name, "mode": mode, "size": size_label,
+        "backend": backend,
+        "us_per_rep": round(per_rep * 1e6, 1),
+        "reps": reps,
+        "total_s": round(total, 6),
+        "hbm_gbps": round(gbps, 1),
+        "pct_hbm_peak": round(pct, 1),
+        "gtx970_40reps_s": base,
+        "speedup_vs_gtx970": round(base / total, 1) if base else None,
+    }
 
 
 def run_sweep(
@@ -73,43 +96,32 @@ def run_sweep(
     stress: bool = False,
     filters: Optional[List[str]] = None,
     csv_path: Optional[str] = None,
+    backends: Optional[List[str]] = None,
 ) -> List[dict]:
     filters = filters or ["gaussian"]
+    backends = backends or ["xla"]
     rng = np.random.default_rng(0)
     budget_s = 0.1 if quick else 0.5
     rows = []
     sizes = SIZES[:2] if quick else SIZES
-    for filter_name in filters:
-        for mode in ("grey", "rgb"):
-            for h in sizes:
-                shape = (h, WIDTH) if mode == "grey" else (h, WIDTH, 3)
-                img = rng.integers(0, 256, size=shape, dtype=np.uint8)
-                per_rep = _measure_per_rep(img, filter_name, budget_s)
-                t40 = per_rep * 40
-                base = (
-                    _CUDA_40REPS.get((mode, h)) if filter_name == "gaussian" else None
-                )
-                rows.append({
-                    "filter": filter_name, "mode": mode,
-                    "size": f"{WIDTH}x{h}",
-                    "us_per_rep": round(per_rep * 1e6, 1),
-                    "reps": 40,
-                    "total_s": round(t40, 6),
-                    "gtx970_40reps_s": base,
-                    "speedup_vs_gtx970": round(base / t40, 1) if base else None,
-                })
-                print(_fmt_row(rows[-1]), file=sys.stderr, flush=True)
-    if stress:
-        img = rng.integers(0, 256, size=(4320, 7680, 3), dtype=np.uint8)
-        per_rep = _measure_per_rep(img, "gaussian", budget_s * 4)
-        rows.append({
-            "filter": "gaussian", "mode": "rgb", "size": "7680x4320 (8K)",
-            "us_per_rep": round(per_rep * 1e6, 1),
-            "reps": 1000,
-            "total_s": round(per_rep * 1000, 6),
-            "gtx970_40reps_s": None, "speedup_vs_gtx970": None,
-        })
-        print(_fmt_row(rows[-1]), file=sys.stderr, flush=True)
+    for backend in backends:
+        for filter_name in filters:
+            for mode in ("grey", "rgb"):
+                for h in sizes:
+                    shape = (h, WIDTH) if mode == "grey" else (h, WIDTH, 3)
+                    img = rng.integers(0, 256, size=shape, dtype=np.uint8)
+                    base = (
+                        _CUDA_40REPS.get((mode, h))
+                        if filter_name == "gaussian" else None
+                    )
+                    rows.append(_row(img, filter_name, mode, f"{WIDTH}x{h}",
+                                     backend, budget_s, 40, base))
+                    print(_fmt_row(rows[-1]), file=sys.stderr, flush=True)
+        if stress:
+            img = rng.integers(0, 256, size=(4320, 7680, 3), dtype=np.uint8)
+            rows.append(_row(img, "gaussian", "rgb", "7680x4320 (8K)",
+                             backend, budget_s * 4, 1000, None))
+            print(_fmt_row(rows[-1]), file=sys.stderr, flush=True)
     if csv_path:
         import csv
 
@@ -122,19 +134,22 @@ def run_sweep(
 
 def _fmt_row(r: dict) -> str:
     sp = f"{r['speedup_vs_gtx970']}x" if r["speedup_vs_gtx970"] else "-"
-    return (f"{r['filter']:>10} {r['mode']:>4} {r['size']:>12}: "
-            f"{r['us_per_rep']:>8} us/rep, {r['reps']} reps = "
-            f"{r['total_s']:.4f} s, vs GTX-970 {sp}")
+    return (f"{r['filter']:>10} {r['mode']:>4} {r['size']:>12} "
+            f"[{r['backend']}]: {r['us_per_rep']:>8} us/rep, "
+            f"{r['hbm_gbps']:>6} GB/s ({r['pct_hbm_peak']}% peak), "
+            f"{r['reps']} reps = {r['total_s']:.4f} s, vs GTX-970 {sp}")
 
 
 def emit_markdown(rows: List[dict]) -> str:
     lines = [
-        "| filter | mode | size | us/rep | reps | total (s) | GTX-970 40 reps (s) | speedup |",
-        "|---|---|---|---|---|---|---|---|",
+        "| filter | mode | size | backend | us/rep | HBM GB/s | % peak "
+        "| reps | total (s) | GTX-970 40 reps (s) | speedup |",
+        "|---|---|---|---|---|---|---|---|---|---|---|",
     ]
     for r in rows:
         lines.append(
-            f"| {r['filter']} | {r['mode']} | {r['size']} | {r['us_per_rep']} "
+            f"| {r['filter']} | {r['mode']} | {r['size']} | {r['backend']} "
+            f"| {r['us_per_rep']} | {r['hbm_gbps']} | {r['pct_hbm_peak']} "
             f"| {r['reps']} | {r['total_s']} | {r['gtx970_40reps_s'] or '-'} "
             f"| {str(r['speedup_vs_gtx970']) + 'x' if r['speedup_vs_gtx970'] else '-'} |"
         )
@@ -150,10 +165,15 @@ def main(argv=None) -> int:
         "--filters", default="gaussian",
         help="comma-separated filter names (default gaussian)",
     )
+    p.add_argument(
+        "--backends", default="xla",
+        help="comma-separated backends to sweep (xla,pallas)",
+    )
     ns = p.parse_args(argv)
     rows = run_sweep(
         quick=ns.quick, stress=ns.stress,
         filters=ns.filters.split(","), csv_path=ns.csv,
+        backends=ns.backends.split(","),
     )
     print(emit_markdown(rows))
     return 0

@@ -1,0 +1,44 @@
+"""Shared roofline model for the benchmark tools.
+
+This workload is memory-bound (SURVEY.md §6: ~0.26 GFLOP/rep vs ~29 MB/rep
+on the north star), so the honest headline is achieved HBM bytes/s against
+the chip's peak — a row far off the roofline is a regression even when the
+vs-GTX-970 speedup column looks flattering. Both ``bench.py`` and
+``bench_sweep`` report through these helpers so the constants and the
+traffic model cannot drift apart.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+V5E_HBM_GBPS = 819.0  # v5e HBM peak bandwidth
+
+
+def effective_fuse(filter_name: str, h_img: int) -> int:
+    """The fuse depth :func:`tpu_stencil.ops.pallas_stencil.iterate` will
+    actually use for this (filter, image height) — HBM traffic per rep is
+    divided by it. Mirrors iterate's clamp exactly."""
+    from tpu_stencil.models.blur import IteratedConv2D
+    from tpu_stencil.ops import pallas_stencil as ps
+
+    plan = IteratedConv2D(filter_name).plan
+    if not ps._supported(plan):
+        return 1
+    bh = min(ps.DEFAULT_BLOCK_H, -(-h_img // 8) * 8)
+    if plan.halo:
+        return max(1, min(ps.DEFAULT_FUSE, bh // (2 * plan.halo)))
+    return ps.DEFAULT_FUSE
+
+
+def achieved(frame_bytes: int, per_rep_s: float, backend: str,
+             filter_name: str, h_img: int) -> Tuple[float, float]:
+    """(HBM GB/s, % of v5e peak) for one measured per-rep time.
+
+    The XLA step reads + writes the frame every rep; the fused Pallas
+    kernel pays HBM once per ``fuse`` reps (ghost-band overhead excluded —
+    it is compute, not extra HBM traffic).
+    """
+    fuse = effective_fuse(filter_name, h_img) if backend == "pallas" else 1
+    gbps = 2 * frame_bytes / fuse / per_rep_s / 1e9
+    return gbps, 100 * gbps / V5E_HBM_GBPS
