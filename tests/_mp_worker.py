@@ -6,11 +6,14 @@ Each process runs the REAL multi-host stack end to end: explicit
 shard_map compute, and concurrent ``write_sharded`` into one shared output
 file (the MPI-IO pattern). Invoked by tests/test_multiprocess.py as:
 
-    python tests/_mp_worker.py <proc_id> <coordinator> <img> <out> <mesh_r> <mesh_c> [ckpt_every]
+    python tests/_mp_worker.py <proc_id> <coordinator> <img> <out> <mesh_r> <mesh_c> [mode]
 
-With ``ckpt_every`` > 0 the job instead runs through ``driver.run_job``
-with sharded checkpointing: every host writes its shards into the shared
-.ckpt data file and process 0 commits the metadata after a barrier.
+``mode`` (optional): an integer N > 0 runs through ``driver.run_job`` with
+sharded checkpointing every N reps (every host writes its shards into the
+shared .ckpt data file, process 0 commits metadata after a barrier);
+``cli`` runs ``tpu_stencil.cli.main`` with argv that *diverges across
+ranks* (rank 1 asks for different reps and output) — the broadcast_config
+wiring must make every rank run rank-0's job anyway.
 """
 
 import os
@@ -22,7 +25,8 @@ def main() -> None:
     coordinator = sys.argv[2]
     img_path, out_path = sys.argv[3], sys.argv[4]
     mesh_shape = (int(sys.argv[5]), int(sys.argv[6]))
-    ckpt_every = int(sys.argv[7]) if len(sys.argv) > 7 else 0
+    mode = sys.argv[7] if len(sys.argv) > 7 else "0"
+    ckpt_every = int(mode) if mode.isdigit() else 0
 
     os.environ["JAX_PLATFORMS"] = "cpu"
     os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
@@ -35,6 +39,53 @@ def main() -> None:
     # Before any JAX computation — the constraint initialize() documents.
     distributed.initialize(coordinator, num_processes=2, process_id=proc_id)
     assert jax.process_count() == 2, jax.process_count()
+
+    if mode == "mesh":
+        # DCN-aware auto factorization: a wide image whose unconstrained
+        # perimeter optimum is (1, 4) — which would put a column-neighbor
+        # ppermute across the host boundary mid-row — must instead pick a
+        # grid whose rows are whole-host runs (cols divide the per-host
+        # device count), so intra-row halo traffic stays on ICI.
+        from tpu_stencil.parallel import mesh as mesh_mod
+        from tpu_stencil.parallel import partition
+
+        assert partition.grid_shape(4, 6, 100) == (1, 4)  # unconstrained
+        m = mesh_mod.make_mesh(image_shape=(6, 100))
+        r, c = m.shape[mesh_mod.ROWS_AXIS], m.shape[mesh_mod.COLS_AXIS]
+        assert (r, c) == (2, 2), (r, c)
+        for row in m.devices:
+            procs = {d.process_index for d in row}
+            assert len(procs) == 1, (
+                f"mesh row spans hosts {procs}: intra-row neighbors must "
+                f"be co-hosted"
+            )
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices("mesh_done")
+        print(f"proc {proc_id} done", flush=True)
+        return
+
+    if mode == "cli":
+        # Divergent argv across ranks: rank 1 asks for 99 reps and a wrong
+        # output path. cli.main's broadcast_config must override both with
+        # rank-0's values (the failure MPI_Bcast prevents,
+        # mpi/mpi_convolution.c:50-70).
+        from tpu_stencil import cli
+
+        mesh = f"{mesh_shape[0]}x{mesh_shape[1]}"
+        if proc_id == 0:
+            argv = [img_path, "20", "12", "3", "rgb",
+                    "--mesh", mesh, "--output", out_path]
+        else:
+            argv = [img_path, "20", "12", "99", "rgb",
+                    "--mesh", mesh, "--output", out_path + ".wrong"]
+        rc = cli.main(argv)
+        assert rc == 0
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices("cli_done")
+        print(f"proc {proc_id} done", flush=True)
+        return
 
     from tpu_stencil.config import ImageType, JobConfig
 

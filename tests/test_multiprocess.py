@@ -64,6 +64,71 @@ def test_two_process_end_to_end(tmp_path, rng, mesh):
     np.testing.assert_array_equal(got, want)
 
 
+def test_two_process_cli_divergent_argv_runs_rank0_job(tmp_path, rng):
+    # Each rank parses its own argv; rank 1's asks for 99 reps and a wrong
+    # output path. cli.main's broadcast_config must make both ranks run
+    # rank-0's 3-rep job into rank-0's output (the silent job shear
+    # MPI_Bcast exists to prevent).
+    img = rng.integers(0, 256, size=(12, 20, 3), dtype=np.uint8)
+    src = str(tmp_path / "in.raw")
+    dst = str(tmp_path / "out.raw")
+    raw_io.write_raw(src, img)
+
+    coordinator = f"127.0.0.1:{_free_port()}"
+    env = dict(
+        os.environ,
+        PYTHONPATH=os.pathsep.join(
+            [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
+            + os.environ.get("PYTHONPATH", "").split(os.pathsep)
+        ),
+    )
+    procs = [
+        subprocess.Popen(
+            [sys.executable, _WORKER, str(pid), coordinator, src, dst,
+             "2", "2", "cli"],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for pid in range(2)
+    ]
+    outs = [p.communicate(timeout=300)[0] for p in procs]
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, f"worker failed:\n{out}"
+
+    assert not os.path.exists(dst + ".wrong")  # rank 1's argv never won
+    got = raw_io.read_raw(dst, 20, 12, 3)
+    want = stencil.reference_stencil_numpy(img, filters.get_filter("gaussian"), 3)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_two_process_dcn_aware_mesh_layout(tmp_path, rng):
+    # Auto factorization across 2 hosts must keep each mesh row within one
+    # host (cols-on-ICI / rows-across-DCN), even when the unconstrained
+    # perimeter optimum would split a row across hosts.
+    src = str(tmp_path / "unused.raw")
+    open(src, "wb").close()
+    coordinator = f"127.0.0.1:{_free_port()}"
+    env = dict(
+        os.environ,
+        PYTHONPATH=os.pathsep.join(
+            [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
+            + os.environ.get("PYTHONPATH", "").split(os.pathsep)
+        ),
+    )
+    procs = [
+        subprocess.Popen(
+            [sys.executable, _WORKER, str(pid), coordinator, src, src,
+             "2", "2", "mesh"],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for pid in range(2)
+    ]
+    outs = [p.communicate(timeout=300)[0] for p in procs]
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, f"worker failed:\n{out}"
+
+
 def test_two_process_checkpointed_run(tmp_path, rng):
     # run_job with --checkpoint-every across 2 processes: sharded ckpt
     # writes + proc-0 metadata commits + final clear must not perturb the

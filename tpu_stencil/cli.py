@@ -28,6 +28,17 @@ def main(argv=None) -> int:
     from tpu_stencil.parallel import distributed
 
     distributed.initialize()
+    import jax
+
+    if jax.process_count() > 1:
+        # Rank 0 validates, everyone else receives — the MPI_Bcast
+        # discipline (mpi/mpi_convolution.c:50-70). Without it, ranks
+        # launched with divergent argv would silently shear the job (each
+        # computing different reps/shape against the same shared files);
+        # with it, every rank runs rank-0's job.
+        cfg = distributed.broadcast_config(
+            cfg if jax.process_index() == 0 else None
+        )
     result = driver.run_job(
         cfg,
         profile_dir=ns.profile,

@@ -62,34 +62,43 @@ def _resolve_step(backend: str):
 
 
 @functools.partial(
-    jax.jit, static_argnames=("plan", "backend"), donate_argnums=(0,)
+    jax.jit, static_argnames=("plan", "backend", "boundary"),
+    donate_argnums=(0,),
 )
 def iterate(img_u8: jax.Array, repetitions: jax.Array,
-            plan: _lowering.StencilPlan, backend: str = "xla") -> jax.Array:
+            plan: _lowering.StencilPlan, backend: str = "xla",
+            boundary: str = "zero") -> jax.Array:
     """Apply the stencil ``repetitions`` times; uint8 in, uint8 out.
 
     The input buffer is donated: XLA reuses it as one of the two HBM
     double-buffers. ``repetitions`` is traced (any rep count, no recompile);
     ``plan`` is static — taps are compiled in as constants so each filter
     gets its fastest schedule (see :mod:`tpu_stencil.ops.lowering`).
+    ``boundary='periodic'`` runs the wraparound semantics; the single-device
+    Pallas kernel is zero-boundary only, so periodic uses the XLA schedule.
     """
-    if resolve_backend(backend) == "pallas":
+    if resolve_backend(backend) == "pallas" and boundary == "zero":
         from tpu_stencil.ops import pallas_stencil
 
         # The Pallas driver owns its rep loop: the carry stays row-padded
         # across repetitions instead of padding/cropping every step.
         return pallas_stencil.iterate(img_u8, repetitions, plan)
-    step = _resolve_step(backend)
+    eff_backend = (
+        "xla" if resolve_backend(backend) == "pallas" else backend
+    )  # pallas is zero-boundary only; periodic runs the XLA schedule
+    step = _resolve_step(eff_backend)
     return jax.lax.fori_loop(
-        0, repetitions, lambda _, x: step(x, plan), img_u8
+        0, repetitions, lambda _, x: step(x, plan, boundary), img_u8
     )
 
 
 @functools.partial(
-    jax.jit, static_argnames=("plan", "backend"), donate_argnums=(0,)
+    jax.jit, static_argnames=("plan", "backend", "boundary"),
+    donate_argnums=(0,),
 )
 def iterate_batch(imgs_u8: jax.Array, repetitions: jax.Array,
-                  plan: _lowering.StencilPlan, backend: str = "xla") -> jax.Array:
+                  plan: _lowering.StencilPlan, backend: str = "xla",
+                  boundary: str = "zero") -> jax.Array:
     """Batched :func:`iterate`: apply the stencil to N independent frames
     ``(N, H, W[, C])`` at once via ``vmap`` — the video/burst mode.
 
@@ -105,7 +114,7 @@ def iterate_batch(imgs_u8: jax.Array, repetitions: jax.Array,
         step = _lowering.padded_step
     else:
         step = _resolve_step(backend)
-    vstep = jax.vmap(lambda x: step(x, plan))
+    vstep = jax.vmap(lambda x: step(x, plan, boundary))
     return jax.lax.fori_loop(0, repetitions, lambda _, x: vstep(x), imgs_u8)
 
 
@@ -120,13 +129,17 @@ class IteratedConv2D:
         self,
         filt: Union[str, Filter, np.ndarray, jax.Array] = "gaussian",
         backend: str = "auto",
+        boundary: str = "zero",
     ) -> None:
         if isinstance(filt, str):
             filt = _filters.get_filter(filt)
+        if boundary not in ("zero", "periodic"):
+            raise ValueError(f"unknown boundary {boundary!r}")
         self.filter = _filters.as_filter(
             filt if isinstance(filt, Filter) else np.asarray(filt)
         )
         self.backend = backend
+        self.boundary = boundary
         self.plan = _lowering.plan_filter(self.filter)
         if backend == "reference":
             self.plan = _lowering.force_f32_plan(self.plan)
@@ -137,7 +150,12 @@ class IteratedConv2D:
 
     def step(self, img_u8: jax.Array) -> jax.Array:
         """A single (unjitted) filter application — the jittable unit."""
-        step = _resolve_step(self.backend)
+        backend = self.backend
+        if self.boundary != "zero" and resolve_backend(backend) == "pallas":
+            backend = "xla"
+        step = _resolve_step(backend)
+        if step is _lowering.padded_step:
+            return step(img_u8, self.plan, self.boundary)
         return step(img_u8, self.plan)
 
     def batch(self, imgs_u8, repetitions: int) -> jax.Array:
@@ -148,7 +166,7 @@ class IteratedConv2D:
             imgs_u8 = jnp.asarray(imgs_u8, dtype=jnp.uint8)
         return iterate_batch(
             imgs_u8, jnp.int32(repetitions), plan=self.plan,
-            backend=resolve_backend(self.backend),
+            backend=resolve_backend(self.backend), boundary=self.boundary,
         )
 
     def __call__(self, img_u8, repetitions: int) -> jax.Array:
@@ -170,5 +188,6 @@ class IteratedConv2D:
         else:
             resolved = resolve_backend(self.backend)
         return iterate(
-            img_u8, jnp.int32(repetitions), plan=self.plan, backend=resolved
+            img_u8, jnp.int32(repetitions), plan=self.plan, backend=resolved,
+            boundary=self.boundary,
         )

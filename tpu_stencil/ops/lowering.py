@@ -257,19 +257,26 @@ def sep_cols_pass(acc_i32: jax.Array, plan: StencilPlan) -> jax.Array:
     return _finish_int(_sep_pass(acc_i32, plan.col_taps, 1), plan)
 
 
-def padded_step(img_u8: jax.Array, plan: StencilPlan) -> jax.Array:
-    """One stencil application with zero boundary padding (same shape out).
+def padded_step(img_u8: jax.Array, plan: StencilPlan,
+                boundary: str = "zero") -> jax.Array:
+    """One stencil application with boundary padding (same shape out).
+
+    ``boundary``: 'zero' (reference MPI semantics) or 'periodic'
+    (wraparound — ``jnp.pad(mode='wrap')``).
 
     For separable plans the pad is applied per pass, in the pass's own dim,
     *after* the int32 convert — measured 3x faster on v5e than padding both
     dims of the uint8 input up front (141 vs 430 us/rep on 1920x2520 RGB):
     XLA fuses a pad into the consuming pass only when the pad dim matches
     the pass dim, and fuses the u8->i32 convert only ahead of a pad.
+    Per-pass wrap is exact for periodic too: the rows-pass output of a
+    row-wrapped array is itself periodic along cols.
     """
     h = plan.halo
     trail = [(0, 0)] * (img_u8.ndim - 2)
+    mode = {"zero": "constant", "periodic": "wrap"}[boundary]
     if plan.kind == "sep_int":
         xi = img_u8.astype(jnp.int32)
-        a = sep_rows_pass(jnp.pad(xi, [(h, h), (0, 0)] + trail), plan)
-        return sep_cols_pass(jnp.pad(a, [(0, 0), (h, h)] + trail), plan)
-    return valid_step(jnp.pad(img_u8, [(h, h), (h, h)] + trail), plan)
+        a = sep_rows_pass(jnp.pad(xi, [(h, h), (0, 0)] + trail, mode=mode), plan)
+        return sep_cols_pass(jnp.pad(a, [(0, 0), (h, h)] + trail, mode=mode), plan)
+    return valid_step(jnp.pad(img_u8, [(h, h), (h, h)] + trail, mode=mode), plan)

@@ -85,12 +85,18 @@ def stencil_step(img_u8: jax.Array, taps: jax.Array, divisor: jax.Array) -> jax.
     return truncate_u8(acc / divisor)
 
 
-def reference_stencil_numpy(img_u8: np.ndarray, filt, reps: int) -> np.ndarray:
+def reference_stencil_numpy(
+    img_u8: np.ndarray, filt, reps: int, boundary: str = "zero"
+) -> np.ndarray:
     """Pure-NumPy golden model of ``reps`` iterations, written independently
-    of the JAX path: explicit per-pixel loops over a zero-padded buffer.
+    of the JAX path: explicit per-pixel loops over a padded buffer.
     Used by tests only — O(H*W*k*k*reps) slow, mirrors
     ``ConvolutionforGrey/RGB`` semantics (``mpi/mpi_convolution.c:301-322``)
     without sharing any code with the fast path.
+
+    ``boundary``: 'zero' (the MPI code's calloc'd ghost ring) or 'periodic'
+    (the wraparound the reference's README *describes* but its code never
+    implements — SURVEY.md Quirk 5; offered as an explicit extension).
 
     ``filt`` is a :class:`tpu_stencil.filters.Filter` (or raw normalized
     array, divisor 1). For exact filters (integer taps, in-range) the
@@ -98,6 +104,8 @@ def reference_stencil_numpy(img_u8: np.ndarray, filt, reps: int) -> np.ndarray:
     reproduce bit-for-bit; otherwise float32 in row-major tap order."""
     from tpu_stencil.filters import as_filter
 
+    if boundary not in ("zero", "periodic"):
+        raise ValueError(f"unknown boundary {boundary!r}")
     f = as_filter(filt)
     taps, divisor = f.taps, np.float32(f.divisor)
     k = f.k
@@ -109,8 +117,13 @@ def reference_stencil_numpy(img_u8: np.ndarray, filt, reps: int) -> np.ndarray:
     h, w, c = img.shape
     cur = img.astype(np.uint8)
     for _ in range(reps):
-        padded = np.zeros((h + 2 * halo, w + 2 * halo, c), np.uint8)
-        padded[halo : halo + h, halo : halo + w] = cur
+        if boundary == "periodic":
+            padded = np.pad(
+                cur, ((halo, halo), (halo, halo), (0, 0)), mode="wrap"
+            )
+        else:
+            padded = np.zeros((h + 2 * halo, w + 2 * halo, c), np.uint8)
+            padded[halo : halo + h, halo : halo + w] = cur
         out = np.empty_like(cur)
         for y in range(h):
             for x in range(w):

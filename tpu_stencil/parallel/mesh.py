@@ -37,9 +37,20 @@ def make_mesh(
     if devices is None:
         devices = jax.devices()
     n = len(devices)
+    n_procs = len({d.process_index for d in devices})
+    if n_procs > 1:
+        # DCN-aware layout: group devices by host so that (with cols
+        # dividing the per-host count) each mesh row is whole-host runs —
+        # column ppermutes ride ICI, only row-boundary strips cross DCN.
+        # jax.devices() is already process-grouped; sorting makes it an
+        # invariant rather than an assumption.
+        devices = sorted(devices, key=lambda d: (d.process_index, d.id))
     if mesh_shape is None:
         h, w = image_shape if image_shape is not None else (1, 1)
-        mesh_shape = partition.grid_shape(n, h, w)
+        per_host = n // n_procs if n % n_procs == 0 else 0
+        mesh_shape = partition.grid_shape(
+            n, h, w, cols_must_divide=per_host if n_procs > 1 else 0
+        )
     r, c = mesh_shape
     if r * c != n:
         raise ValueError(f"mesh shape {r}x{c} != {n} devices")

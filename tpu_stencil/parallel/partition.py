@@ -15,27 +15,47 @@ from __future__ import annotations
 from typing import Tuple
 
 
-def grid_shape(n_devices: int, height: int, width: int) -> Tuple[int, int]:
+def grid_shape(
+    n_devices: int, height: int, width: int,
+    cols_must_divide: int = 0,
+) -> Tuple[int, int]:
     """Perimeter-minimizing (rows, cols) grid with rows*cols == n_devices.
 
     Minimizes ``height/rows + width/cols`` (proportional to halo bytes per
     device) over all factor pairs; ties broken toward more row splits
     (contiguous rows = friendlier raw-file I/O offsets).
+
+    ``cols_must_divide`` > 0 restricts candidates to ``cols`` dividing that
+    value — the DCN-aware constraint: with devices grouped by host and
+    ``cols`` dividing the per-host device count, every mesh row is made of
+    whole-host runs, so the frequent column-neighbor ppermutes ride ICI and
+    only row-boundary strips cross the (much slower) DCN. Falls back to the
+    unconstrained optimum when no factorization satisfies it.
     """
     if n_devices < 1:
         raise ValueError(f"n_devices must be >= 1, got {n_devices}")
-    best: Tuple[float, int] | None = None
-    best_r = 1
-    for r in range(1, n_devices + 1):
-        if n_devices % r:
-            continue
-        c = n_devices // r
-        cost = height / r + width / c
-        key = (cost, -r)
-        if best is None or key < best:
-            best = key
-            best_r = r
-    return best_r, n_devices // best_r
+
+    def search(constrained: bool) -> Tuple[int, int] | None:
+        best = None
+        best_r = 0
+        for r in range(1, n_devices + 1):
+            if n_devices % r:
+                continue
+            c = n_devices // r
+            if constrained and cols_must_divide % c:
+                continue
+            cost = height / r + width / c
+            key = (cost, -r)
+            if best is None or key < best:
+                best = key
+                best_r = r
+        return (best_r, n_devices // best_r) if best_r else None
+
+    if cols_must_divide > 0:
+        got = search(constrained=True)
+        if got is not None:
+            return got
+    return search(constrained=False)
 
 
 def pad_amounts(height: int, width: int, grid: Tuple[int, int]) -> Tuple[int, int]:
