@@ -1,0 +1,352 @@
+#!/usr/bin/env python
+"""Kernel lab: time fused-stencil variants on the real chip to locate the
+VPU bottleneck (VERDICT r2 item 1: 84.7 us/rep at 5.2% of HBM peak).
+
+Variants (bit-exact unless marked ABLATION):
+  shipped      — tpu_stencil.ops.pallas_stencil.iterate as shipped
+  current      — lab re-implementation of the shipped kernel (sanity)
+  hoist        — keep-mask iotas/compares hoisted out of the rep loop
+  shrink       — NO per-rep pad: the carry value contracts by halo per rep
+                 (static shapes inside the unrolled fuse loop); hoisted mask
+  *_pair       — binomial pair-add decomposition ((1,2,1) = (1,1)*(1,1)):
+                 adds only, alternating roll directions so no recentre
+  abl_*        — ablations of 'shrink' (WRONG OUTPUT, timing only)
+
+Usage:  python tools/kernel_lab.py [variant ...]
+"""
+
+from __future__ import annotations
+
+import functools
+import sys
+import time
+from math import comb
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+sys.path.insert(0, ".")
+
+from tpu_stencil.ops import lowering as _lowering
+from tpu_stencil.ops import pallas_stencil as ps
+from tpu_stencil.filters import get_filter
+from tpu_stencil.runtime.autotune import _steady_state_per_rep
+
+H, W, C = 2520, 1920, 3
+
+
+def _binomial_chain(taps):
+    k = len(taps)
+    if tuple(taps) == tuple(comb(k - 1, i) for i in range(k)):
+        return k - 1
+    return None
+
+
+def _lane_roll(x, off, wc):
+    """out[:, c] = x[:, c + off] (end-around)."""
+    if off == 0:
+        return x
+    if off < 0:
+        return pltpu.roll(x, -off, 1)
+    return pltpu.roll(x, wc - off, 1)
+
+
+def _rep_val(cur, *, plan, dt, wc, channels, opts):
+    """One rep on a value of R rows -> R - 2*halo rows (valid)."""
+    h = plan.halo
+    rows_in = cur.shape[0]
+    rows_out = rows_in - 2 * h
+    pair = opts.get("pair_add")
+
+    # rows pass
+    if opts.get("no_rows"):
+        acc = cur[h:h + rows_out, :]
+    elif pair and _binomial_chain(plan.row_taps) is not None:
+        acc = cur
+        for d in range(_binomial_chain(plan.row_taps)):
+            n = acc.shape[0] - 1
+            acc = acc[0:n, :] + acc[1:n + 1, :]
+    else:
+        acc = None
+        for t_idx, tap in enumerate(plan.row_taps):
+            if tap == 0:
+                continue
+            term = cur[t_idx:t_idx + rows_out, :]
+            if tap != 1:
+                if dt == jnp.int16 and tap > 0:
+                    term = ps._mul_const_adds(term, tap)
+                else:
+                    term = term * tap
+            acc = term if acc is None else acc + term
+    if acc.dtype != jnp.int32:
+        acc = acc.astype(jnp.int32)
+
+    # cols pass
+    if opts.get("no_cols"):
+        col = acc
+    elif pair and _binomial_chain(plan.col_taps) is not None:
+        col = acc
+        chain = _binomial_chain(plan.col_taps)
+        for d in range(chain):
+            off = channels if d < chain // 2 else -channels
+            col = col + _lane_roll(col, off, wc)
+    else:
+        col = None
+        for t_idx, tap in enumerate(plan.col_taps):
+            if tap == 0:
+                continue
+            term = _lane_roll(acc, (t_idx - h) * channels, wc)
+            if tap != 1:
+                term = term * tap
+            col = term if col is None else col + term
+
+    if opts.get("no_finish"):
+        return col
+    val = col >> plan.shift
+    if ps._clip_needed(plan):
+        val = jnp.clip(val, 0, 255)
+    return val
+
+
+def _lab_kernel(in_hbm, out_ref, s_u8, sem, *, plan, block_h, grid,
+                halo_al, fuse, n_rows_real, wc, wc_real, channels, opts):
+    i = pl.program_id(0)
+    h = plan.halo
+    tile_rows = block_h + 2 * halo_al
+    dt = ps._acc_dtype(plan)
+
+    # ---- DMA (same as shipped kernel) ----
+    def copy_for(j, slot, size_case):
+        if size_case == 0:
+            src, dst, size = 0, halo_al, min(block_h + halo_al, grid * block_h)
+        elif size_case == 1:
+            src, dst, size = j * block_h - halo_al, 0, block_h + 2 * halo_al
+        else:
+            src, dst, size = j * block_h - halo_al, 0, block_h + halo_al
+        src = pl.multiple_of(src, 8)
+        return pltpu.make_async_copy(
+            in_hbm.at[pl.ds(src, size)], s_u8.at[slot, pl.ds(dst, size)],
+            sem.at[slot])
+
+    def issue(j, slot):
+        if grid == 1:
+            s_u8[slot, 0:halo_al, :] = jnp.zeros((halo_al, wc), jnp.uint8)
+            copy_for(j, slot, 0).start()
+            s_u8[slot, pl.ds(block_h + halo_al, halo_al), :] = jnp.zeros(
+                (halo_al, wc), jnp.uint8)
+            return
+
+        @pl.when(j == 0)
+        def _():
+            s_u8[slot, 0:halo_al, :] = jnp.zeros((halo_al, wc), jnp.uint8)
+            copy_for(j, slot, 0).start()
+
+        @pl.when(j == grid - 1)
+        def _():
+            copy_for(j, slot, 2).start()
+            s_u8[slot, pl.ds(block_h + halo_al, halo_al), :] = jnp.zeros(
+                (halo_al, wc), jnp.uint8)
+
+        if grid > 2:
+            @pl.when(jnp.logical_and(j > 0, j < grid - 1))
+            def _():
+                copy_for(j, slot, 1).start()
+
+    def wait(j, slot):
+        if grid == 1:
+            copy_for(j, slot, 0).wait()
+            return
+
+        @pl.when(j == 0)
+        def _():
+            copy_for(j, slot, 0).wait()
+
+        @pl.when(j == grid - 1)
+        def _():
+            copy_for(j, slot, 2).wait()
+
+        if grid > 2:
+            @pl.when(jnp.logical_and(j > 0, j < grid - 1))
+            def _():
+                copy_for(j, slot, 1).wait()
+
+    slot = jax.lax.rem(i, 2)
+
+    @pl.when(i == 0)
+    def _():
+        issue(i, slot)
+
+    if grid > 1:
+        @pl.when(i + 1 < grid)
+        def _():
+            issue(i + 1, jax.lax.rem(i + 1, 2))
+
+    wait(i, slot)
+
+    cur = s_u8[slot].astype(dt)
+    masked = not opts.get("no_mask")
+
+    if opts.get("shrink"):
+        # Hoisted full-tile mask; per-rep: one static slice + one select.
+        if masked:
+            rid = jax.lax.broadcasted_iota(jnp.int32, (tile_rows, wc), 0)
+            gid = rid + (i * block_h - halo_al)
+            keep = gid.astype(jnp.uint32) < jnp.uint32(n_rows_real)
+            if wc_real != wc:
+                cid = jax.lax.broadcasted_iota(jnp.int32, (tile_rows, wc), 1)
+                keep = jnp.logical_and(keep, cid < wc_real)
+        off = 0  # absolute tile row of cur's row 0
+        for t in range(fuse):
+            val = _rep_val(cur, plan=plan, dt=dt, wc=wc, channels=channels,
+                           opts=opts)
+            off += h
+            if masked:
+                val = jnp.where(keep[off:off + val.shape[0], :], val, 0)
+            cur = val.astype(dt)
+        o = halo_al - fuse * h
+        out_ref[:] = cur[o:o + block_h, :].astype(jnp.uint8)
+    else:
+        keep = None
+        if masked and opts.get("hoist"):
+            rows_out = tile_rows - 2 * h
+            rid = jax.lax.broadcasted_iota(jnp.int32, (rows_out, wc), 0)
+            gid = rid + (i * block_h - halo_al + h)
+            keep = gid.astype(jnp.uint32) < jnp.uint32(n_rows_real)
+            if wc_real != wc:
+                cid = jax.lax.broadcasted_iota(jnp.int32, (rows_out, wc), 1)
+                keep = jnp.logical_and(keep, cid < wc_real)
+        for t in range(fuse):
+            val = _rep_val(cur, plan=plan, dt=dt, wc=wc, channels=channels,
+                           opts=opts)
+            if masked:
+                if keep is None:
+                    rid = jax.lax.broadcasted_iota(jnp.int32, val.shape, 0)
+                    gid = rid + (i * block_h - halo_al + h)
+                    k2 = gid.astype(jnp.uint32) < jnp.uint32(n_rows_real)
+                    if wc_real != wc:
+                        cid = jax.lax.broadcasted_iota(
+                            jnp.int32, val.shape, 1)
+                        k2 = jnp.logical_and(k2, cid < wc_real)
+                else:
+                    k2 = keep
+                val = jnp.where(k2, val, 0)
+            cur = jnp.pad(val, ((h, h), (0, 0))).astype(dt)
+        out_ref[:] = cur[halo_al:halo_al + block_h, :].astype(jnp.uint8)
+
+
+def build_variant(plan, shape, channels, block_h=128, fuse=8, **opts):
+    hh, wc = shape[0], shape[1] * channels
+    block_h = -(-block_h // 8) * 8
+    bh = min(block_h, -(-hh // 8) * 8)
+    hp = -(-hh // bh) * bh
+    if plan.halo:
+        fuse = max(1, min(fuse, bh // (2 * plan.halo)))
+    wcp = -(-(wc + plan.halo * channels) // 128) * 128
+    grid = hp // bh
+    halo_al = -(-(fuse * plan.halo) // 8) * 8
+    kernel = functools.partial(
+        _lab_kernel, plan=plan, block_h=bh, grid=grid, halo_al=halo_al,
+        fuse=fuse, n_rows_real=hh, wc=wcp, wc_real=wc, channels=channels,
+        opts=opts)
+    call = pl.pallas_call(
+        kernel,
+        grid=(grid,),
+        out_shape=jax.ShapeDtypeStruct((hp, wcp), jnp.uint8),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec((bh, wcp), lambda i: (i, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((2, bh + 2 * halo_al, wcp), jnp.uint8),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+    )
+
+    def iterate(img_u8, repetitions):
+        x2 = img_u8.reshape(hh, wc)
+        if hp != hh or wcp != wc:
+            x2 = jnp.pad(x2, ((0, hp - hh), (0, wcp - wc)))
+        out = jax.lax.fori_loop(0, repetitions // fuse, lambda _, x: call(x),
+                                x2)
+        return out[:hh, :wc].reshape(img_u8.shape)
+
+    return iterate, fuse
+
+
+def time_variant(name, iterate_fn, img, fuse, plan=None, check=True):
+    jit_fn = jax.jit(iterate_fn, donate_argnums=0)
+
+    def run(n):
+        dev = jax.device_put(img)
+        np.asarray(dev.ravel()[0])
+        t0 = time.perf_counter()
+        out = jit_fn(dev, jnp.int32(n))
+        np.asarray(out.ravel()[0])
+        return time.perf_counter() - t0
+
+    try:
+        run(2 * fuse)
+    except Exception as e:
+        msg = str(e).split("\n")[0][:160]
+        print(f"{name:22s} FAILED: {type(e).__name__}: {msg}")
+        return None
+    ok = "-"
+    if check:
+        assert plan is not None
+        dev = jax.device_put(img)
+        got = np.asarray(jit_fn(dev, jnp.int32(fuse)))
+        want = np.asarray(jax.jit(
+            lambda x: jax.lax.fori_loop(
+                0, fuse, lambda _, y: _lowering.padded_step(y, plan), x)
+        )(img))
+        ok = bool(np.array_equal(got, want))
+    base = 2000 - (2000 % fuse)
+    per_rep = _steady_state_per_rep(run, base)
+    print(f"{name:22s} {per_rep*1e6:8.2f} us/rep   exact={ok}")
+    return per_rep
+
+
+VARIANTS = {
+    "current": dict(),
+    "hoist": dict(hoist=True),
+    "hoist_pair": dict(hoist=True, pair_add=True),
+    "shrink": dict(shrink=True),
+    "shrink_pair": dict(shrink=True, pair_add=True),
+    "shrink_pair_b256": dict(shrink=True, pair_add=True, block_h=256),
+    "shrink_pair_f16_b256": dict(shrink=True, pair_add=True, block_h=256,
+                                 fuse=16),
+    "abl_no_mask": dict(shrink=True, pair_add=True, no_mask=True),
+    "abl_no_cols": dict(shrink=True, pair_add=True, no_cols=True,
+                        no_mask=True),
+    "abl_no_rows": dict(shrink=True, pair_add=True, no_rows=True,
+                        no_mask=True),
+    "abl_dma_only": dict(shrink=True, pair_add=True, no_rows=True,
+                         no_cols=True, no_mask=True, no_finish=True),
+}
+
+
+def main():
+    want = sys.argv[1:] or ["shipped"] + list(VARIANTS)
+    plan = _lowering.plan_filter(get_filter("gaussian"))
+    rng = np.random.default_rng(0)
+    img = rng.integers(0, 256, size=(H, W, C), dtype=np.uint8)
+    print(f"platform={jax.default_backend()} plan={plan.kind} "
+          f"row_taps={plan.row_taps} col_taps={plan.col_taps}")
+
+    for name in want:
+        if name == "shipped":
+            def shipped(x, n):
+                return ps.iterate(x, jnp.int32(n), plan)
+            time_variant("shipped(iterate)", shipped, img, 8, check=False)
+            continue
+        opts = dict(VARIANTS[name])
+        bh = opts.pop("block_h", 128)
+        fz = opts.pop("fuse", 8)
+        it, fuse = build_variant(plan, (H, W), C, block_h=bh, fuse=fz, **opts)
+        time_variant(name, it, img, fuse, plan=plan,
+                     check=not name.startswith("abl_"))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,139 @@
+#!/usr/bin/env python
+"""Micro-cost individual VPU ops inside a Pallas kernel at north-star scale.
+
+Each case runs a chain of N identical ops on a ~(128, 5888) VMEM tile per
+grid program (20 programs — the fused stencil kernel's footprint) and
+reports the marginal cost of one full-tile op-pass: (t(chain 2N) -
+t(chain N)) / N, which cancels load/store/DMA overhead.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+sys.path.insert(0, ".")
+from tpu_stencil.runtime.autotune import _steady_state_per_rep
+
+WC = 5888
+BLOCK = 128
+GRID = 20
+EXTRA = 160  # headroom rows for shrinking (slice) chains (>= 8 * 2N)
+IN_BLOCK = BLOCK + EXTRA
+
+
+def make_case(body, n_ops, dtype, strip=None):
+    def kernel(x_ref, o_ref):
+        if strip:
+            # whole chain per lane-strip, result written straight to the
+            # output slice — tests register residency of small working sets
+            for s in range(0, WC, strip):
+                x = x_ref[:, s:s + strip].astype(dtype)
+                for i in range(n_ops):
+                    x = body(x, i)
+                o_ref[:, s:s + strip] = x[:BLOCK].astype(jnp.uint8)
+        else:
+            x = x_ref[:].astype(dtype)
+            for i in range(n_ops):
+                x = body(x, i)
+            o_ref[:] = x[:BLOCK].astype(jnp.uint8)
+
+    call = pl.pallas_call(
+        kernel,
+        grid=(GRID,),
+        out_shape=jax.ShapeDtypeStruct((GRID * BLOCK, WC), jnp.uint8),
+        in_specs=[pl.BlockSpec((IN_BLOCK, WC), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((BLOCK, WC), lambda i: (i, 0)),
+    )
+
+    def iterate(x, reps):
+        # out is smaller than in; pad back so the carry shape is stable.
+        # The pad cost is constant per launch, so it cancels in the
+        # chain-2N minus chain-N differencing.
+        return jax.lax.fori_loop(
+            0, reps, lambda _, y: jnp.pad(call(y), (
+                (0, GRID * (IN_BLOCK - BLOCK)), (0, 0))), x)
+
+    return iterate
+
+
+def main():
+    rng = np.random.default_rng(0)
+    img = rng.integers(0, 256, size=(GRID * IN_BLOCK, WC), dtype=np.uint8)
+
+    i16, i32 = jnp.int16, jnp.int32
+
+    def shrink_add(x, i):
+        n = x.shape[0] - 1
+        return x[0:n] + x[1:n + 1]
+
+    def aligned_shrink_add(x, i):
+        n = x.shape[0] - 8
+        return x[0:n] + x[8:n + 8]
+
+    cases = {
+        "strip_add_i32": (lambda x, i: x + x, i32, 512),
+        "strip128_add_i32": (lambda x, i: x + x, i32, 128),
+        "subroll1_add_i32": (lambda x, i: x + pltpu.roll(x, 1, 0), i32),
+        "subroll1_add_u8": (lambda x, i: x + pltpu.roll(x, 1, 0), jnp.uint8),
+        "cvt_u8_i32_rt": (
+            lambda x, i: x.astype(jnp.int32).astype(jnp.uint8), jnp.uint8),
+        "add_u8": (lambda x, i: x + x, jnp.uint8),
+        "add_i32": (lambda x, i: x + x, i32),
+        "add_i16": (lambda x, i: x + x, i16),
+        "mis_slice_add_i32": (shrink_add, i32),
+        "mis_slice_add_i16": (shrink_add, i16),
+        "al_slice_add_i16": (aligned_shrink_add, i16),
+        "roll3_i32": (lambda x, i: pltpu.roll(x, 3, 1), i32),
+        "roll3_add_i32": (lambda x, i: x + pltpu.roll(x, 3, 1), i32),
+        "roll1_add_i32": (lambda x, i: x + pltpu.roll(x, 1, 1), i32),
+        "roll128_add_i32": (lambda x, i: x + pltpu.roll(x, 128, 1), i32),
+        "shift_i32": (lambda x, i: x >> 1, i32),
+        "where_i32": (lambda x, i: jnp.where(x > 0, x, 0), i32),
+        "cvt_i16_i32_rt": (lambda x, i: x.astype(i32).astype(i16), i16),
+        "mul_i32": (lambda x, i: x * 3, i32),
+        "clip_i32": (lambda x, i: jnp.clip(x, 0, 255), i32),
+    }
+    sel = sys.argv[1:] or list(cases)
+    N = 8
+
+    for name in sel:
+        case = cases[name]
+        body, dtype = case[0], case[1]
+        strip = case[2] if len(case) > 2 else None
+        chains = {}
+        fail = None
+        for n_ops in (N, 2 * N):
+            it = make_case(body, n_ops, dtype, strip=strip)
+            jf = jax.jit(it, donate_argnums=0)
+
+            def run(reps):
+                dev = jax.device_put(img)
+                np.asarray(dev.ravel()[0])
+                t0 = time.perf_counter()
+                out = jf(dev, jnp.int32(reps))
+                np.asarray(out.ravel()[0])
+                return time.perf_counter() - t0
+
+            try:
+                run(2)
+            except Exception as e:
+                fail = f"{type(e).__name__}: {str(e).splitlines()[0][:120]}"
+                break
+            chains[n_ops] = _steady_state_per_rep(run, 200)
+        if fail:
+            print(f"{name:22s} FAILED {fail}")
+            continue
+        per_op = (chains[2 * N] - chains[N]) / N
+        print(f"{name:22s} {per_op*1e6:7.2f} us/op-pass   "
+              f"(chain{N}={chains[N]*1e6:6.1f} chain{2*N}={chains[2*N]*1e6:6.1f})")
+
+
+if __name__ == "__main__":
+    main()
