@@ -119,3 +119,47 @@ def test_model_autotune_backend_resolves(tmp_path, monkeypatch, rng):
     out = np.asarray(model(img, 2))
     want = stencil.reference_stencil_numpy(img, filters.get_filter("gaussian"), 2)
     np.testing.assert_array_equal(out, want)
+
+
+def test_auto_is_shape_aware_alias_of_autotune(plan, tmp_path, monkeypatch):
+    # r2 verdict item 3: bare 'auto' (the CLI default) must consult the
+    # autotune cache, not unconditionally resolve to XLA.
+    import jax
+    from tpu_stencil.models.blur import IteratedConv2D
+
+    monkeypatch.setenv("TPU_STENCIL_AUTOTUNE_CACHE", str(tmp_path / "c.json"))
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+
+    def fake_measure(plan, shape, channels, backend, reps=0):
+        return 1e-6 if backend == "pallas" else 2e-6
+
+    monkeypatch.setattr(autotune, "measure_backend", fake_measure)
+    model = IteratedConv2D("gaussian", backend="auto")
+    assert model.resolved_backend((2520, 1920), 3) == "pallas"
+    # second resolution is a pure cache hit
+    monkeypatch.setattr(
+        autotune, "measure_backend",
+        lambda *a, **k: (_ for _ in ()).throw(AssertionError("cache miss")),
+    )
+    assert model.resolved_backend((2520, 1920), 3) == "pallas"
+
+
+def test_sharded_runner_resolves_auto_against_tile(rng, monkeypatch, tmp_path):
+    # The sharded runner must hand shape-aware resolution the per-device
+    # tile (not the global image), and honor the verdict instead of
+    # silently demoting to XLA.
+    from tpu_stencil.models.blur import IteratedConv2D
+    from tpu_stencil.parallel.sharded import ShardedRunner
+
+    monkeypatch.setenv("TPU_STENCIL_AUTOTUNE_CACHE", str(tmp_path / "c.json"))
+    seen = {}
+
+    def spy(self, shape, channels):
+        seen["shape"], seen["channels"] = tuple(shape), channels
+        return "xla"
+
+    monkeypatch.setattr(IteratedConv2D, "resolved_backend", spy)
+    model = IteratedConv2D("gaussian", backend="auto")
+    runner = ShardedRunner(model, (64, 96), 3, mesh_shape=(2, 4))
+    assert runner.backend == "xla"
+    assert seen == {"shape": (32, 24), "channels": 3}

@@ -181,3 +181,20 @@ def test_put_batched_shards_leading_axis(rng):
     assert len(dev.sharding.device_set) == 4  # actually spread over devices
     np.testing.assert_array_equal(np.asarray(dev)[:5], imgs)
     np.testing.assert_array_equal(np.asarray(dev)[5:], 0)
+
+
+def test_cli_platform_override(tmp_path, rng, capsys):
+    # --platform routes through jax.config.update, which beats a pinned
+    # JAX_PLATFORMS env var (r2 verdict item 5: the DEPLOY.md CPU-mesh
+    # recipe must work under environments that force the env var).
+    img = rng.integers(0, 256, size=(6, 8, 1), dtype=np.uint8)
+    p = str(tmp_path / "tiny.raw")
+    raw_io.write_raw(p, img)
+    rc = cli.main([p, "8", "6", "2", "grey", "--platform", "cpu",
+                   "--mesh", "2x4"])
+    assert rc == 0
+    out = raw_io.read_raw(str(tmp_path / "blur_tiny.raw"), 8, 6, 1)
+    want = stencil.reference_stencil_numpy(
+        img[..., 0], filters.get_filter("gaussian"), 2
+    )
+    np.testing.assert_array_equal(out[..., 0], want)

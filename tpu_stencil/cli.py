@@ -21,6 +21,13 @@ def main(argv=None) -> int:
     # parse_args does no JAX work, so parse first: --help/usage errors must
     # exit without joining a pod rendezvous.
     cfg, ns = parse_args(argv)
+    if ns.platform:
+        # The config API beats a pinned JAX_PLATFORMS env var (a
+        # sitecustomize can force-export one); must land before the first
+        # backend initialization, i.e. before distributed bring-up.
+        import jax
+
+        jax.config.update("jax_platforms", ns.platform)
     # Multi-process bring-up precedes the first JAX computation (the
     # MPI_Init-leads-main discipline, mpi/mpi_convolution.c:23). Auto mode:
     # joins a Cloud TPU pod job when the environment defines one, and is a

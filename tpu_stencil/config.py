@@ -131,6 +131,14 @@ def build_parser() -> argparse.ArgumentParser:
              "selects R*C devices (no spatial sharding)",
     )
     p.add_argument(
+        "--platform", default=None, choices=["cpu", "tpu", "gpu"],
+        help="force the JAX platform via the config API before backend "
+             "init. Needed where the environment pins JAX_PLATFORMS (a "
+             "sitecustomize can make the env var unwinnable), e.g. the "
+             "docs/DEPLOY.md virtual CPU-mesh recipe: --platform cpu with "
+             "XLA_FLAGS=--xla_force_host_platform_device_count=8",
+    )
+    p.add_argument(
         "--profile", default=None, metavar="DIR",
         help="write a jax.profiler trace of the compute window to DIR",
     )

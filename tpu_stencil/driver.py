@@ -229,7 +229,15 @@ def run_job(
         output_path=cfg.output_path,
         compute_seconds=compute_seconds,
         total_seconds=total_t.elapsed,
-        backend=resolve_backend(cfg.backend),
+        # frames>1 batches via the vmapped XLA schedule regardless of
+        # backend (iterate_batch demotes pallas), so report what actually
+        # ran; single-frame reports the shape-aware resolution
+        # (auto/autotune consult the measured cache, memoized in-process).
+        backend=(
+            ("xla" if resolve_backend(cfg.backend) == "pallas"
+             else resolve_backend(cfg.backend)) if cfg.frames > 1
+            else model.resolved_backend((cfg.height, cfg.width), cfg.channels)
+        ),
         mesh_shape=None,
     )
 

@@ -122,7 +122,7 @@ def best_backend(
     shape: Tuple[int, int],
     channels: int,
     cache: bool = True,
-    measure=measure_backend,
+    measure=None,
 ) -> str:
     """The faster of XLA/Pallas for this (platform, filter, shape), from the
     disk cache when available, measured (and cached) otherwise. Platforms
@@ -133,6 +133,8 @@ def best_backend(
         return "xla"
     if plan.kind == "direct_f32":
         return "xla"  # pallas would fall back anyway
+    if measure is None:
+        measure = measure_backend  # late-bound: monkeypatchable, testable
     key = _key(plan, shape, channels)
     store = _load_cache() if cache else {}
     hit = store.get(key)
