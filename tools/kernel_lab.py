@@ -111,12 +111,57 @@ def _rep_val(cur, *, plan, dt, wc, channels, opts):
     return val
 
 
+def _rep_val_strips(cur, *, plan, dt, wc, channels, opts):
+    """One rep, computed lane-strip by lane-strip so each strip's whole op
+    chain (rows adds, cols rolls, shift, select) can stay in vector
+    registers — one VMEM sweep per rep instead of one per op. Strip reads
+    overlap by 128 lanes per side (lane-aligned) so cols rolls stay local;
+    the overlap columns are recomputed, not communicated."""
+    h = plan.halo
+    rows_in = cur.shape[0]
+    rows_out = rows_in - 2 * h
+    strip = opts.get("strip", 512)
+    gl = 128  # lane-aligned ghost read per side; >= halo*channels
+    parts = []
+    for s in range(0, wc, strip):
+        width = min(strip, wc - s)
+        if s == 0:
+            # Left edge: the ghost source is the far-right lane pad (zeroed
+            # every rep by the select), the same wrap the full-tile roll
+            # exploits — zero-boundary semantics for free.
+            xs = jnp.concatenate(
+                [cur[:, wc - gl:], cur[:, 0:width + gl]], axis=1
+            )
+        else:
+            xs = cur[:, s - gl:min(wc, s + width + gl)]
+        swc = xs.shape[1]
+        # rows pass (pair-add binomial: adds only)
+        acc = xs
+        for d in range(_binomial_chain(plan.row_taps)):
+            n = acc.shape[0] - 1
+            acc = acc[0:n, :] + acc[1:n + 1, :]
+        if acc.dtype != jnp.int32:
+            acc = acc.astype(jnp.int32)
+        # cols pass within the strip (end-around wrap lands only in ghost
+        # or pad columns, cropped below / re-zeroed by the select)
+        col = acc
+        chain = _binomial_chain(plan.col_taps)
+        for d in range(chain):
+            off = channels if d < chain // 2 else -channels
+            col = col + _lane_roll(col, off, swc)
+        val = col >> plan.shift
+        if ps._clip_needed(plan):
+            val = jnp.clip(val, 0, 255)
+        parts.append(val[:, gl:gl + width])
+    return jnp.concatenate(parts, axis=1)
+
+
 def _lab_kernel(in_hbm, out_ref, s_u8, sem, *, plan, block_h, grid,
                 halo_al, fuse, n_rows_real, wc, wc_real, channels, opts):
     i = pl.program_id(0)
     h = plan.halo
     tile_rows = block_h + 2 * halo_al
-    dt = ps._acc_dtype(plan)
+    dt = jnp.int32 if opts.get("i32") else ps._acc_dtype(plan)
 
     # ---- DMA (same as shipped kernel) ----
     def copy_for(j, slot, size_case):
@@ -199,9 +244,10 @@ def _lab_kernel(in_hbm, out_ref, s_u8, sem, *, plan, block_h, grid,
                 cid = jax.lax.broadcasted_iota(jnp.int32, (tile_rows, wc), 1)
                 keep = jnp.logical_and(keep, cid < wc_real)
         off = 0  # absolute tile row of cur's row 0
+        rep_fn = _rep_val_strips if opts.get("strips") else _rep_val
         for t in range(fuse):
-            val = _rep_val(cur, plan=plan, dt=dt, wc=wc, channels=channels,
-                           opts=opts)
+            val = rep_fn(cur, plan=plan, dt=dt, wc=wc, channels=channels,
+                         opts=opts)
             off += h
             if masked:
                 val = jnp.where(keep[off:off + val.shape[0], :], val, 0)
@@ -251,6 +297,8 @@ def build_variant(plan, shape, channels, block_h=128, fuse=8, **opts):
         _lab_kernel, plan=plan, block_h=bh, grid=grid, halo_al=halo_al,
         fuse=fuse, n_rows_real=hh, wc=wcp, wc_real=wc, channels=channels,
         opts=opts)
+    import os
+
     call = pl.pallas_call(
         kernel,
         grid=(grid,),
@@ -261,6 +309,7 @@ def build_variant(plan, shape, channels, block_h=128, fuse=8, **opts):
             pltpu.VMEM((2, bh + 2 * halo_al, wcp), jnp.uint8),
             pltpu.SemaphoreType.DMA((2,)),
         ],
+        interpret=bool(os.environ.get("TPU_LAB_INTERPRET")),
     )
 
     def iterate(img_u8, repetitions):
@@ -316,6 +365,11 @@ VARIANTS = {
     "shrink_pair_b256": dict(shrink=True, pair_add=True, block_h=256),
     "shrink_pair_f16_b256": dict(shrink=True, pair_add=True, block_h=256,
                                  fuse=16),
+    "shrink_strips": dict(shrink=True, strips=True),
+    "shrink_strips_i32": dict(shrink=True, strips=True, i32=True),
+    "shrink_strips_256": dict(shrink=True, strips=True, strip=256, i32=True),
+    "shrink_strips_1024": dict(shrink=True, strips=True, strip=1024,
+                               i32=True),
     "abl_no_mask": dict(shrink=True, pair_add=True, no_mask=True),
     "abl_no_cols": dict(shrink=True, pair_add=True, no_cols=True,
                         no_mask=True),
