@@ -69,11 +69,53 @@ def _measure_per_rep(
     return _steady_state_per_rep(timed, lo)
 
 
+def _measure_batch_per_frame_rep(
+    imgs: np.ndarray, filter_name: str, budget_s: float
+) -> float:
+    """Steady-state seconds per frame-repetition of the vmapped batch mode
+    (``--frames``): frames are embarrassingly parallel, so the interesting
+    number is us per frame*rep vs the single-frame row."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_stencil.models.blur import IteratedConv2D, iterate_batch
+    from tpu_stencil.runtime.autotune import _steady_state_per_rep
+
+    model = IteratedConv2D(filter_name, backend="xla")
+
+    def timed(n_reps: int) -> float:
+        dev = jax.device_put(imgs)
+        np.asarray(dev.ravel()[0])
+        t0 = time.perf_counter()
+        out = iterate_batch(
+            dev, jnp.int32(n_reps), plan=model.plan, backend="xla"
+        )
+        np.asarray(out.ravel()[0])
+        return time.perf_counter() - t0
+
+    timed(1)
+    probe = 100
+    est = max(timed(probe) / probe, 1e-8)
+    lo = min(max(int(budget_s / est), 100), 50_000)
+    return _steady_state_per_rep(timed, lo) / imgs.shape[0]
+
+
 def _row(img, filter_name, mode, size_label, backend, budget_s, reps,
-         base) -> dict:
+         base, retries: int = 2) -> dict:
     from tpu_stencil.runtime import roofline
 
-    per_rep = _measure_per_rep(img, filter_name, budget_s, backend)
+    last = None
+    for attempt in range(retries + 1):
+        try:
+            per_rep = _measure_per_rep(img, filter_name, budget_s, backend)
+            break
+        except Exception as e:  # transient tunnel drops must not kill a sweep
+            last = e
+            print(f"row {size_label} [{backend}] attempt {attempt} failed: "
+                  f"{type(e).__name__}: {e}", file=sys.stderr, flush=True)
+            time.sleep(15 * (attempt + 1))
+    else:
+        raise last
     total = per_rep * reps
     gbps, pct = roofline.achieved(
         img.nbytes, per_rep, backend, filter_name, img.shape[0]
@@ -97,13 +139,21 @@ def run_sweep(
     filters: Optional[List[str]] = None,
     csv_path: Optional[str] = None,
     backends: Optional[List[str]] = None,
+    frames: int = 0,
 ) -> List[dict]:
     filters = filters or ["gaussian"]
     backends = backends or ["xla"]
     rng = np.random.default_rng(0)
     budget_s = 0.1 if quick else 0.5
     rows = []
+    writer = _IncrementalCsv(csv_path)  # survives a tunnel drop mid-sweep
     sizes = SIZES[:2] if quick else SIZES
+
+    def add(row):
+        rows.append(row)
+        writer.write(row)
+        print(_fmt_row(row), file=sys.stderr, flush=True)
+
     for backend in backends:
         for filter_name in filters:
             for mode in ("grey", "rgb"):
@@ -114,22 +164,55 @@ def run_sweep(
                         _CUDA_40REPS.get((mode, h))
                         if filter_name == "gaussian" else None
                     )
-                    rows.append(_row(img, filter_name, mode, f"{WIDTH}x{h}",
-                                     backend, budget_s, 40, base))
-                    print(_fmt_row(rows[-1]), file=sys.stderr, flush=True)
+                    add(_row(img, filter_name, mode, f"{WIDTH}x{h}",
+                             backend, budget_s, 40, base))
         if stress:
             img = rng.integers(0, 256, size=(4320, 7680, 3), dtype=np.uint8)
-            rows.append(_row(img, "gaussian", "rgb", "7680x4320 (8K)",
-                             backend, budget_s * 4, 1000, None))
-            print(_fmt_row(rows[-1]), file=sys.stderr, flush=True)
-    if csv_path:
+            add(_row(img, "gaussian", "rgb", "7680x4320 (8K)",
+                     backend, budget_s * 4, 1000, None))
+    if frames:
+        imgs = rng.integers(
+            0, 256, size=(frames, 2520, WIDTH, 3), dtype=np.uint8
+        )
+        per_fr = _measure_batch_per_frame_rep(imgs, "gaussian", budget_s)
+        from tpu_stencil.runtime import roofline
+
+        gbps, pct = roofline.achieved(
+            imgs.nbytes // frames, per_fr, "xla", "gaussian", 2520
+        )
+        add({
+            "filter": "gaussian", "mode": "rgb",
+            "size": f"{WIDTH}x2520 x{frames} frames", "backend": "xla",
+            "us_per_rep": round(per_fr * 1e6, 1), "reps": 40,
+            "total_s": round(per_fr * 40 * frames, 6),
+            "hbm_gbps": round(gbps, 1), "pct_hbm_peak": round(pct, 1),
+            "gtx970_40reps_s": _CUDA_40REPS[("rgb", 2520)] * frames,
+            "speedup_vs_gtx970": round(
+                _CUDA_40REPS[("rgb", 2520)] / (per_fr * 40), 1
+            ),
+        })
+    return rows
+
+
+class _IncrementalCsv:
+    """Append each row as it is measured; a crash loses nothing."""
+
+    def __init__(self, path: Optional[str]) -> None:
+        self.path = path
+        self._writer = None
+        self._file = None
+
+    def write(self, row: dict) -> None:
+        if not self.path:
+            return
         import csv
 
-        with open(csv_path, "w", newline="") as f:
-            w = csv.DictWriter(f, fieldnames=list(rows[0].keys()))
-            w.writeheader()
-            w.writerows(rows)
-    return rows
+        if self._writer is None:
+            self._file = open(self.path, "w", newline="")
+            self._writer = csv.DictWriter(self._file, fieldnames=list(row.keys()))
+            self._writer.writeheader()
+        self._writer.writerow(row)
+        self._file.flush()
 
 
 def _fmt_row(r: dict) -> str:
@@ -169,11 +252,16 @@ def main(argv=None) -> int:
         "--backends", default="xla",
         help="comma-separated backends to sweep (xla,pallas)",
     )
+    p.add_argument(
+        "--frames", type=int, default=0, metavar="N",
+        help="also measure the vmapped batch mode with N north-star frames "
+             "(reports us per frame*rep)",
+    )
     ns = p.parse_args(argv)
     rows = run_sweep(
         quick=ns.quick, stress=ns.stress,
         filters=ns.filters.split(","), csv_path=ns.csv,
-        backends=ns.backends.split(","),
+        backends=ns.backends.split(","), frames=ns.frames,
     )
     print(emit_markdown(rows))
     return 0
