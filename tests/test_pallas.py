@@ -130,3 +130,35 @@ def test_direct_int_plan_matches_golden(rng, reps):
         img, filters.get_filter("edge"), reps
     )
     np.testing.assert_array_equal(got, want)
+
+
+@pytest.mark.parametrize("schedule", ["shrink", "strips"])
+@pytest.mark.parametrize("name,reps", [
+    ("gaussian", 5), ("gaussian5", 4), ("edge", 3), ("box", 3),
+])
+def test_schedules_match_golden(rng, schedule, name, reps):
+    # r3 kernel redesign: the shrink/strips per-rep schedules (no per-rep
+    # pad; hoisted mask; strip-resident op chains) must be bit-exact for
+    # every plan kind, incl. multi-block grids and lane pad.
+    img = rng.integers(0, 256, size=(70, 45, 3), dtype=np.uint8)
+    plan = lowering.plan_filter(filters.get_filter(name))
+    got = np.asarray(
+        pallas_stencil.iterate(img, jnp.int32(reps), plan, block_h=24,
+                               fuse=4, interpret=True, schedule=schedule)
+    )
+    want = stencil.reference_stencil_numpy(img, filters.get_filter(name), reps)
+    np.testing.assert_array_equal(got, want)
+
+
+@pytest.mark.parametrize("schedule", ["shrink", "strips"])
+def test_schedules_grey_and_single_block(rng, schedule):
+    img = rng.integers(0, 256, size=(40, 33), dtype=np.uint8)
+    plan = lowering.plan_filter(filters.get_filter("gaussian"))
+    got = np.asarray(
+        pallas_stencil.iterate(img, jnp.int32(6), plan, block_h=64,
+                               fuse=3, interpret=True, schedule=schedule)
+    )
+    want = stencil.reference_stencil_numpy(
+        img, filters.get_filter("gaussian"), 6
+    )
+    np.testing.assert_array_equal(got, want)

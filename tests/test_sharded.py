@@ -168,3 +168,19 @@ def test_sharded_iterate_convenience(rng):
     ))
     want = np.asarray(IteratedConv2D("gaussian", backend="xla")(img, 2))
     np.testing.assert_array_equal(got, want)
+
+
+@requires_8
+@pytest.mark.parametrize("schedule", ["shrink", "strips"])
+def test_pallas_sharded_schedules_match_single_device(
+    rng, schedule, monkeypatch
+):
+    # The r3 per-rep schedules must be bit-exact under shard_map too: the
+    # valid-ghost kernel's hoisted mask tracks the traced global offsets.
+    from tpu_stencil.ops import pallas_stencil
+
+    monkeypatch.setattr(pallas_stencil, "DEFAULT_SCHEDULE", schedule)
+    img = rng.integers(0, 256, size=(24, 16, 3), dtype=np.uint8)
+    got = _run(img, "gaussian", 11, (2, 2), backend="pallas")
+    want = np.asarray(IteratedConv2D("gaussian", backend="xla")(img, 11))
+    np.testing.assert_array_equal(got, want)

@@ -56,6 +56,20 @@ DEFAULT_BLOCK_H = 128
 DEFAULT_FUSE = 8
 _MAX_ROLL_HALO = 128  # cols-pass ghost width limit (halo * channels)
 
+# Per-rep schedule inside the fused kernel (see _sep_kernel):
+#   'pad'    — fixed-shape carry: mask-select + jnp.pad every rep (r2).
+#   'shrink' — the carry value contracts by halo per rep (static shapes in
+#              the unrolled fuse loop): no per-rep pad, hoisted mask.
+#   'strips' — 'shrink' with each rep computed lane-strip by lane-strip so
+#              the whole op chain per strip can stay register-resident
+#              (full-tile op-passes measured ~9 us each on v5e — the op
+#              count, not the op kind, is what the r2 roofline gap is).
+# The default is measured, not assumed: tools/kernel_lab.py times all
+# three on hardware.
+DEFAULT_SCHEDULE = "pad"
+_STRIP = 512          # strips schedule: lanes per strip
+_STRIP_GHOST = 128    # lane-aligned ghost read per strip side
+
 
 def _acc_dtype(plan: StencilPlan):
     """Accumulator for the sep rows pass: int16 doubles VPU lane throughput
@@ -104,15 +118,15 @@ def _clip_needed(plan: StencilPlan) -> bool:
     return not (nonneg and total == 2 ** plan.shift)
 
 
-def _rep_val(cur, *, plan: StencilPlan, dt, tile_rows: int, wc: int,
-             channels: int):
+def _rep_val(cur, *, plan: StencilPlan, dt, wc: int, channels: int):
     """One repetition on a VMEM tile *value*: the separable (or direct)
-    passes plus the finishing shift/clip. ``cur`` has ``tile_rows`` rows and
-    ``wc`` flat lanes in the accumulator dtype; returns the finished int32
-    values (each in [0, 255]) of shape ``(tile_rows - 2*halo, wc)`` —
-    *before* any boundary re-zeroing, which is the caller's (kernel's) job
-    because zero-boundary and valid-ghost kernels differ exactly there."""
+    passes plus the finishing shift/clip. ``cur`` has ``wc`` flat lanes in
+    the accumulator dtype; returns the finished int32 values (each in
+    [0, 255]) with ``2*halo`` fewer rows (valid correlation) — *before*
+    any boundary re-zeroing, which is the caller's (kernel's) job because
+    zero-boundary and valid-ghost kernels differ exactly there."""
     h = plan.halo
+    tile_rows = cur.shape[0]
 
     def lane_roll(x, off):
         """x shifted so out[:, c] = x[:, c + off]. Rolls wrap lane content
@@ -192,9 +206,60 @@ def _rep_val(cur, *, plan: StencilPlan, dt, tile_rows: int, wc: int,
     return val
 
 
+def _rep_val_strips(cur, *, plan: StencilPlan, dt, wc: int, channels: int):
+    """One repetition computed lane-strip by lane-strip (same contract as
+    :func:`_rep_val`): each strip's whole op chain — rows adds, cols rolls,
+    shift, clip — touches a working set small enough to stay in vector
+    registers, aiming at one VMEM sweep per rep instead of one per op.
+
+    Strip reads overlap ``_STRIP_GHOST`` lanes per side (lane-aligned, >=
+    halo*channels by the ``_MAX_ROLL_HALO`` guard) so cols rolls stay
+    strip-local; overlap columns are recomputed, not communicated. Strip
+    0's left ghost wraps to the far-right columns — for the zero-boundary
+    kernel those are the re-zeroed lane pad (exact boundary semantics);
+    for the valid-ghost kernel the wrapped values land only in the
+    contracted discard band, the same guarantee the full-tile roll gives.
+    """
+    gl = _STRIP_GHOST
+    parts = []
+    for s in range(0, wc, _STRIP):
+        width = min(_STRIP, wc - s)
+        if s == 0:
+            xs = jnp.concatenate(
+                [cur[:, wc - gl:], cur[:, 0:width + gl]], axis=1
+            )
+        else:
+            xs = cur[:, s - gl:min(wc, s + width + gl)]
+        val = _rep_val(xs, plan=plan, dt=dt, wc=xs.shape[1],
+                       channels=channels)
+        parts.append(val[:, gl:gl + width])
+    return jnp.concatenate(parts, axis=1)
+
+
+def _shrink_loop(cur, keep, *, plan: StencilPlan, fuse: int, schedule: str,
+                 wc: int, channels: int):
+    """The 'shrink'/'strips' rep loop: the carry value contracts by halo
+    per rep (static shapes inside the unrolled loop) — no per-rep
+    ``jnp.pad``, no per-rep iota: ``keep`` is the hoisted full-tile mask
+    (None = never mask). int32 throughout: int16 adds measured *slower*
+    than int32 on v5e Mosaic (tools/op_cost.py: 13.9 vs 8.9 us/op-pass).
+    Returns the carry after ``fuse`` reps (2*fuse*halo fewer rows)."""
+    h = plan.halo
+    body = _rep_val_strips if schedule == "strips" else _rep_val
+    off = 0
+    for _ in range(fuse):
+        val = body(cur, plan=plan, dt=jnp.int32, wc=wc, channels=channels)
+        off += h
+        if keep is not None:
+            val = jnp.where(keep[off:off + val.shape[0], :], val, 0)
+        cur = val
+    return cur
+
+
 def _sep_kernel(in_hbm, out_ref, s_u8, sem, *, plan: StencilPlan,
                 block_h: int, grid: int, halo_al: int, fuse: int,
-                n_rows_real: int, wc: int, wc_real: int, channels: int):
+                n_rows_real: int, wc: int, wc_real: int, channels: int,
+                schedule: str = "pad"):
     """One row-block program: DMA (block + fuse*halo ghosts), then ``fuse``
     fused separable reps, then one uint8 block store.
 
@@ -286,6 +351,22 @@ def _sep_kernel(in_hbm, out_ref, s_u8, sem, *, plan: StencilPlan,
 
     wait(i, slot)
 
+    if schedule != "pad":
+        # Hoisted full-tile mask (one iota/compare for all reps); the
+        # shrink loop re-applies it on a static slice per rep.
+        cur = s_u8[slot].astype(jnp.int32)
+        rid = jax.lax.broadcasted_iota(jnp.int32, (tile_rows, wc), 0)
+        gid = rid + (i * block_h - halo_al)
+        keep = gid.astype(jnp.uint32) < jnp.uint32(n_rows_real)
+        if wc_real != wc:
+            cid = jax.lax.broadcasted_iota(jnp.int32, (tile_rows, wc), 1)
+            keep = jnp.logical_and(keep, cid < wc_real)
+        cur = _shrink_loop(cur, keep, plan=plan, fuse=fuse,
+                           schedule=schedule, wc=wc, channels=channels)
+        o = halo_al - fuse * h
+        out_ref[:] = cur[o:o + block_h, :].astype(jnp.uint8)
+        return
+
     cur = s_u8[slot].astype(dt)
 
     for t in range(fuse):
@@ -294,8 +375,7 @@ def _sep_kernel(in_hbm, out_ref, s_u8, sem, *, plan: StencilPlan,
         # wraps them into the left edge, a left roll reads them in place),
         # so no per-tap mask is needed — only the per-rep pad re-zeroing
         # below.
-        val = _rep_val(cur, plan=plan, dt=dt, tile_rows=tile_rows, wc=wc,
-                       channels=channels)
+        val = _rep_val(cur, plan=plan, dt=dt, wc=wc, channels=channels)
 
         # --- re-establish zero ghosts for the next rep: pad lanes and
         # below-image rows back to zero (above-image rows stay zero by
@@ -321,7 +401,7 @@ def _sep_kernel(in_hbm, out_ref, s_u8, sem, *, plan: StencilPlan,
 def _valid_kernel(scal_ref, in_hbm, out_ref, s_u8, sem, *, plan: StencilPlan,
                   block_h: int, grid: int, halo_al: int, fuse: int,
                   ghost: int, wc: int, rows_glob: int, cols_glob_c: int,
-                  channels: int):
+                  channels: int, schedule: str = "pad"):
     """Valid-ghost row-block program for *sharded* execution: the input
     already carries ``halo_al`` rows (and ``ghost*channels`` lanes) of
     ghost data per side — real neighbor values delivered by the halo
@@ -367,11 +447,27 @@ def _valid_kernel(scal_ref, in_hbm, out_ref, s_u8, sem, *, plan: StencilPlan,
 
     row0 = scal_ref[0, 0]  # global row of this shard's first interior row
     col0 = scal_ref[0, 1]  # global flat col of first interior lane
+
+    if schedule != "pad":
+        cur = s_u8[slot].astype(jnp.int32)
+        rid = jax.lax.broadcasted_iota(jnp.int32, (tile_rows, wc), 0)
+        gid = rid + (row0 + i * block_h - halo_al)
+        keep = gid.astype(jnp.uint32) < jnp.uint32(rows_glob)
+        cid = jax.lax.broadcasted_iota(jnp.int32, (tile_rows, wc), 1)
+        gcol = cid + (col0 - ghost * channels)
+        keep = jnp.logical_and(
+            keep, gcol.astype(jnp.uint32) < jnp.uint32(cols_glob_c)
+        )
+        cur = _shrink_loop(cur, keep, plan=plan, fuse=fuse,
+                           schedule=schedule, wc=wc, channels=channels)
+        o = halo_al - fuse * h
+        out_ref[:] = cur[o:o + block_h, :].astype(jnp.uint8)
+        return
+
     cur = s_u8[slot].astype(dt)
 
     for t in range(fuse):
-        val = _rep_val(cur, plan=plan, dt=dt, tile_rows=tile_rows, wc=wc,
-                       channels=channels)
+        val = _rep_val(cur, plan=plan, dt=dt, wc=wc, channels=channels)
         # Global-boundary re-zero. val row rid sits at global row
         # row0 + i*block_h - halo_al + rid + h; val lane cid at global flat
         # col col0 + cid - ghost*channels. One unsigned compare per axis
@@ -396,7 +492,8 @@ def _valid_kernel(scal_ref, in_hbm, out_ref, s_u8, sem, *, plan: StencilPlan,
 def valid_fused(ext_u8: jax.Array, plan: StencilPlan, fuse: int,
                 channels: int, row0, col0, global_shape,
                 block_h: int = DEFAULT_BLOCK_H,
-                interpret: bool = False, vma=None) -> jax.Array:
+                interpret: bool = False, vma=None,
+                schedule: str = None) -> jax.Array:
     """Apply ``fuse`` reps to a ghost-extended flat tile (sharded local op).
 
     ``ext_u8``: ``(th + 2*g, (tw + 2*g) * channels)`` uint8, ``g = fuse *
@@ -430,6 +527,7 @@ def valid_fused(ext_u8: jax.Array, plan: StencilPlan, fuse: int,
         _valid_kernel, plan=plan, block_h=bh, grid=grid, halo_al=halo_al,
         fuse=fuse, ghost=g, wc=wl, rows_glob=global_shape[0],
         cols_glob_c=global_shape[1], channels=channels,
+        schedule=schedule or DEFAULT_SCHEDULE,
     )
     out = pl.pallas_call(
         kernel,
@@ -458,13 +556,13 @@ def valid_fused(ext_u8: jax.Array, plan: StencilPlan, fuse: int,
 
 def _build_call(plan: StencilPlan, hp: int, h_real: int, wc: int,
                 wc_real: int, channels: int, block_h: int, fuse: int,
-                interpret: bool):
+                interpret: bool, schedule: str = None):
     grid = hp // block_h
     halo_al = -(-(fuse * plan.halo) // 8) * 8  # sublane-aligned DMA halo
     kernel = functools.partial(
         _sep_kernel, plan=plan, block_h=block_h, grid=grid, halo_al=halo_al,
         fuse=fuse, n_rows_real=h_real, wc=wc, wc_real=wc_real,
-        channels=channels,
+        channels=channels, schedule=schedule or DEFAULT_SCHEDULE,
     )
     return pl.pallas_call(
         kernel,
@@ -486,7 +584,7 @@ def _supported(plan: StencilPlan) -> bool:
 
 def iterate(img_u8: jax.Array, repetitions: jax.Array, plan: StencilPlan,
             block_h: int = DEFAULT_BLOCK_H, fuse: int = DEFAULT_FUSE,
-            interpret: bool = False) -> jax.Array:
+            interpret: bool = False, schedule: str = None) -> jax.Array:
     """Apply the Pallas stencil ``repetitions`` times (traceable/jittable).
 
     Runs ``repetitions // fuse`` launches of the fuse-rep kernel plus
@@ -517,8 +615,10 @@ def iterate(img_u8: jax.Array, repetitions: jax.Array, plan: StencilPlan,
     wcp = -(-(wc + plan.halo * channels) // 128) * 128
     if hp != hh or wcp != wc:
         x2 = jnp.pad(x2, ((0, hp - hh), (0, wcp - wc)))
-    fused = _build_call(plan, hp, hh, wcp, wc, channels, bh, fuse, interpret)
-    single = _build_call(plan, hp, hh, wcp, wc, channels, bh, 1, interpret)
+    fused = _build_call(plan, hp, hh, wcp, wc, channels, bh, fuse, interpret,
+                        schedule=schedule)
+    single = _build_call(plan, hp, hh, wcp, wc, channels, bh, 1, interpret,
+                         schedule=schedule)
     if fuse > 1:
         out = jax.lax.fori_loop(
             0, repetitions // fuse, lambda _, x: fused(x), x2
