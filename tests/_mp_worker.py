@@ -115,7 +115,11 @@ def main() -> None:
     from tpu_stencil.models.blur import IteratedConv2D
     from tpu_stencil.parallel.sharded import ShardedRunner
 
-    model = IteratedConv2D(cfg.filter_name, backend="xla")
+    # mode == "autotune": the runner's backend agreement path — rank 0
+    # resolves (xla on CPU without measuring) and broadcasts its verdict;
+    # both ranks must compile the same program and stay bit-exact.
+    backend = "autotune" if mode == "autotune" else "xla"
+    model = IteratedConv2D(cfg.filter_name, backend=backend)
     runner = ShardedRunner(
         model, (cfg.height, cfg.width), cfg.channels,
         mesh_shape=cfg.mesh_shape, devices=jax.devices(),

@@ -163,3 +163,39 @@ def test_two_process_checkpointed_run(tmp_path, rng):
     want = stencil.reference_stencil_numpy(img, filters.get_filter("gaussian"), 3)
     np.testing.assert_array_equal(got, want)
     assert not os.path.exists(dst + ".ckpt.json")  # cleared after success
+
+
+def test_two_process_autotune_backend_agreement(tmp_path, rng):
+    # backend='autotune' multi-process: rank 0 resolves the winner and
+    # broadcasts it (divergent per-rank winners would shear the compiled
+    # ppermute programs exactly like divergent argv); both ranks must
+    # complete and the shared output must be golden-exact.
+    img = rng.integers(0, 256, size=(12, 20, 3), dtype=np.uint8)
+    src = str(tmp_path / "in.raw")
+    dst = str(tmp_path / "out.raw")
+    raw_io.write_raw(src, img)
+    coordinator = f"127.0.0.1:{_free_port()}"
+    env = dict(
+        os.environ,
+        PYTHONPATH=os.pathsep.join(
+            [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
+            + os.environ.get("PYTHONPATH", "").split(os.pathsep)
+        ),
+    )
+    procs = [
+        subprocess.Popen(
+            [sys.executable, _WORKER, str(pid), coordinator, src, dst,
+             "2", "2", "autotune"],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for pid in range(2)
+    ]
+    outs = [p.communicate(timeout=300)[0] for p in procs]
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, f"worker failed:\n{out}"
+    got = raw_io.read_raw(dst, 20, 12, 3)
+    want = stencil.reference_stencil_numpy(
+        img, filters.get_filter("gaussian"), 3
+    )
+    np.testing.assert_array_equal(got, want)

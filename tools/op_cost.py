@@ -113,6 +113,9 @@ def main():
         "roll3_add_i32": (lambda x, i: x + pltpu.roll(x, 3, 1), i32),
         "roll1_add_i32": (lambda x, i: x + pltpu.roll(x, 1, 1), i32),
         "roll128_add_i32": (lambda x, i: x + pltpu.roll(x, 128, 1), i32),
+        "add_f32": (lambda x, i: x + x, jnp.float32),
+        "mul_add_f32": (lambda x, i: x * np.float32(0.998) + x, jnp.float32),
+        "mul_add_i32": (lambda x, i: x * 3 + x, i32),
         "shift_i32": (lambda x, i: x >> 1, i32),
         "where_i32": (lambda x, i: jnp.where(x > 0, x, 0), i32),
         "cvt_i16_i32_rt": (lambda x, i: x.astype(i32).astype(i16), i16),

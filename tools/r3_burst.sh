@@ -1,0 +1,24 @@
+#!/bin/bash
+# Round-3 TPU measurement burst: run as soon as the tunnel recovers.
+# Stage 1: op-cost table; Stage 2: kernel-lab variant timings.
+# Outputs append to /tmp/r3_opcost.log and /tmp/r3_lab.log.
+set -u
+cd /root/repo
+
+echo "=== burst start $(date +%H:%M:%S) ===" | tee -a /tmp/r3_opcost.log
+
+python tools/op_cost.py \
+    roll3_add_i32 roll1_add_i32 shift_i32 where_i32 cvt_u8_i32_rt \
+    subroll1_add_i32 strip_add_i32 strip128_add_i32 \
+    add_f32 mul_add_f32 mul_add_i32 \
+    mxu_rows_bf16 mxu_rows_i8 \
+    >> /tmp/r3_opcost.log 2>&1
+
+echo "=== op_cost done $(date +%H:%M:%S) ===" | tee -a /tmp/r3_opcost.log /tmp/r3_lab.log
+
+python tools/kernel_lab.py \
+    shipped shrink shrink_strips shrink_strips_i32 shrink_strips_256 \
+    shrink_strips_1024 shrink_pair hoist \
+    >> /tmp/r3_lab.log 2>&1
+
+echo "=== lab done $(date +%H:%M:%S) ===" | tee -a /tmp/r3_lab.log

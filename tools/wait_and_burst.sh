@@ -1,0 +1,17 @@
+#!/bin/bash
+# Poll the TPU tunnel; when it answers, run the r3 measurement burst.
+set -u
+while true; do
+  if timeout 60 python -c "
+import jax, numpy as np
+x = jax.device_put(np.ones((8,128), np.float32))
+assert np.asarray(x).sum() == 1024
+" >/dev/null 2>&1; then
+    echo "$(date +%H:%M:%S) TPU ALIVE - starting burst"
+    break
+  fi
+  echo "$(date +%H:%M:%S) down"
+  sleep 25
+done
+bash /root/repo/tools/r3_burst.sh
+echo "burst complete $(date +%H:%M:%S)"
