@@ -53,3 +53,30 @@ def test_bench_emits_single_json_line_without_failures(tmp_path):
     assert len(lines) == 1  # the ONE-json-line driver contract
     result = json.loads(lines[0])
     assert set(result) >= {"metric", "value", "unit", "vs_baseline"}
+
+
+def test_sweep_incremental_csv_and_retry(tmp_path, monkeypatch):
+    # The sweep must keep already-measured rows on a crash (incremental
+    # CSV) and retry a transiently-failing row instead of dying.
+    import csv as csv_mod
+
+    from tpu_stencil.runtime import bench_sweep
+
+    calls = {"n": 0}
+
+    def flaky_measure(img, filter_name, budget_s, backend):
+        calls["n"] += 1
+        if calls["n"] == 2:  # second row's first attempt dies like a drop
+            raise RuntimeError("UNAVAILABLE: tunnel reset")
+        return 1e-6
+
+    monkeypatch.setattr(bench_sweep, "_measure_per_rep", flaky_measure)
+    monkeypatch.setattr(bench_sweep.time, "sleep", lambda s: None)
+    path = str(tmp_path / "sweep.csv")
+    rows = bench_sweep.run_sweep(quick=True, csv_path=path)
+    assert len(rows) == 4  # quick: 2 sizes x {grey, rgb}
+    with open(path) as f:
+        got = list(csv_mod.DictReader(f))
+    assert len(got) == 4
+    assert float(got[0]["us_per_rep"]) == 1.0
+    assert calls["n"] == 5  # 4 rows + 1 retried attempt
