@@ -41,6 +41,7 @@ plans (the non-separable edge /28: k lane-rolls of the carry + k*k MACs);
 from __future__ import annotations
 
 import functools
+import os
 from typing import Optional
 
 import numpy as np
@@ -65,8 +66,13 @@ _MAX_ROLL_HALO = 128  # cols-pass ghost width limit (halo * channels)
 #              (full-tile op-passes measured ~9 us each on v5e — the op
 #              count, not the op kind, is what the r2 roofline gap is).
 # The default is measured, not assumed: tools/kernel_lab.py times all
-# three on hardware.
-DEFAULT_SCHEDULE = "pad"
+# three on hardware. Env override for on-hardware A/B through the CLI.
+DEFAULT_SCHEDULE = os.environ.get("TPU_STENCIL_PALLAS_SCHEDULE", "pad")
+if DEFAULT_SCHEDULE not in ("pad", "shrink", "strips"):
+    raise ValueError(
+        f"TPU_STENCIL_PALLAS_SCHEDULE must be pad|shrink|strips, "
+        f"got {DEFAULT_SCHEDULE!r}"
+    )
 _STRIP = 512          # strips schedule: lanes per strip
 _STRIP_GHOST = 128    # lane-aligned ghost read per strip side
 
