@@ -1,0 +1,72 @@
+#!/usr/bin/env python
+"""Regenerate docs/BENCHMARKS.md from a bench_sweep CSV.
+
+Usage: python tools/gen_benchmarks_md.py sweep.csv [--out docs/BENCHMARKS.md]
+       [--note "round-3, v5e chip, 2026-07-30"]
+"""
+
+from __future__ import annotations
+
+import argparse
+import csv
+import datetime
+
+
+HEADER = """# Benchmarks — measured sweep
+
+{note}
+
+Method: steady-state two-point differencing (t(2N) - t(N)) / N on-device —
+the dispatch/fence overhead cancels, matching the reference's compute-only
+MPI window (``mpi/mpi_convolution.c:151-155,242``). Reference numbers are
+the GTX-970 whole-program times at 40 reps (``README.pdf`` p.87 /
+BASELINE.md). HBM roofline: % of the v5e's 819 GB/s peak at the backend's
+actual traffic model (fused Pallas moves 2x15 MB per ``fuse`` reps; XLA
+per rep).
+
+Regenerate with:
+
+```bash
+python -m tpu_stencil.runtime.bench_sweep --backends xla,pallas --stress \\
+    --frames 8 --csv docs/BENCHMARKS.csv
+python tools/gen_benchmarks_md.py docs/BENCHMARKS.csv
+```
+"""
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("csv_path")
+    p.add_argument("--out", default="docs/BENCHMARKS.md")
+    p.add_argument("--note", default=None)
+    ns = p.parse_args()
+    with open(ns.csv_path) as f:
+        rows = list(csv.DictReader(f))
+    note = ns.note or (
+        f"Measured on one TPU v5e chip, {datetime.date.today().isoformat()} "
+        f"(round 3)."
+    )
+    lines = [HEADER.format(note=note)]
+    lines.append(
+        "| filter | mode | size | backend | us/rep | HBM GB/s | % peak "
+        "| reps | total (s) | GTX-970 40 reps (s) | speedup |"
+    )
+    lines.append("|---|---|---|---|---|---|---|---|---|---|---|")
+    for r in rows:
+        sp = r.get("speedup_vs_gtx970") or ""
+        g = lambda k: r.get(k) or "-"
+        lines.append(
+            f"| {g('filter')} | {g('mode')} | {g('size')} | {g('backend')} "
+            f"| {g('us_per_rep')} | {g('hbm_gbps')} | {g('pct_hbm_peak')} "
+            f"| {g('reps')} | {g('total_s')} | {g('gtx970_40reps_s')} "
+            f"| {sp + 'x' if sp else '-'} |"
+        )
+    lines.append("")
+    with open(ns.out, "w") as f:
+        f.write("\n".join(lines))
+    print(f"wrote {ns.out} ({len(rows)} rows)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
