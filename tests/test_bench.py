@@ -63,16 +63,24 @@ def test_sweep_incremental_csv_and_retry(tmp_path, monkeypatch):
     from tpu_stencil.runtime import bench_sweep
 
     calls = {"n": 0}
+    path_holder = {}
 
     def flaky_measure(img, filter_name, budget_s, backend):
         calls["n"] += 1
         if calls["n"] == 2:  # second row's first attempt dies like a drop
+            # crash-persistence property: row 1 must already be on disk
+            # BEFORE row 2 completes (not buffered until sweep end)
+            with open(path_holder["p"]) as f:
+                persisted = list(csv_mod.DictReader(f))
+            assert len(persisted) == 1
+            assert float(persisted[0]["us_per_rep"]) == 1.0
             raise RuntimeError("UNAVAILABLE: tunnel reset")
         return 1e-6
 
     monkeypatch.setattr(bench_sweep, "_measure_per_rep", flaky_measure)
     monkeypatch.setattr(bench_sweep.time, "sleep", lambda s: None)
     path = str(tmp_path / "sweep.csv")
+    path_holder["p"] = path
     rows = bench_sweep.run_sweep(quick=True, csv_path=path)
     assert len(rows) == 4  # quick: 2 sizes x {grey, rgb}
     with open(path) as f:

@@ -34,36 +34,44 @@ python tools/gen_benchmarks_md.py docs/BENCHMARKS.csv
 """
 
 
+_FIELDS = (
+    "filter", "mode", "size", "backend", "us_per_rep", "hbm_gbps",
+    "pct_hbm_peak", "reps", "total_s", "gtx970_40reps_s",
+    "speedup_vs_gtx970",
+)
+
+
 def main() -> int:
+    import sys
+
+    sys.path.insert(0, ".")
+    from tpu_stencil.runtime.bench_sweep import emit_markdown
+
     p = argparse.ArgumentParser()
     p.add_argument("csv_path")
     p.add_argument("--out", default="docs/BENCHMARKS.md")
     p.add_argument("--note", default=None)
     ns = p.parse_args()
     with open(ns.csv_path) as f:
-        rows = list(csv.DictReader(f))
+        # normalize (older CSVs may lack columns) and reuse the sweep's own
+        # formatter so the doc can never drift from what bench_sweep prints
+        # emit_markdown renders falsy speedup/gtx970 cells as '-' itself
+        rows = [
+            {
+                k: r.get(k) or (
+                    "" if k in ("speedup_vs_gtx970", "gtx970_40reps_s")
+                    else "-"
+                )
+                for k in _FIELDS
+            }
+            for r in csv.DictReader(f)
+        ]
     note = ns.note or (
         f"Measured on one TPU v5e chip, {datetime.date.today().isoformat()} "
         f"(round 3)."
     )
-    lines = [HEADER.format(note=note)]
-    lines.append(
-        "| filter | mode | size | backend | us/rep | HBM GB/s | % peak "
-        "| reps | total (s) | GTX-970 40 reps (s) | speedup |"
-    )
-    lines.append("|---|---|---|---|---|---|---|---|---|---|---|")
-    for r in rows:
-        sp = r.get("speedup_vs_gtx970") or ""
-        g = lambda k: r.get(k) or "-"
-        lines.append(
-            f"| {g('filter')} | {g('mode')} | {g('size')} | {g('backend')} "
-            f"| {g('us_per_rep')} | {g('hbm_gbps')} | {g('pct_hbm_peak')} "
-            f"| {g('reps')} | {g('total_s')} | {g('gtx970_40reps_s')} "
-            f"| {sp + 'x' if sp else '-'} |"
-        )
-    lines.append("")
     with open(ns.out, "w") as f:
-        f.write("\n".join(lines))
+        f.write(HEADER.format(note=note) + emit_markdown(rows) + "\n")
     print(f"wrote {ns.out} ({len(rows)} rows)")
     return 0
 

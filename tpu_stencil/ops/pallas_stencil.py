@@ -68,11 +68,18 @@ _MAX_ROLL_HALO = 128  # cols-pass ghost width limit (halo * channels)
 # The default is measured, not assumed: tools/kernel_lab.py times all
 # three on hardware. Env override for on-hardware A/B through the CLI.
 DEFAULT_SCHEDULE = os.environ.get("TPU_STENCIL_PALLAS_SCHEDULE", "pad")
-if DEFAULT_SCHEDULE not in ("pad", "shrink", "strips"):
-    raise ValueError(
-        f"TPU_STENCIL_PALLAS_SCHEDULE must be pad|shrink|strips, "
-        f"got {DEFAULT_SCHEDULE!r}"
-    )
+
+
+def _check_schedule(schedule: Optional[str]) -> str:
+    schedule = schedule or DEFAULT_SCHEDULE
+    if schedule not in ("pad", "shrink", "strips"):
+        raise ValueError(
+            f"schedule must be pad|shrink|strips, got {schedule!r}"
+        )
+    return schedule
+
+
+_check_schedule(DEFAULT_SCHEDULE)  # env override validated at import
 _STRIP = 512          # strips schedule: lanes per strip
 _STRIP_GHOST = 128    # lane-aligned ghost read per strip side
 
@@ -533,7 +540,7 @@ def valid_fused(ext_u8: jax.Array, plan: StencilPlan, fuse: int,
         _valid_kernel, plan=plan, block_h=bh, grid=grid, halo_al=halo_al,
         fuse=fuse, ghost=g, wc=wl, rows_glob=global_shape[0],
         cols_glob_c=global_shape[1], channels=channels,
-        schedule=schedule or DEFAULT_SCHEDULE,
+        schedule=_check_schedule(schedule),
     )
     out = pl.pallas_call(
         kernel,
@@ -568,7 +575,7 @@ def _build_call(plan: StencilPlan, hp: int, h_real: int, wc: int,
     kernel = functools.partial(
         _sep_kernel, plan=plan, block_h=block_h, grid=grid, halo_al=halo_al,
         fuse=fuse, n_rows_real=h_real, wc=wc, wc_real=wc_real,
-        channels=channels, schedule=schedule or DEFAULT_SCHEDULE,
+        channels=channels, schedule=_check_schedule(schedule),
     )
     return pl.pallas_call(
         kernel,
