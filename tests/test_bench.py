@@ -88,3 +88,27 @@ def test_sweep_incremental_csv_and_retry(tmp_path, monkeypatch):
     assert len(got) == 4
     assert float(got[0]["us_per_rep"]) == 1.0
     assert calls["n"] == 5  # 4 rows + 1 retried attempt
+
+
+def test_sweep_frames_row(tmp_path, monkeypatch):
+    # --frames adds one batch-mode row with per-frame*rep normalization.
+    from tpu_stencil.runtime import bench_sweep
+
+    monkeypatch.setattr(
+        bench_sweep, "_measure_per_rep", lambda *a, **k: 1e-6
+    )
+    seen = {}
+
+    def fake_batch(imgs, filter_name, budget_s):
+        seen["n_frames"] = imgs.shape[0]
+        return 2e-6  # per frame*rep
+
+    monkeypatch.setattr(
+        bench_sweep, "_measure_batch_per_frame_rep", fake_batch
+    )
+    rows = bench_sweep.run_sweep(quick=True, frames=4)
+    assert seen["n_frames"] == 4
+    fr = rows[-1]
+    assert "x4 frames" in fr["size"]
+    assert fr["us_per_rep"] == 2.0
+    assert fr["speedup_vs_gtx970"] > 0

@@ -134,7 +134,8 @@ def test_direct_int_plan_matches_golden(rng, reps):
 
 @pytest.mark.parametrize("schedule", ["shrink", "strips"])
 @pytest.mark.parametrize("name,reps", [
-    ("gaussian", 5), ("gaussian5", 4), ("edge", 3), ("box", 3),
+    ("gaussian", 5), ("gaussian5", 4), ("gaussian7", 2), ("edge", 3),
+    ("box", 3),
 ])
 def test_schedules_match_golden(rng, schedule, name, reps):
     # r3 kernel redesign: the shrink/strips per-rep schedules (no per-rep
