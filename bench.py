@@ -80,8 +80,8 @@ def _time_fn(jit_fn, img) -> float:
 def _measure_backend(backend: str) -> dict:
     """Steady-state per-rep seconds for one backend on the north star.
 
-    For the Pallas backend, every per-rep schedule (pad/shrink/strips —
-    see ops/pallas_stencil.py) is measured and the best one is reported,
+    For the Pallas backend, every per-rep schedule (pad/shrink/strips/pack
+    — see ops/pallas_stencil.py) is measured and the best one is reported,
     so the capture always reflects the kernel's best available
     configuration even if the default has not been flipped yet."""
     import functools
@@ -102,7 +102,7 @@ def _measure_backend(backend: str) -> dict:
         return {"us_per_rep": round(per_rep * 1e6, 2), "per_rep_s": per_rep}
 
     schedules = {}
-    for sched in ("pad", "shrink", "strips"):
+    for sched in ("pad", "shrink", "strips", "pack"):
         jit_fn = jax.jit(
             functools.partial(
                 pallas_stencil.iterate, plan=model.plan, schedule=sched

@@ -156,6 +156,52 @@ def _rep_val_strips(cur, *, plan, dt, wc, channels, opts):
     return jnp.concatenate(parts, axis=1)
 
 
+def _rep_val_packed(cur, *, plan, wc, channels, opts):
+    """One rep on a SWAR-packed value: two image rows per i32 lane element
+    (low/high 16 bits). Halves are independent bit fields — adds never
+    carry across because every intermediate is < 2^16 (gated by the
+    caller). Returns the un-finished cols-pass accumulator (caller does
+    shift + AND-mask)."""
+    h = plan.halo
+    strip = opts.get("strip")
+
+    def one(x):
+        swc = x.shape[1]
+        n_rows = x.shape[0] - 2 * h
+        acc = None
+        for t_idx, tap in enumerate(plan.row_taps):
+            if tap == 0:
+                continue
+            term = x[t_idx:t_idx + n_rows, :]
+            if tap != 1:
+                term = term * tap
+            acc = term if acc is None else acc + term
+        col = None
+        for t_idx, tap in enumerate(plan.col_taps):
+            if tap == 0:
+                continue
+            term = _lane_roll(acc, (t_idx - h) * channels, swc)
+            if tap != 1:
+                term = term * tap
+            col = term if col is None else col + term
+        return col
+
+    if not strip:
+        return one(cur)
+    gl = 128
+    parts = []
+    for s in range(0, wc, strip):
+        width = min(strip, wc - s)
+        if s == 0:
+            xs = jnp.concatenate(
+                [cur[:, wc - gl:], cur[:, 0:width + gl]], axis=1
+            )
+        else:
+            xs = cur[:, s - gl:min(wc, s + width + gl)]
+        parts.append(one(xs)[:, gl:gl + width])
+    return jnp.concatenate(parts, axis=1)
+
+
 def _lab_kernel(in_hbm, out_ref, s_u8, sem, *, plan, block_h, grid,
                 halo_al, fuse, n_rows_real, wc, wc_real, channels, opts):
     i = pl.program_id(0)
@@ -233,6 +279,45 @@ def _lab_kernel(in_hbm, out_ref, s_u8, sem, *, plan, block_h, grid,
 
     cur = s_u8[slot].astype(dt)
     masked = not opts.get("no_mask")
+
+    if opts.get("swar"):
+        # SWAR pack: two image rows per i32 lane. Halves overlap by
+        # 2*halo_al >= 2*fuse*h so each half's valid band independently
+        # covers its part of the output — no cross-half seam data needed.
+        g = fuse * plan.halo
+        kp = tile_rows // 2 + halo_al  # packed rows; overlap = 2*halo_al
+        lo = s_u8[slot, 0:kp, :].astype(jnp.int32)
+        hi = s_u8[slot, pl.ds(tile_rows - kp, kp), :].astype(jnp.int32)
+        cur = lo | (hi << 16)
+        # Hoisted packed mask: per-half row bound + shared col bound +
+        # the post-shift byte mask (outputs are <= 255 when clip elides).
+        rid = jax.lax.broadcasted_iota(jnp.int32, (kp, wc), 0)
+        glo = rid + (i * block_h - halo_al)
+        ghi = rid + (i * block_h - halo_al + tile_rows - kp)
+        m = jnp.where(glo.astype(jnp.uint32) < jnp.uint32(n_rows_real),
+                      0x00FF, 0)
+        m = m | jnp.where(
+            ghi.astype(jnp.uint32) < jnp.uint32(n_rows_real), 0x00FF0000, 0)
+        if wc_real != wc:
+            cid = jax.lax.broadcasted_iota(jnp.int32, (kp, wc), 1)
+            m = jnp.where(cid < wc_real, m, 0)
+        off = 0
+        for t in range(fuse):
+            col = _rep_val_packed(cur, plan=plan, wc=wc, channels=channels,
+                                  opts=opts)
+            off += plan.halo
+            cur = (col >> plan.shift) & m[off:off + col.shape[0], :]
+        # Unpack: low half serves output rows [0, block_h/2), high half
+        # the rest (coverage guaranteed by halo_al >= g).
+        bh2 = block_h // 2
+        o1 = halo_al - g  # cur row of tile row halo_al
+        out_ref[0:bh2, :] = cur[o1:o1 + bh2, :].astype(jnp.uint8)
+        # tile row halo_al + bh2 in the high half = packed row
+        # halo_al + bh2 - (tile_rows - kp), minus the g contraction.
+        o2 = halo_al + bh2 - (tile_rows - kp) - g
+        out_ref[pl.ds(bh2, block_h - bh2), :] = (
+            cur[o2:o2 + block_h - bh2, :] >> 16).astype(jnp.uint8)
+        return
 
     if opts.get("shrink"):
         # Hoisted full-tile mask; per-rep: one static slice + one select.
@@ -370,6 +455,10 @@ VARIANTS = {
     "shrink_strips_256": dict(shrink=True, strips=True, strip=256, i32=True),
     "shrink_strips_1024": dict(shrink=True, strips=True, strip=1024,
                                i32=True),
+    "swar": dict(swar=True),
+    "swar_strips": dict(swar=True, strip=512),
+    "swar_strips_1024": dict(swar=True, strip=1024),
+    "swar_b256": dict(swar=True, block_h=256),
     "abl_no_mask": dict(shrink=True, pair_add=True, no_mask=True),
     "abl_no_cols": dict(shrink=True, pair_add=True, no_cols=True,
                         no_mask=True),

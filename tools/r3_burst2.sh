@@ -1,0 +1,8 @@
+#!/bin/bash
+# Round-3 burst #2: SWAR-variant lab timings (run on tunnel recovery).
+set -u
+cd /root/repo
+echo "=== burst2 start $(date +%H:%M:%S) ===" | tee -a /tmp/r3_lab2.log
+python -u tools/kernel_lab.py swar swar_strips swar_strips_1024 swar_b256 \
+    >> /tmp/r3_lab2.log 2>&1
+echo "=== burst2 done $(date +%H:%M:%S) ===" | tee -a /tmp/r3_lab2.log

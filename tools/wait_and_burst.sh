@@ -13,5 +13,5 @@ assert np.asarray(x).sum() == 1024
   echo "$(date +%H:%M:%S) down"
   sleep 25
 done
-bash /root/repo/tools/r3_burst.sh
+bash ${R3_BURST:-/root/repo/tools/r3_burst.sh}
 echo "burst complete $(date +%H:%M:%S)"

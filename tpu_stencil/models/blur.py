@@ -83,7 +83,13 @@ def iterate(img_u8: jax.Array, repetitions: jax.Array,
 
         # The Pallas driver owns its rep loop: the carry stays row-padded
         # across repetitions instead of padding/cropping every step.
-        return pallas_stencil.iterate(img_u8, repetitions, plan)
+        # Interpret on CPU: Mosaic only compiles for TPU, and the sharded
+        # runner already runs interpret there — the single-device CLI path
+        # must behave the same (--backend pallas --platform cpu).
+        return pallas_stencil.iterate(
+            img_u8, repetitions, plan,
+            interpret=jax.default_backend() != "tpu",
+        )
     eff_backend = (
         "xla" if resolve_backend(backend) == "pallas" else backend
     )  # pallas is zero-boundary only; periodic runs the XLA schedule

@@ -65,17 +65,53 @@ _MAX_ROLL_HALO = 128  # cols-pass ghost width limit (halo * channels)
 #              the whole op chain per strip can stay register-resident
 #              (full-tile op-passes measured ~9 us each on v5e — the op
 #              count, not the op kind, is what the r2 roofline gap is).
+#   'pack'   — SWAR: two image rows per i32 lane element (low/high 16
+#              bits), halving the element count of every roll/add/shift
+#              pass — lane rolls at ~19 us/full-tile-pass are the r3 cost
+#              center, and Mosaic's lane rotate is 32-bit only, so packing
+#              is the one way to move two rows per rotated element. The
+#              halves overlap by the ghost depth so neither needs the
+#              other's data; boundary re-zero + the per-rep uint8
+#              truncation fold into one AND with a hoisted packed mask.
+#              Applies when every intermediate fits 16 bits (gaussian /
+#              gaussian5: 255 * 2^shift < 2^16); other plans degrade to
+#              'shrink'.
 # The default is measured, not assumed: tools/kernel_lab.py times all
-# three on hardware. Env override for on-hardware A/B through the CLI.
+# schedules on hardware. Env override for on-hardware A/B through the CLI.
 DEFAULT_SCHEDULE = os.environ.get("TPU_STENCIL_PALLAS_SCHEDULE", "pad")
+
+_SCHEDULES = ("pad", "shrink", "strips", "pack")
 
 
 def _check_schedule(schedule: Optional[str]) -> str:
     schedule = schedule or DEFAULT_SCHEDULE
-    if schedule not in ("pad", "shrink", "strips"):
+    if schedule not in _SCHEDULES:
         raise ValueError(
-            f"schedule must be pad|shrink|strips, got {schedule!r}"
+            f"schedule must be one of {'|'.join(_SCHEDULES)}, "
+            f"got {schedule!r}"
         )
+    return schedule
+
+
+def _pack_ok(plan: StencilPlan, block_h: int) -> bool:
+    """'pack' preconditions: separable nonneg dyadic plan whose per-rep
+    intermediates all fit 16 bits (255 * 2^shift < 2^16 <=> shift <= 8,
+    since total weight == 2^shift when the clip elides), and an even
+    half-block split that keeps the two out_ref stores sublane-aligned."""
+    return (
+        plan.kind == "sep_int"
+        and plan.shift is not None
+        and plan.shift <= 8
+        and not _clip_needed(plan)
+        and block_h % 16 == 0
+    )
+
+
+def _effective_schedule(schedule: Optional[str], plan: StencilPlan,
+                        block_h: int) -> str:
+    schedule = _check_schedule(schedule)
+    if schedule == "pack" and not _pack_ok(plan, block_h):
+        return "shrink"
     return schedule
 
 
@@ -249,6 +285,86 @@ def _rep_val_strips(cur, *, plan: StencilPlan, dt, wc: int, channels: int):
     return jnp.concatenate(parts, axis=1)
 
 
+def _packed_passes(cur, *, plan: StencilPlan, wc: int, channels: int):
+    """Separable rows+cols passes on a SWAR-packed value (two rows per i32
+    lane, low/high 16 bits). Pure adds/multiplies/rolls act on both halves
+    at once; no carry crosses the bit-16 boundary because ``_pack_ok``
+    bounds every intermediate below 2^16. Returns the unfinished cols-pass
+    accumulator (the caller shifts and AND-masks)."""
+    h = plan.halo
+    rows_out = cur.shape[0] - 2 * h
+    acc = None
+    for t_idx, tap in enumerate(plan.row_taps):
+        if tap == 0:
+            continue
+        term = cur[t_idx:t_idx + rows_out, :]
+        if tap != 1:
+            term = term * tap
+        acc = term if acc is None else acc + term
+    col = None
+    for t_idx, tap in enumerate(plan.col_taps):
+        if tap == 0:
+            continue
+        off = (t_idx - h) * channels
+        if off == 0:
+            term = acc
+        elif off < 0:
+            term = pltpu.roll(acc, -off, 1)
+        else:
+            term = pltpu.roll(acc, wc - off, 1)
+        if tap != 1:
+            term = term * tap
+        col = term if col is None else col + term
+    return col
+
+
+def _packed_loop(out_ref, tile_u8, keep_rows, keep_cols, *,
+                 plan: StencilPlan, block_h: int, halo_al: int, fuse: int,
+                 wc: int, channels: int):
+    """The 'pack' rep loop + unpack, shared by both kernels.
+
+    ``tile_u8``: the (block_h + 2*halo_al, wc) uint8 VMEM tile value.
+    ``keep_rows``: tile-row index -> bool keep (callers bake in their
+    global row offset; applied to each half at its own tile offset);
+    ``keep_cols``: lane keep (None = all lanes kept). The two halves
+    overlap by 2*halo_al >= 2*fuse*halo rows, so each half's valid band
+    independently covers its half of the output block and no cross-half
+    seam data is ever needed.
+    """
+    h = plan.halo
+    g = fuse * h
+    tile_rows = tile_u8.shape[0]
+    kp = tile_rows // 2 + halo_al  # packed rows; halves overlap 2*halo_al
+    lo = tile_u8[0:kp, :].astype(jnp.int32)
+    hi = tile_u8[tile_rows - kp:tile_rows, :].astype(jnp.int32)
+    cur = lo | (hi << 16)
+    # Hoisted packed mask: per-half row bound, shared lane bound, and the
+    # post-shift byte mask (per-rep outputs are <= 255 when the clip
+    # elides) — the per-rep boundary re-zero AND uint8 truncation become
+    # one AND. Out-of-extent pixels zero; kept (and in-extent garbage)
+    # lanes truncate to their low byte, keeping every later add < 2^16.
+    rid = jax.lax.broadcasted_iota(jnp.int32, (kp, wc), 0)
+    m = jnp.where(keep_rows(rid), 0x000000FF, 0)
+    m = m | jnp.where(keep_rows(rid + (tile_rows - kp)), 0x00FF0000, 0)
+    if keep_cols is not None:
+        cid = jax.lax.broadcasted_iota(jnp.int32, (kp, wc), 1)
+        m = jnp.where(keep_cols(cid), m, 0)
+    off = 0
+    for _ in range(fuse):
+        col = _packed_passes(cur, plan=plan, wc=wc, channels=channels)
+        off += h
+        cur = (col >> plan.shift) & m[off:off + col.shape[0], :]
+    # Unpack: the low half serves output rows [0, block_h/2), the high
+    # half the rest; both start at the same carry row because the halves'
+    # tile offsets differ by exactly block_h/2 (tile_rows - kp).
+    bh2 = block_h // 2
+    o = halo_al - g
+    out_ref[0:bh2, :] = cur[o:o + bh2, :].astype(jnp.uint8)
+    out_ref[bh2:block_h, :] = (
+        cur[o:o + block_h - bh2, :] >> 16
+    ).astype(jnp.uint8)
+
+
 def _shrink_loop(cur, keep, *, plan: StencilPlan, fuse: int, schedule: str,
                  wc: int, channels: int):
     """The 'shrink'/'strips' rep loop: the carry value contracts by halo
@@ -364,6 +480,18 @@ def _sep_kernel(in_hbm, out_ref, s_u8, sem, *, plan: StencilPlan,
 
     wait(i, slot)
 
+    if schedule == "pack":
+        base = i * block_h - halo_al  # global row of tile row 0
+        _packed_loop(
+            out_ref, s_u8[slot],
+            lambda rid: (rid + base).astype(jnp.uint32)
+            < jnp.uint32(n_rows_real),
+            (lambda cid: cid < wc_real) if wc_real != wc else None,
+            plan=plan, block_h=block_h, halo_al=halo_al, fuse=fuse,
+            wc=wc, channels=channels,
+        )
+        return
+
     if schedule != "pad":
         # Hoisted full-tile mask (one iota/compare for all reps); the
         # shrink loop re-applies it on a static slice per rep.
@@ -461,6 +589,20 @@ def _valid_kernel(scal_ref, in_hbm, out_ref, s_u8, sem, *, plan: StencilPlan,
     row0 = scal_ref[0, 0]  # global row of this shard's first interior row
     col0 = scal_ref[0, 1]  # global flat col of first interior lane
 
+    if schedule == "pack":
+        base = row0 + i * block_h - halo_al  # global row of tile row 0
+        cbase = col0 - ghost * channels      # global flat col of lane 0
+        _packed_loop(
+            out_ref, s_u8[slot],
+            lambda rid: (rid + base).astype(jnp.uint32)
+            < jnp.uint32(rows_glob),
+            lambda cid: (cid + cbase).astype(jnp.uint32)
+            < jnp.uint32(cols_glob_c),
+            plan=plan, block_h=block_h, halo_al=halo_al, fuse=fuse,
+            wc=wc, channels=channels,
+        )
+        return
+
     if schedule != "pad":
         cur = s_u8[slot].astype(jnp.int32)
         rid = jax.lax.broadcasted_iota(jnp.int32, (tile_rows, wc), 0)
@@ -540,7 +682,7 @@ def valid_fused(ext_u8: jax.Array, plan: StencilPlan, fuse: int,
         _valid_kernel, plan=plan, block_h=bh, grid=grid, halo_al=halo_al,
         fuse=fuse, ghost=g, wc=wl, rows_glob=global_shape[0],
         cols_glob_c=global_shape[1], channels=channels,
-        schedule=_check_schedule(schedule),
+        schedule=_effective_schedule(schedule, plan, bh),
     )
     out = pl.pallas_call(
         kernel,
@@ -575,7 +717,8 @@ def _build_call(plan: StencilPlan, hp: int, h_real: int, wc: int,
     kernel = functools.partial(
         _sep_kernel, plan=plan, block_h=block_h, grid=grid, halo_al=halo_al,
         fuse=fuse, n_rows_real=h_real, wc=wc, wc_real=wc_real,
-        channels=channels, schedule=_check_schedule(schedule),
+        channels=channels, schedule=_effective_schedule(schedule, plan,
+                                                        block_h),
     )
     return pl.pallas_call(
         kernel,
