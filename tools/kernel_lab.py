@@ -174,7 +174,7 @@ def _rep_val_packed(cur, *, plan, wc, channels, opts):
                 continue
             term = x[t_idx:t_idx + n_rows, :]
             if tap != 1:
-                term = term * tap
+                term = ps._mul_const_adds(term, tap)  # match shipped pack
             acc = term if acc is None else acc + term
         col = None
         for t_idx, tap in enumerate(plan.col_taps):
@@ -182,7 +182,7 @@ def _rep_val_packed(cur, *, plan, wc, channels, opts):
                 continue
             term = _lane_roll(acc, (t_idx - h) * channels, swc)
             if tap != 1:
-                term = term * tap
+                term = ps._mul_const_adds(term, tap)
             col = term if col is None else col + term
         return col
 

@@ -85,10 +85,17 @@ def iterate(img_u8: jax.Array, repetitions: jax.Array,
         # across repetitions instead of padding/cropping every step.
         # Interpret on CPU: Mosaic only compiles for TPU, and the sharded
         # runner already runs interpret there — the single-device CLI path
-        # must behave the same (--backend pallas --platform cpu).
+        # must behave the same (--backend pallas --platform cpu). Other
+        # platforms fail loudly rather than silently timing the HLO
+        # interpreter as a 'pallas' number.
+        plat = jax.default_backend()
+        if plat not in ("tpu", "cpu"):
+            raise NotImplementedError(
+                "the Pallas backend targets TPU (interpret mode on CPU); "
+                f"on {plat!r} use --backend xla"
+            )
         return pallas_stencil.iterate(
-            img_u8, repetitions, plan,
-            interpret=jax.default_backend() != "tpu",
+            img_u8, repetitions, plan, interpret=plat == "cpu",
         )
     eff_backend = (
         "xla" if resolve_backend(backend) == "pallas" else backend

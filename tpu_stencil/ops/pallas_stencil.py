@@ -299,7 +299,10 @@ def _packed_passes(cur, *, plan: StencilPlan, wc: int, channels: int):
             continue
         term = cur[t_idx:t_idx + rows_out, :]
         if tap != 1:
-            term = term * tap
+            # Shift-add chain, never a vector multiply: full-tile i32
+            # multiplies measured ~60 us/pass vs ~9 for adds (op_cost.py),
+            # and doubling-by-add is SWAR-safe (bounds hold per _pack_ok).
+            term = _mul_const_adds(term, tap)
         acc = term if acc is None else acc + term
     col = None
     for t_idx, tap in enumerate(plan.col_taps):
@@ -313,7 +316,7 @@ def _packed_passes(cur, *, plan: StencilPlan, wc: int, channels: int):
         else:
             term = pltpu.roll(acc, wc - off, 1)
         if tap != 1:
-            term = term * tap
+            term = _mul_const_adds(term, tap)
         col = term if col is None else col + term
     return col
 
