@@ -102,7 +102,7 @@ def _measure_backend(backend: str) -> dict:
         return {"us_per_rep": round(per_rep * 1e6, 2), "per_rep_s": per_rep}
 
     schedules = {}
-    for sched in ("pad", "shrink", "strips", "pack"):
+    for sched in ("pad", "shrink", "strips", "pack", "pack_strips"):
         jit_fn = jax.jit(
             functools.partial(
                 pallas_stencil.iterate, plan=model.plan, schedule=sched

@@ -132,7 +132,7 @@ def test_direct_int_plan_matches_golden(rng, reps):
     np.testing.assert_array_equal(got, want)
 
 
-@pytest.mark.parametrize("schedule", ["shrink", "strips", "pack"])
+@pytest.mark.parametrize("schedule", ["shrink", "strips", "pack", "pack_strips"])
 @pytest.mark.parametrize("name,reps", [
     ("gaussian", 5), ("gaussian5", 4), ("gaussian7", 2), ("edge", 3),
     ("box", 3),
@@ -151,7 +151,7 @@ def test_schedules_match_golden(rng, schedule, name, reps):
     np.testing.assert_array_equal(got, want)
 
 
-@pytest.mark.parametrize("schedule", ["shrink", "strips", "pack"])
+@pytest.mark.parametrize("schedule", ["shrink", "strips", "pack", "pack_strips"])
 def test_schedules_grey_and_single_block(rng, schedule):
     img = rng.integers(0, 256, size=(40, 33), dtype=np.uint8)
     plan = lowering.plan_filter(filters.get_filter("gaussian"))
@@ -165,8 +165,9 @@ def test_schedules_grey_and_single_block(rng, schedule):
     np.testing.assert_array_equal(got, want)
 
 
+@pytest.mark.parametrize("schedule", ["pack", "pack_strips"])
 @pytest.mark.parametrize("name,reps", [("gaussian", 8), ("gaussian5", 4)])
-def test_pack_schedule_genuine(rng, name, reps):
+def test_pack_schedule_genuine(rng, schedule, name, reps):
     # block_h % 16 == 0 and shift <= 8: the SWAR branch actually runs
     # (block_h=24 in the shared schedule test degrades pack -> shrink).
     # gaussian5 is the 16-bit boundary case: 255 * 2^8 = 65280 < 2^16.
@@ -175,7 +176,7 @@ def test_pack_schedule_genuine(rng, name, reps):
     assert pallas_stencil._pack_ok(plan, 32)
     got = np.asarray(
         pallas_stencil.iterate(img, jnp.int32(reps), plan, block_h=32,
-                               fuse=4, interpret=True, schedule="pack")
+                               fuse=4, interpret=True, schedule=schedule)
     )
     want = stencil.reference_stencil_numpy(img, filters.get_filter(name), reps)
     np.testing.assert_array_equal(got, want)
@@ -188,6 +189,8 @@ def test_pack_degrades_for_wide_or_clipped_plans():
         plan = lowering.plan_filter(filters.get_filter(name))
         assert not pallas_stencil._pack_ok(plan, 32)
         assert pallas_stencil._effective_schedule("pack", plan, 32) == "shrink"
+        assert pallas_stencil._effective_schedule(
+            "pack_strips", plan, 32) == "strips"
     plan = lowering.plan_filter(filters.get_filter("gaussian"))
     assert pallas_stencil._effective_schedule("pack", plan, 24) == "shrink"
     assert pallas_stencil._effective_schedule("pack", plan, 32) == "pack"

@@ -171,7 +171,7 @@ def test_sharded_iterate_convenience(rng):
 
 
 @requires_8
-@pytest.mark.parametrize("schedule", ["shrink", "strips", "pack"])
+@pytest.mark.parametrize("schedule", ["shrink", "strips", "pack", "pack_strips"])
 def test_pallas_sharded_schedules_match_single_device(
     rng, schedule, monkeypatch
 ):

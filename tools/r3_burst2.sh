@@ -10,25 +10,29 @@ cd /root/repo
 : > /tmp/r3_lab2.log
 echo "=== burst2 start $(date +%H:%M:%S) ===" | tee -a /tmp/r3_lab2.log
 
-# 1. SWAR lab variants vs shipped
+# 1. SWAR lab variants vs the best exact non-swar ones (shrink /
+# shrink_strips_1024) so the schedule verdict below has a real baseline.
 python -u tools/kernel_lab.py swar swar_strips swar_strips_1024 swar_b256 \
-    shipped >> /tmp/r3_lab2.log 2>&1
+    shrink shrink_strips_1024 shipped >> /tmp/r3_lab2.log 2>&1
 echo "=== lab done $(date +%H:%M:%S) ===" | tee -a /tmp/r3_lab2.log
 
-# Pick the sweep/1x1 schedule from the lab verdict: any exact swar
-# variant beating the best non-swar one selects 'pack'.
+# Pick the sweep/1x1 schedule from the lab verdict: fastest exact
+# variant, mapped to its production schedule name.
 SCHED=$(python - <<'EOF'
 import re
 best = {}
 for line in open("/tmp/r3_lab2.log"):
-    m = re.match(r"(\S+)\s+([0-9.]+) us/rep\s+exact=(True|-)\s*$", line)
+    m = re.match(r"(\S+)\s+([0-9.]+) us/rep\s+exact=True\s*$", line)
     if m:
         best[m.group(1)] = float(m.group(2))
-swar = min((v for k, v in best.items() if k.startswith("swar")), default=None)
-rest = min((v for k, v in best.items() if not k.startswith("swar")),
-           default=None)
-print("pack" if swar is not None and (rest is None or swar < rest)
-      else "shrink")
+def to_schedule(name):
+    for prefix, sched in (("swar_strips", "pack_strips"), ("swar", "pack"),
+                          ("shrink_strips", "strips"), ("shrink", "shrink"),
+                          ("hoist", "shrink")):
+        if name.startswith(prefix):
+            return sched
+    return "pad"
+print(to_schedule(min(best, key=best.get)) if best else "pad")
 EOF
 )
 echo "schedule verdict: $SCHED" | tee -a /tmp/r3_lab2.log

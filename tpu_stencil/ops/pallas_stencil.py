@@ -76,11 +76,13 @@ _MAX_ROLL_HALO = 128  # cols-pass ghost width limit (halo * channels)
 #              Applies when every intermediate fits 16 bits (gaussian /
 #              gaussian5: 255 * 2^shift < 2^16); other plans degrade to
 #              'shrink'.
+#   'pack_strips' — 'pack' with each rep computed lane-strip by
+#              lane-strip (the 'strips' trick on packed values).
 # The default is measured, not assumed: tools/kernel_lab.py times all
 # schedules on hardware. Env override for on-hardware A/B through the CLI.
 DEFAULT_SCHEDULE = os.environ.get("TPU_STENCIL_PALLAS_SCHEDULE", "pad")
 
-_SCHEDULES = ("pad", "shrink", "strips", "pack")
+_SCHEDULES = ("pad", "shrink", "strips", "pack", "pack_strips")
 
 
 def _check_schedule(schedule: Optional[str]) -> str:
@@ -110,8 +112,8 @@ def _pack_ok(plan: StencilPlan, block_h: int) -> bool:
 def _effective_schedule(schedule: Optional[str], plan: StencilPlan,
                         block_h: int) -> str:
     schedule = _check_schedule(schedule)
-    if schedule == "pack" and not _pack_ok(plan, block_h):
-        return "shrink"
+    if schedule.startswith("pack") and not _pack_ok(plan, block_h):
+        return "strips" if schedule == "pack_strips" else "shrink"
     return schedule
 
 
@@ -255,10 +257,9 @@ def _rep_val(cur, *, plan: StencilPlan, dt, wc: int, channels: int):
     return val
 
 
-def _rep_val_strips(cur, *, plan: StencilPlan, dt, wc: int, channels: int):
-    """One repetition computed lane-strip by lane-strip (same contract as
-    :func:`_rep_val`): each strip's whole op chain — rows adds, cols rolls,
-    shift, clip — touches a working set small enough to stay in vector
+def _strips_map(body, cur, wc: int):
+    """Apply ``body(strip_value)`` lane-strip by lane-strip: each strip's
+    whole op chain touches a working set small enough to stay in vector
     registers, aiming at one VMEM sweep per rep instead of one per op.
 
     Strip reads overlap ``_STRIP_GHOST`` lanes per side (lane-aligned, >=
@@ -279,10 +280,18 @@ def _rep_val_strips(cur, *, plan: StencilPlan, dt, wc: int, channels: int):
             )
         else:
             xs = cur[:, s - gl:min(wc, s + width + gl)]
-        val = _rep_val(xs, plan=plan, dt=dt, wc=xs.shape[1],
-                       channels=channels)
-        parts.append(val[:, gl:gl + width])
+        parts.append(body(xs)[:, gl:gl + width])
     return jnp.concatenate(parts, axis=1)
+
+
+def _rep_val_strips(cur, *, plan: StencilPlan, dt, wc: int, channels: int):
+    """One repetition, lane-strip by lane-strip (same contract as
+    :func:`_rep_val`); see :func:`_strips_map` for the windowing."""
+    return _strips_map(
+        lambda xs: _rep_val(xs, plan=plan, dt=dt, wc=xs.shape[1],
+                            channels=channels),
+        cur, wc,
+    )
 
 
 def _packed_passes(cur, *, plan: StencilPlan, wc: int, channels: int):
@@ -321,9 +330,20 @@ def _packed_passes(cur, *, plan: StencilPlan, wc: int, channels: int):
     return col
 
 
+def _packed_passes_strips(cur, *, plan: StencilPlan, wc: int, channels: int):
+    """:func:`_packed_passes` computed lane-strip by lane-strip — the
+    'strips' register-residency trick on packed values; see
+    :func:`_strips_map` for the windowing and wrap argument."""
+    return _strips_map(
+        lambda xs: _packed_passes(xs, plan=plan, wc=xs.shape[1],
+                                  channels=channels),
+        cur, wc,
+    )
+
+
 def _packed_loop(out_ref, tile_u8, keep_rows, keep_cols, *,
                  plan: StencilPlan, block_h: int, halo_al: int, fuse: int,
-                 wc: int, channels: int):
+                 wc: int, channels: int, strips: bool = False):
     """The 'pack' rep loop + unpack, shared by both kernels.
 
     ``tile_u8``: the (block_h + 2*halo_al, wc) uint8 VMEM tile value.
@@ -352,9 +372,10 @@ def _packed_loop(out_ref, tile_u8, keep_rows, keep_cols, *,
     if keep_cols is not None:
         cid = jax.lax.broadcasted_iota(jnp.int32, (kp, wc), 1)
         m = jnp.where(keep_cols(cid), m, 0)
+    body = _packed_passes_strips if strips else _packed_passes
     off = 0
     for _ in range(fuse):
-        col = _packed_passes(cur, plan=plan, wc=wc, channels=channels)
+        col = body(cur, plan=plan, wc=wc, channels=channels)
         off += h
         cur = (col >> plan.shift) & m[off:off + col.shape[0], :]
     # Unpack: the low half serves output rows [0, block_h/2), the high
@@ -483,7 +504,7 @@ def _sep_kernel(in_hbm, out_ref, s_u8, sem, *, plan: StencilPlan,
 
     wait(i, slot)
 
-    if schedule == "pack":
+    if schedule.startswith("pack"):
         base = i * block_h - halo_al  # global row of tile row 0
         _packed_loop(
             out_ref, s_u8[slot],
@@ -491,7 +512,7 @@ def _sep_kernel(in_hbm, out_ref, s_u8, sem, *, plan: StencilPlan,
             < jnp.uint32(n_rows_real),
             (lambda cid: cid < wc_real) if wc_real != wc else None,
             plan=plan, block_h=block_h, halo_al=halo_al, fuse=fuse,
-            wc=wc, channels=channels,
+            wc=wc, channels=channels, strips=schedule == "pack_strips",
         )
         return
 
@@ -592,7 +613,7 @@ def _valid_kernel(scal_ref, in_hbm, out_ref, s_u8, sem, *, plan: StencilPlan,
     row0 = scal_ref[0, 0]  # global row of this shard's first interior row
     col0 = scal_ref[0, 1]  # global flat col of first interior lane
 
-    if schedule == "pack":
+    if schedule.startswith("pack"):
         base = row0 + i * block_h - halo_al  # global row of tile row 0
         cbase = col0 - ghost * channels      # global flat col of lane 0
         _packed_loop(
@@ -602,7 +623,7 @@ def _valid_kernel(scal_ref, in_hbm, out_ref, s_u8, sem, *, plan: StencilPlan,
             lambda cid: (cid + cbase).astype(jnp.uint32)
             < jnp.uint32(cols_glob_c),
             plan=plan, block_h=block_h, halo_al=halo_al, fuse=fuse,
-            wc=wc, channels=channels,
+            wc=wc, channels=channels, strips=schedule == "pack_strips",
         )
         return
 
