@@ -59,22 +59,32 @@ def test_measures_once_then_caches(plan, tmp_path, monkeypatch):
     monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
     calls = []
 
-    def fake_measure(plan, shape, channels, backend, reps=0):
-        calls.append(backend)
-        return 1e-6 if backend == "pallas" else 2e-6
+    def fake_measure(plan, shape, channels, backend, reps=0, schedule=None):
+        calls.append((backend, schedule))
+        if backend != "pallas":
+            return 2e-6
+        return 1e-6 if schedule == "pack" else 1.5e-6
 
-    got = autotune.best_backend(plan, (128, 96), 3, measure=fake_measure)
-    assert got == "pallas"
-    assert sorted(calls) == ["pallas", "xla"]
+    got = autotune.best_config(plan, (128, 96), 3, measure=fake_measure)
+    assert got == ("pallas", "pack")
+    # one xla measurement + one per distinct (non-degrading) schedule
+    assert ("xla", None) in calls
+    scheds = sorted(s for b, s in calls if b == "pallas")
+    assert scheds == sorted(autotune._pallas_schedules(plan, (128, 96)))
     # cache hit: no further measurement, even with a failing measurer
     def boom(*a, **k):
         raise AssertionError("cache miss")
 
+    assert autotune.best_config(plan, (128, 96), 3, measure=boom) == (
+        "pallas", "pack"
+    )
     assert autotune.best_backend(plan, (128, 96), 3, measure=boom) == "pallas"
     cache = json.load(open(str(tmp_path / "c.json")))
     (entry,) = cache.values()
     assert entry["backend"] == "pallas"
-    assert entry["us_per_rep"] == {"pallas": 1.0, "xla": 2.0}
+    assert entry["schedule"] == "pack"
+    assert entry["us_per_rep"]["xla"] == 2.0
+    assert entry["us_per_rep"]["pallas[pack]"] == 1.0
 
 
 def test_distinct_shapes_get_distinct_keys(plan, tmp_path, monkeypatch):
@@ -83,7 +93,7 @@ def test_distinct_shapes_get_distinct_keys(plan, tmp_path, monkeypatch):
     monkeypatch.setenv("TPU_STENCIL_AUTOTUNE_CACHE", str(tmp_path / "c.json"))
     monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
 
-    def fake_measure(plan, shape, channels, backend, reps=0):
+    def fake_measure(plan, shape, channels, backend, reps=0, schedule=None):
         # pallas wins tall shapes, xla wins short ones
         if backend == "pallas":
             return 1e-6 if shape[0] > 1000 else 3e-6
@@ -130,7 +140,7 @@ def test_auto_is_shape_aware_alias_of_autotune(plan, tmp_path, monkeypatch):
     monkeypatch.setenv("TPU_STENCIL_AUTOTUNE_CACHE", str(tmp_path / "c.json"))
     monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
 
-    def fake_measure(plan, shape, channels, backend, reps=0):
+    def fake_measure(plan, shape, channels, backend, reps=0, schedule=None):
         return 1e-6 if backend == "pallas" else 2e-6
 
     monkeypatch.setattr(autotune, "measure_backend", fake_measure)
@@ -156,10 +166,65 @@ def test_sharded_runner_resolves_auto_against_tile(rng, monkeypatch, tmp_path):
 
     def spy(self, shape, channels):
         seen["shape"], seen["channels"] = tuple(shape), channels
-        return "xla"
+        return "xla", None
 
-    monkeypatch.setattr(IteratedConv2D, "resolved_backend", spy)
+    monkeypatch.setattr(IteratedConv2D, "resolved_config", spy)
     model = IteratedConv2D("gaussian", backend="auto")
     runner = ShardedRunner(model, (64, 96), 3, mesh_shape=(2, 4))
     assert runner.backend == "xla"
     assert seen == {"shape": (32, 24), "channels": 3}
+
+
+def test_sharded_runner_honors_resolved_schedule(monkeypatch, tmp_path):
+    # The (backend, schedule) verdict must reach the compiled sharded
+    # program, not just the backend half.
+    from tpu_stencil.models.blur import IteratedConv2D
+    from tpu_stencil.parallel.sharded import ShardedRunner
+
+    monkeypatch.setenv("TPU_STENCIL_AUTOTUNE_CACHE", str(tmp_path / "c.json"))
+    monkeypatch.setattr(
+        IteratedConv2D, "resolved_config",
+        lambda self, shape, channels: ("pallas", "pack"),
+    )
+    model = IteratedConv2D("gaussian", backend="auto")
+    runner = ShardedRunner(model, (64, 96), 3, mesh_shape=(2, 4))
+    assert runner.backend == "pallas"
+    assert runner.schedule == "pack"
+
+
+def test_stale_cached_schedule_remeasures(plan, tmp_path, monkeypatch):
+    # A cache written by a build whose schedule set has since changed must
+    # re-measure, not crash every later run.
+    import jax
+
+    path = tmp_path / "c.json"
+    monkeypatch.setenv("TPU_STENCIL_AUTOTUNE_CACHE", str(path))
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+
+    def fake_measure(plan, shape, channels, backend, reps=0, schedule=None):
+        return 1e-6 if backend == "pallas" else 2e-6
+
+    key = autotune._key(plan, (64, 64), 1)
+    path.write_text(json.dumps({key: {"backend": "pallas",
+                                      "schedule": "swar-gone"}}))
+    got = autotune.best_config(plan, (64, 64), 1, measure=fake_measure)
+    assert got[0] == "pallas"
+    assert got[1] is None or got[1] in autotune._pallas_schedules(
+        plan, (64, 64)
+    )
+
+
+def test_one_broken_schedule_does_not_kill_the_tune(plan, tmp_path,
+                                                    monkeypatch):
+    import jax
+
+    monkeypatch.setenv("TPU_STENCIL_AUTOTUNE_CACHE", str(tmp_path / "c.json"))
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+
+    def fake_measure(plan, shape, channels, backend, reps=0, schedule=None):
+        if schedule == "pack_strips":
+            raise RuntimeError("mosaic says no")
+        return 1e-6 if (backend, schedule) == ("pallas", "pack") else 2e-6
+
+    got = autotune.best_config(plan, (128, 96), 3, measure=fake_measure)
+    assert got == ("pallas", "pack")

@@ -459,6 +459,7 @@ VARIANTS = {
     "swar_strips": dict(swar=True, strip=512),
     "swar_strips_1024": dict(swar=True, strip=1024),
     "swar_b256": dict(swar=True, block_h=256),
+    "swar_f16_b256": dict(swar=True, block_h=256, fuse=16),
     "abl_no_mask": dict(shrink=True, pair_add=True, no_mask=True),
     "abl_no_cols": dict(shrink=True, pair_add=True, no_cols=True,
                         no_mask=True),

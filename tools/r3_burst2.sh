@@ -13,7 +13,7 @@ echo "=== burst2 start $(date +%H:%M:%S) ===" | tee -a /tmp/r3_lab2.log
 # 1. SWAR lab variants vs the best exact non-swar ones (shrink /
 # shrink_strips_1024) so the schedule verdict below has a real baseline.
 python -u tools/kernel_lab.py swar swar_strips swar_strips_1024 swar_b256 \
-    shrink shrink_strips_1024 shipped >> /tmp/r3_lab2.log 2>&1
+    swar_f16_b256 shrink shrink_strips_1024 shipped >> /tmp/r3_lab2.log 2>&1
 echo "=== lab done $(date +%H:%M:%S) ===" | tee -a /tmp/r3_lab2.log
 
 # Pick the sweep/1x1 schedule from the lab verdict: fastest exact

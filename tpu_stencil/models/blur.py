@@ -62,13 +62,10 @@ def _resolve_step(backend: str):
     raise ValueError(f"unknown backend {backend!r}")
 
 
-@functools.partial(
-    jax.jit, static_argnames=("plan", "backend", "boundary"),
-    donate_argnums=(0,),
-)
 def iterate(img_u8: jax.Array, repetitions: jax.Array,
             plan: _lowering.StencilPlan, backend: str = "xla",
-            boundary: str = "zero") -> jax.Array:
+            boundary: str = "zero",
+            schedule: Optional[str] = None) -> jax.Array:
     """Apply the stencil ``repetitions`` times; uint8 in, uint8 out.
 
     The input buffer is donated: XLA reuses it as one of the two HBM
@@ -77,7 +74,22 @@ def iterate(img_u8: jax.Array, repetitions: jax.Array,
     gets its fastest schedule (see :mod:`tpu_stencil.ops.lowering`).
     ``boundary='periodic'`` runs the wraparound semantics; the single-device
     Pallas kernel is zero-boundary only, so periodic uses the XLA schedule.
+    ``schedule`` picks the Pallas per-rep schedule (None = default; ignored
+    by the XLA backend).
     """
+    if not (resolve_backend(backend) == "pallas" and boundary == "zero"):
+        # schedule only affects the Pallas path; normalize it out of the
+        # jit cache key so xla/periodic calls never recompile per schedule.
+        schedule = None
+    return _iterate_impl(img_u8, repetitions, plan=plan, backend=backend,
+                         boundary=boundary, schedule=schedule)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("plan", "backend", "boundary", "schedule"),
+    donate_argnums=(0,),
+)
+def _iterate_impl(img_u8, repetitions, plan, backend, boundary, schedule):
     if resolve_backend(backend) == "pallas" and boundary == "zero":
         from tpu_stencil.ops import pallas_stencil
 
@@ -96,6 +108,7 @@ def iterate(img_u8: jax.Array, repetitions: jax.Array,
             )
         return pallas_stencil.iterate(
             img_u8, repetitions, plan, interpret=plat == "cpu",
+            schedule=schedule,
         )
     eff_backend = (
         "xla" if resolve_backend(backend) == "pallas" else backend
@@ -163,11 +176,14 @@ class IteratedConv2D:
     def halo(self) -> int:
         return self.filter.halo
 
-    def resolved_backend(self, shape: Tuple[int, int], channels: int) -> str:
-        """The concrete backend for this (filter, shape): 'auto'/'autotune'
-        consult the autotune cache, measuring once per shape on TPU (the
-        fast path is the default path — r2 verdict item 3); explicit
-        backends pass through."""
+    def resolved_config(
+        self, shape: Tuple[int, int], channels: int
+    ) -> Tuple[str, Optional[str]]:
+        """The concrete (backend, pallas_schedule) for this (filter,
+        shape): 'auto'/'autotune' consult the autotune cache, measuring
+        once per shape on TPU (the fast path is the default path — r2
+        verdict item 3); explicit backends pass through with the default
+        schedule."""
         if self.backend in ("auto", "autotune"):
             key = (tuple(shape), channels)
             if key not in self._resolved:
@@ -177,11 +193,15 @@ class IteratedConv2D:
                 # never pay the measurement twice (e.g. once for compute,
                 # once for the report) even when the cache dir is
                 # unwritable and the disk store silently fails.
-                self._resolved[key] = autotune.best_backend(
+                self._resolved[key] = autotune.best_config(
                     self.plan, tuple(shape), channels
                 )
             return self._resolved[key]
-        return resolve_backend(self.backend)
+        return resolve_backend(self.backend), None
+
+    def resolved_backend(self, shape: Tuple[int, int], channels: int) -> str:
+        """Back-compat: the backend half of :meth:`resolved_config`."""
+        return self.resolved_config(shape, channels)[0]
 
     def step(self, img_u8: jax.Array) -> jax.Array:
         """A single (unjitted) filter application — the jittable unit."""
@@ -214,8 +234,8 @@ class IteratedConv2D:
         else:
             img_u8 = jnp.asarray(img_u8, dtype=jnp.uint8)
         ch = img_u8.shape[2] if img_u8.ndim == 3 else 1
-        resolved = self.resolved_backend(tuple(img_u8.shape[:2]), ch)
+        resolved, schedule = self.resolved_config(tuple(img_u8.shape[:2]), ch)
         return iterate(
             img_u8, jnp.int32(repetitions), plan=self.plan, backend=resolved,
-            boundary=self.boundary,
+            boundary=self.boundary, schedule=schedule,
         )

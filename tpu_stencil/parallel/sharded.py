@@ -66,7 +66,8 @@ def _local_step(tile_u8, plan, axes, mask_tile):
     return out
 
 
-def _pallas_local_chunk(tile_u8, plan, axes, fuse, global_shape, interpret):
+def _pallas_local_chunk(tile_u8, plan, axes, fuse, global_shape, interpret,
+                        schedule=None):
     """``fuse`` repetitions for one exchange: widen the halo exchange to
     ``fuse * halo`` uint8 ghosts (2 ppermute phases per *chunk* instead of
     per rep) and run the valid-ghost Pallas kernel, whose trusted band
@@ -87,7 +88,7 @@ def _pallas_local_chunk(tile_u8, plan, axes, fuse, global_shape, interpret):
     col0 = lax.axis_index(col_axis) * (tw * channels)
     out2 = pallas_stencil.valid_fused(
         ext2, plan, fuse, channels, row0, col0, global_shape,
-        interpret=interpret, vma=(row_axis, col_axis),
+        interpret=interpret, vma=(row_axis, col_axis), schedule=schedule,
     )
     return out2.reshape(tile_u8.shape)
 
@@ -101,6 +102,7 @@ def build_sharded_iterate(
     global_shape=None,
     fuse: int = 1,
     interpret: bool = False,
+    schedule=None,
 ):
     """Compile-once builder for the sharded iteration program.
 
@@ -126,7 +128,7 @@ def build_sharded_iterate(
 
         def step_chunk(x, n_fused, mask_tile):
             out = _pallas_local_chunk(
-                x, plan, axes, n_fused, global_shape, interpret
+                x, plan, axes, n_fused, global_shape, interpret, schedule
             )
             if mask_tile is not None:
                 out = out * mask_tile
@@ -201,20 +203,30 @@ def _pallas_plan_supported(plan, channels: int) -> bool:
     )
 
 
-def _agreed_backend(model, tile, channels) -> str:
+def _agreed_config(model, tile, channels):
     """Shape-aware auto/autotune resolution with multi-host agreement:
-    rank 0 resolves (cache hit or one measurement), everyone receives."""
+    rank 0 resolves (cache hit or one measurement), everyone receives the
+    (backend, pallas_schedule) verdict. Encoding: -1 = xla, otherwise an
+    index into the schedule list (len = pallas with the default schedule)
+    — every process must compile the identical program, schedule included."""
     if jax.process_count() == 1:
-        return model.resolved_backend(tile, channels)
+        return model.resolved_config(tile, channels)
     from jax.experimental import multihost_utils
 
-    vote = np.int32(0)
+    from tpu_stencil.ops import pallas_stencil
+
+    scheds = list(pallas_stencil._SCHEDULES)
+    vote = np.int32(-1)
     if jax.process_index() == 0:
-        vote = np.int32(
-            1 if model.resolved_backend(tile, channels) == "pallas" else 0
-        )
-    vote = multihost_utils.broadcast_one_to_all(vote)
-    return "pallas" if int(vote) == 1 else "xla"
+        backend, schedule = model.resolved_config(tile, channels)
+        if backend == "pallas":
+            vote = np.int32(
+                scheds.index(schedule) if schedule in scheds else len(scheds)
+            )
+    vote = int(multihost_utils.broadcast_one_to_all(vote))
+    if vote < 0:
+        return "xla", None
+    return "pallas", scheds[vote] if vote < len(scheds) else None
 
 
 class ShardedRunner:
@@ -241,6 +253,7 @@ class ShardedRunner:
         self.padded_shape = (self.h + ph, self.w + pw)
         tile = partition.tile_shape(self.h, self.w, self.mesh_shape)
         pallas_ok = _pallas_plan_supported(model.plan, channels)
+        self.schedule = None  # pallas per-rep schedule (None = default)
         if model.backend in ("auto", "autotune"):
             if not pallas_ok:
                 # Unsupported plans would be demoted below anyway — never
@@ -257,7 +270,9 @@ class ShardedRunner:
                 # verdict is broadcast so every process compiles the same
                 # collective program — divergent winners would shear the
                 # ppermute sequences exactly like divergent argv.
-                self.backend = _agreed_backend(model, tile, channels)
+                self.backend, self.schedule = _agreed_config(
+                    model, tile, channels
+                )
         else:
             self.backend = resolve_backend(model.backend)
         if min(tile) < model.halo:
@@ -306,6 +321,7 @@ class ShardedRunner:
             ),
             fuse=self.fuse,
             interpret=interpret,
+            schedule=self.schedule,
         )
         if self.needs_mask:
             mask = np.zeros(self.padded_shape, np.uint8)

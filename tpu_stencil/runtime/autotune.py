@@ -73,9 +73,10 @@ def _store_cache(cache: dict) -> None:
 
 def measure_backend(
     plan: StencilPlan, shape: Tuple[int, int], channels: int, backend: str,
-    reps: int = 400,
+    reps: int = 400, schedule: Optional[str] = None,
 ) -> float:
-    """Steady-state seconds per repetition of ``backend`` on this shape."""
+    """Steady-state seconds per repetition of ``backend`` on this shape
+    (``schedule`` selects the Pallas per-rep schedule; None = default)."""
     import jax
     import jax.numpy as jnp
 
@@ -89,7 +90,8 @@ def measure_backend(
         dev = jax.device_put(img)  # fresh every call: iterate donates
         np.asarray(dev.ravel()[0])
         t0 = time.perf_counter()
-        out = iterate(dev, jnp.int32(n), plan=plan, backend=backend)
+        out = iterate(dev, jnp.int32(n), plan=plan, backend=backend,
+                      schedule=schedule)
         np.asarray(out.ravel()[0])
         return time.perf_counter() - t0
 
@@ -117,6 +119,81 @@ def _steady_state_per_rep(run, reps: int) -> float:
     return hi / (2 * reps)
 
 
+def _pallas_schedules(plan: StencilPlan, shape: Tuple[int, int]):
+    """The distinct Pallas per-rep schedules for this (plan, shape):
+    schedules that would degrade (e.g. pack on gaussian7, or on a block
+    clamped to an odd image height) duplicate their degradation target and
+    are never measured twice. Mirrors the block clamp in
+    ``pallas_stencil.iterate``."""
+    from tpu_stencil.ops import pallas_stencil as ps
+
+    bh = min(-(-ps.DEFAULT_BLOCK_H // 8) * 8, -(-shape[0] // 8) * 8)
+    return [
+        s for s in ps._SCHEDULES
+        if ps._effective_schedule(s, plan, bh) == s
+    ]
+
+
+def best_config(
+    plan: StencilPlan,
+    shape: Tuple[int, int],
+    channels: int,
+    cache: bool = True,
+    measure=None,
+) -> Tuple[str, Optional[str]]:
+    """The fastest (backend, pallas_schedule) for this (platform, filter,
+    shape), from the disk cache when available, measured (and cached)
+    otherwise — the schedule space is {XLA} + {Pallas x per-rep schedule}.
+    Platforms without a Pallas TPU path short-circuit to XLA; the schedule
+    is None for XLA (and for pre-schedule cache entries, which then run
+    the measured-default schedule)."""
+    import jax
+
+    if jax.default_backend() not in ("tpu", "axon"):
+        return "xla", None
+    if plan.kind == "direct_f32":
+        return "xla", None  # pallas would fall back anyway
+    from tpu_stencil.ops import pallas_stencil as ps
+
+    if measure is None:
+        measure = measure_backend  # late-bound: monkeypatchable, testable
+    key = _key(plan, shape, channels)
+    store = _load_cache() if cache else {}
+    hit = store.get(key)
+    if (
+        isinstance(hit, dict)
+        and hit.get("backend") in _CANDIDATES
+        # A stale schedule name (cache written by a build whose schedule
+        # set has since changed) must re-measure, not crash every run.
+        and (hit.get("schedule") is None or hit["schedule"] in ps._SCHEDULES)
+    ):
+        return hit["backend"], hit.get("schedule")
+    candidates = [("xla", None)] + [
+        ("pallas", s) for s in _pallas_schedules(plan, shape)
+    ]
+    timings = {}
+    last_err = None
+    for b, s in candidates:
+        try:
+            timings[(b, s)] = measure(plan, shape, channels, b, schedule=s)
+        except Exception as e:  # one broken schedule must not kill the tune
+            last_err = e
+    if not timings:
+        raise last_err
+    winner, win_sched = min(timings, key=timings.get)
+    if cache:
+        store[key] = {
+            "backend": winner,
+            "schedule": win_sched,
+            "us_per_rep": {
+                (b if s is None else f"{b}[{s}]"): round(t * 1e6, 2)
+                for (b, s), t in timings.items()
+            },
+        }
+        _store_cache(store)
+    return winner, win_sched
+
+
 def best_backend(
     plan: StencilPlan,
     shape: Tuple[int, int],
@@ -124,28 +201,5 @@ def best_backend(
     cache: bool = True,
     measure=None,
 ) -> str:
-    """The faster of XLA/Pallas for this (platform, filter, shape), from the
-    disk cache when available, measured (and cached) otherwise. Platforms
-    without a Pallas TPU path (CPU, interpret-only) short-circuit to XLA."""
-    import jax
-
-    if jax.default_backend() not in ("tpu", "axon"):
-        return "xla"
-    if plan.kind == "direct_f32":
-        return "xla"  # pallas would fall back anyway
-    if measure is None:
-        measure = measure_backend  # late-bound: monkeypatchable, testable
-    key = _key(plan, shape, channels)
-    store = _load_cache() if cache else {}
-    hit = store.get(key)
-    if isinstance(hit, dict) and hit.get("backend") in _CANDIDATES:
-        return hit["backend"]
-    timings = {b: measure(plan, shape, channels, b) for b in _CANDIDATES}
-    winner = min(timings, key=timings.get)
-    if cache:
-        store[key] = {
-            "backend": winner,
-            "us_per_rep": {b: round(t * 1e6, 2) for b, t in timings.items()},
-        }
-        _store_cache(store)
-    return winner
+    """Back-compat wrapper: the backend half of :func:`best_config`."""
+    return best_config(plan, shape, channels, cache=cache, measure=measure)[0]
