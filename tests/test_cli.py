@@ -198,3 +198,51 @@ def test_cli_platform_override(tmp_path, rng, capsys):
         img[..., 0], filters.get_filter("gaussian"), 2
     )
     np.testing.assert_array_equal(out[..., 0], want)
+
+
+def test_schedule_flag_parses_and_validates():
+    from tpu_stencil.ops import pallas_stencil
+
+    cfg, _ = parse_args(
+        ["waterfall.raw", "1920", "2520", "40", "rgb", "--schedule", "pack"]
+    )
+    assert cfg.schedule == "pack"
+    with pytest.raises(ValueError):
+        JobConfig("x", 5, 5, 1, ImageType.GREY, schedule="nope")
+    # the argparse choices list must track the canonical schedule set
+    from tpu_stencil.config import build_parser
+
+    (act,) = [a for a in build_parser()._actions if a.dest == "schedule"]
+    assert tuple(act.choices) == pallas_stencil._SCHEDULES
+
+
+def test_schedule_flag_reaches_model(tmp_path, rng):
+    from tpu_stencil.models.blur import IteratedConv2D
+
+    model = IteratedConv2D("gaussian", backend="pallas", schedule="pack")
+    assert model.resolved_config((64, 48), 3) == ("pallas", "pack")
+    # forced schedule never applies to xla
+    model = IteratedConv2D("gaussian", backend="xla", schedule="pack")
+    assert model.resolved_config((64, 48), 3) == ("xla", None)
+    with pytest.raises(ValueError):
+        IteratedConv2D("gaussian", schedule="bogus")
+
+
+def test_schedule_flag_cli_end_to_end(tmp_path, rng):
+    import subprocess, sys
+    img = rng.integers(0, 256, size=(24, 16, 3), dtype=np.uint8)
+    src = tmp_path / "img.raw"
+    img.tofile(src)
+    out = tmp_path / "o.raw"
+    r = subprocess.run(
+        [sys.executable, "-m", "tpu_stencil", str(src), "16", "24", "3",
+         "rgb", "--backend", "pallas", "--schedule", "pack_strips",
+         "--platform", "cpu", "--output", str(out)],
+        capture_output=True, text=True, timeout=300,
+    )
+    assert r.returncode == 0, r.stderr
+    from tpu_stencil.ops import stencil
+    from tpu_stencil import filters as _f
+    want = stencil.reference_stencil_numpy(img, _f.get_filter("gaussian"), 3)
+    got = np.fromfile(out, np.uint8).reshape(24, 16, 3)
+    np.testing.assert_array_equal(got, want)

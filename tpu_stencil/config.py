@@ -17,6 +17,12 @@ import os
 from typing import Optional, Tuple
 
 
+# Canonical Pallas per-rep schedule names (see docs/KERNEL.md and
+# ops/pallas_stencil.py, which imports this tuple). Lives here so CLI
+# parsing/validation stays jax-free.
+PALLAS_SCHEDULES = ("pad", "shrink", "strips", "pack", "pack_strips")
+
+
 class ImageType(enum.Enum):
     """Pixel layout of a headerless raw image (1 or 3 bytes per pixel)."""
 
@@ -42,6 +48,7 @@ class JobConfig:
     mesh_shape: Optional[Tuple[int, int]] = None  # (rows, cols); None = auto
     output: Optional[str] = None  # None -> blur_<basename> beside input
     frames: int = 1  # >1: batched video mode (N concatenated raw frames)
+    schedule: Optional[str] = None  # Pallas per-rep schedule (None = tuned)
     # Accumulation dtype is a property of the backend's plan, not a flag:
     # integer plans accumulate exactly (int16/int32), --backend reference
     # forces the float32 semantics of the C code. A separate dtype knob was
@@ -60,6 +67,11 @@ class JobConfig:
             raise ValueError(f"mesh_shape must be two positive ints, got {self.mesh_shape}")
         if self.frames < 1:
             raise ValueError(f"frames must be >= 1, got {self.frames}")
+        if self.schedule is not None and self.schedule not in PALLAS_SCHEDULES:
+            raise ValueError(
+                f"unknown schedule {self.schedule!r}; expected one of "
+                f"{'|'.join(PALLAS_SCHEDULES)}"
+            )
 
     @property
     def channels(self) -> int:
@@ -131,6 +143,14 @@ def build_parser() -> argparse.ArgumentParser:
              "selects R*C devices (no spatial sharding)",
     )
     p.add_argument(
+        "--schedule", default=None, choices=list(PALLAS_SCHEDULES),
+        help="force the Pallas per-rep schedule (see docs/KERNEL.md); "
+             "default: the autotuned winner (or the kernel default for an "
+             "explicit --backend pallas). Ignored by the XLA backend and "
+             "by --frames batch mode (which runs the vmapped XLA step); "
+             "schedules a plan cannot run degrade to their fallback",
+    )
+    p.add_argument(
         "--platform", default=None, choices=["cpu", "tpu", "gpu"],
         help="force the JAX platform via the config API before backend "
              "init. Needed where the environment pins JAX_PLATFORMS (a "
@@ -192,6 +212,7 @@ def parse_args(argv=None) -> Tuple[JobConfig, argparse.Namespace]:
             mesh_shape=mesh_shape,
             output=ns.output,
             frames=ns.frames,
+            schedule=ns.schedule,
         )
     except ValueError as e:
         parser.error(str(e))

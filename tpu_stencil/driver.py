@@ -161,7 +161,8 @@ def run_job(
     if checkpoint_every < 0:
         raise ValueError(f"checkpoint_every must be >= 0, got {checkpoint_every}")
     with Timer() as total_t:
-        model = IteratedConv2D(cfg.filter_name, backend=cfg.backend)
+        model = IteratedConv2D(cfg.filter_name, backend=cfg.backend,
+                               schedule=cfg.schedule)
 
         if devices is None:
             devices = jax.devices()

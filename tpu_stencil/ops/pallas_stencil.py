@@ -50,6 +50,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from tpu_stencil.config import PALLAS_SCHEDULES as _SCHEDULES
 from tpu_stencil.ops import lowering as _lowering
 from tpu_stencil.ops.lowering import StencilPlan
 
@@ -82,8 +83,6 @@ _MAX_ROLL_HALO = 128  # cols-pass ghost width limit (halo * channels)
 # schedules on hardware. Env override for on-hardware A/B through the CLI.
 DEFAULT_SCHEDULE = os.environ.get("TPU_STENCIL_PALLAS_SCHEDULE", "pad")
 
-_SCHEDULES = ("pad", "shrink", "strips", "pack", "pack_strips")
-
 
 def _check_schedule(schedule: Optional[str]) -> str:
     schedule = schedule or DEFAULT_SCHEDULE
@@ -93,6 +92,14 @@ def _check_schedule(schedule: Optional[str]) -> str:
             f"got {schedule!r}"
         )
     return schedule
+
+
+def effective_block_h(n_rows: int, block_h: int = DEFAULT_BLOCK_H) -> int:
+    """The block height :func:`iterate` actually runs for an ``n_rows``-tall
+    image: 8-row (sublane) aligned, clamped to the padded image height.
+    Exposed so the autotuner's schedule dedup sees the same clamp."""
+    block_h = -(-block_h // 8) * 8  # DMA descriptors need 8-row alignment
+    return min(block_h, -(-n_rows // 8) * 8)
 
 
 def _pack_ok(plan: StencilPlan, block_h: int) -> bool:
@@ -783,8 +790,7 @@ def iterate(img_u8: jax.Array, repetitions: jax.Array, plan: StencilPlan,
             0, repetitions, lambda _, x: _lowering.padded_step(x, plan), img_u8
         )
     x2 = img_u8.reshape(hh, wc)
-    block_h = -(-block_h // 8) * 8  # DMA descriptors require 8-row alignment
-    bh = min(block_h, -(-hh // 8) * 8)
+    bh = effective_block_h(hh, block_h)
     hp = -(-hh // bh) * bh
     # Cap fuse so the ghost bands stay a small fraction of the block
     # (compute overhead 2*fuse*halo/block_h) and the tile fits VMEM.

@@ -253,7 +253,9 @@ class ShardedRunner:
         self.padded_shape = (self.h + ph, self.w + pw)
         tile = partition.tile_shape(self.h, self.w, self.mesh_shape)
         pallas_ok = _pallas_plan_supported(model.plan, channels)
-        self.schedule = None  # pallas per-rep schedule (None = default)
+        # Pallas per-rep schedule: a constructor-forced one (--schedule)
+        # wins; otherwise the autotuned verdict below (None = default).
+        self.schedule = getattr(model, "schedule", None)
         if model.backend in ("auto", "autotune"):
             if not pallas_ok:
                 # Unsupported plans would be demoted below anyway — never
@@ -270,9 +272,11 @@ class ShardedRunner:
                 # verdict is broadcast so every process compiles the same
                 # collective program — divergent winners would shear the
                 # ppermute sequences exactly like divergent argv.
-                self.backend, self.schedule = _agreed_config(
+                self.backend, agreed_schedule = _agreed_config(
                     model, tile, channels
                 )
+                if self.schedule is None:
+                    self.schedule = agreed_schedule
         else:
             self.backend = resolve_backend(model.backend)
         if min(tile) < model.halo:

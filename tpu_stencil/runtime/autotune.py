@@ -123,11 +123,11 @@ def _pallas_schedules(plan: StencilPlan, shape: Tuple[int, int]):
     """The distinct Pallas per-rep schedules for this (plan, shape):
     schedules that would degrade (e.g. pack on gaussian7, or on a block
     clamped to an odd image height) duplicate their degradation target and
-    are never measured twice. Mirrors the block clamp in
+    are never measured twice. Uses the same block clamp as
     ``pallas_stencil.iterate``."""
     from tpu_stencil.ops import pallas_stencil as ps
 
-    bh = min(-(-ps.DEFAULT_BLOCK_H // 8) * 8, -(-shape[0] // 8) * 8)
+    bh = ps.effective_block_h(shape[0])
     return [
         s for s in ps._SCHEDULES
         if ps._effective_schedule(s, plan, bh) == s
