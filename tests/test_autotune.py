@@ -228,3 +228,28 @@ def test_one_broken_schedule_does_not_kill_the_tune(plan, tmp_path,
 
     got = autotune.best_config(plan, (128, 96), 3, measure=fake_measure)
     assert got == ("pallas", "pack")
+
+
+def test_forced_schedule_restricts_tuning_space(plan, tmp_path, monkeypatch):
+    # --schedule + auto: the xla-vs-pallas verdict must be decided by the
+    # forced schedule's timing (cached under its own key), not the global
+    # winner's.
+    import jax
+
+    monkeypatch.setenv("TPU_STENCIL_AUTOTUNE_CACHE", str(tmp_path / "c.json"))
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    calls = []
+
+    def fake_measure(plan, shape, channels, backend, reps=0, schedule=None):
+        calls.append((backend, schedule))
+        if backend == "xla":
+            return 2e-6
+        return 1e-6 if schedule == "pack" else 3e-6  # only pack beats xla
+
+    got = autotune.best_config(plan, (128, 96), 3, measure=fake_measure,
+                               force_schedule="pad")
+    assert got == ("xla", None)  # pallas[pad] (3us) loses to xla (2us)
+    assert calls == [("xla", None), ("pallas", "pad")]
+    # unforced resolution is a separate cache entry and still finds pack
+    got = autotune.best_config(plan, (128, 96), 3, measure=fake_measure)
+    assert got == ("pallas", "pack")

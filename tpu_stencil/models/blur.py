@@ -199,13 +199,15 @@ class IteratedConv2D:
                 # In-process memo on top of the disk cache: a job must
                 # never pay the measurement twice (e.g. once for compute,
                 # once for the report) even when the cache dir is
-                # unwritable and the disk store silently fails.
+                # unwritable and the disk store silently fails. A forced
+                # schedule restricts the tuning space so the xla-vs-pallas
+                # verdict is decided by the schedule that will run.
                 self._resolved[key] = autotune.best_config(
-                    self.plan, tuple(shape), channels
+                    self.plan, tuple(shape), channels,
+                    force_schedule=self.schedule,
                 )
-            backend, schedule = self._resolved[key]
-        else:
-            backend, schedule = resolve_backend(self.backend), None
+            return self._resolved[key]
+        backend, schedule = resolve_backend(self.backend), None
         if self.schedule is not None and backend == "pallas":
             schedule = self.schedule
         return backend, schedule

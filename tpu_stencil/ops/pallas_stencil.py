@@ -159,6 +159,48 @@ def _mul_const_adds(x, c: int):
     return result
 
 
+def _lane_roll(x, off: int, wc: int):
+    """x shifted so out[:, c] = x[:, c + off]. Rolls wrap lane content
+    end-around; both kernels arrange >= halo*C discardable lanes at the
+    edges so wrapped values never land in trusted output."""
+    if off == 0:
+        return x
+    if off < 0:
+        return pltpu.roll(x, -off, 1)
+    return pltpu.roll(x, wc - off, 1)
+
+
+def _rows_binomial(acc, d: int):
+    """d-fold (1,1) self-convolution down the sublane axis: d pair-adds of
+    shrinking slices — the valid binomial-row correlation."""
+    for _ in range(d):
+        n = acc.shape[0] - 1
+        acc = acc[0:n, :] + acc[1:n + 1, :]
+    return acc
+
+
+def _cols_binomial(col, d: int, channels: int, wc: int):
+    """d pair-adds with alternating roll direction (first half +C, second
+    -C) so the binomial result stays centered on the original lanes."""
+    for d_i in range(d):
+        off = channels if d_i < d // 2 else -channels
+        col = col + _lane_roll(col, off, wc)
+    return col
+
+
+def _binomial_chain(taps) -> Optional[int]:
+    """Chain length d when ``taps`` are the binomial coefficients C(d, i)
+    — i.e. the d-fold self-convolution of (1, 1) — else None. Binomial
+    passes then lower to d pair-adds instead of per-tap shift-add chains
+    (gaussian7's taps 6/15/20 alone cost ~20 adds the chain never pays)."""
+    from math import comb
+
+    d = len(taps) - 1
+    if tuple(taps) == tuple(comb(d, i) for i in range(d + 1)):
+        return d
+    return None
+
+
 def _clip_needed(plan: StencilPlan) -> bool:
     """clip(acc >> shift, 0, 255) is the identity when taps are non-negative
     and their total weight equals 2^shift: acc <= 255 * 2^shift."""
@@ -187,36 +229,37 @@ def _rep_val(cur, *, plan: StencilPlan, dt, wc: int, channels: int):
     tile_rows = cur.shape[0]
 
     def lane_roll(x, off):
-        """x shifted so out[:, c] = x[:, c + off]. Rolls wrap lane content
-        end-around; both kernels arrange >= halo*C discardable lanes at the
-        edges so wrapped values never land in trusted output."""
-        if off == 0:
-            return x
-        if off < 0:
-            return pltpu.roll(x, -off, 1)
-        return pltpu.roll(x, wc - off, 1)
+        return _lane_roll(x, off, wc)
 
     def sep_rep(cur):
         # --- rows pass: valid 1-D correlation by sublane slicing (free on
         # the VPU — just shifted adds); output rows [0, tile_rows - 2h)
         # map to tile rows [h, tile_rows - h).
-        acc = None
-        for t_idx, tap in enumerate(plan.row_taps):
-            if tap == 0:
-                continue
-            term = cur[t_idx : t_idx + tile_rows - 2 * h, :]
-            if tap != 1:
-                if dt == jnp.int16 and tap > 0:
-                    term = _mul_const_adds(term, tap)
-                else:
-                    term = term * tap
-            acc = term if acc is None else acc + term
-        if acc is None:
-            acc = jnp.zeros((tile_rows - 2 * h, wc), dt)
-        if dt != jnp.int32:
+        rchain = _binomial_chain(plan.row_taps)
+        if rchain is not None:
+            # Binomial taps = d-fold (1,1) self-convolution: d pair-adds.
+            acc = _rows_binomial(cur, rchain)
+        else:
+            acc = None
+            for t_idx, tap in enumerate(plan.row_taps):
+                if tap == 0:
+                    continue
+                term = cur[t_idx : t_idx + tile_rows - 2 * h, :]
+                if tap != 1:
+                    if dt == jnp.int16 and tap > 0:
+                        term = _mul_const_adds(term, tap)
+                    else:
+                        term = term * tap
+                acc = term if acc is None else acc + term
+            if acc is None:
+                acc = jnp.zeros((tile_rows - 2 * h, wc), dt)
+        if acc.dtype != jnp.int32:
             acc = acc.astype(jnp.int32)  # lane rotate is 32-bit only
 
         # --- cols pass as lane rotations ---
+        cchain = _binomial_chain(plan.col_taps)
+        if cchain is not None:
+            return _cols_binomial(acc, cchain, channels, wc)
         col = None
         for t_idx, tap in enumerate(plan.col_taps):
             if tap == 0:
@@ -309,28 +352,31 @@ def _packed_passes(cur, *, plan: StencilPlan, wc: int, channels: int):
     accumulator (the caller shifts and AND-masks)."""
     h = plan.halo
     rows_out = cur.shape[0] - 2 * h
-    acc = None
-    for t_idx, tap in enumerate(plan.row_taps):
-        if tap == 0:
-            continue
-        term = cur[t_idx:t_idx + rows_out, :]
-        if tap != 1:
-            # Shift-add chain, never a vector multiply: full-tile i32
-            # multiplies measured ~60 us/pass vs ~9 for adds (op_cost.py),
-            # and doubling-by-add is SWAR-safe (bounds hold per _pack_ok).
-            term = _mul_const_adds(term, tap)
-        acc = term if acc is None else acc + term
+
+    rchain = _binomial_chain(plan.row_taps)
+    if rchain is not None:
+        acc = _rows_binomial(cur, rchain)
+    else:
+        acc = None
+        for t_idx, tap in enumerate(plan.row_taps):
+            if tap == 0:
+                continue
+            term = cur[t_idx:t_idx + rows_out, :]
+            if tap != 1:
+                # Shift-add chain, never a vector multiply: full-tile i32
+                # multiplies measured ~60 us/pass vs ~9 for adds
+                # (op_cost.py); both adds and doublings are SWAR-safe
+                # (bounds hold per _pack_ok).
+                term = _mul_const_adds(term, tap)
+            acc = term if acc is None else acc + term
+    cchain = _binomial_chain(plan.col_taps)
+    if cchain is not None:
+        return _cols_binomial(acc, cchain, channels, wc)
     col = None
     for t_idx, tap in enumerate(plan.col_taps):
         if tap == 0:
             continue
-        off = (t_idx - h) * channels
-        if off == 0:
-            term = acc
-        elif off < 0:
-            term = pltpu.roll(acc, -off, 1)
-        else:
-            term = pltpu.roll(acc, wc - off, 1)
+        term = _lane_roll(acc, (t_idx - h) * channels, wc)
         if tap != 1:
             term = _mul_const_adds(term, tap)
         col = term if col is None else col + term

@@ -140,13 +140,18 @@ def best_config(
     channels: int,
     cache: bool = True,
     measure=None,
+    force_schedule: Optional[str] = None,
 ) -> Tuple[str, Optional[str]]:
     """The fastest (backend, pallas_schedule) for this (platform, filter,
     shape), from the disk cache when available, measured (and cached)
     otherwise — the schedule space is {XLA} + {Pallas x per-rep schedule}.
     Platforms without a Pallas TPU path short-circuit to XLA; the schedule
     is None for XLA (and for pre-schedule cache entries, which then run
-    the measured-default schedule)."""
+    the measured-default schedule). ``force_schedule`` (the --schedule
+    flag) restricts the Pallas side to that one schedule (after any
+    degrade for this plan/shape), so the xla-vs-pallas verdict is decided
+    by timings of the schedule that will actually run — cached under its
+    own key."""
     import jax
 
     if jax.default_backend() not in ("tpu", "axon"):
@@ -158,6 +163,11 @@ def best_config(
     if measure is None:
         measure = measure_backend  # late-bound: monkeypatchable, testable
     key = _key(plan, shape, channels)
+    if force_schedule is not None:
+        force_schedule = ps._effective_schedule(
+            force_schedule, plan, ps.effective_block_h(shape[0])
+        )
+        key += f"|forced={force_schedule}"
     store = _load_cache() if cache else {}
     hit = store.get(key)
     if (
@@ -168,9 +178,11 @@ def best_config(
         and (hit.get("schedule") is None or hit["schedule"] in ps._SCHEDULES)
     ):
         return hit["backend"], hit.get("schedule")
-    candidates = [("xla", None)] + [
-        ("pallas", s) for s in _pallas_schedules(plan, shape)
-    ]
+    pallas_scheds = (
+        [force_schedule] if force_schedule is not None
+        else _pallas_schedules(plan, shape)
+    )
+    candidates = [("xla", None)] + [("pallas", s) for s in pallas_scheds]
     timings = {}
     last_err = None
     for b, s in candidates:

@@ -120,9 +120,23 @@ def _row(img, filter_name, mode, size_label, backend, budget_s, reps,
     gbps, pct = roofline.achieved(
         img.nbytes, per_rep, backend, filter_name, img.shape[0]
     )
+    label = backend
+    if backend == "pallas":
+        # Record which per-rep schedule actually produced this row: the
+        # kernel default (TPU_STENCIL_PALLAS_SCHEDULE), after any degrade
+        # for this plan/shape — the artifact must never attribute a
+        # degraded run to the schedule that could not apply.
+        from tpu_stencil.models.blur import IteratedConv2D
+        from tpu_stencil.ops import pallas_stencil as ps
+
+        ran = ps._effective_schedule(
+            None, IteratedConv2D(filter_name).plan,
+            ps.effective_block_h(img.shape[0]),
+        )
+        label = f"pallas[{ran}]"
     return {
         "filter": filter_name, "mode": mode, "size": size_label,
-        "backend": backend,
+        "backend": label,
         "us_per_rep": round(per_rep * 1e6, 1),
         "reps": reps,
         "total_s": round(total, 6),
