@@ -55,9 +55,13 @@ def main(argv=None) -> int:
     # Reference-format output line (mpi/mpi_convolution.c:274 prints seconds).
     print(f"Execution time: {result.compute_seconds:.3f} sec")
     if ns.time:
+        sched = (
+            f" schedule={result.schedule or 'default'}"
+            if result.backend == "pallas" else ""
+        )
         print(
             f"total (incl. I/O): {result.total_seconds:.3f} sec; "
-            f"backend={result.backend} mesh={result.mesh_shape}"
+            f"backend={result.backend}{sched} mesh={result.mesh_shape}"
         )
     print(f"wrote {result.output_path}")
     return 0

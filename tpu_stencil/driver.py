@@ -86,6 +86,7 @@ class JobResult:
     total_seconds: float    # whole job incl. I/O (the CUDA variant's window)
     backend: str
     mesh_shape: Optional[tuple]
+    schedule: Optional[str] = None  # pallas per-rep schedule that ran
 
 
 def _maybe_profile(profile_dir: Optional[str]):
@@ -226,20 +227,25 @@ def run_job(
         _store_output(cfg, out)
         _clear_checkpoint(cfg, checkpoint_every, resume)
 
+    # frames>1 batches via the vmapped XLA schedule regardless of backend
+    # (iterate_batch demotes pallas), so report what actually ran;
+    # single-frame reports the shape-aware resolution (auto/autotune
+    # consult the measured cache, memoized in-process).
+    if cfg.frames > 1:
+        rb = resolve_backend(cfg.backend)
+        ran_backend = "xla" if rb == "pallas" else rb
+        ran_schedule = None
+    else:
+        ran_backend, ran_schedule = model.resolved_config(
+            (cfg.height, cfg.width), cfg.channels
+        )
     return JobResult(
         output_path=cfg.output_path,
         compute_seconds=compute_seconds,
         total_seconds=total_t.elapsed,
-        # frames>1 batches via the vmapped XLA schedule regardless of
-        # backend (iterate_batch demotes pallas), so report what actually
-        # ran; single-frame reports the shape-aware resolution
-        # (auto/autotune consult the measured cache, memoized in-process).
-        backend=(
-            ("xla" if resolve_backend(cfg.backend) == "pallas"
-             else resolve_backend(cfg.backend)) if cfg.frames > 1
-            else model.resolved_backend((cfg.height, cfg.width), cfg.channels)
-        ),
+        backend=ran_backend,
         mesh_shape=None,
+        schedule=ran_schedule if ran_backend == "pallas" else None,
     )
 
 
@@ -315,4 +321,5 @@ def _run_sharded(cfg, model, devices, profile_dir, checkpoint_every, resume,
         total_seconds=total_t.elapsed,
         backend=runner.backend,
         mesh_shape=runner.mesh_shape,
+        schedule=runner.schedule if runner.backend == "pallas" else None,
     )

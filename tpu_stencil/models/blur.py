@@ -208,7 +208,13 @@ class IteratedConv2D:
                 )
             return self._resolved[key]
         backend, schedule = resolve_backend(self.backend), None
-        if self.schedule is not None and backend == "pallas":
+        if backend == "pallas":
+            from tpu_stencil.ops import pallas_stencil
+
+            if not pallas_stencil.plan_supported(self.plan, channels):
+                # iterate() would silently fall back to the XLA lowering;
+                # resolve (and report) the backend that actually runs.
+                return "xla", None
             schedule = self.schedule
         return backend, schedule
 

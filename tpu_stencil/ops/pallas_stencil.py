@@ -815,6 +815,13 @@ def _supported(plan: StencilPlan) -> bool:
     return plan.kind in ("sep_int", "direct_int")
 
 
+def plan_supported(plan: StencilPlan, channels: int) -> bool:
+    """Whether the Pallas kernels can run this plan at all — the same
+    predicate :func:`iterate` uses for its silent XLA fallback, exposed so
+    reporting layers never claim a Pallas run that fell back."""
+    return _supported(plan) and plan.halo * channels <= _MAX_ROLL_HALO
+
+
 def iterate(img_u8: jax.Array, repetitions: jax.Array, plan: StencilPlan,
             block_h: int = DEFAULT_BLOCK_H, fuse: int = DEFAULT_FUSE,
             interpret: bool = False, schedule: str = None) -> jax.Array:

@@ -197,10 +197,7 @@ def _pallas_plan_supported(plan, channels: int) -> bool:
         from tpu_stencil.ops import pallas_stencil
     except ImportError:
         return False
-    return (
-        pallas_stencil._supported(plan)
-        and plan.halo * channels <= pallas_stencil._MAX_ROLL_HALO
-    )
+    return pallas_stencil.plan_supported(plan, channels)
 
 
 def _agreed_config(model, tile, channels):
