@@ -99,16 +99,23 @@ def test_sweep_frames_row(tmp_path, monkeypatch):
     )
     seen = {}
 
-    def fake_batch(imgs, filter_name, budget_s):
+    def fake_batch(imgs, filter_name, budget_s, backend="xla"):
         seen["n_frames"] = imgs.shape[0]
+        seen.setdefault("backends", []).append(backend)
         return 2e-6  # per frame*rep
 
     monkeypatch.setattr(
         bench_sweep, "_measure_batch_per_frame_rep", fake_batch
     )
-    rows = bench_sweep.run_sweep(quick=True, frames=4)
+    rows = bench_sweep.run_sweep(
+        quick=True, frames=4, backends=["xla", "pallas"]
+    )
     assert seen["n_frames"] == 4
-    fr = rows[-1]
-    assert "x4 frames" in fr["size"]
-    assert fr["us_per_rep"] == 2.0
-    assert fr["speedup_vs_gtx970"] > 0
+    # one frames row per swept backend, schedule recorded for pallas
+    assert seen["backends"] == ["xla", "pallas"]
+    fr_xla, fr_pallas = rows[-2], rows[-1]
+    assert "x4 frames" in fr_xla["size"]
+    assert fr_xla["backend"] == "xla"
+    assert fr_pallas["backend"].startswith("pallas[")
+    assert fr_xla["us_per_rep"] == 2.0
+    assert fr_xla["speedup_vs_gtx970"] > 0

@@ -246,3 +246,25 @@ def test_schedule_flag_cli_end_to_end(tmp_path, rng):
     want = stencil.reference_stencil_numpy(img, _f.get_filter("gaussian"), 3)
     got = np.fromfile(out, np.uint8).reshape(24, 16, 3)
     np.testing.assert_array_equal(got, want)
+
+
+def test_cli_frames_pallas_batch(tmp_path, rng, capsys):
+    # --frames with an explicit pallas backend runs the fused tall-image
+    # kernel on a single device (interpret on CPU) and reports it.
+    imgs = rng.integers(0, 256, size=(3, 20, 16, 3), dtype=np.uint8)
+    src = str(tmp_path / "clip.raw")
+    imgs.tofile(src)
+    out = str(tmp_path / "o.raw")
+    # --mesh 1x1 pins the clip to one device (the test env exposes 8
+    # virtual CPU devices, and multi-device batches demote to xla).
+    assert cli.main(
+        [src, "16", "20", "4", "rgb", "--frames", "3", "--mesh", "1x1",
+         "--backend", "pallas", "--output", out, "--time"]
+    ) == 0
+    assert "backend=pallas" in capsys.readouterr().out
+    got = np.fromfile(out, np.uint8).reshape(3, 20, 16, 3)
+    for k in range(3):
+        want = stencil.reference_stencil_numpy(
+            imgs[k], filters.get_filter("gaussian"), 4
+        )
+        np.testing.assert_array_equal(got[k], want)

@@ -200,7 +200,14 @@ def run_job(
 
         start_rep, frame = _maybe_restore(cfg, resume)
         img = _load_input(cfg) if frame is None else frame
-        step_fn = model.batch if cfg.frames > 1 else model
+        if cfg.frames > 1:
+            # Single-device clips run the fused tall-image Pallas path
+            # (model.batch_config decides); multi-device batches shard the
+            # frame axis and vmap the XLA step.
+            def step_fn(x, n, _single=(n_dev == 1)):
+                return model.batch(x, n, single_device=_single)
+        else:
+            step_fn = model
         if cfg.frames > 1 and n_dev > 1:
             img_dev = _put_batched(np.asarray(img), devices)
         else:
@@ -227,14 +234,15 @@ def run_job(
         _store_output(cfg, out)
         _clear_checkpoint(cfg, checkpoint_every, resume)
 
-    # frames>1 batches via the vmapped XLA schedule regardless of backend
-    # (iterate_batch demotes pallas), so report what actually ran;
-    # single-frame reports the shape-aware resolution (auto/autotune
-    # consult the measured cache, memoized in-process).
+    # Report what actually ran: batch mode asks the same decision helper
+    # the compute path used; single-frame reports the shape-aware
+    # resolution (auto/autotune consult the measured cache, memoized
+    # in-process).
     if cfg.frames > 1:
-        rb = resolve_backend(cfg.backend)
-        ran_backend = "xla" if rb == "pallas" else rb
-        ran_schedule = None
+        ran_backend, ran_schedule = model.batch_config(
+            (cfg.height, cfg.width), cfg.channels, n_dev == 1,
+            n_frames=cfg.frames,
+        )
     else:
         ran_backend, ran_schedule = model.resolved_config(
             (cfg.height, cfg.width), cfg.channels

@@ -145,6 +145,18 @@ def iterate_batch(imgs_u8: jax.Array, repetitions: jax.Array,
     return jax.lax.fori_loop(0, repetitions, lambda _, x: vstep(x), imgs_u8)
 
 
+@functools.partial(
+    jax.jit, static_argnames=("plan", "interpret", "schedule"),
+    donate_argnums=(0,),
+)
+def _jit_frames(imgs_u8, repetitions, plan, interpret, schedule):
+    from tpu_stencil.ops import pallas_stencil
+
+    return pallas_stencil.iterate_frames(
+        imgs_u8, repetitions, plan, interpret=interpret, schedule=schedule
+    )
+
+
 class IteratedConv2D:
     """Iterated stencil model: a filter plus an iteration schedule.
 
@@ -206,16 +218,26 @@ class IteratedConv2D:
                     self.plan, tuple(shape), channels,
                     force_schedule=self.schedule,
                 )
-            return self._resolved[key]
-        backend, schedule = resolve_backend(self.backend), None
+            backend, schedule = self._resolved[key]
+        else:
+            backend, schedule = resolve_backend(self.backend), None
+            if backend == "pallas":
+                from tpu_stencil.ops import pallas_stencil
+
+                if not pallas_stencil.plan_supported(self.plan, channels):
+                    # iterate() would silently fall back to the XLA
+                    # lowering; resolve (and report) the backend that
+                    # actually runs.
+                    return "xla", None
+                schedule = self.schedule
         if backend == "pallas":
             from tpu_stencil.ops import pallas_stencil
 
-            if not pallas_stencil.plan_supported(self.plan, channels):
-                # iterate() would silently fall back to the XLA lowering;
-                # resolve (and report) the backend that actually runs.
-                return "xla", None
-            schedule = self.schedule
+            # Resolve (and report) the schedule that actually runs at this
+            # launch's block height — never a degraded-away name.
+            schedule = pallas_stencil.effective_schedule_for(
+                self.plan, shape[0], schedule
+            )
         return backend, schedule
 
     def resolved_backend(self, shape: Tuple[int, int], channels: int) -> str:
@@ -232,15 +254,51 @@ class IteratedConv2D:
             return step(img_u8, self.plan, self.boundary)
         return step(img_u8, self.plan)
 
-    def batch(self, imgs_u8, repetitions: int) -> jax.Array:
-        """Batched video/burst mode: (N, H, W[, C]) frames, vmapped."""
+    def batch_config(
+        self, frame_shape: Tuple[int, int], channels: int,
+        single_device: bool, n_frames: int = 1,
+    ) -> Tuple[str, Optional[str]]:
+        """The (backend, schedule) the batch path will run. Pallas batches
+        run the fused tall-image kernel (`iterate_frames`) — zero-gap rows
+        between frames, re-zeroed every rep — which needs the clip on one
+        device (multi-device batches shard the frame axis and vmap the XLA
+        step instead)."""
+        if single_device and self.boundary == "zero":
+            backend, schedule = self.resolved_config(frame_shape, channels)
+            if backend == "pallas" and jax.default_backend() in ("tpu", "cpu"):
+                from tpu_stencil.ops import pallas_stencil
+
+                # The tall layout's block height can degrade a schedule the
+                # single-frame launch could run; report what runs.
+                rows = n_frames * pallas_stencil.frames_stride(
+                    self.plan, frame_shape[0]
+                )
+                return backend, pallas_stencil.effective_schedule_for(
+                    self.plan, rows, schedule
+                )
+        rb = resolve_backend(self.backend)
+        return ("xla" if rb == "pallas" else rb), None
+
+    def batch(self, imgs_u8, repetitions: int,
+              single_device: bool = False) -> jax.Array:
+        """Batched video/burst mode: (N, H, W[, C]) frames."""
         if isinstance(imgs_u8, jax.Array):
             imgs_u8 = jnp.array(imgs_u8, dtype=jnp.uint8, copy=True)
         else:
             imgs_u8 = jnp.asarray(imgs_u8, dtype=jnp.uint8)
+        ch = imgs_u8.shape[3] if imgs_u8.ndim == 4 else 1
+        backend, schedule = self.batch_config(
+            tuple(imgs_u8.shape[1:3]), ch, single_device,
+            n_frames=imgs_u8.shape[0],
+        )
+        if backend == "pallas":
+            return _jit_frames(
+                imgs_u8, jnp.int32(repetitions), plan=self.plan,
+                interpret=jax.default_backend() == "cpu", schedule=schedule,
+            )
         return iterate_batch(
             imgs_u8, jnp.int32(repetitions), plan=self.plan,
-            backend=resolve_backend(self.backend), boundary=self.boundary,
+            backend=backend, boundary=self.boundary,
         )
 
     def __call__(self, img_u8, repetitions: int) -> jax.Array:

@@ -102,6 +102,23 @@ def effective_block_h(n_rows: int, block_h: int = DEFAULT_BLOCK_H) -> int:
     return min(block_h, -(-n_rows // 8) * 8)
 
 
+def frames_stride(plan: StencilPlan, frame_h: int) -> int:
+    """Row stride of the fused-frames tall layout: each frame plus a
+    ``halo``-row zero gap (re-zeroed every rep — the inter-frame zero
+    boundary)."""
+    return frame_h + plan.halo
+
+
+def effective_schedule_for(plan: StencilPlan, n_rows: int,
+                           schedule: Optional[str] = None) -> str:
+    """The schedule that actually runs for an ``n_rows``-tall launch —
+    the requested (or default) schedule after any degrade at the block
+    height :func:`iterate`/:func:`iterate_frames` will use. Reporting
+    layers must use this so a degraded run is never attributed to a
+    schedule that could not apply."""
+    return _effective_schedule(schedule, plan, effective_block_h(n_rows))
+
+
 def _pack_ok(plan: StencilPlan, block_h: int) -> bool:
     """'pack' preconditions: separable nonneg dyadic plan whose per-rep
     intermediates all fit 16 bits (255 * 2^shift < 2^16 <=> shift <= 8,
@@ -186,6 +203,23 @@ def _cols_binomial(col, d: int, channels: int, wc: int):
         off = channels if d_i < d // 2 else -channels
         col = col + _lane_roll(col, off, wc)
     return col
+
+
+def _row_keep(gid, n_rows_real: int, frame):
+    """Row-keep predicate shared by every schedule's boundary mask.
+
+    ``gid`` is the global row index (int32, may be negative above the
+    image); 0 <= gid < n_rows_real as ONE unsigned compare (negatives wrap
+    big). ``frame`` = (stride, frame_h) marks the batched-frames layout:
+    frames of ``frame_h`` real rows every ``stride`` rows, the ``stride -
+    frame_h`` gap rows between them re-zeroed every rep so blur never
+    bleeds across frames (the gap is the inter-frame zero boundary, kept
+    zero by exactly the mechanism that keeps the image edge zero)."""
+    keep = gid.astype(jnp.uint32) < jnp.uint32(n_rows_real)
+    if frame is not None:
+        stride, frame_h = frame
+        keep = jnp.logical_and(keep, jax.lax.rem(gid, stride) < frame_h)
+    return keep
 
 
 def _binomial_chain(taps) -> Optional[int]:
@@ -465,7 +499,7 @@ def _shrink_loop(cur, keep, *, plan: StencilPlan, fuse: int, schedule: str,
 def _sep_kernel(in_hbm, out_ref, s_u8, sem, *, plan: StencilPlan,
                 block_h: int, grid: int, halo_al: int, fuse: int,
                 n_rows_real: int, wc: int, wc_real: int, channels: int,
-                schedule: str = "pad"):
+                schedule: str = "pad", frame=None):
     """One row-block program: DMA (block + fuse*halo ghosts), then ``fuse``
     fused separable reps, then one uint8 block store.
 
@@ -561,8 +595,7 @@ def _sep_kernel(in_hbm, out_ref, s_u8, sem, *, plan: StencilPlan,
         base = i * block_h - halo_al  # global row of tile row 0
         _packed_loop(
             out_ref, s_u8[slot],
-            lambda rid: (rid + base).astype(jnp.uint32)
-            < jnp.uint32(n_rows_real),
+            lambda rid: _row_keep(rid + base, n_rows_real, frame),
             (lambda cid: cid < wc_real) if wc_real != wc else None,
             plan=plan, block_h=block_h, halo_al=halo_al, fuse=fuse,
             wc=wc, channels=channels, strips=schedule == "pack_strips",
@@ -575,7 +608,7 @@ def _sep_kernel(in_hbm, out_ref, s_u8, sem, *, plan: StencilPlan,
         cur = s_u8[slot].astype(jnp.int32)
         rid = jax.lax.broadcasted_iota(jnp.int32, (tile_rows, wc), 0)
         gid = rid + (i * block_h - halo_al)
-        keep = gid.astype(jnp.uint32) < jnp.uint32(n_rows_real)
+        keep = _row_keep(gid, n_rows_real, frame)
         if wc_real != wc:
             cid = jax.lax.broadcasted_iota(jnp.int32, (tile_rows, wc), 1)
             keep = jnp.logical_and(keep, cid < wc_real)
@@ -600,13 +633,12 @@ def _sep_kernel(in_hbm, out_ref, s_u8, sem, *, plan: StencilPlan,
         # construction: stencil of zeros is zero), then h zero rows per
         # side restore the tile shape.  For edge blocks those zeros ARE
         # the boundary condition; for interior blocks they land in the
-        # contracted garbage band and are never read validly.
+        # contracted garbage band and are never read validly. (Rows above
+        # the image must re-zero too — their rep-t value reads real image
+        # rows and would otherwise leak back in at rep t+1.)
         rid = jax.lax.broadcasted_iota(jnp.int32, val.shape, 0)
         gid = rid + (i * block_h - halo_al + h)
-        # 0 <= gid < n_rows_real as ONE unsigned compare (negatives wrap big):
-        # rows above the image must re-zero too — their rep-t value reads
-        # real image rows and would otherwise leak back in at rep t+1.
-        keep = gid.astype(jnp.uint32) < jnp.uint32(n_rows_real)
+        keep = _row_keep(gid, n_rows_real, frame)
         if wc_real != wc:
             cid = jax.lax.broadcasted_iota(jnp.int32, val.shape, 1)
             keep = jnp.logical_and(keep, cid < wc_real)
@@ -788,7 +820,7 @@ def valid_fused(ext_u8: jax.Array, plan: StencilPlan, fuse: int,
 
 def _build_call(plan: StencilPlan, hp: int, h_real: int, wc: int,
                 wc_real: int, channels: int, block_h: int, fuse: int,
-                interpret: bool, schedule: str = None):
+                interpret: bool, schedule: str = None, frame=None):
     grid = hp // block_h
     halo_al = -(-(fuse * plan.halo) // 8) * 8  # sublane-aligned DMA halo
     kernel = functools.partial(
@@ -796,6 +828,7 @@ def _build_call(plan: StencilPlan, hp: int, h_real: int, wc: int,
         fuse=fuse, n_rows_real=h_real, wc=wc, wc_real=wc_real,
         channels=channels, schedule=_effective_schedule(schedule, plan,
                                                         block_h),
+        frame=frame,
     )
     return pl.pallas_call(
         kernel,
@@ -822,6 +855,40 @@ def plan_supported(plan: StencilPlan, channels: int) -> bool:
     return _supported(plan) and plan.halo * channels <= _MAX_ROLL_HALO
 
 
+def _run_rep_loop(x2, repetitions, plan: StencilPlan, rows: int,
+                  rows_real: int, wc: int, channels: int, block_h: int,
+                  fuse: int, interpret: bool, schedule, frame=None):
+    """Shared tail of :func:`iterate` / :func:`iterate_frames`: clamp the
+    block and fuse depth, pad to block/lane multiples (>= halo*C ghost
+    lanes), run ``repetitions`` as fused + remainder single-rep launches,
+    and crop. ``x2`` is the flat (rows, wc) uint8 view."""
+    bh = effective_block_h(rows, block_h)
+    hp = -(-rows // bh) * bh
+    # Cap fuse so the ghost bands stay a small fraction of the block
+    # (compute overhead 2*fuse*halo/block_h) and the tile fits VMEM.
+    # halo-0 (1x1) filters have no ghost bands: any fuse depth is free.
+    if plan.halo:
+        fuse = max(1, min(fuse, bh // (2 * plan.halo)))
+    # Lane-aligned width with >= halo*C ghost lanes (pad doubles as ghosts).
+    wcp = -(-(wc + plan.halo * channels) // 128) * 128
+    if hp != rows or wcp != wc:
+        x2 = jnp.pad(x2, ((0, hp - rows), (0, wcp - wc)))
+    fused = _build_call(plan, hp, rows_real, wcp, wc, channels, bh, fuse,
+                        interpret, schedule=schedule, frame=frame)
+    single = _build_call(plan, hp, rows_real, wcp, wc, channels, bh, 1,
+                         interpret, schedule=schedule, frame=frame)
+    if fuse > 1:
+        out = jax.lax.fori_loop(
+            0, repetitions // fuse, lambda _, x: fused(x), x2
+        )
+        out = jax.lax.fori_loop(
+            0, repetitions % fuse, lambda _, x: single(x), out
+        )
+    else:
+        out = jax.lax.fori_loop(0, repetitions, lambda _, x: single(x), x2)
+    return out[:rows, :wc]
+
+
 def iterate(img_u8: jax.Array, repetitions: jax.Array, plan: StencilPlan,
             block_h: int = DEFAULT_BLOCK_H, fuse: int = DEFAULT_FUSE,
             interpret: bool = False, schedule: str = None) -> jax.Array:
@@ -843,31 +910,49 @@ def iterate(img_u8: jax.Array, repetitions: jax.Array, plan: StencilPlan,
             0, repetitions, lambda _, x: _lowering.padded_step(x, plan), img_u8
         )
     x2 = img_u8.reshape(hh, wc)
-    bh = effective_block_h(hh, block_h)
-    hp = -(-hh // bh) * bh
-    # Cap fuse so the ghost bands stay a small fraction of the block
-    # (compute overhead 2*fuse*halo/block_h) and the tile fits VMEM.
-    # halo-0 (1x1) filters have no ghost bands: any fuse depth is free.
-    if plan.halo:
-        fuse = max(1, min(fuse, bh // (2 * plan.halo)))
-    # Lane-aligned width with >= halo*C ghost lanes (pad doubles as ghosts).
-    wcp = -(-(wc + plan.halo * channels) // 128) * 128
-    if hp != hh or wcp != wc:
-        x2 = jnp.pad(x2, ((0, hp - hh), (0, wcp - wc)))
-    fused = _build_call(plan, hp, hh, wcp, wc, channels, bh, fuse, interpret,
-                        schedule=schedule)
-    single = _build_call(plan, hp, hh, wcp, wc, channels, bh, 1, interpret,
-                         schedule=schedule)
-    if fuse > 1:
-        out = jax.lax.fori_loop(
-            0, repetitions // fuse, lambda _, x: fused(x), x2
+    out = _run_rep_loop(x2, repetitions, plan, hh, hh, wc, channels,
+                        block_h, fuse, interpret, schedule)
+    return out.reshape(shape)
+
+
+def iterate_frames(imgs_u8: jax.Array, repetitions: jax.Array,
+                   plan: StencilPlan, block_h: int = DEFAULT_BLOCK_H,
+                   fuse: int = DEFAULT_FUSE, interpret: bool = False,
+                   schedule: str = None) -> jax.Array:
+    """Apply the stencil ``repetitions`` times to N independent frames
+    ``(N, H, W[, C])`` — the fused-kernel batch mode.
+
+    The clip runs as ONE tall image: frames stacked with ``halo`` zero gap
+    rows between them. The per-rep boundary mask re-zeroes the gaps every
+    rep (`_row_keep`'s frame-periodic predicate), so blur never bleeds
+    across frames — each frame sees exactly the zero boundary it would see
+    alone — while the whole clip shares one kernel launch, one DMA
+    pipeline, and the ``fuse``x HBM traffic cut. The vmapped XLA path
+    (``models.blur.iterate_batch``) pays full per-rep HBM traffic instead.
+
+    Falls back to the vmapped XLA lowering for unsupported plans.
+    """
+    shape = imgs_u8.shape
+    n, hh, w = shape[0], shape[1], shape[2]
+    channels = shape[3] if imgs_u8.ndim == 4 else 1
+    wc = w * channels
+    if not plan_supported(plan, channels):
+        step = jax.vmap(lambda x: _lowering.padded_step(x, plan))
+        return jax.lax.fori_loop(
+            0, repetitions, lambda _, x: step(x), imgs_u8
         )
-        out = jax.lax.fori_loop(
-            0, repetitions % fuse, lambda _, x: single(x), out
-        )
-    else:
-        out = jax.lax.fori_loop(0, repetitions, lambda _, x: single(x), x2)
-    return out[:hh, :wc].reshape(shape)
+    gap = plan.halo
+    stride = frames_stride(plan, hh)
+    frame = (stride, hh) if gap else None
+    x = imgs_u8.reshape(n, hh, wc)
+    if gap:
+        x = jnp.pad(x, ((0, 0), (0, gap), (0, 0)))
+    x2 = x.reshape(n * stride, wc)
+    rows_real = n * stride - gap  # the tail gap doubles as bottom pad
+    out = _run_rep_loop(x2, repetitions, plan, n * stride, rows_real, wc,
+                        channels, block_h, fuse, interpret, schedule,
+                        frame=frame)
+    return out.reshape(n, stride, wc)[:, :hh, :].reshape(shape)
 
 
 def padded_step(img_u8: jax.Array, plan: StencilPlan,

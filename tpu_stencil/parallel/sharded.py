@@ -314,6 +314,12 @@ class ShardedRunner:
                 elif not self.needs_mask:
                     self.fuse = pallas_stencil.DEFAULT_FUSE
                 interpret = jax.default_backend() == "cpu"
+                # Resolve the schedule that actually runs at the tile's
+                # block height (valid_fused may degrade e.g. pack on a
+                # short tile) so reporting never names a degraded-away one.
+                self.schedule = pallas_stencil.effective_schedule_for(
+                    model.plan, tile[0], self.schedule
+                )
         self._fn = build_sharded_iterate(
             self.mesh, model.plan, channels, self.needs_mask,
             backend=self.backend,

@@ -70,26 +70,40 @@ def _measure_per_rep(
 
 
 def _measure_batch_per_frame_rep(
-    imgs: np.ndarray, filter_name: str, budget_s: float
+    imgs: np.ndarray, filter_name: str, budget_s: float,
+    backend: str = "xla",
 ) -> float:
-    """Steady-state seconds per frame-repetition of the vmapped batch mode
+    """Steady-state seconds per frame-repetition of the batch mode
     (``--frames``): frames are embarrassingly parallel, so the interesting
-    number is us per frame*rep vs the single-frame row."""
+    number is us per frame*rep vs the single-frame row. ``backend='xla'``
+    measures the vmapped step; ``'pallas'`` the fused tall-image kernel
+    (``pallas_stencil.iterate_frames``)."""
+    import functools
+
     import jax
     import jax.numpy as jnp
 
     from tpu_stencil.models.blur import IteratedConv2D, iterate_batch
     from tpu_stencil.runtime.autotune import _steady_state_per_rep
 
-    model = IteratedConv2D(filter_name, backend="xla")
+    model = IteratedConv2D(filter_name, backend=backend)
+    if backend == "pallas":
+        from tpu_stencil.ops import pallas_stencil
+
+        fn = jax.jit(
+            functools.partial(pallas_stencil.iterate_frames, plan=model.plan),
+            donate_argnums=0,
+        )
+    else:
+        fn = functools.partial(
+            iterate_batch, plan=model.plan, backend=backend
+        )
 
     def timed(n_reps: int) -> float:
         dev = jax.device_put(imgs)
         np.asarray(dev.ravel()[0])
         t0 = time.perf_counter()
-        out = iterate_batch(
-            dev, jnp.int32(n_reps), plan=model.plan, backend="xla"
-        )
+        out = fn(dev, jnp.int32(n_reps))
         np.asarray(out.ravel()[0])
         return time.perf_counter() - t0
 
@@ -98,6 +112,18 @@ def _measure_batch_per_frame_rep(
     est = max(timed(probe) / probe, 1e-8)
     lo = min(max(int(budget_s / est), 100), 50_000)
     return _steady_state_per_rep(timed, lo) / imgs.shape[0]
+
+
+def _pallas_label(filter_name: str, n_rows: int) -> str:
+    """Row label recording which per-rep schedule actually produced a
+    pallas measurement: the kernel default (TPU_STENCIL_PALLAS_SCHEDULE)
+    after any degrade at this launch's block height — the artifact must
+    never attribute a degraded run to the schedule that could not apply."""
+    from tpu_stencil.models.blur import IteratedConv2D
+    from tpu_stencil.ops import pallas_stencil as ps
+
+    ran = ps.effective_schedule_for(IteratedConv2D(filter_name).plan, n_rows)
+    return f"pallas[{ran}]"
 
 
 def _row(img, filter_name, mode, size_label, backend, budget_s, reps,
@@ -120,20 +146,10 @@ def _row(img, filter_name, mode, size_label, backend, budget_s, reps,
     gbps, pct = roofline.achieved(
         img.nbytes, per_rep, backend, filter_name, img.shape[0]
     )
-    label = backend
-    if backend == "pallas":
-        # Record which per-rep schedule actually produced this row: the
-        # kernel default (TPU_STENCIL_PALLAS_SCHEDULE), after any degrade
-        # for this plan/shape — the artifact must never attribute a
-        # degraded run to the schedule that could not apply.
-        from tpu_stencil.models.blur import IteratedConv2D
-        from tpu_stencil.ops import pallas_stencil as ps
-
-        ran = ps._effective_schedule(
-            None, IteratedConv2D(filter_name).plan,
-            ps.effective_block_h(img.shape[0]),
-        )
-        label = f"pallas[{ran}]"
+    label = (
+        _pallas_label(filter_name, img.shape[0])
+        if backend == "pallas" else backend
+    )
     return {
         "filter": filter_name, "mode": mode, "size": size_label,
         "backend": label,
@@ -188,23 +204,35 @@ def run_sweep(
         imgs = rng.integers(
             0, 256, size=(frames, 2520, WIDTH, 3), dtype=np.uint8
         )
-        per_fr = _measure_batch_per_frame_rep(imgs, "gaussian", budget_s)
         from tpu_stencil.runtime import roofline
 
-        gbps, pct = roofline.achieved(
-            imgs.nbytes // frames, per_fr, "xla", "gaussian", 2520
-        )
-        add({
-            "filter": "gaussian", "mode": "rgb",
-            "size": f"{WIDTH}x2520 x{frames} frames", "backend": "xla",
-            "us_per_rep": round(per_fr * 1e6, 1), "reps": 40,
-            "total_s": round(per_fr * 40 * frames, 6),
-            "hbm_gbps": round(gbps, 1), "pct_hbm_peak": round(pct, 1),
-            "gtx970_40reps_s": _CUDA_40REPS[("rgb", 2520)] * frames,
-            "speedup_vs_gtx970": round(
-                _CUDA_40REPS[("rgb", 2520)] / (per_fr * 40), 1
-            ),
-        })
+        for backend in backends:
+            per_fr = _measure_batch_per_frame_rep(
+                imgs, "gaussian", budget_s, backend
+            )
+            gbps, pct = roofline.achieved(
+                imgs.nbytes // frames, per_fr, backend, "gaussian", 2520
+            )
+            label = backend
+            if backend == "pallas":
+                from tpu_stencil.models.blur import IteratedConv2D
+                from tpu_stencil.ops import pallas_stencil as ps
+
+                tall_rows = frames * ps.frames_stride(
+                    IteratedConv2D("gaussian").plan, 2520
+                )
+                label = _pallas_label("gaussian", tall_rows)
+            add({
+                "filter": "gaussian", "mode": "rgb",
+                "size": f"{WIDTH}x2520 x{frames} frames", "backend": label,
+                "us_per_rep": round(per_fr * 1e6, 1), "reps": 40,
+                "total_s": round(per_fr * 40 * frames, 6),
+                "hbm_gbps": round(gbps, 1), "pct_hbm_peak": round(pct, 1),
+                "gtx970_40reps_s": _CUDA_40REPS[("rgb", 2520)] * frames,
+                "speedup_vs_gtx970": round(
+                    _CUDA_40REPS[("rgb", 2520)] / (per_fr * 40), 1
+                ),
+            })
     return rows
 
 
