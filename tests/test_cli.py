@@ -176,9 +176,10 @@ def test_put_batched_shards_leading_axis(rng):
     if len(jax.devices()) < 4:
         pytest.skip("needs 4 virtual devices")
     imgs = rng.integers(0, 256, size=(5, 4, 4), dtype=np.uint8)
-    dev = driver._put_batched(imgs, jax.devices()[:4])
+    dev, mesh = driver._put_batched(imgs, jax.devices()[:4])
     assert dev.shape == (8, 4, 4)  # padded to a device multiple
     assert len(dev.sharding.device_set) == 4  # actually spread over devices
+    assert mesh.axis_names == ("b",)
     np.testing.assert_array_equal(np.asarray(dev)[:5], imgs)
     np.testing.assert_array_equal(np.asarray(dev)[5:], 0)
 
@@ -266,5 +267,26 @@ def test_cli_frames_pallas_batch(tmp_path, rng, capsys):
     for k in range(3):
         want = stencil.reference_stencil_numpy(
             imgs[k], filters.get_filter("gaussian"), 4
+        )
+        np.testing.assert_array_equal(got[k], want)
+
+
+def test_cli_frames_pallas_sharded_batch(tmp_path, rng, capsys):
+    # Multi-device batch with an explicit pallas backend: each device runs
+    # the fused tall-image kernel on its local frames via shard_map (no
+    # collectives — frames are independent).
+    imgs = rng.integers(0, 256, size=(6, 24, 16, 3), dtype=np.uint8)
+    src = str(tmp_path / "clip6.raw")
+    imgs.tofile(src)
+    out = str(tmp_path / "o6.raw")
+    assert cli.main(
+        [src, "16", "24", "5", "rgb", "--frames", "6", "--mesh", "1x2",
+         "--backend", "pallas", "--output", out, "--time"]
+    ) == 0
+    assert "backend=pallas" in capsys.readouterr().out
+    got = np.fromfile(out, np.uint8).reshape(6, 24, 16, 3)
+    for k in range(6):
+        want = stencil.reference_stencil_numpy(
+            imgs[k], filters.get_filter("gaussian"), 5
         )
         np.testing.assert_array_equal(got[k], want)

@@ -45,11 +45,11 @@ def _load_input(cfg: JobConfig) -> np.ndarray:
     return images_io.load_image(cfg.image, cfg.image_type)
 
 
-def _put_batched(imgs: np.ndarray, devices) -> jax.Array:
+def _put_batched(imgs: np.ndarray, devices):
     """Shard the frame axis of (N, H, W[, C]) over ``devices`` — batch-axis
     data parallelism: frames are independent, so unlike the spatial mesh
     there is NO halo traffic, only the final gather. Pads N to a device
-    multiple with zero frames (callers crop)."""
+    multiple with zero frames (callers crop). Returns (array, mesh)."""
     from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
     n = len(devices)
@@ -59,9 +59,10 @@ def _put_batched(imgs: np.ndarray, devices) -> jax.Array:
             [imgs, np.zeros((pad,) + imgs.shape[1:], imgs.dtype)]
         )
     mesh = Mesh(np.asarray(devices), ("b",))
-    return jax.device_put(
+    arr = jax.device_put(
         jax.numpy.asarray(imgs), NamedSharding(mesh, PartitionSpec("b"))
     )
+    return arr, mesh
 
 
 def _store_output(cfg: JobConfig, out: np.ndarray) -> None:
@@ -200,18 +201,36 @@ def run_job(
 
         start_rep, frame = _maybe_restore(cfg, resume)
         img = _load_input(cfg) if frame is None else frame
-        if cfg.frames > 1:
-            # Single-device clips run the fused tall-image Pallas path
-            # (model.batch_config decides); multi-device batches shard the
-            # frame axis and vmap the XLA step.
-            def step_fn(x, n, _single=(n_dev == 1)):
-                return model.batch(x, n, single_device=_single)
-        else:
-            step_fn = model
+        bmesh = None
         if cfg.frames > 1 and n_dev > 1:
-            img_dev = _put_batched(np.asarray(img), devices)
+            img_dev, bmesh = _put_batched(np.asarray(img), devices)
         else:
             img_dev = jax.device_put(jax.numpy.asarray(img), devices[0])
+        if cfg.frames > 1:
+            # Frames are device-local either way (single device, or one
+            # local clip per device under the 1-D batch mesh), so the
+            # fused tall-image Pallas path applies when the model resolves
+            # to it; otherwise the vmapped XLA step.
+            per_dev = -(-cfg.frames // n_dev)
+            b_backend, b_schedule = model.batch_config(
+                (cfg.height, cfg.width), cfg.channels, True,
+                n_frames=per_dev,
+            )
+            if b_backend == "pallas" and bmesh is not None:
+                from tpu_stencil.parallel import sharded as _sharded
+
+                frames_fn = _sharded.build_batched_frames(
+                    bmesh, model.plan, b_schedule,
+                    interpret=jax.default_backend() == "cpu",
+                )
+
+                def step_fn(x, n):
+                    return frames_fn(x, jax.numpy.int32(n))
+            else:
+                def step_fn(x, n):
+                    return model.batch(x, n, single_device=n_dev == 1)
+        else:
+            step_fn = model
         img_dev = step_fn(img_dev, 0)  # warm-up compile; output == input
         img_dev.block_until_ready()
         fetch = (
@@ -240,8 +259,8 @@ def run_job(
     # in-process).
     if cfg.frames > 1:
         ran_backend, ran_schedule = model.batch_config(
-            (cfg.height, cfg.width), cfg.channels, n_dev == 1,
-            n_frames=cfg.frames,
+            (cfg.height, cfg.width), cfg.channels, True,
+            n_frames=-(-cfg.frames // n_dev),
         )
     else:
         ran_backend, ran_schedule = model.resolved_config(

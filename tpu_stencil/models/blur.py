@@ -260,9 +260,13 @@ class IteratedConv2D:
     ) -> Tuple[str, Optional[str]]:
         """The (backend, schedule) the batch path will run. Pallas batches
         run the fused tall-image kernel (`iterate_frames`) — zero-gap rows
-        between frames, re-zeroed every rep — which needs the clip on one
-        device (multi-device batches shard the frame axis and vmap the XLA
-        step instead)."""
+        between frames, re-zeroed every rep. ``single_device`` means the
+        frames are device-local: one device holds the whole clip, or (the
+        driver's multi-device path) each device runs the tall kernel on
+        its own frames via ``sharded.build_batched_frames``; pass
+        ``n_frames`` = frames per device so the schedule degrade is
+        computed at the tall launch's real block height. When frames are
+        not device-local the vmapped XLA step runs instead."""
         if single_device and self.boundary == "zero":
             backend, schedule = self.resolved_config(frame_shape, channels)
             if backend == "pallas" and jax.default_backend() in ("tpu", "cpu"):

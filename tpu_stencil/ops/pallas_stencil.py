@@ -820,7 +820,8 @@ def valid_fused(ext_u8: jax.Array, plan: StencilPlan, fuse: int,
 
 def _build_call(plan: StencilPlan, hp: int, h_real: int, wc: int,
                 wc_real: int, channels: int, block_h: int, fuse: int,
-                interpret: bool, schedule: str = None, frame=None):
+                interpret: bool, schedule: str = None, frame=None,
+                vma=None):
     grid = hp // block_h
     halo_al = -(-(fuse * plan.halo) // 8) * 8  # sublane-aligned DMA halo
     kernel = functools.partial(
@@ -833,7 +834,12 @@ def _build_call(plan: StencilPlan, hp: int, h_real: int, wc: int,
     return pl.pallas_call(
         kernel,
         grid=(grid,),
-        out_shape=jax.ShapeDtypeStruct((hp, wc), jnp.uint8),
+        # Inside shard_map the result varies over the mesh axes; declare
+        # it when given (check_vma cannot infer through a pallas_call).
+        out_shape=jax.ShapeDtypeStruct(
+            (hp, wc), jnp.uint8,
+            **({"vma": frozenset(vma)} if vma else {}),
+        ),
         in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
         out_specs=pl.BlockSpec((block_h, wc), lambda i: (i, 0)),
         scratch_shapes=[
@@ -857,7 +863,8 @@ def plan_supported(plan: StencilPlan, channels: int) -> bool:
 
 def _run_rep_loop(x2, repetitions, plan: StencilPlan, rows: int,
                   rows_real: int, wc: int, channels: int, block_h: int,
-                  fuse: int, interpret: bool, schedule, frame=None):
+                  fuse: int, interpret: bool, schedule, frame=None,
+                  vma=None):
     """Shared tail of :func:`iterate` / :func:`iterate_frames`: clamp the
     block and fuse depth, pad to block/lane multiples (>= halo*C ghost
     lanes), run ``repetitions`` as fused + remainder single-rep launches,
@@ -874,9 +881,9 @@ def _run_rep_loop(x2, repetitions, plan: StencilPlan, rows: int,
     if hp != rows or wcp != wc:
         x2 = jnp.pad(x2, ((0, hp - rows), (0, wcp - wc)))
     fused = _build_call(plan, hp, rows_real, wcp, wc, channels, bh, fuse,
-                        interpret, schedule=schedule, frame=frame)
+                        interpret, schedule=schedule, frame=frame, vma=vma)
     single = _build_call(plan, hp, rows_real, wcp, wc, channels, bh, 1,
-                         interpret, schedule=schedule, frame=frame)
+                         interpret, schedule=schedule, frame=frame, vma=vma)
     if fuse > 1:
         out = jax.lax.fori_loop(
             0, repetitions // fuse, lambda _, x: fused(x), x2
@@ -918,7 +925,7 @@ def iterate(img_u8: jax.Array, repetitions: jax.Array, plan: StencilPlan,
 def iterate_frames(imgs_u8: jax.Array, repetitions: jax.Array,
                    plan: StencilPlan, block_h: int = DEFAULT_BLOCK_H,
                    fuse: int = DEFAULT_FUSE, interpret: bool = False,
-                   schedule: str = None) -> jax.Array:
+                   schedule: str = None, vma=None) -> jax.Array:
     """Apply the stencil ``repetitions`` times to N independent frames
     ``(N, H, W[, C])`` — the fused-kernel batch mode.
 
@@ -951,7 +958,7 @@ def iterate_frames(imgs_u8: jax.Array, repetitions: jax.Array,
     rows_real = n * stride - gap  # the tail gap doubles as bottom pad
     out = _run_rep_loop(x2, repetitions, plan, n * stride, rows_real, wc,
                         channels, block_h, fuse, interpret, schedule,
-                        frame=frame)
+                        frame=frame, vma=vma)
     return out.reshape(n, stride, wc)[:, :hh, :].reshape(shape)
 
 

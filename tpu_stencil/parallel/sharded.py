@@ -170,6 +170,33 @@ def build_sharded_iterate(
     return jax.jit(mapped, donate_argnums=(0,))
 
 
+def build_batched_frames(mesh: Mesh, plan: _lowering.StencilPlan,
+                         schedule=None, interpret: bool = False):
+    """Compile-once builder for batch-axis frame parallelism with the
+    fused tall-image kernel: each device runs
+    :func:`pallas_stencil.iterate_frames` on its local frames — frames
+    are independent, so there is NO collective at all, just D independent
+    fused kernels (the vmapped XLA alternative pays full per-rep HBM
+    traffic). ``mesh`` is 1-D over axis 'b'; the frame count must be a
+    device multiple (``driver._put_batched`` zero-pads).
+
+    Returns ``fn(imgs, reps) -> imgs`` (jitted, input donated)."""
+    from tpu_stencil.ops import pallas_stencil
+
+    def local(imgs_local, reps):
+        return pallas_stencil.iterate_frames(
+            imgs_local, reps, plan, interpret=interpret, schedule=schedule,
+            vma=("b",),
+        )
+
+    mapped = shard_map(
+        local, mesh=mesh, in_specs=(P("b"), P()), out_specs=P("b"),
+        # Same interpret-mode vma caveat as build_sharded_iterate.
+        check_vma=not interpret,
+    )
+    return jax.jit(mapped, donate_argnums=(0,))
+
+
 def sharded_iterate(
     img_u8: jax.Array,
     filt: jax.Array,

@@ -91,7 +91,12 @@ def _measure_batch_per_frame_rep(
         from tpu_stencil.ops import pallas_stencil
 
         fn = jax.jit(
-            functools.partial(pallas_stencil.iterate_frames, plan=model.plan),
+            functools.partial(
+                pallas_stencil.iterate_frames, plan=model.plan,
+                # Mosaic compiles for TPU only; interpret elsewhere (the
+                # same guard every other pallas entry point applies).
+                interpret=jax.default_backend() != "tpu",
+            ),
             donate_argnums=0,
         )
     else:
@@ -114,34 +119,48 @@ def _measure_batch_per_frame_rep(
     return _steady_state_per_rep(timed, lo) / imgs.shape[0]
 
 
-def _pallas_label(filter_name: str, n_rows: int) -> str:
+def _pallas_label(filter_name: str, frame_h: int,
+                  n_frames: int = 1) -> str:
     """Row label recording which per-rep schedule actually produced a
     pallas measurement: the kernel default (TPU_STENCIL_PALLAS_SCHEDULE)
     after any degrade at this launch's block height — the artifact must
-    never attribute a degraded run to the schedule that could not apply."""
+    never attribute a degraded run to the schedule that could not apply.
+    ``n_frames > 1`` labels the fused tall-image batch launch."""
     from tpu_stencil.models.blur import IteratedConv2D
     from tpu_stencil.ops import pallas_stencil as ps
 
-    ran = ps.effective_schedule_for(IteratedConv2D(filter_name).plan, n_rows)
+    plan = IteratedConv2D(filter_name).plan
+    rows = (
+        frame_h if n_frames == 1  # single-frame launch: no gap rows
+        else n_frames * ps.frames_stride(plan, frame_h)
+    )
+    ran = ps.effective_schedule_for(plan, rows)
     return f"pallas[{ran}]"
+
+
+def _with_retries(measure_fn, label: str, retries: int = 2):
+    """Run one measurement with retry/backoff: transient tunnel drops must
+    not kill a (possibly hours-long) sweep."""
+    last = None
+    for attempt in range(retries + 1):
+        try:
+            return measure_fn()
+        except Exception as e:
+            last = e
+            print(f"row {label} attempt {attempt} failed: "
+                  f"{type(e).__name__}: {e}", file=sys.stderr, flush=True)
+            time.sleep(15 * (attempt + 1))
+    raise last
 
 
 def _row(img, filter_name, mode, size_label, backend, budget_s, reps,
          base, retries: int = 2) -> dict:
     from tpu_stencil.runtime import roofline
 
-    last = None
-    for attempt in range(retries + 1):
-        try:
-            per_rep = _measure_per_rep(img, filter_name, budget_s, backend)
-            break
-        except Exception as e:  # transient tunnel drops must not kill a sweep
-            last = e
-            print(f"row {size_label} [{backend}] attempt {attempt} failed: "
-                  f"{type(e).__name__}: {e}", file=sys.stderr, flush=True)
-            time.sleep(15 * (attempt + 1))
-    else:
-        raise last
+    per_rep = _with_retries(
+        lambda: _measure_per_rep(img, filter_name, budget_s, backend),
+        f"{size_label} [{backend}]", retries,
+    )
     total = per_rep * reps
     gbps, pct = roofline.achieved(
         img.nbytes, per_rep, backend, filter_name, img.shape[0]
@@ -207,21 +226,19 @@ def run_sweep(
         from tpu_stencil.runtime import roofline
 
         for backend in backends:
-            per_fr = _measure_batch_per_frame_rep(
-                imgs, "gaussian", budget_s, backend
+            per_fr = _with_retries(
+                lambda: _measure_batch_per_frame_rep(
+                    imgs, "gaussian", budget_s, backend
+                ),
+                f"x{frames} frames [{backend}]",
             )
             gbps, pct = roofline.achieved(
                 imgs.nbytes // frames, per_fr, backend, "gaussian", 2520
             )
-            label = backend
-            if backend == "pallas":
-                from tpu_stencil.models.blur import IteratedConv2D
-                from tpu_stencil.ops import pallas_stencil as ps
-
-                tall_rows = frames * ps.frames_stride(
-                    IteratedConv2D("gaussian").plan, 2520
-                )
-                label = _pallas_label("gaussian", tall_rows)
+            label = (
+                _pallas_label("gaussian", 2520, n_frames=frames)
+                if backend == "pallas" else backend
+            )
             add({
                 "filter": "gaussian", "mode": "rgb",
                 "size": f"{WIDTH}x2520 x{frames} frames", "backend": label,
@@ -296,8 +313,9 @@ def main(argv=None) -> int:
     )
     p.add_argument(
         "--frames", type=int, default=0, metavar="N",
-        help="also measure the vmapped batch mode with N north-star frames "
-             "(reports us per frame*rep)",
+        help="also measure the batch mode with N north-star frames, one "
+             "row per swept backend (xla = vmapped step, pallas = fused "
+             "tall-image kernel); reports us per frame*rep",
     )
     ns = p.parse_args(argv)
     rows = run_sweep(
