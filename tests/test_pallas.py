@@ -253,3 +253,29 @@ def test_model_batch_single_device_runs_pallas(rng):
             imgs[k], filters.get_filter("gaussian"), 4
         )
         np.testing.assert_array_equal(got[k], want)
+
+
+@pytest.mark.parametrize("seed", range(5))
+def test_random_integer_filters_fuzz(seed):
+    # Randomized kernels exercise plan kinds the named registry misses
+    # (asymmetric separable taps, non-separable mixed-sign direct plans)
+    # across the pallas schedules; interpret mode vs the golden model.
+    rng = np.random.default_rng(seed)
+    k = int(rng.choice([3, 5]))
+    taps = rng.integers(-2, 7, size=(k, k))
+    if not taps.any():
+        taps[k // 2, k // 2] = 1
+    filt = filters.as_filter(taps.astype(np.int64))
+    plan = lowering.plan_filter(filt)
+    img = rng.integers(0, 256, size=(50, 21, 3), dtype=np.uint8)
+    want = stencil.reference_stencil_numpy(img, filt, 2)
+    for schedule in ("pad", "shrink"):
+        got = np.asarray(
+            pallas_stencil.iterate(img, jnp.int32(2), plan, block_h=24,
+                                   fuse=2, interpret=True,
+                                   schedule=schedule)
+        )
+        np.testing.assert_array_equal(
+            got, want, err_msg=f"seed={seed} k={k} schedule={schedule} "
+                               f"kind={plan.kind}"
+        )
