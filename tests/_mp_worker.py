@@ -65,6 +65,26 @@ def main() -> None:
         print(f"proc {proc_id} done", flush=True)
         return
 
+    if mode == "frames":
+        # Multi-host --frames: each process computes and writes its own
+        # contiguous frame range into the shared output (offset I/O); 3
+        # frames over 2 processes exercises an uneven split (2 + 1).
+        from tpu_stencil import driver
+        from tpu_stencil.config import ImageType, JobConfig
+
+        cfg = JobConfig(
+            image=img_path, width=8, height=10, repetitions=2,
+            image_type=ImageType.RGB, backend="xla", frames=3,
+            output=out_path,
+        )
+        res = driver.run_job(cfg)
+        assert res.output_path == out_path
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices("frames_done")
+        print(f"proc {proc_id} done", flush=True)
+        return
+
     if mode == "cli":
         # Divergent argv across ranks: rank 1 asks for 99 reps and a wrong
         # output path. cli.main's broadcast_config must override both with

@@ -199,3 +199,40 @@ def test_two_process_autotune_backend_agreement(tmp_path, rng):
         img, filters.get_filter("gaussian"), 3
     )
     np.testing.assert_array_equal(got, want)
+
+
+def test_two_process_frames_ranges(tmp_path, rng):
+    # Multi-host --frames: process 0 owns frames [0,2), process 1 frame
+    # [2,3); both write their byte ranges into one shared output.
+    frames = rng.integers(0, 256, size=(3, 10, 8, 3), dtype=np.uint8)
+    src = str(tmp_path / "clip.raw")
+    dst = str(tmp_path / "out.raw")
+    frames.tofile(src)
+
+    coordinator = f"127.0.0.1:{_free_port()}"
+    env = dict(
+        os.environ,
+        PYTHONPATH=os.pathsep.join(
+            [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
+            + os.environ.get("PYTHONPATH", "").split(os.pathsep)
+        ),
+    )
+    procs = [
+        subprocess.Popen(
+            [sys.executable, _WORKER, str(pid), coordinator, src, dst,
+             "1", "2", "frames"],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for pid in range(2)
+    ]
+    outs = [p.communicate(timeout=300)[0] for p in procs]
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, f"worker failed:\n{out}"
+
+    got = np.fromfile(dst, np.uint8).reshape(3, 10, 8, 3)
+    for k in range(3):
+        want = stencil.reference_stencil_numpy(
+            frames[k], filters.get_filter("gaussian"), 2
+        )
+        np.testing.assert_array_equal(got[k], want, err_msg=f"frame {k}")

@@ -138,9 +138,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "--frames", type=int, default=1, metavar="N",
         help="batched video mode: the raw input holds N concatenated frames "
-             "(vmap over the frame axis; frames never mix). Raw-only and "
-             "single-host; frames shard the batch axis, so --mesh RxC just "
-             "selects R*C devices (no spatial sharding)",
+             "(frames never mix). Raw-only. Frames shard the batch axis — "
+             "--mesh RxC just selects R*C devices (no spatial sharding); "
+             "multi-host runs split the clip into per-process frame ranges "
+             "with offset I/O, one device per host (--mesh and "
+             "checkpointing stay single-host)",
     )
     p.add_argument(
         "--schedule", default=None, choices=list(PALLAS_SCHEDULES),

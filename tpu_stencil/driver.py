@@ -180,8 +180,9 @@ def run_job(
                     "a clip"
                 )
             if jax.process_count() > 1:
-                raise NotImplementedError(
-                    "--frames batching is single-host for now"
+                return _run_frames_multihost(
+                    cfg, model, profile_dir, checkpoint_every, resume,
+                    total_t,
                 )
             if cfg.mesh_shape is not None:
                 # --mesh RxC spells spatial sharding; frames shard the batch
@@ -273,6 +274,73 @@ def run_job(
         backend=ran_backend,
         mesh_shape=None,
         schedule=ran_schedule if ran_backend == "pallas" else None,
+    )
+
+
+def _run_frames_multihost(cfg, model, profile_dir, checkpoint_every,
+                          resume, total_t) -> JobResult:
+    """Multi-host ``--frames``: each process owns a contiguous frame range
+    — frames are embarrassingly parallel, so the only shared state is the
+    input/output files (per-host offset I/O, the MPI-IO pattern) and the
+    final max-reduce of the compute window. Every host runs the fused
+    tall-image path on its local frames (one device per host for now)."""
+    from tpu_stencil.io import native
+
+    if checkpoint_every or resume:
+        raise NotImplementedError(
+            "--frames checkpoint/resume is single-host for now"
+        )
+    if cfg.mesh_shape is not None:
+        raise NotImplementedError(
+            "--mesh with multi-host --frames is not supported: each host "
+            "runs its own frame range on one local device"
+        )
+    p, n_proc = jax.process_index(), jax.process_count()
+    per = -(-cfg.frames // n_proc)
+    f0, f1 = p * per, min(cfg.frames, (p + 1) * per)
+    n_local = max(0, f1 - f0)
+    h, w, ch = cfg.height, cfg.width, cfg.channels
+    compute = 0.0
+    out = None
+    if n_local:
+        rows = raw_io.read_raw_rows(cfg.image, f0 * h, n_local * h, w, ch)
+        imgs = rows.reshape(n_local, h, w, ch)
+        if ch == 1:
+            imgs = imgs[..., 0]
+        dev = jax.device_put(
+            jax.numpy.asarray(imgs), jax.local_devices()[0]
+        )
+
+        def step_fn(x, n):
+            return model.batch(x, n, single_device=True)
+
+        dev = step_fn(dev, 0)  # warm-up compile; output == input
+        dev.block_until_ready()
+        with _maybe_profile(profile_dir):
+            out_dev, compute = _checkpointed_iterate(
+                cfg, step_fn, None, dev, 0, 0
+            )
+        out = np.asarray(out_dev)
+    # Collective: every process participates, frame-less ones with 0.
+    compute_seconds = max_across_processes(compute)
+    native.set_size(cfg.output_path, cfg.frames * h * w * ch)
+    if n_local:
+        block = out.reshape(n_local * h, w, ch)
+        raw_io.write_raw_block(
+            cfg.output_path, f0 * h, 0, block, w, ch, cfg.frames * h
+        )
+    # Report at this host's real frame count: a straggler host's shorter
+    # tall launch can degrade differently than a full one.
+    backend, schedule = model.batch_config(
+        (h, w), ch, True, n_frames=n_local or per
+    )
+    return JobResult(
+        output_path=cfg.output_path,
+        compute_seconds=compute_seconds,
+        total_seconds=total_t.elapsed,
+        backend=backend,
+        mesh_shape=None,
+        schedule=schedule if backend == "pallas" else None,
     )
 
 
