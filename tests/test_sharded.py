@@ -184,3 +184,18 @@ def test_pallas_sharded_schedules_match_single_device(
     got = _run(img, "gaussian", 11, (2, 2), backend="pallas")
     want = np.asarray(IteratedConv2D("gaussian", backend="xla")(img, 11))
     np.testing.assert_array_equal(got, want)
+
+
+@requires_8
+@pytest.mark.parametrize("name", ["gaussian5", "gaussian7"])
+def test_pallas_sharded_wide_filters_pack_degrade(rng, name, monkeypatch):
+    # Wide halos under the pack schedule: gaussian5 packs (shift 8),
+    # gaussian7 degrades to shrink — both must stay bit-exact under
+    # shard_map with multi-rep-deep exchanged ghosts.
+    from tpu_stencil.ops import pallas_stencil
+
+    monkeypatch.setattr(pallas_stencil, "DEFAULT_SCHEDULE", "pack")
+    img = rng.integers(0, 256, size=(32, 24, 3), dtype=np.uint8)
+    got = _run(img, name, 5, (2, 2), backend="pallas")
+    want = np.asarray(IteratedConv2D(name, backend="xla")(img, 5))
+    np.testing.assert_array_equal(got, want)

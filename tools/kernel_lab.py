@@ -162,29 +162,13 @@ def _rep_val_packed(cur, *, plan, wc, channels, opts):
     carry across because every intermediate is < 2^16 (gated by the
     caller). Returns the un-finished cols-pass accumulator (caller does
     shift + AND-mask)."""
-    h = plan.halo
     strip = opts.get("strip")
 
     def one(x):
-        swc = x.shape[1]
-        n_rows = x.shape[0] - 2 * h
-        acc = None
-        for t_idx, tap in enumerate(plan.row_taps):
-            if tap == 0:
-                continue
-            term = x[t_idx:t_idx + n_rows, :]
-            if tap != 1:
-                term = ps._mul_const_adds(term, tap)  # match shipped pack
-            acc = term if acc is None else acc + term
-        col = None
-        for t_idx, tap in enumerate(plan.col_taps):
-            if tap == 0:
-                continue
-            term = _lane_roll(acc, (t_idx - h) * channels, swc)
-            if tap != 1:
-                term = ps._mul_const_adds(term, tap)
-            col = term if col is None else col + term
-        return col
+        # The SHIPPED packed passes: the lab A/B must time the kernel that
+        # would actually ship (binomial chains, shift-add multiplies).
+        return ps._packed_passes(x, plan=plan, wc=x.shape[1],
+                                 channels=channels)
 
     if not strip:
         return one(cur)
