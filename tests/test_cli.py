@@ -290,3 +290,31 @@ def test_cli_frames_pallas_sharded_batch(tmp_path, rng, capsys):
             imgs[k], filters.get_filter("gaussian"), 5
         )
         np.testing.assert_array_equal(got[k], want)
+
+
+def test_cli_boundary_periodic(tmp_path, rng, capsys):
+    # --boundary periodic: the wraparound the reference's README describes
+    # but its code never implements (SURVEY.md Quirk 5).
+    img = rng.integers(0, 256, size=(10, 8, 3), dtype=np.uint8)
+    src = str(tmp_path / "p.raw")
+    raw_io.write_raw(src, img)
+    out = str(tmp_path / "o.raw")
+    assert cli.main([src, "8", "10", "3", "rgb", "--boundary", "periodic",
+                     "--backend", "pallas", "--mesh", "1x1",
+                     "--output", out, "--time"]) == 0
+    # pallas cannot run periodic; the report must name what actually ran
+    assert "backend=xla" in capsys.readouterr().out
+    got = np.fromfile(out, np.uint8).reshape(10, 8, 3)
+    want = stencil.reference_stencil_numpy(
+        img, filters.get_filter("gaussian"), 3, boundary="periodic"
+    )
+    np.testing.assert_array_equal(got, want)
+
+
+def test_cli_boundary_periodic_mesh_rejected(tmp_path, rng):
+    img = rng.integers(0, 256, size=(8, 8), dtype=np.uint8)
+    src = str(tmp_path / "p.raw")
+    raw_io.write_raw(src, img[..., None])
+    with pytest.raises(NotImplementedError):
+        cli.main([src, "8", "8", "1", "grey", "--boundary", "periodic",
+                  "--mesh", "2x2"])

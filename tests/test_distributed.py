@@ -149,3 +149,10 @@ def test_read_sharded_reads_each_row_range_once(tmp_path, rng, monkeypatch):
     distributed.read_sharded(p, 32, 40, 3, runner.sharding)
     # 2 mesh rows x 4 col tiles: exactly one disk read per row range
     assert sorted(calls) == [0, 16]
+
+
+def test_config_string_codec_carries_schedule_and_boundary():
+    from tpu_stencil.parallel import distributed as d
+
+    strs = ["img.raw", "gaussian", "auto", "", "pack", "periodic"]
+    assert d._decode_strs(d._encode_strs(strs)) == strs

@@ -49,6 +49,7 @@ class JobConfig:
     output: Optional[str] = None  # None -> blur_<basename> beside input
     frames: int = 1  # >1: batched video mode (N concatenated raw frames)
     schedule: Optional[str] = None  # Pallas per-rep schedule (None = tuned)
+    boundary: str = "zero"  # zero (reference semantics) | periodic
     # Accumulation dtype is a property of the backend's plan, not a flag:
     # integer plans accumulate exactly (int16/int32), --backend reference
     # forces the float32 semantics of the C code. A separate dtype knob was
@@ -71,6 +72,10 @@ class JobConfig:
             raise ValueError(
                 f"unknown schedule {self.schedule!r}; expected one of "
                 f"{'|'.join(PALLAS_SCHEDULES)}"
+            )
+        if self.boundary not in ("zero", "periodic"):
+            raise ValueError(
+                f"unknown boundary {self.boundary!r}; expected zero|periodic"
             )
 
     @property
@@ -145,6 +150,13 @@ def build_parser() -> argparse.ArgumentParser:
              "checkpointing stay single-host)",
     )
     p.add_argument(
+        "--boundary", default="zero", choices=["zero", "periodic"],
+        help="edge semantics: zero (the reference's calloc'd ghost ring) "
+             "or periodic — the wraparound the reference's README describes "
+             "but its code never implements (SURVEY.md Quirk 5). Periodic "
+             "runs the XLA schedule, single-device / --frames only",
+    )
+    p.add_argument(
         "--schedule", default=None, choices=list(PALLAS_SCHEDULES),
         help="force the Pallas per-rep schedule (see docs/KERNEL.md); "
              "default: the autotuned winner (or the kernel default for an "
@@ -215,6 +227,7 @@ def parse_args(argv=None) -> Tuple[JobConfig, argparse.Namespace]:
             output=ns.output,
             frames=ns.frames,
             schedule=ns.schedule,
+            boundary=ns.boundary,
         )
     except ValueError as e:
         parser.error(str(e))

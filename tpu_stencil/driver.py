@@ -164,7 +164,7 @@ def run_job(
         raise ValueError(f"checkpoint_every must be >= 0, got {checkpoint_every}")
     with Timer() as total_t:
         model = IteratedConv2D(cfg.filter_name, backend=cfg.backend,
-                               schedule=cfg.schedule)
+                               schedule=cfg.schedule, boundary=cfg.boundary)
 
         if devices is None:
             devices = jax.devices()
@@ -197,8 +197,23 @@ def run_job(
                 n_b = min(n_dev, cfg.frames)
             devices, n_dev = devices[:n_b], n_b
         if cfg.frames == 1 and (n_dev > 1 or cfg.mesh_shape is not None):
-            return _run_sharded(cfg, model, devices, profile_dir,
-                                checkpoint_every, resume, total_t)
+            if cfg.boundary != "zero":
+                # The sharded halo exchange is zero-boundary; periodic
+                # wraparound would need edge ranks to exchange with the
+                # opposite edge (halo_exchange supports it, the runner
+                # does not wire it yet). Run single-device instead; an
+                # explicit multi-device mesh request fails loudly.
+                if cfg.mesh_shape not in (None, (1, 1)) or (
+                    jax.process_count() > 1
+                ):
+                    raise NotImplementedError(
+                        "--boundary periodic is single-device / --frames "
+                        "only (the sharded runner is zero-boundary)"
+                    )
+                devices, n_dev = devices[:1], 1
+            else:
+                return _run_sharded(cfg, model, devices, profile_dir,
+                                    checkpoint_every, resume, total_t)
 
         start_rep, frame = _maybe_restore(cfg, resume)
         img = _load_input(cfg) if frame is None else frame

@@ -203,6 +203,12 @@ class IteratedConv2D:
         verdict item 3); explicit backends pass through. A constructor-
         forced ``schedule`` (the --schedule flag) overrides the tuned one
         whenever Pallas runs."""
+        if self.boundary != "zero":
+            # The Pallas kernels are zero-boundary only; periodic runs
+            # (and reports) the XLA schedule — never measure or name a
+            # backend that cannot run these semantics.
+            rb = resolve_backend(self.backend)
+            return ("xla" if rb == "pallas" else rb), None
         if self.backend in ("auto", "autotune"):
             key = (tuple(shape), channels)
             if key not in self._resolved:

@@ -154,11 +154,15 @@ def broadcast_config(cfg: Optional[JobConfig]) -> JobConfig:
     )
     names = multihost_utils.broadcast_one_to_all(
         _encode_strs([cfg.image, cfg.filter_name, cfg.backend,
-                      cfg.output if cfg.output is not None else ""])
+                      cfg.output if cfg.output is not None else "",
+                      cfg.schedule if cfg.schedule is not None else "",
+                      cfg.boundary])
         if jax.process_index() == 0
         else np.zeros(_STR_BUF, np.uint8)
     )
-    image, filter_name, backend, output = _decode_strs(names)
+    image, filter_name, backend, output, schedule, boundary = (
+        _decode_strs(names)
+    )
     mesh_shape = (
         (int(fields[4]), int(fields[5])) if int(fields[4]) > 0 else None
     )
@@ -173,6 +177,8 @@ def broadcast_config(cfg: Optional[JobConfig]) -> JobConfig:
         mesh_shape=mesh_shape,
         output=output or None,
         frames=int(fields[6]),
+        schedule=schedule or None,
+        boundary=boundary,
     )
 
 

@@ -39,6 +39,10 @@ def _fingerprint(cfg: JobConfig) -> dict:
         "filter": cfg.filter_name,
         "repetitions": cfg.repetitions,
         "frames": cfg.frames,
+        # Boundary semantics change every pixel near an edge: resuming a
+        # zero-boundary checkpoint under periodic (or vice versa) would
+        # mix semantics silently.
+        "boundary": cfg.boundary,
     }
 
 
