@@ -220,3 +220,30 @@ def test_stale_version_sweep_is_rep_ordered(tmp_path, rng):
     # deleted r2 before writing it; r1 must be gone
     assert os.path.exists(base + ".r2") and not os.path.exists(base + ".r1")
     checkpoint.clear(cfg)
+
+
+def test_legacy_checkpoint_without_boundary_key_resumes(tmp_path, rng):
+    # Checkpoints written before the boundary field existed must resume
+    # as zero-boundary (the only semantics that existed), not be refused.
+    import json
+
+    from tpu_stencil.runtime import checkpoint as ckpt
+
+    img = rng.integers(0, 256, size=(6, 6), dtype=np.uint8)
+    src = str(tmp_path / "img.raw")
+    img.tofile(src)
+    cfg = JobConfig(src, 6, 6, 4, ImageType.GREY,
+                    output=str(tmp_path / "o.raw"))
+    ckpt.save(cfg, 2, img)
+    meta_path = cfg.output_path + ".ckpt.json"
+    meta = json.load(open(meta_path))
+    del meta["boundary"]  # simulate a pre-upgrade checkpoint
+    json.dump(meta, open(meta_path, "w"))
+    rep, frame = ckpt.restore(cfg)
+    assert rep == 2
+    np.testing.assert_array_equal(frame, img)
+    # ...but a periodic job must still refuse it
+    cfg_p = JobConfig(src, 6, 6, 4, ImageType.GREY,
+                      output=str(tmp_path / "o.raw"), boundary="periodic")
+    with pytest.raises(ValueError):
+        ckpt.restore(cfg_p)

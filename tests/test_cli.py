@@ -311,10 +311,25 @@ def test_cli_boundary_periodic(tmp_path, rng, capsys):
     np.testing.assert_array_equal(got, want)
 
 
-def test_cli_boundary_periodic_mesh_rejected(tmp_path, rng):
+def test_cli_boundary_periodic_mesh(tmp_path, rng):
+    # Sharded periodic: edge ranks wrap to the opposite edge via ppermute.
     img = rng.integers(0, 256, size=(8, 8), dtype=np.uint8)
     src = str(tmp_path / "p.raw")
     raw_io.write_raw(src, img[..., None])
+    assert cli.main([src, "8", "8", "2", "grey", "--boundary", "periodic",
+                     "--mesh", "2x2"]) == 0
+    got = np.fromfile(str(tmp_path / "blur_p.raw"), np.uint8).reshape(8, 8)
+    want = stencil.reference_stencil_numpy(
+        img, filters.get_filter("gaussian"), 2, boundary="periodic"
+    )
+    np.testing.assert_array_equal(got, want)
+
+
+def test_cli_boundary_periodic_indivisible_mesh_rejected(tmp_path, rng):
+    # A padded grid would wrap pad pixels into the image: refuse loudly.
+    img = rng.integers(0, 256, size=(9, 8), dtype=np.uint8)
+    src = str(tmp_path / "p9.raw")
+    raw_io.write_raw(src, img[..., None])
     with pytest.raises(NotImplementedError):
-        cli.main([src, "8", "8", "1", "grey", "--boundary", "periodic",
+        cli.main([src, "8", "9", "1", "grey", "--boundary", "periodic",
                   "--mesh", "2x2"])

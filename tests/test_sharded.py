@@ -199,3 +199,21 @@ def test_pallas_sharded_wide_filters_pack_degrade(rng, name, monkeypatch):
     got = _run(img, name, 5, (2, 2), backend="pallas")
     want = np.asarray(IteratedConv2D(name, backend="xla")(img, 5))
     np.testing.assert_array_equal(got, want)
+
+
+@requires_8
+@pytest.mark.parametrize("name", ["gaussian", "gaussian5"])
+def test_sharded_periodic_matches_golden(rng, name):
+    # Periodic wraparound sharded over a 2x2 mesh: edge ranks exchange
+    # with the opposite edge; bit-exact vs the periodic golden model.
+    from tpu_stencil.ops import stencil as stencil_mod
+
+    img = rng.integers(0, 256, size=(16, 24, 3), dtype=np.uint8)
+    model = IteratedConv2D(name, backend="xla", boundary="periodic")
+    runner = sharded.ShardedRunner(model, (16, 24), 3, mesh_shape=(2, 2),
+                                   devices=jax.devices()[:4])
+    got = np.asarray(runner.fetch(runner.run(runner.put(img), 4)))
+    want = stencil_mod.reference_stencil_numpy(
+        img, filters.get_filter(name), 4, boundary="periodic"
+    )
+    np.testing.assert_array_equal(got, want)

@@ -154,7 +154,8 @@ def build_parser() -> argparse.ArgumentParser:
         help="edge semantics: zero (the reference's calloc'd ghost ring) "
              "or periodic — the wraparound the reference's README describes "
              "but its code never implements (SURVEY.md Quirk 5). Periodic "
-             "runs the XLA schedule, single-device / --frames only",
+             "runs the XLA schedule; sharded meshes wrap edge ranks to the "
+             "opposite edge and need a grid that divides the image",
     )
     p.add_argument(
         "--schedule", default=None, choices=list(PALLAS_SCHEDULES),

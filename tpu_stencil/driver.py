@@ -197,23 +197,24 @@ def run_job(
                 n_b = min(n_dev, cfg.frames)
             devices, n_dev = devices[:n_b], n_b
         if cfg.frames == 1 and (n_dev > 1 or cfg.mesh_shape is not None):
-            if cfg.boundary != "zero":
-                # The sharded halo exchange is zero-boundary; periodic
-                # wraparound would need edge ranks to exchange with the
-                # opposite edge (halo_exchange supports it, the runner
-                # does not wire it yet). Run single-device instead; an
-                # explicit multi-device mesh request fails loudly.
-                if cfg.mesh_shape not in (None, (1, 1)) or (
-                    jax.process_count() > 1
-                ):
-                    raise NotImplementedError(
-                        "--boundary periodic is single-device / --frames "
-                        "only (the sharded runner is zero-boundary)"
-                    )
+            if (cfg.boundary != "zero" and cfg.mesh_shape is None
+                    and jax.process_count() == 1):
+                # A periodic run that never asked for a mesh must not fail
+                # on an auto-chosen grid the image happens not to divide:
+                # run single-device. Explicit --mesh requests go through
+                # (the runner validates divisibility loudly).
                 devices, n_dev = devices[:1], 1
-            else:
-                return _run_sharded(cfg, model, devices, profile_dir,
-                                    checkpoint_every, resume, total_t)
+            if cfg.mesh_shape is not None and jax.process_count() == 1:
+                # --mesh RxC selects R*C devices (same contract as the
+                # frames path); asking for more than exist still fails in
+                # make_mesh. Multi-host meshes must span all devices.
+                n_m = cfg.mesh_shape[0] * cfg.mesh_shape[1]
+                devices = devices[:n_m]
+            # Periodic runs sharded too: halo_exchange wraps edge ranks to
+            # the opposite edge (the runner refuses padded/indivisible
+            # periodic grids, which would wrap pad pixels into the image).
+            return _run_sharded(cfg, model, devices, profile_dir,
+                                checkpoint_every, resume, total_t)
 
         start_rep, frame = _maybe_restore(cfg, resume)
         img = _load_input(cfg) if frame is None else frame

@@ -33,7 +33,7 @@ from tpu_stencil.parallel.halo import halo_exchange
 from tpu_stencil.parallel.mesh import make_mesh, ROWS_AXIS, COLS_AXIS
 
 
-def _local_step(tile_u8, plan, axes, mask_tile):
+def _local_step(tile_u8, plan, axes, mask_tile, boundary="zero"):
     """One local iteration: halo exchange + the plan's kernel + pad re-zero.
 
     For separable plans, communication is phased like the compute (the same
@@ -54,12 +54,12 @@ def _local_step(tile_u8, plan, axes, mask_tile):
     halo = plan.halo
     if plan.kind == "sep_int":
         xi = tile_u8.astype(jnp.int32)
-        ext0 = halo_exchange(xi, halo, ((row_axis, r, dim0),))
+        ext0 = halo_exchange(xi, halo, ((row_axis, r, dim0),), boundary)
         a = _lowering.sep_rows_pass(ext0, plan)
-        ext1 = halo_exchange(a, halo, ((col_axis, c, dim1),))
+        ext1 = halo_exchange(a, halo, ((col_axis, c, dim1),), boundary)
         out = _lowering.sep_cols_pass(ext1, plan)
     else:
-        ext = halo_exchange(tile_u8, halo, axes)
+        ext = halo_exchange(tile_u8, halo, axes, boundary)
         out = _lowering.valid_step(ext, plan)
     if mask_tile is not None:
         out = out * mask_tile
@@ -103,6 +103,7 @@ def build_sharded_iterate(
     fuse: int = 1,
     interpret: bool = False,
     schedule=None,
+    boundary: str = "zero",
 ):
     """Compile-once builder for the sharded iteration program.
 
@@ -118,6 +119,11 @@ def build_sharded_iterate(
     spec = P(ROWS_AXIS, COLS_AXIS) if channels == 1 else P(ROWS_AXIS, COLS_AXIS, None)
 
     if backend == "pallas":
+        if boundary != "zero":
+            raise ValueError(
+                "the valid-ghost Pallas kernel is zero-boundary; periodic "
+                "sharded runs use the XLA path (the runner demotes)"
+            )
         if needs_mask and fuse != 1:
             # The fused kernel only re-zeroes outside the padded global
             # extent; the pad region inside it must be re-zeroed every rep
@@ -136,7 +142,7 @@ def build_sharded_iterate(
     else:
         def step_chunk(x, n_fused, mask_tile):
             assert n_fused == 1
-            return _local_step(x, plan, axes, mask_tile)
+            return _local_step(x, plan, axes, mask_tile, boundary)
 
     def iter_tile(tile, reps, mask_tile):
         # ``fuse`` reps per exchange, then the remainder one at a time.
@@ -276,7 +282,19 @@ class ShardedRunner:
         ph, pw = partition.pad_amounts(self.h, self.w, self.mesh_shape)
         self.padded_shape = (self.h + ph, self.w + pw)
         tile = partition.tile_shape(self.h, self.w, self.mesh_shape)
-        pallas_ok = _pallas_plan_supported(model.plan, channels)
+        self.boundary = getattr(model, "boundary", "zero")
+        if self.boundary == "periodic" and (ph or pw):
+            # The pad region would be wrapped into the opposite edge —
+            # silently wrong output. Periodic needs grid-divisible shapes.
+            raise NotImplementedError(
+                f"periodic boundaries need the image ({self.h}x{self.w}) "
+                f"to divide the mesh grid {self.mesh_shape}; pick a mesh "
+                "that divides the image or run single-device"
+            )
+        pallas_ok = (
+            _pallas_plan_supported(model.plan, channels)
+            and self.boundary == "zero"  # valid-ghost kernel is zero-only
+        )
         # Pallas per-rep schedule: a constructor-forced one (--schedule)
         # wins; otherwise the autotuned verdict below (None = default).
         self.schedule = getattr(model, "schedule", None)
@@ -356,6 +374,7 @@ class ShardedRunner:
             fuse=self.fuse,
             interpret=interpret,
             schedule=self.schedule,
+            boundary=self.boundary,
         )
         if self.needs_mask:
             mask = np.zeros(self.padded_shape, np.uint8)

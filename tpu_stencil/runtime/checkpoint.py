@@ -68,7 +68,10 @@ def restore(cfg: JobConfig) -> Optional[Tuple[int, np.ndarray]]:
     with open(meta_path) as f:
         meta = json.load(f)
     want = _fingerprint(cfg)
-    if {k: meta.get(k) for k in want} != want:
+    # Pre-boundary checkpoints lack the key; they were all written
+    # under zero-boundary semantics (the only mode that existed).
+    if {k: meta.get(k, 'zero' if k == 'boundary' else None)
+            for k in want} != want:
         raise ValueError(
             f"checkpoint at {data_path} was written for a different job "
             f"({meta} != {want}); delete it or change --output"
@@ -141,7 +144,10 @@ def restore_sharded(cfg: JobConfig, sharding) -> Optional[Tuple[int, "object"]]:
     with open(meta_path) as f:
         meta = json.load(f)
     want = _fingerprint(cfg)
-    if {k: meta.get(k) for k in want} != want:
+    # Pre-boundary checkpoints lack the key; they were all written
+    # under zero-boundary semantics (the only mode that existed).
+    if {k: meta.get(k, 'zero' if k == 'boundary' else None)
+            for k in want} != want:
         raise ValueError(
             f"checkpoint at {meta_path} was written for a different job "
             f"({meta} != {want}); delete it or change --output"
