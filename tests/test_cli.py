@@ -333,3 +333,20 @@ def test_cli_boundary_periodic_indivisible_mesh_rejected(tmp_path, rng):
     with pytest.raises(NotImplementedError):
         cli.main([src, "8", "9", "1", "grey", "--boundary", "periodic",
                   "--mesh", "2x2"])
+
+
+def test_cli_frames_periodic(tmp_path, rng):
+    # Batch mode + periodic: each frame wraps around its own edges.
+    frames = rng.integers(0, 256, size=(2, 8, 6, 3), dtype=np.uint8)
+    src = str(tmp_path / "clipp.raw")
+    frames.tofile(src)
+    out = str(tmp_path / "op.raw")
+    assert cli.main([src, "6", "8", "3", "rgb", "--frames", "2",
+                     "--boundary", "periodic", "--mesh", "1x1",
+                     "--output", out]) == 0
+    got = np.fromfile(out, np.uint8).reshape(2, 8, 6, 3)
+    for k in range(2):
+        want = stencil.reference_stencil_numpy(
+            frames[k], filters.get_filter("gaussian"), 3, boundary="periodic"
+        )
+        np.testing.assert_array_equal(got[k], want)
