@@ -41,6 +41,11 @@ if os.environ.get("TPU_STENCIL_BENCH_SHAPE"):  # smoke tests only
 ATTEMPTS = 4
 BACKOFFS = (30, 90, 180)  # seconds between attempts
 CHILD_TIMEOUT = 1800  # per-attempt wall clock (compiles are ~20-60s each)
+# A dead TPU tunnel hangs jax backend init silently (no output at all,
+# observed 2026-07-30: >8h outage); a live child logs its platform line
+# within ~a minute. Kill attempts that show zero progress early instead
+# of burning CHILD_TIMEOUT per attempt.
+INIT_TIMEOUT = int(os.environ.get("TPU_STENCIL_BENCH_INIT_TIMEOUT", "240"))
 
 
 def _backoffs():
@@ -194,6 +199,52 @@ def child_main() -> int:
     return 0
 
 
+def _run_child(env):
+    """One capture attempt with an init watchdog: kill the child if it
+    produces NO output within INIT_TIMEOUT (a dead tunnel hangs backend
+    init silently), otherwise allow the full CHILD_TIMEOUT. Returns
+    (returncode or None, stdout, stderr)."""
+    import threading
+
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+    )
+    err_chunks = []
+    progressed = threading.Event()
+
+    def drain():
+        for line in proc.stderr:
+            err_chunks.append(line)
+            progressed.set()
+
+    t = threading.Thread(target=drain, daemon=True)
+    t.start()
+    start = time.time()
+    while (proc.poll() is None and not progressed.is_set()
+           and time.time() - start < INIT_TIMEOUT):
+        time.sleep(1)
+    if proc.poll() is None and not progressed.is_set():
+        proc.kill()
+        proc.wait()
+        t.join(2)
+        return None, "", "".join(err_chunks) + (
+            f"\nno child output within {INIT_TIMEOUT}s "
+            "(backend init hung - tunnel down?)\n"
+        )
+    try:
+        out, _ = proc.communicate(timeout=CHILD_TIMEOUT)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        out, _ = proc.communicate()
+        t.join(2)
+        return None, out, "".join(err_chunks) + (
+            f"\ntimed out after {CHILD_TIMEOUT}s\n"
+        )
+    t.join(2)
+    return proc.returncode, out, "".join(err_chunks)
+
+
 def main() -> int:
     if os.environ.get("TPU_STENCIL_BENCH_CHILD") == "1":
         return child_main()
@@ -201,30 +252,16 @@ def main() -> int:
     last_line = None
     for attempt in range(ATTEMPTS):
         env = dict(os.environ, TPU_STENCIL_BENCH_CHILD="1")
-        try:
-            proc = subprocess.run(
-                [sys.executable, os.path.abspath(__file__)],
-                env=env, capture_output=True, text=True,
-                timeout=CHILD_TIMEOUT,
-            )
-        except subprocess.TimeoutExpired as e:
-            # Preserve the child's trail (platform/compile/progress lines):
-            # without it a hung capture is undiagnosable.
-            if e.stderr:
-                sys.stderr.write(
-                    e.stderr if isinstance(e.stderr, str)
-                    else e.stderr.decode(errors="replace")
-                )
-            log(f"attempt {attempt}: timed out after {CHILD_TIMEOUT}s")
-            proc = None
-        if proc is not None:
-            sys.stderr.write(proc.stderr)
-            lines = [l for l in proc.stdout.splitlines() if l.strip()]
-            if proc.returncode == 0 and lines:
-                print(lines[-1])
-                return 0
-            last_line = lines[-1] if lines else None
-            log(f"attempt {attempt}: rc={proc.returncode}")
+        rc, out, err = _run_child(env)
+        # Preserve the child's trail (platform/compile/progress lines):
+        # without it a hung capture is undiagnosable.
+        sys.stderr.write(err)
+        lines = [l for l in out.splitlines() if l.strip()]
+        if rc == 0 and lines:
+            print(lines[-1])
+            return 0
+        last_line = lines[-1] if lines else last_line
+        log(f"attempt {attempt}: rc={rc}")
         if attempt < ATTEMPTS - 1:
             backoffs = _backoffs()
             delay = backoffs[min(attempt, len(backoffs) - 1)]
