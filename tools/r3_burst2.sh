@@ -52,3 +52,11 @@ echo "=== 1x1 done $(date +%H:%M:%S) ===" | tee -a /tmp/r3_lab2.log
 python -u -m tpu_stencil.runtime.bench_sweep --backends xla,pallas \
     --stress --frames 8 --csv docs/BENCHMARKS.csv > /tmp/r3_sweep.log 2>&1
 echo "=== sweep done $(date +%H:%M:%S) ===" | tee -a /tmp/r3_lab2.log
+
+# 6. Regenerate the published table from the fresh CSV (so the artifacts
+# are complete even if this runs unattended after the session).
+python tools/gen_benchmarks_md.py docs/BENCHMARKS.csv \
+    --note "round 3, one TPU v5e chip via the axon tunnel, schedule=$SCHED ($(date +%F))" \
+    >> /tmp/r3_lab2.log 2>&1
+cp /tmp/r3_bench.json /root/repo/docs/BENCH_r03_preview.json 2>/dev/null || true
+echo "=== burst complete $(date +%H:%M:%S) ===" | tee -a /tmp/r3_lab2.log
