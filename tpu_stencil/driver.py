@@ -20,7 +20,7 @@ import numpy as np
 from tpu_stencil.config import JobConfig
 from tpu_stencil.io import images as images_io
 from tpu_stencil.io import raw as raw_io
-from tpu_stencil.models.blur import IteratedConv2D, resolve_backend
+from tpu_stencil.models.blur import IteratedConv2D
 from tpu_stencil.utils.timing import Timer, max_across_processes
 
 

@@ -19,7 +19,7 @@ mesh shape can use any format.
 from __future__ import annotations
 
 import os
-from typing import Optional, Tuple
+from typing import Tuple
 
 import numpy as np
 

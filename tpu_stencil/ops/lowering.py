@@ -36,7 +36,6 @@ Exactness arguments (vs the int64 golden model in
 from __future__ import annotations
 
 import dataclasses
-import math
 from fractions import Fraction
 from typing import Optional, Tuple
 

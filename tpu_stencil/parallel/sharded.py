@@ -16,7 +16,6 @@ semantics at the true image edge.
 
 from __future__ import annotations
 
-import functools
 from typing import Optional, Sequence, Tuple
 
 import numpy as np
