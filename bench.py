@@ -210,16 +210,24 @@ def _run_child(env):
         [sys.executable, os.path.abspath(__file__)],
         env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
     )
-    err_chunks = []
+    # One owner per pipe: communicate() would race the stderr drain thread
+    # for the same fd and silently drop whatever its internal reader
+    # consumed — the child's diagnostic trail must survive intact.
+    err_chunks, out_chunks = [], []
     progressed = threading.Event()
 
-    def drain():
+    def drain_err():
         for line in proc.stderr:
             err_chunks.append(line)
             progressed.set()
 
-    t = threading.Thread(target=drain, daemon=True)
-    t.start()
+    def drain_out():
+        out_chunks.append(proc.stdout.read())
+
+    t_err = threading.Thread(target=drain_err, daemon=True)
+    t_out = threading.Thread(target=drain_out, daemon=True)
+    t_err.start()
+    t_out.start()
     start = time.time()
     while (proc.poll() is None and not progressed.is_set()
            and time.time() - start < INIT_TIMEOUT):
@@ -227,22 +235,28 @@ def _run_child(env):
     if proc.poll() is None and not progressed.is_set():
         proc.kill()
         proc.wait()
-        t.join(2)
-        return None, "", "".join(err_chunks) + (
+        t_err.join(2)
+        t_out.join(2)
+        return None, "".join(out_chunks), "".join(err_chunks) + (
             f"\nno child output within {INIT_TIMEOUT}s "
             "(backend init hung - tunnel down?)\n"
         )
+    # The watchdog window counts against the attempt budget: total wall
+    # clock per attempt stays <= CHILD_TIMEOUT, not INIT + CHILD.
+    remaining = max(CHILD_TIMEOUT - (time.time() - start), 1.0)
     try:
-        out, _ = proc.communicate(timeout=CHILD_TIMEOUT)
+        proc.wait(timeout=remaining)
     except subprocess.TimeoutExpired:
         proc.kill()
-        out, _ = proc.communicate()
-        t.join(2)
-        return None, out, "".join(err_chunks) + (
+        proc.wait()
+        t_err.join(2)
+        t_out.join(2)
+        return None, "".join(out_chunks), "".join(err_chunks) + (
             f"\ntimed out after {CHILD_TIMEOUT}s\n"
         )
-    t.join(2)
-    return proc.returncode, out, "".join(err_chunks)
+    t_err.join(5)
+    t_out.join(5)
+    return proc.returncode, "".join(out_chunks), "".join(err_chunks)
 
 
 def main() -> int:

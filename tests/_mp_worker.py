@@ -65,16 +65,20 @@ def main() -> None:
         print(f"proc {proc_id} done", flush=True)
         return
 
-    if mode == "frames":
+    if mode.startswith("frames"):
         # Multi-host --frames: each process computes and writes its own
-        # contiguous frame range into the shared output (offset I/O); 3
-        # frames over 2 processes exercises an uneven split (2 + 1).
+        # contiguous frame range into the shared output (offset I/O),
+        # batch-sharding its local frames over its 2 local devices. 3
+        # frames over 2 processes exercises an uneven split (2 + 1, the
+        # second host running a single device); 5 exercises per-host
+        # zero-frame padding (3 local frames over 2 devices).
         from tpu_stencil import driver
         from tpu_stencil.config import ImageType, JobConfig
 
+        n_frames = int(mode[len("frames"):] or 3)
         cfg = JobConfig(
             image=img_path, width=8, height=10, repetitions=2,
-            image_type=ImageType.RGB, backend="xla", frames=3,
+            image_type=ImageType.RGB, backend="xla", frames=n_frames,
             output=out_path,
         )
         res = driver.run_job(cfg)

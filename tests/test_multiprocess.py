@@ -201,10 +201,14 @@ def test_two_process_autotune_backend_agreement(tmp_path, rng):
     np.testing.assert_array_equal(got, want)
 
 
-def test_two_process_frames_ranges(tmp_path, rng):
-    # Multi-host --frames: process 0 owns frames [0,2), process 1 frame
-    # [2,3); both write their byte ranges into one shared output.
-    frames = rng.integers(0, 256, size=(3, 10, 8, 3), dtype=np.uint8)
+@pytest.mark.parametrize("n_frames", [3, 5])
+def test_two_process_frames_ranges(tmp_path, rng, n_frames):
+    # Multi-host --frames: each process owns a contiguous frame range and
+    # batch-shards it over its 2 local devices, writing its byte range
+    # into one shared output. n_frames=3: uneven host split (2 + 1, host 1
+    # on a single device); n_frames=5: per-host padding (3 local frames
+    # over 2 devices — the zero pad frame must be cropped before write).
+    frames = rng.integers(0, 256, size=(n_frames, 10, 8, 3), dtype=np.uint8)
     src = str(tmp_path / "clip.raw")
     dst = str(tmp_path / "out.raw")
     frames.tofile(src)
@@ -220,7 +224,7 @@ def test_two_process_frames_ranges(tmp_path, rng):
     procs = [
         subprocess.Popen(
             [sys.executable, _WORKER, str(pid), coordinator, src, dst,
-             "1", "2", "frames"],
+             "1", "2", f"frames{n_frames}"],
             env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
             text=True,
         )
@@ -230,8 +234,8 @@ def test_two_process_frames_ranges(tmp_path, rng):
     for p, out in zip(procs, outs):
         assert p.returncode == 0, f"worker failed:\n{out}"
 
-    got = np.fromfile(dst, np.uint8).reshape(3, 10, 8, 3)
-    for k in range(3):
+    got = np.fromfile(dst, np.uint8).reshape(n_frames, 10, 8, 3)
+    for k in range(n_frames):
         want = stencil.reference_stencil_numpy(
             frames[k], filters.get_filter("gaussian"), 2
         )

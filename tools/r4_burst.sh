@@ -1,0 +1,68 @@
+#!/bin/bash
+# Round-4 burst: the full hardware checklist, run on tunnel recovery.
+# Same sequence as r3_burst2.sh but with round-4 provenance and every
+# artifact copied into the repo as soon as it exists (VERDICT r3 item 7:
+# a successful burst must leave committed evidence even if the driver's
+# capture window times out later).
+# Logs: /tmp/r4_bench.json + .log (north star, all schedules),
+#       /tmp/r4_lab.log (op-level lab, informational),
+#       /tmp/r4_autotune.log, /tmp/r4_1x1.log, /tmp/r4_sweep.log.
+set -u
+cd /root/repo
+
+: > /tmp/r4_lab.log
+echo "=== r4 burst start $(date +%H:%M:%S) ===" | tee -a /tmp/r4_lab.log
+
+# 1. North-star capture: measures XLA + every pallas schedule on the
+# SHIPPED kernel and reports the best (retry-hardened).
+python -u bench.py > /tmp/r4_bench.json 2> /tmp/r4_bench.log
+echo "=== bench done rc=$? $(date +%H:%M:%S) ===" | tee -a /tmp/r4_lab.log
+# Commit-able preview immediately (before anything else can fail).
+cp /tmp/r4_bench.json /root/repo/docs/BENCH_r04_preview.json 2>/dev/null || true
+
+# Schedule verdict for the sweep/1x1 runs: the fastest measured schedule
+# of the shipped kernel (falls back to 'pad' if the capture failed).
+SCHED=$(python - <<'EOF'
+import json
+try:
+    r = json.load(open("/tmp/r4_bench.json"))
+    scheds = r.get("pallas_schedules_us_per_rep") or {}
+    print(min(scheds, key=scheds.get) if scheds else "pad")
+except Exception:
+    print("pad")
+EOF
+)
+echo "schedule verdict: $SCHED" | tee -a /tmp/r4_lab.log
+export TPU_STENCIL_PALLAS_SCHEDULE=$SCHED
+
+# 2. Kernel lab (informational: variant-level attribution)
+python -u tools/kernel_lab.py swar swar_strips swar_strips_1024 swar_b256 \
+    swar_f16_b256 shrink shrink_strips_1024 shipped >> /tmp/r4_lab.log 2>&1
+echo "=== lab done $(date +%H:%M:%S) ===" | tee -a /tmp/r4_lab.log
+
+# 3. Autotune cache evidence — real (backend, schedule) verdicts on chip
+python -c "import numpy as np; np.random.default_rng(0).integers(
+    0,256,(2520,1920,3),dtype=np.uint8).tofile('/tmp/bench_img.raw')"
+TPU_STENCIL_AUTOTUNE_CACHE=docs/autotune_v5e.json \
+    python -u -m tpu_stencil /tmp/bench_img.raw 1920 2520 40 rgb \
+    --backend autotune --time --output /tmp/o.raw > /tmp/r4_autotune.log 2>&1
+echo "=== autotune done rc=$? $(date +%H:%M:%S) ===" | tee -a /tmp/r4_lab.log
+
+# 4. Sharded Pallas compiled on chip: 1x1 mesh (VERDICT r3 item 4)
+python -u -m tpu_stencil /tmp/bench_img.raw 1920 2520 40 rgb \
+    --mesh 1x1 --backend pallas --time --output /tmp/o2.raw \
+    > /tmp/r4_1x1.log 2>&1
+echo "=== 1x1 done rc=$? $(date +%H:%M:%S) ===" | tee -a /tmp/r4_lab.log
+
+# 5. Full sweep incl. stress + frames (VERDICT r3 items 2/3)
+python -u -m tpu_stencil.runtime.bench_sweep --backends xla,pallas \
+    --stress --frames 8 --csv docs/BENCHMARKS.csv > /tmp/r4_sweep.log 2>&1
+echo "=== sweep done rc=$? $(date +%H:%M:%S) ===" | tee -a /tmp/r4_lab.log
+
+# 6. Regenerate the published table from the fresh CSV (so the artifacts
+# are complete even if this runs unattended after the session).
+python tools/gen_benchmarks_md.py docs/BENCHMARKS.csv \
+    --note "round 4, one TPU v5e chip via the axon tunnel, schedule=$SCHED ($(date +%F))" \
+    >> /tmp/r4_lab.log 2>&1
+cp /tmp/r4_lab.log /root/repo/docs/r4_lab.log 2>/dev/null || true
+echo "=== r4 burst complete $(date +%H:%M:%S) ===" | tee -a /tmp/r4_lab.log

@@ -161,9 +161,10 @@ def build_parser() -> argparse.ArgumentParser:
         "--schedule", default=None, choices=list(PALLAS_SCHEDULES),
         help="force the Pallas per-rep schedule (see docs/KERNEL.md); "
              "default: the autotuned winner (or the kernel default for an "
-             "explicit --backend pallas). Ignored by the XLA backend and "
-             "by --frames batch mode (which runs the vmapped XLA step); "
-             "schedules a plan cannot run degrade to their fallback",
+             "explicit --backend pallas). Applies to --frames batch mode "
+             "too when the backend resolves to pallas (the fused tall-image "
+             "kernel); ignored by the XLA backend; schedules a plan cannot "
+             "run degrade to their fallback",
     )
     p.add_argument(
         "--platform", default=None, choices=["cpu", "tpu", "gpu"],

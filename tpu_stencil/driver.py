@@ -65,6 +65,47 @@ def _put_batched(imgs: np.ndarray, devices):
     return arr, mesh
 
 
+def _place_frames(model, imgs: np.ndarray, devices):
+    """Place an (N, H, W[, C]) clip on ``devices`` (batch-axis sharding
+    when more than one — ``_put_batched`` zero-pads N to a device
+    multiple; callers crop) and build the step fn the batch path runs:
+    frames are device-local either way (one device holds the whole clip,
+    or one local clip per device under the 1-D 'b' mesh), so the fused
+    tall-image Pallas path applies when the model resolves to it;
+    otherwise the vmapped XLA step. Returns ``(img_dev, step_fn)``.
+
+    Shared by the single-host driver and the per-host half of
+    ``_run_frames_multihost`` — the backend/schedule decision must never
+    fork between them."""
+    n_dev = len(devices)
+    frame_shape = tuple(imgs.shape[1:3])
+    channels = imgs.shape[3] if imgs.ndim == 4 else 1
+    b_backend, b_schedule = model.batch_config(
+        frame_shape, channels, True, n_frames=-(-imgs.shape[0] // n_dev)
+    )
+    if n_dev > 1:
+        img_dev, bmesh = _put_batched(np.asarray(imgs), devices)
+        if b_backend == "pallas":
+            from tpu_stencil.parallel import sharded as _sharded
+
+            frames_fn = _sharded.build_batched_frames(
+                bmesh, model.plan, b_schedule,
+                interpret=jax.default_backend() == "cpu",
+            )
+
+            def step_fn(x, n):
+                return frames_fn(x, jax.numpy.int32(n))
+        else:
+            def step_fn(x, n):
+                return model.batch(x, n, single_device=False)
+    else:
+        img_dev = jax.device_put(jax.numpy.asarray(imgs), devices[0])
+
+        def step_fn(x, n):
+            return model.batch(x, n, single_device=True)
+    return img_dev, step_fn
+
+
 def _store_output(cfg: JobConfig, out: np.ndarray) -> None:
     """Write the result in the container format of the output path."""
     if cfg.frames > 1:
@@ -218,35 +259,10 @@ def run_job(
 
         start_rep, frame = _maybe_restore(cfg, resume)
         img = _load_input(cfg) if frame is None else frame
-        bmesh = None
-        if cfg.frames > 1 and n_dev > 1:
-            img_dev, bmesh = _put_batched(np.asarray(img), devices)
+        if cfg.frames > 1:
+            img_dev, step_fn = _place_frames(model, np.asarray(img), devices)
         else:
             img_dev = jax.device_put(jax.numpy.asarray(img), devices[0])
-        if cfg.frames > 1:
-            # Frames are device-local either way (single device, or one
-            # local clip per device under the 1-D batch mesh), so the
-            # fused tall-image Pallas path applies when the model resolves
-            # to it; otherwise the vmapped XLA step.
-            per_dev = -(-cfg.frames // n_dev)
-            b_backend, b_schedule = model.batch_config(
-                (cfg.height, cfg.width), cfg.channels, True,
-                n_frames=per_dev,
-            )
-            if b_backend == "pallas" and bmesh is not None:
-                from tpu_stencil.parallel import sharded as _sharded
-
-                frames_fn = _sharded.build_batched_frames(
-                    bmesh, model.plan, b_schedule,
-                    interpret=jax.default_backend() == "cpu",
-                )
-
-                def step_fn(x, n):
-                    return frames_fn(x, jax.numpy.int32(n))
-            else:
-                def step_fn(x, n):
-                    return model.batch(x, n, single_device=n_dev == 1)
-        else:
             step_fn = model
         img_dev = step_fn(img_dev, 0)  # warm-up compile; output == input
         img_dev.block_until_ready()
@@ -298,8 +314,10 @@ def _run_frames_multihost(cfg, model, profile_dir, checkpoint_every,
     """Multi-host ``--frames``: each process owns a contiguous frame range
     — frames are embarrassingly parallel, so the only shared state is the
     input/output files (per-host offset I/O, the MPI-IO pattern) and the
-    final max-reduce of the compute window. Every host runs the fused
-    tall-image path on its local frames (one device per host for now)."""
+    final max-reduce of the compute window. Every host batch-shards its
+    local frames over its local devices (a per-host 1-D 'b' mesh — purely
+    addressable-device computation, no cross-host collectives except the
+    final compute-window max)."""
     from tpu_stencil.io import native
 
     if checkpoint_every or resume:
@@ -308,8 +326,9 @@ def _run_frames_multihost(cfg, model, profile_dir, checkpoint_every,
         )
     if cfg.mesh_shape is not None:
         raise NotImplementedError(
-            "--mesh with multi-host --frames is not supported: each host "
-            "runs its own frame range on one local device"
+            "--mesh with multi-host --frames is not supported: frames "
+            "shard the batch axis over each host's local devices "
+            "automatically (spatial meshes do not apply to clips)"
         )
     p, n_proc = jax.process_index(), jax.process_count()
     per = -(-cfg.frames // n_proc)
@@ -318,25 +337,24 @@ def _run_frames_multihost(cfg, model, profile_dir, checkpoint_every,
     h, w, ch = cfg.height, cfg.width, cfg.channels
     compute = 0.0
     out = None
+    n_ld = 1
     if n_local:
         rows = raw_io.read_raw_rows(cfg.image, f0 * h, n_local * h, w, ch)
         imgs = rows.reshape(n_local, h, w, ch)
         if ch == 1:
             imgs = imgs[..., 0]
-        dev = jax.device_put(
-            jax.numpy.asarray(imgs), jax.local_devices()[0]
+        local_devs = jax.local_devices()
+        n_ld = min(len(local_devs), n_local)
+        dev, step_fn = _place_frames(
+            model, np.asarray(imgs), local_devs[:n_ld]
         )
-
-        def step_fn(x, n):
-            return model.batch(x, n, single_device=True)
-
         dev = step_fn(dev, 0)  # warm-up compile; output == input
         dev.block_until_ready()
         with _maybe_profile(profile_dir):
             out_dev, compute = _checkpointed_iterate(
                 cfg, step_fn, None, dev, 0, 0
             )
-        out = np.asarray(out_dev)
+        out = np.asarray(out_dev)[:n_local]  # crop device-multiple padding
     # Collective: every process participates, frame-less ones with 0.
     compute_seconds = max_across_processes(compute)
     native.set_size(cfg.output_path, cfg.frames * h * w * ch)
@@ -345,10 +363,10 @@ def _run_frames_multihost(cfg, model, profile_dir, checkpoint_every,
         raw_io.write_raw_block(
             cfg.output_path, f0 * h, 0, block, w, ch, cfg.frames * h
         )
-    # Report at this host's real frame count: a straggler host's shorter
-    # tall launch can degrade differently than a full one.
+    # Report at this host's real per-device frame count: a straggler
+    # host's shorter tall launch can degrade differently than a full one.
     backend, schedule = model.batch_config(
-        (h, w), ch, True, n_frames=n_local or per
+        (h, w), ch, True, n_frames=-(-(n_local or per) // n_ld)
     )
     return JobResult(
         output_path=cfg.output_path,

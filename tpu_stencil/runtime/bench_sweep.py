@@ -90,12 +90,20 @@ def _measure_batch_per_frame_rep(
     if backend == "pallas":
         from tpu_stencil.ops import pallas_stencil
 
+        # Mosaic compiles for TPU only; interpret is acceptable on CPU
+        # (where everything is slow anyway) but on any other platform a
+        # silently-interpreted run would be reported as a 'pallas' row —
+        # fail loudly instead (same guard as blur._iterate_impl).
+        plat = jax.default_backend()
+        if plat not in ("tpu", "cpu"):
+            raise NotImplementedError(
+                "the Pallas frames benchmark targets TPU (interpret mode "
+                f"on CPU); on {plat!r} sweep with --backends xla"
+            )
         fn = jax.jit(
             functools.partial(
                 pallas_stencil.iterate_frames, plan=model.plan,
-                # Mosaic compiles for TPU only; interpret elsewhere (the
-                # same guard every other pallas entry point applies).
-                interpret=jax.default_backend() != "tpu",
+                interpret=plat == "cpu",
             ),
             donate_argnums=0,
         )
@@ -140,11 +148,15 @@ def _pallas_label(filter_name: str, frame_h: int,
 
 def _with_retries(measure_fn, label: str, retries: int = 2):
     """Run one measurement with retry/backoff: transient tunnel drops must
-    not kill a (possibly hours-long) sweep."""
+    not kill a (possibly hours-long) sweep. Deterministic capability
+    errors (NotImplementedError guards) can never succeed on retry and
+    fail fast instead of burning the backoff budget."""
     last = None
     for attempt in range(retries + 1):
         try:
             return measure_fn()
+        except NotImplementedError:
+            raise
         except Exception as e:
             last = e
             print(f"row {label} attempt {attempt} failed: "
