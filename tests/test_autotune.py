@@ -87,6 +87,33 @@ def test_measures_once_then_caches(plan, tmp_path, monkeypatch):
     assert entry["us_per_rep"]["pallas[pack]"] == 1.0
 
 
+def test_cache_roundtrips_with_real_measurement(plan, tmp_path, monkeypatch):
+    # VERDICT r3 item 5: every other autotune test monkeypatches
+    # measure_backend; this one runs the REAL measurement machinery (tiny
+    # shape). Only the platform gate is spoofed (CPU short-circuits before
+    # the cache): xla is genuinely timed via iterate + steady-state
+    # differencing; the pallas candidates fail on CPU's missing Mosaic and
+    # are survived by the per-candidate guard. The verdict must land in
+    # the cache file and the second resolution must be a pure disk hit.
+    import jax
+
+    path = tmp_path / "c.json"
+    monkeypatch.setenv("TPU_STENCIL_AUTOTUNE_CACHE", str(path))
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+
+    got = autotune.best_config(plan, (32, 24), 1)
+    assert got == ("xla", None)  # the only candidate that runs on CPU
+    cache = json.load(open(str(path)))
+    (entry,) = cache.values()
+    assert entry["backend"] == "xla"
+    assert entry["us_per_rep"]["xla"] > 0  # a real, nonzero timing
+
+    def boom(*a, **k):
+        raise AssertionError("cache miss: second resolution re-measured")
+
+    assert autotune.best_config(plan, (32, 24), 1, measure=boom) == got
+
+
 def test_distinct_shapes_get_distinct_keys(plan, tmp_path, monkeypatch):
     import jax
 

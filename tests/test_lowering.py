@@ -45,6 +45,39 @@ def test_plan_matches_golden(rng, name):
     np.testing.assert_array_equal(got, want)
 
 
+def test_binomial_chain_detection():
+    assert lowering._binomial_chain((1, 2, 1)) == 2
+    assert lowering._binomial_chain((1, 4, 6, 4, 1)) == 4
+    assert lowering._binomial_chain((1, 6, 15, 20, 15, 6, 1)) == 6
+    assert lowering._binomial_chain((1, 1, 1)) is None  # box is not binomial
+    assert lowering._binomial_chain((1,)) == 0  # identity: no chain needed
+
+
+@pytest.mark.parametrize("name", ["gaussian", "gaussian5", "gaussian7", "box"])
+@pytest.mark.parametrize("reps", [1, 3])
+def test_pair_add_plans_match_golden(rng, name, reps):
+    # The pair-add chain computes the same integer sums in a different
+    # association — bit-exactness must be unchanged (box has non-binomial
+    # taps and must silently keep the MAC path).
+    import dataclasses
+
+    from tpu_stencil.models.blur import iterate
+
+    f = filters.get_filter(name)
+    plan = dataclasses.replace(lowering.plan_filter(f), xla_pair_add=True)
+    img = rng.integers(0, 256, size=(13, 11, 3), dtype=np.uint8)
+    got = np.asarray(iterate(img, reps, plan=plan, backend="xla"))
+    want = stencil.reference_stencil_numpy(img, f, reps)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_pair_add_env_flag_sets_new_plans(monkeypatch):
+    monkeypatch.setenv("TPU_STENCIL_XLA_PAIR_ADD", "1")
+    assert lowering.plan_filter(filters.get_filter("gaussian")).xla_pair_add
+    monkeypatch.delenv("TPU_STENCIL_XLA_PAIR_ADD")
+    assert not lowering.plan_filter(filters.get_filter("gaussian")).xla_pair_add
+
+
 @pytest.mark.parametrize("name", ["gaussian", "edge"])
 def test_plan_matches_f32_fallback_for_exact_filters(rng, name):
     # the fast integer plans and the f32 plan agree for exact filters

@@ -468,6 +468,21 @@ def main():
                 return ps.iterate(x, jnp.int32(n), plan)
             time_variant("shipped(iterate)", shipped, img, 8, check=False)
             continue
+        if name in ("xla", "xla_pair"):
+            # The XLA lowering A/B: per-tap MACs vs the binomial pair-add
+            # chain (lowering._sep_pass). Distinct plans -> distinct jit
+            # cache entries, so both really retrace.
+            import dataclasses as _dc
+
+            from tpu_stencil.models import blur as _blur
+
+            p2 = _dc.replace(plan, xla_pair_add=name == "xla_pair")
+
+            def xla_it(x, n, _p=p2):
+                return _blur.iterate(x, n, plan=_p, backend="xla")
+
+            time_variant(name, xla_it, img, 8, plan=plan)
+            continue
         opts = dict(VARIANTS[name])
         bh = opts.pop("block_h", 128)
         fz = opts.pop("fuse", 8)

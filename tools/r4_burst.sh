@@ -35,9 +35,11 @@ EOF
 echo "schedule verdict: $SCHED" | tee -a /tmp/r4_lab.log
 export TPU_STENCIL_PALLAS_SCHEDULE=$SCHED
 
-# 2. Kernel lab (informational: variant-level attribution)
+# 2. Kernel lab (informational: variant-level attribution) + the XLA
+# pair-add A/B (lowering.StencilPlan.xla_pair_add)
 python -u tools/kernel_lab.py swar swar_strips swar_strips_1024 swar_b256 \
-    swar_f16_b256 shrink shrink_strips_1024 shipped >> /tmp/r4_lab.log 2>&1
+    swar_f16_b256 shrink shrink_strips_1024 shipped xla xla_pair \
+    >> /tmp/r4_lab.log 2>&1
 echo "=== lab done $(date +%H:%M:%S) ===" | tee -a /tmp/r4_lab.log
 
 # 3. Autotune cache evidence — real (backend, schedule) verdicts on chip

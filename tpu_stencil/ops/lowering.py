@@ -36,7 +36,9 @@ Exactness arguments (vs the int64 golden model in
 from __future__ import annotations
 
 import dataclasses
+import os
 from fractions import Fraction
+from math import comb
 from typing import Optional, Tuple
 
 import numpy as np
@@ -60,6 +62,13 @@ class StencilPlan:
     row_taps: Optional[Tuple[int, ...]] = None  # sep_int: pass along rows axis
     col_taps: Optional[Tuple[int, ...]] = None  # sep_int: pass along cols axis
     shift: Optional[int] = None          # dyadic fast path: >> shift
+    # XLA sep_int passes lower binomial taps to pair-add chains (no
+    # multiplies — r3 op costing: full-tile i32 multiply ~60 us/pass vs
+    # ~9 for adds) instead of per-tap MACs. A plan field, not an env read
+    # inside the pass, so flipping it retraces (it is part of every jit
+    # cache key). Opt-in until the hardware A/B lands (kernel_lab
+    # 'xla'/'xla_pair'; TPU_STENCIL_XLA_PAIR_ADD=1 flips new plans).
+    xla_pair_add: bool = False
 
     @property
     def halo(self) -> int:
@@ -97,6 +106,7 @@ def plan_filter(f: Filter) -> StencilPlan:
     k = f.k
     taps_t = tuple(tuple(float(v) for v in row) for row in taps)
     ti = _as_int_matrix(taps)
+    pair = os.environ.get("TPU_STENCIL_XLA_PAIR_ADD") == "1"
 
     # Fast integer plans are only selected when they provably reproduce the
     # defined semantics (= the golden model in reference_stencil_numpy):
@@ -122,6 +132,7 @@ def plan_filter(f: Filter) -> StencilPlan:
                         row_taps=tuple(int(v) for v in col_red),
                         col_taps=tuple(int(v) for v in r0),
                         shift=int(eff.numerator).bit_length() - 1,
+                        xla_pair_add=pair,
                     )
                 if eff_int and bound < _EXACT_F32:
                     # exact convert + one correctly-rounded divide of the
@@ -132,6 +143,7 @@ def plan_filter(f: Filter) -> StencilPlan:
                         row_taps=tuple(int(v) for v in col_red),
                         col_taps=tuple(int(v) for v in r0),
                         shift=None,
+                        xla_pair_add=pair,
                     )
         bound = 255 * int(np.abs(ti).sum())
         if f.is_dyadic and bound < _I32_MAX:
@@ -153,11 +165,37 @@ def plan_filter(f: Filter) -> StencilPlan:
 # --------------------------------------------------------------------------
 
 
-def _sep_pass(x: jax.Array, taps: Tuple[int, ...], dim: int) -> jax.Array:
+def _binomial_chain(taps: Tuple[int, ...]) -> Optional[int]:
+    """``k-1`` when ``taps`` is the binomial row C(k-1, i) — the whole
+    gaussian family, since gaussian<k> is the (k-1)-fold self-convolution
+    of (1, 1) — else None."""
+    k = len(taps)
+    if tuple(taps) == tuple(comb(k - 1, i) for i in range(k)):
+        return k - 1
+    return None
+
+
+def _sep_pass(x: jax.Array, taps: Tuple[int, ...], dim: int,
+              pair_add: bool = False) -> jax.Array:
     """Valid 1-D integer correlation along ``dim`` (static taps, zeros
-    skipped, 1-multiplies elided)."""
+    skipped, 1-multiplies elided). ``pair_add`` lowers binomial taps to a
+    pair-add chain: d applications of ``y[i] = x[i] + x[i+1]`` produce
+    exactly ``sum_i C(d, i) x[i]`` — same integer values in any order, so
+    bit-exactness is unchanged, and the per-tap multiplies disappear.
+    Intermediates are partial sums of the final nonnegative accumulation,
+    so the plan's existing int32/f32 bounds cover them."""
     k = len(taps)
     n = x.shape[dim] - (k - 1)
+    chain = _binomial_chain(taps) if pair_add else None
+    if chain:
+        acc = x
+        for _ in range(chain):
+            m = acc.shape[dim] - 1
+            lo = [slice(None)] * x.ndim
+            hi = [slice(None)] * x.ndim
+            lo[dim], hi[dim] = slice(0, m), slice(1, m + 1)
+            acc = acc[tuple(lo)] + acc[tuple(hi)]
+        return acc
     acc = None
     for i, t in enumerate(taps):
         if t == 0:
@@ -191,8 +229,8 @@ def valid_step(ext_u8: jax.Array, plan: StencilPlan) -> jax.Array:
     """
     if plan.kind == "sep_int":
         xi = ext_u8.astype(jnp.int32)
-        a = _sep_pass(xi, plan.row_taps, 0)
-        b = _sep_pass(a, plan.col_taps, 1)
+        a = _sep_pass(xi, plan.row_taps, 0, plan.xla_pair_add)
+        b = _sep_pass(a, plan.col_taps, 1, plan.xla_pair_add)
         return _finish_int(b, plan)
     if plan.kind == "direct_int":
         xi = ext_u8.astype(jnp.int32)
@@ -247,13 +285,15 @@ def _original_divisor(plan: StencilPlan) -> float:
 def sep_rows_pass(xi32: jax.Array, plan: StencilPlan) -> jax.Array:
     """sep_int phase 1: valid 1-D pass along rows (dim 0) of a dim-0-extended
     int32 array."""
-    return _sep_pass(xi32, plan.row_taps, 0)
+    return _sep_pass(xi32, plan.row_taps, 0, plan.xla_pair_add)
 
 
 def sep_cols_pass(acc_i32: jax.Array, plan: StencilPlan) -> jax.Array:
     """sep_int phase 2: valid 1-D pass along cols (dim 1) of a dim-1-extended
     int32 intermediate, then the finishing shift/divide."""
-    return _finish_int(_sep_pass(acc_i32, plan.col_taps, 1), plan)
+    return _finish_int(
+        _sep_pass(acc_i32, plan.col_taps, 1, plan.xla_pair_add), plan
+    )
 
 
 def padded_step(img_u8: jax.Array, plan: StencilPlan,

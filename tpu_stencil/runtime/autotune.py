@@ -45,10 +45,16 @@ def _key(plan: StencilPlan, shape: Tuple[int, int], channels: int) -> str:
     taps = ";".join(",".join(str(v) for v in row) for row in plan.taps)
     # jax.__version__ in the key: a runtime upgrade can flip which backend
     # wins, so verdicts must not outlive the stack they were measured on.
-    return "|".join(
+    key = "|".join(
         [jax.default_backend(), jax.__version__, plan.kind,
          str(plan.divisor), taps, f"{shape[0]}x{shape[1]}x{channels}"]
     )
+    # The XLA lowering variant changes what "xla" costs, so a verdict
+    # measured under one lowering must not answer for the other (appended
+    # only when set, keeping default-path keys stable across builds).
+    if plan.xla_pair_add:
+        key += "|pair"
+    return key
 
 
 def _load_cache() -> dict:
