@@ -37,8 +37,11 @@ def main() -> None:
     from tpu_stencil.parallel import distributed
 
     # Before any JAX computation — the constraint initialize() documents.
-    distributed.initialize(coordinator, num_processes=2, process_id=proc_id)
-    assert jax.process_count() == 2, jax.process_count()
+    n_procs = int(os.environ.get("MP_WORKER_NPROCS", "2"))
+    distributed.initialize(
+        coordinator, num_processes=n_procs, process_id=proc_id
+    )
+    assert jax.process_count() == n_procs, jax.process_count()
 
     if mode == "mesh":
         # DCN-aware auto factorization: a wide image whose unconstrained
@@ -62,6 +65,74 @@ def main() -> None:
         from jax.experimental import multihost_utils
 
         multihost_utils.sync_global_devices("mesh_done")
+        print(f"proc {proc_id} done", flush=True)
+        return
+
+    if mode.startswith("framesckpt"):
+        # Multi-host --frames with checkpointing: every process writes its
+        # frame range into the shared versioned data file each chunk and
+        # joins the commit barrier; artifacts are swept after the finish.
+        # framesckpt1 leaves process 1 frame-less — it must still run the
+        # commit-barrier schedule or every checkpoint deadlocks.
+        from tpu_stencil import driver
+        from tpu_stencil.config import ImageType, JobConfig
+
+        cfg = JobConfig(
+            image=img_path, width=8, height=10, repetitions=3,
+            image_type=ImageType.RGB, backend="xla",
+            frames=int(mode[len("framesckpt"):] or 5),
+            output=out_path,
+        )
+        driver.run_job(cfg, checkpoint_every=1)
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices("framesckpt_done")
+        print(f"proc {proc_id} done", flush=True)
+        return
+
+    if mode == "framesresume":
+        # Multi-host --frames resume: seed a rep-1 checkpoint holding a
+        # DIFFERENT clip's state, then resume — the run must continue from
+        # the checkpoint bytes, not re-read the input (the final output
+        # below is checked against the seeded clip's golden, not the
+        # input's).
+        import numpy as np
+
+        from tpu_stencil import driver, filters as flt
+        from tpu_stencil.config import ImageType, JobConfig
+        from tpu_stencil.ops import stencil as st
+        from tpu_stencil.runtime import checkpoint as ckpt
+
+        n_frames = 5
+        cfg = JobConfig(
+            image=img_path, width=8, height=10, repetitions=3,
+            image_type=ImageType.RGB, backend="xla", frames=n_frames,
+            output=out_path,
+        )
+        per = -(-n_frames // jax.process_count())
+        f0 = proc_id * per
+        n_local = max(0, min(n_frames, f0 + per) - f0)
+        clip_b = np.random.default_rng(99).integers(
+            0, 256, (n_frames, 10, 8, 3), np.uint8
+        )
+        g = flt.get_filter("gaussian")
+        seed = (
+            np.stack([
+                st.reference_stencil_numpy(clip_b[k], g, 1)
+                for k in range(f0, f0 + n_local)
+            ]) if n_local else None
+        )
+        ckpt.save_frames_sharded(cfg, 1, seed, f0)  # collective commit
+        from jax.experimental import multihost_utils
+
+        # The commit barrier precedes rank 0's metadata publish; a reader
+        # starting immediately could see no/stale metadata. Real resumes
+        # happen in a later process; here the same processes resume, so
+        # order the publish before the restore explicitly.
+        multihost_utils.sync_global_devices("seed_committed")
+        driver.run_job(cfg, resume=True)
+
+        multihost_utils.sync_global_devices("framesresume_done")
         print(f"proc {proc_id} done", flush=True)
         return
 

@@ -240,3 +240,62 @@ def test_two_process_frames_ranges(tmp_path, rng, n_frames):
             frames[k], filters.get_filter("gaussian"), 2
         )
         np.testing.assert_array_equal(got[k], want, err_msg=f"frame {k}")
+
+
+@pytest.mark.parametrize("mode,n_frames,n_procs,reps_from_input", [
+    ("framesckpt5", 5, 2, True),
+    # 2 frames over 3 processes: process 2 is frame-less and must still
+    # run the commit-barrier schedule (else every checkpoint deadlocks).
+    ("framesckpt2", 2, 3, True),
+    ("framesresume", 5, 2, False),
+])
+def test_two_process_frames_checkpointing(tmp_path, rng, mode, n_frames,
+                                          n_procs, reps_from_input):
+    # framesckpt*: the full driver path with --checkpoint-every 1 — every
+    # process writes its frame byte range into the shared versioned data
+    # file each chunk, all processes join each commit barrier (including
+    # any frame-less ones), artifacts are swept at the finish.
+    # framesresume: a pre-seeded rep-1 checkpoint holds a DIFFERENT
+    # clip's state; the resumed run must produce that clip's 3-rep golden
+    # (proof it continued from checkpoint bytes, not the input file).
+    frames = rng.integers(0, 256, size=(n_frames, 10, 8, 3), dtype=np.uint8)
+    src = str(tmp_path / "clip.raw")
+    dst = str(tmp_path / "out.raw")
+    frames.tofile(src)
+
+    coordinator = f"127.0.0.1:{_free_port()}"
+    env = dict(
+        os.environ,
+        MP_WORKER_NPROCS=str(n_procs),
+        PYTHONPATH=os.pathsep.join(
+            [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
+            + os.environ.get("PYTHONPATH", "").split(os.pathsep)
+        ),
+    )
+    procs = [
+        subprocess.Popen(
+            [sys.executable, _WORKER, str(pid), coordinator, src, dst,
+             "1", "2", mode],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for pid in range(n_procs)
+    ]
+    outs = [p.communicate(timeout=300)[0] for p in procs]
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, f"worker failed:\n{out}"
+
+    if reps_from_input:
+        want_clip = frames
+    else:
+        want_clip = np.random.default_rng(99).integers(
+            0, 256, (n_frames, 10, 8, 3), np.uint8
+        )
+    got = np.fromfile(dst, np.uint8).reshape(n_frames, 10, 8, 3)
+    for k in range(n_frames):
+        want = stencil.reference_stencil_numpy(
+            want_clip[k], filters.get_filter("gaussian"), 3
+        )
+        np.testing.assert_array_equal(got[k], want, err_msg=f"frame {k}")
+    leftovers = [f for f in os.listdir(tmp_path) if ".ckpt" in f]
+    assert leftovers == [], f"checkpoint artifacts not swept: {leftovers}"

@@ -317,13 +317,13 @@ def _run_frames_multihost(cfg, model, profile_dir, checkpoint_every,
     final max-reduce of the compute window. Every host batch-shards its
     local frames over its local devices (a per-host 1-D 'b' mesh — purely
     addressable-device computation, no cross-host collectives except the
-    final compute-window max)."""
+    final compute-window max). Checkpoints use the sharded frames format:
+    every process writes its frame range into one shared versioned data
+    file each chunk (``checkpoint.save_frames_sharded``) — frame-less
+    processes still join every commit barrier."""
     from tpu_stencil.io import native
+    from tpu_stencil.runtime import checkpoint as ckpt
 
-    if checkpoint_every or resume:
-        raise NotImplementedError(
-            "--frames checkpoint/resume is single-host for now"
-        )
     if cfg.mesh_shape is not None:
         raise NotImplementedError(
             "--mesh with multi-host --frames is not supported: frames "
@@ -335,14 +335,27 @@ def _run_frames_multihost(cfg, model, profile_dir, checkpoint_every,
     f0, f1 = p * per, min(cfg.frames, (p + 1) * per)
     n_local = max(0, f1 - f0)
     h, w, ch = cfg.height, cfg.width, cfg.channels
+    start_rep, restored = 0, None
+    if resume:
+        r = ckpt.restore_frames_sharded(cfg, f0, n_local)
+        if r is not None:
+            start_rep, restored = r
+
+    def save_fn(rep, d):
+        local = np.asarray(d)[:n_local] if n_local else None
+        ckpt.save_frames_sharded(cfg, rep, local, f0)
+
     compute = 0.0
     out = None
     n_ld = 1
     if n_local:
-        rows = raw_io.read_raw_rows(cfg.image, f0 * h, n_local * h, w, ch)
-        imgs = rows.reshape(n_local, h, w, ch)
-        if ch == 1:
-            imgs = imgs[..., 0]
+        if restored is None:
+            rows = raw_io.read_raw_rows(cfg.image, f0 * h, n_local * h, w, ch)
+            imgs = rows.reshape(n_local, h, w, ch)
+            if ch == 1:
+                imgs = imgs[..., 0]
+        else:
+            imgs = restored
         local_devs = jax.local_devices()
         n_ld = min(len(local_devs), n_local)
         dev, step_fn = _place_frames(
@@ -352,9 +365,18 @@ def _run_frames_multihost(cfg, model, profile_dir, checkpoint_every,
         dev.block_until_ready()
         with _maybe_profile(profile_dir):
             out_dev, compute = _checkpointed_iterate(
-                cfg, step_fn, None, dev, 0, 0
+                cfg, step_fn, save_fn, dev, checkpoint_every, start_rep
             )
         out = np.asarray(out_dev)[:n_local]  # crop device-multiple padding
+    elif checkpoint_every:
+        # Frame-less process: THE SAME chunk loop as the compute path (a
+        # no-op run on a dummy carry) so its save/commit-barrier schedule
+        # can never diverge from the frame-owning processes'.
+        _checkpointed_iterate(
+            cfg, lambda x, n: x, save_fn,
+            jax.numpy.zeros((), jax.numpy.uint8), checkpoint_every,
+            start_rep,
+        )
     # Collective: every process participates, frame-less ones with 0.
     compute_seconds = max_across_processes(compute)
     native.set_size(cfg.output_path, cfg.frames * h * w * ch)
@@ -363,6 +385,10 @@ def _run_frames_multihost(cfg, model, profile_dir, checkpoint_every,
         raw_io.write_raw_block(
             cfg.output_path, f0 * h, 0, block, w, ch, cfg.frames * h
         )
+    if checkpoint_every or resume:
+        # Everyone is past restore and compute (the max-reduce above is a
+        # collective); process 0 sweeps the checkpoint artifacts.
+        ckpt.clear(cfg)
     # Report at this host's real per-device frame count: a straggler
     # host's shorter tall launch can degrade differently than a full one.
     backend, schedule = model.batch_config(

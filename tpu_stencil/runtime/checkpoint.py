@@ -46,6 +46,41 @@ def _fingerprint(cfg: JobConfig) -> dict:
     }
 
 
+def _check_meta(meta: dict, cfg: JobConfig, where: str) -> None:
+    """Refuse a checkpoint written for a different job. Pre-boundary
+    checkpoints lack the key; they were all written under zero-boundary
+    semantics (the only mode that existed)."""
+    want = _fingerprint(cfg)
+    if {k: meta.get(k, "zero" if k == "boundary" else None)
+            for k in want} != want:
+        raise ValueError(
+            f"checkpoint at {where} was written for a different job "
+            f"({meta} != {want}); delete it or change --output"
+        )
+
+
+def _commit_meta(cfg: JobConfig, rep: int, versioned: str) -> None:
+    """Sharded-format commit: after a cross-host barrier (every writer's
+    data is durable), process 0 atomically publishes the metadata naming
+    the versioned data file, then sweeps older versions."""
+    import jax
+
+    data_path, meta_path = _paths(cfg)
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(f"ckpt_data_r{rep}")
+    if jax.process_index() == 0:
+        meta = dict(_fingerprint(cfg), rep=rep,
+                    data=os.path.basename(versioned))
+        tmp_meta = meta_path + ".tmp"
+        with open(tmp_meta, "w") as f:
+            json.dump(meta, f)
+        os.replace(tmp_meta, meta_path)
+        for name in _stale_versions(data_path, before_rep=rep):
+            os.remove(name)
+
+
 def save(cfg: JobConfig, rep: int, frame: np.ndarray) -> None:
     """Atomically persist the frame as the state after ``rep`` repetitions."""
     data_path, meta_path = _paths(cfg)
@@ -67,15 +102,7 @@ def restore(cfg: JobConfig) -> Optional[Tuple[int, np.ndarray]]:
         return None
     with open(meta_path) as f:
         meta = json.load(f)
-    want = _fingerprint(cfg)
-    # Pre-boundary checkpoints lack the key; they were all written
-    # under zero-boundary semantics (the only mode that existed).
-    if {k: meta.get(k, 'zero' if k == 'boundary' else None)
-            for k in want} != want:
-        raise ValueError(
-            f"checkpoint at {data_path} was written for a different job "
-            f"({meta} != {want}); delete it or change --output"
-        )
+    _check_meta(meta, cfg, data_path)
     path = data_path
     if meta.get("data"):  # sharded-format checkpoint: versioned data file
         path = os.path.join(os.path.dirname(data_path) or ".", meta["data"])
@@ -104,27 +131,68 @@ def save_sharded(cfg: JobConfig, rep: int, out_dev) -> None:
     data is complete on every host. Requires a shared filesystem, the same
     assumption the reference's MPI-IO made (SURVEY.md §2 C6/C16).
     """
-    import jax
-
     from tpu_stencil.parallel import distributed
 
-    data_path, meta_path = _paths(cfg)
+    data_path, _ = _paths(cfg)
     versioned = f"{data_path}.r{rep}"
     distributed.write_sharded(
         versioned, out_dev, cfg.height, cfg.width, cfg.channels
     )
-    if jax.process_count() > 1:
-        from jax.experimental import multihost_utils
+    _commit_meta(cfg, rep, versioned)
 
-        multihost_utils.sync_global_devices(f"ckpt_data_r{rep}")
-    if jax.process_index() == 0:
-        meta = dict(_fingerprint(cfg), rep=rep, data=os.path.basename(versioned))
-        tmp_meta = meta_path + ".tmp"
-        with open(tmp_meta, "w") as f:
-            json.dump(meta, f)
-        os.replace(tmp_meta, meta_path)
-        for name in _stale_versions(data_path, before_rep=rep):
-            os.remove(name)
+
+def save_frames_sharded(
+    cfg: JobConfig, rep: int, frames_local, f0: int
+) -> None:
+    """Multi-host ``--frames`` checkpoint: every process pwrites its
+    contiguous frame range [f0, f0 + n) into one shared versioned data
+    file (the clip's own byte layout), then — after the cross-host
+    barrier — process 0 commits the metadata. Frame-less processes pass
+    ``frames_local=None``: they write nothing but MUST still call this
+    every chunk (the commit barrier counts every process)."""
+    data_path, _ = _paths(cfg)
+    versioned = f"{data_path}.r{rep}"
+    frame_bytes = cfg.height * cfg.width * cfg.channels
+    if frames_local is not None and len(frames_local):
+        arr = np.ascontiguousarray(np.asarray(frames_local, np.uint8))
+        native.ensure_size(versioned, cfg.frames * frame_bytes)
+        native.pwrite_full(versioned, f0 * frame_bytes, arr.tobytes())
+    _commit_meta(cfg, rep, versioned)
+
+
+def restore_frames_sharded(
+    cfg: JobConfig, f0: int, n_local: int
+) -> Optional[Tuple[int, np.ndarray]]:
+    """Return (completed reps, this host's frames [f0, f0 + n_local))
+    from a matching checkpoint, or None. Sharded-format data is read by
+    byte range (each host touches only its own frames); a legacy
+    single-host whole-clip checkpoint is read whole and sliced, so
+    progress survives a switch to multi-host."""
+    data_path, meta_path = _paths(cfg)
+    if not os.path.exists(meta_path):
+        return None
+    with open(meta_path) as f:
+        meta = json.load(f)
+    _check_meta(meta, cfg, meta_path)
+    frame_bytes = cfg.height * cfg.width * cfg.channels
+    if meta.get("data"):
+        versioned = os.path.join(
+            os.path.dirname(data_path) or ".", meta["data"]
+        )
+        if not os.path.exists(versioned):
+            return None
+        buf = native.pread_full(
+            versioned, f0 * frame_bytes, n_local * frame_bytes
+        )
+        shape = (n_local, cfg.height, cfg.width)
+        if cfg.channels != 1:
+            shape += (cfg.channels,)
+        return int(meta["rep"]), np.frombuffer(buf, np.uint8).reshape(shape)
+    legacy = restore(cfg)
+    if legacy is None:
+        return None
+    rep, clip = legacy
+    return rep, clip[f0:f0 + n_local]
 
 
 def restore_sharded(cfg: JobConfig, sharding) -> Optional[Tuple[int, "object"]]:
@@ -143,15 +211,7 @@ def restore_sharded(cfg: JobConfig, sharding) -> Optional[Tuple[int, "object"]]:
         return None
     with open(meta_path) as f:
         meta = json.load(f)
-    want = _fingerprint(cfg)
-    # Pre-boundary checkpoints lack the key; they were all written
-    # under zero-boundary semantics (the only mode that existed).
-    if {k: meta.get(k, 'zero' if k == 'boundary' else None)
-            for k in want} != want:
-        raise ValueError(
-            f"checkpoint at {meta_path} was written for a different job "
-            f"({meta} != {want}); delete it or change --output"
-        )
+    _check_meta(meta, cfg, meta_path)
     if meta.get("data"):
         versioned = os.path.join(
             os.path.dirname(data_path) or ".", meta["data"]
