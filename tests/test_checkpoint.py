@@ -202,6 +202,46 @@ def test_cross_format_resume_both_directions(tmp_path, rng):
     checkpoint.clear(cfg)
 
 
+def test_frames_sharded_save_restore_round_trip(tmp_path, rng):
+    # Single-process exercise of the multi-host --frames checkpoint
+    # format: two "hosts" write disjoint frame byte ranges into the same
+    # versioned data file, each restores only its own range; the legacy
+    # whole-clip format restores sliced (cross-format resume).
+    cfg = _cfg(tmp_path, frames=5, image_type=ImageType.RGB, width=8,
+               height=10, output=str(tmp_path / "o.raw"))
+    clip = rng.integers(0, 256, size=(5, 10, 8, 3), dtype=np.uint8)
+    checkpoint.save_frames_sharded(cfg, 3, clip[:3], 0)
+    checkpoint.save_frames_sharded(cfg, 3, clip[3:], 3)
+    rep, back = checkpoint.restore_frames_sharded(cfg, 3, 2)
+    assert rep == 3
+    np.testing.assert_array_equal(back, clip[3:])
+    rep, back = checkpoint.restore_frames_sharded(cfg, 3, 0)  # frame-less
+    assert rep == 3 and back.shape == (0, 10, 8, 3)
+    # whole-clip restore() reads the same sharded-format data
+    rep, whole = checkpoint.restore(cfg)
+    np.testing.assert_array_equal(whole, clip)
+    checkpoint.clear(cfg)
+    # legacy single-host format restores sliced per host
+    checkpoint.save(cfg, 2, clip)
+    rep, back = checkpoint.restore_frames_sharded(cfg, 3, 2)
+    assert rep == 2
+    np.testing.assert_array_equal(back, clip[3:])
+    checkpoint.clear(cfg)
+    assert checkpoint.restore_frames_sharded(cfg, 0, 3) is None
+
+
+def test_frames_sharded_restore_refuses_other_job(tmp_path, rng):
+    cfg = _cfg(tmp_path, frames=4, image_type=ImageType.RGB, width=8,
+               height=10, output=str(tmp_path / "o.raw"))
+    clip = rng.integers(0, 256, size=(4, 10, 8, 3), dtype=np.uint8)
+    checkpoint.save_frames_sharded(cfg, 1, clip, 0)
+    other = _cfg(tmp_path, frames=4, image_type=ImageType.RGB, width=8,
+                 height=10, output=str(tmp_path / "o.raw"),
+                 filter_name="box")
+    with pytest.raises(ValueError, match="different job"):
+        checkpoint.restore_frames_sharded(other, 0, 2)
+
+
 def test_stale_version_sweep_is_rep_ordered(tmp_path, rng):
     # the GC must only collect files with a LOWER rep — a concurrently
     # appearing next-rep file (another host running ahead) must survive
