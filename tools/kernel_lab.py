@@ -64,6 +64,19 @@ def _rep_val(cur, *, plan, dt, wc, channels, opts):
     # rows pass
     if opts.get("no_rows"):
         acc = cur[h:h + rows_out, :]
+    elif (pair and opts.get("rows_roll")
+          and _binomial_chain(plan.row_taps) is not None):
+        # Sublane-roll chain: x[i+1] arrives via a full-tile rotate plus an
+        # ALIGNED add instead of a sublane-misaligned slice add (r3 op
+        # costs: misaligned slice add 50.7 us/pass vs roll ~19-28 + aligned
+        # add 8.9). Wrap garbage lands in the last `chain` rows — inside
+        # the contracted discard band, cropped by the aligned final slice.
+        acc = cur
+        for d in range(_binomial_chain(plan.row_taps)):
+            # out[i] = x[i] + x[i+1]; +1 expressed as the non-negative
+            # end-around rotate rows-1 (pltpu.roll rejects negatives).
+            acc = acc + pltpu.roll(acc, acc.shape[0] - 1, 0)
+        acc = acc[0:rows_out, :]
     elif pair and _binomial_chain(plan.row_taps) is not None:
         acc = cur
         for d in range(_binomial_chain(plan.row_taps)):
@@ -434,6 +447,7 @@ VARIANTS = {
     "shrink_pair_b256": dict(shrink=True, pair_add=True, block_h=256),
     "shrink_pair_f16_b256": dict(shrink=True, pair_add=True, block_h=256,
                                  fuse=16),
+    "shrink_rollrows": dict(shrink=True, pair_add=True, rows_roll=True),
     "shrink_strips": dict(shrink=True, strips=True),
     "shrink_strips_i32": dict(shrink=True, strips=True, i32=True),
     "shrink_strips_256": dict(shrink=True, strips=True, strip=256, i32=True),
