@@ -18,6 +18,7 @@ Usage:  python tools/kernel_lab.py [variant ...]
 from __future__ import annotations
 
 import functools
+import os
 import sys
 import time
 from math import comb
@@ -29,6 +30,12 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 sys.path.insert(0, ".")
+
+if os.environ.get("TPU_LAB_PLATFORM"):
+    # Rehearsal hook: select the platform through the config API (an env
+    # JAX_PLATFORMS is unwinnable under the axon sitecustomize). The real
+    # measurement runs leave this unset and use the default TPU.
+    jax.config.update("jax_platforms", os.environ["TPU_LAB_PLATFORM"])
 
 from tpu_stencil.ops import lowering as _lowering
 from tpu_stencil.ops import pallas_stencil as ps

@@ -7,8 +7,23 @@
 # Logs: /tmp/r4_bench.json + .log (north star, all schedules),
 #       /tmp/r4_lab.log (op-level lab, informational),
 #       /tmp/r4_autotune.log, /tmp/r4_1x1.log, /tmp/r4_sweep.log.
+#
+# Rehearsal knobs (CPU dry-run of the script logic before the one-shot
+# unattended hardware run; defaults = the real protocol): R4_W/R4_H/
+# R4_REPS shrink the CLI steps' image, R4_SWEEP_ARGS the sweep grid,
+# R4_LAB_VARIANTS the lab list, R4_CSV/R4_PREVIEW/R4_AT_CACHE/R4_LOG_COPY
+# redirect artifacts away from docs/. bench.py itself is shrunk via its
+# own TPU_STENCIL_BENCH_* env knobs.
 set -u
 cd /root/repo
+
+W=${R4_W:-1920}; H=${R4_H:-2520}; REPS=${R4_REPS:-40}
+SWEEP_ARGS=${R4_SWEEP_ARGS:---backends xla,pallas --stress --frames 8}
+LAB=${R4_LAB_VARIANTS:-swar swar_strips swar_strips_1024 swar_b256 swar_f16_b256 shrink shrink_rollrows shrink_strips_1024 shipped xla xla_pair}
+CSV=${R4_CSV:-docs/BENCHMARKS.csv}
+PREVIEW=${R4_PREVIEW:-/root/repo/docs/BENCH_r04_preview.json}
+AT_CACHE=${R4_AT_CACHE:-docs/autotune_v5e.json}
+LOG_COPY=${R4_LOG_COPY:-/root/repo/docs/r4_lab.log}
 
 : > /tmp/r4_lab.log
 echo "=== r4 burst start $(date +%H:%M:%S) ===" | tee -a /tmp/r4_lab.log
@@ -18,21 +33,24 @@ echo "=== r4 burst start $(date +%H:%M:%S) ===" | tee -a /tmp/r4_lab.log
 python -u bench.py > /tmp/r4_bench.json 2> /tmp/r4_bench.log
 echo "=== bench done rc=$? $(date +%H:%M:%S) ===" | tee -a /tmp/r4_lab.log
 # Commit-able preview immediately (before anything else can fail).
-cp /tmp/r4_bench.json /root/repo/docs/BENCH_r04_preview.json 2>/dev/null || true
+cp /tmp/r4_bench.json "$PREVIEW" 2>/dev/null || true
 
 # Schedule verdict for the sweep/1x1 runs: the fastest measured schedule
 # of the shipped kernel (falls back to 'pad' if the capture failed).
-SCHED=$(python - <<'EOF'
+read -r SCHED PLAT <<EOF2
+$(python - <<'EOF'
 import json
 try:
     r = json.load(open("/tmp/r4_bench.json"))
     scheds = r.get("pallas_schedules_us_per_rep") or {}
-    print(min(scheds, key=scheds.get) if scheds else "pad")
+    print(min(scheds, key=scheds.get) if scheds else "pad",
+          r.get("platform", "unknown"))
 except Exception:
-    print("pad")
+    print("pad unknown")
 EOF
 )
-echo "schedule verdict: $SCHED" | tee -a /tmp/r4_lab.log
+EOF2
+echo "schedule verdict: $SCHED (platform=$PLAT)" | tee -a /tmp/r4_lab.log
 export TPU_STENCIL_PALLAS_SCHEDULE=$SCHED
 
 # 1.5 Self-finalize: flip the shipped default to the measured winner
@@ -41,7 +59,9 @@ export TPU_STENCIL_PALLAS_SCHEDULE=$SCHED
 # round driver commits uncommitted work, so this lands even if the burst
 # finishes unattended.
 PS=tpu_stencil/ops/pallas_stencil.py
-if [ "$SCHED" != "pad" ] \
+# Platform guard: never flip the shipped default from a CPU/unknown
+# rehearsal measurement — only a verdict measured on real TPU counts.
+if [ "$SCHED" != "pad" ] && { [ "$PLAT" = "tpu" ] || [ "$PLAT" = "axon" ]; } \
     && grep -q '"TPU_STENCIL_PALLAS_SCHEDULE", "pad")' $PS; then
   cp $PS /tmp/r4_ps_backup.py  # never git-checkout: may hold other edits
   sed -i "s/\"TPU_STENCIL_PALLAS_SCHEDULE\", \"pad\")/\"TPU_STENCIL_PALLAS_SCHEDULE\", \"$SCHED\")/" $PS
@@ -58,34 +78,36 @@ fi
 
 # 2. Kernel lab (informational: variant-level attribution) + the XLA
 # pair-add A/B (lowering.StencilPlan.xla_pair_add)
-python -u tools/kernel_lab.py swar swar_strips swar_strips_1024 swar_b256 \
-    swar_f16_b256 shrink shrink_rollrows shrink_strips_1024 shipped \
-    xla xla_pair >> /tmp/r4_lab.log 2>&1
+python -u tools/kernel_lab.py $LAB >> /tmp/r4_lab.log 2>&1
 echo "=== lab done $(date +%H:%M:%S) ===" | tee -a /tmp/r4_lab.log
 
 # 3. Autotune cache evidence — real (backend, schedule) verdicts on chip
-python -c "import numpy as np; np.random.default_rng(0).integers(
-    0,256,(2520,1920,3),dtype=np.uint8).tofile('/tmp/bench_img.raw')"
-TPU_STENCIL_AUTOTUNE_CACHE=docs/autotune_v5e.json \
-    python -u -m tpu_stencil /tmp/bench_img.raw 1920 2520 40 rgb \
-    --backend autotune --time --output /tmp/o.raw > /tmp/r4_autotune.log 2>&1
+W=$W H=$H python -c "import numpy as np, os
+np.random.default_rng(0).integers(
+    0,256,(int(os.environ['H']),int(os.environ['W']),3),
+    dtype=np.uint8).tofile('/tmp/bench_img.raw')" 2>>/tmp/r4_lab.log
+CLI_EXTRA=${R4_CLI_EXTRA:-}
+TPU_STENCIL_AUTOTUNE_CACHE=$AT_CACHE \
+    python -u -m tpu_stencil /tmp/bench_img.raw $W $H $REPS rgb \
+    --backend autotune --time --output /tmp/o.raw $CLI_EXTRA \
+    > /tmp/r4_autotune.log 2>&1
 echo "=== autotune done rc=$? $(date +%H:%M:%S) ===" | tee -a /tmp/r4_lab.log
 
 # 4. Sharded Pallas compiled on chip: 1x1 mesh (VERDICT r3 item 4)
-python -u -m tpu_stencil /tmp/bench_img.raw 1920 2520 40 rgb \
-    --mesh 1x1 --backend pallas --time --output /tmp/o2.raw \
+python -u -m tpu_stencil /tmp/bench_img.raw $W $H $REPS rgb \
+    --mesh 1x1 --backend pallas --time --output /tmp/o2.raw $CLI_EXTRA \
     > /tmp/r4_1x1.log 2>&1
 echo "=== 1x1 done rc=$? $(date +%H:%M:%S) ===" | tee -a /tmp/r4_lab.log
 
 # 5. Full sweep incl. stress + frames (VERDICT r3 items 2/3)
-python -u -m tpu_stencil.runtime.bench_sweep --backends xla,pallas \
-    --stress --frames 8 --csv docs/BENCHMARKS.csv > /tmp/r4_sweep.log 2>&1
+python -u -m tpu_stencil.runtime.bench_sweep $SWEEP_ARGS \
+    --csv "$CSV" > /tmp/r4_sweep.log 2>&1
 echo "=== sweep done rc=$? $(date +%H:%M:%S) ===" | tee -a /tmp/r4_lab.log
 
 # 6. Regenerate the published table from the fresh CSV (so the artifacts
 # are complete even if this runs unattended after the session).
-python tools/gen_benchmarks_md.py docs/BENCHMARKS.csv \
+python tools/gen_benchmarks_md.py "$CSV" --out "${CSV%.csv}.md" \
     --note "round 4, one TPU v5e chip via the axon tunnel, schedule=$SCHED ($(date +%F))" \
     >> /tmp/r4_lab.log 2>&1
-cp /tmp/r4_lab.log /root/repo/docs/r4_lab.log 2>/dev/null || true
+cp /tmp/r4_lab.log "$LOG_COPY" 2>/dev/null || true
 echo "=== r4 burst complete $(date +%H:%M:%S) ===" | tee -a /tmp/r4_lab.log

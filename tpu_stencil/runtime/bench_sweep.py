@@ -329,7 +329,17 @@ def main(argv=None) -> int:
              "row per swept backend (xla = vmapped step, pallas = fused "
              "tall-image kernel); reports us per frame*rep",
     )
+    p.add_argument(
+        "--platform", default=None, choices=["cpu", "tpu", "gpu"],
+        help="force the JAX platform via the config API (same contract as "
+             "the CLI flag — wins over a pinned JAX_PLATFORMS); rehearsal "
+             "use, real sweeps run on the default TPU",
+    )
     ns = p.parse_args(argv)
+    if ns.platform:
+        import jax
+
+        jax.config.update("jax_platforms", ns.platform)
     rows = run_sweep(
         quick=ns.quick, stress=ns.stress,
         filters=ns.filters.split(","), csv_path=ns.csv,
