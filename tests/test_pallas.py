@@ -151,6 +151,30 @@ def test_schedules_match_golden(rng, schedule, name, reps):
     np.testing.assert_array_equal(got, want)
 
 
+@pytest.mark.parametrize(
+    "schedule", ["pad", "shrink", "strips", "pack", "pack_strips"]
+)
+def test_rows_roll_lowering_matches_golden(rng, schedule, monkeypatch):
+    # The alternative rows-pass lowering (sublane rotates + aligned adds,
+    # TPU_STENCIL_ROWS_ROLL): same integer sums reassociated, wrap garbage
+    # cropped — bit-exact for every schedule that uses _rows_binomial.
+    # Unique image shape: _ROWS_ROLL is read at trace time, so a shape
+    # shared with other tests could hit their cached (non-roll) programs.
+    monkeypatch.setattr(pallas_stencil, "_ROWS_ROLL", True)
+    img = rng.integers(0, 256, size=(66, 41, 3), dtype=np.uint8)
+    for name, reps in (("gaussian", 5), ("gaussian5", 2)):
+        plan = lowering.plan_filter(filters.get_filter(name))
+        got = np.asarray(
+            pallas_stencil.iterate(img, jnp.int32(reps), plan, block_h=32,
+                                   fuse=2, interpret=True,
+                                   schedule=schedule)
+        )
+        want = stencil.reference_stencil_numpy(
+            img, filters.get_filter(name), reps
+        )
+        np.testing.assert_array_equal(got, want, err_msg=f"{name}")
+
+
 @pytest.mark.parametrize("schedule", ["shrink", "strips", "pack", "pack_strips"])
 def test_schedules_grey_and_single_block(rng, schedule):
     img = rng.integers(0, 256, size=(40, 33), dtype=np.uint8)

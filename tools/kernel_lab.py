@@ -78,7 +78,9 @@ def _rep_val(cur, *, plan, dt, wc, channels, opts):
         # costs: misaligned slice add 50.7 us/pass vs roll ~19-28 + aligned
         # add 8.9). Wrap garbage lands in the last `chain` rows — inside
         # the contracted discard band, cropped by the aligned final slice.
-        acc = cur
+        # Rotate is 32-bit only on Mosaic; int32 adds also beat int16
+        # (r3 op costs) so the widening is free of perf apology.
+        acc = cur if cur.dtype == jnp.int32 else cur.astype(jnp.int32)
         for d in range(_binomial_chain(plan.row_taps)):
             # out[i] = x[i] + x[i+1]; +1 expressed as the non-negative
             # end-around rotate rows-1 (pltpu.roll rejects negatives).

@@ -79,6 +79,10 @@ fi
 # 2. Kernel lab (informational: variant-level attribution) + the XLA
 # pair-add A/B (lowering.StencilPlan.xla_pair_add)
 python -u tools/kernel_lab.py $LAB >> /tmp/r4_lab.log 2>&1
+echo "--- shipped kernel, rows-roll lowering (TPU_STENCIL_ROWS_ROLL=1) ---" \
+    | tee -a /tmp/r4_lab.log
+TPU_STENCIL_ROWS_ROLL=1 python -u tools/kernel_lab.py shipped \
+    >> /tmp/r4_lab.log 2>&1
 echo "=== lab done $(date +%H:%M:%S) ===" | tee -a /tmp/r4_lab.log
 
 # 3. Autotune cache evidence — real (backend, schedule) verdicts on chip

@@ -187,9 +187,37 @@ def _lane_roll(x, off: int, wc: int):
     return pltpu.roll(x, wc - off, 1)
 
 
+# Rows-pass lowering knob, read at import (process-level — a trace-time
+# env read would be silently defeated by the jit cache): 0 = pair-adds of
+# shrinking sublane-misaligned slices; 1 = full-tile sublane rotates +
+# ALIGNED adds with one aligned crop. The r3 op costs (misaligned slice
+# add 50.7 us/full-tile pass vs rotate ~19-28 + aligned add 8.9) make the
+# rotate form a credible win; tools/kernel_lab.py 'shrink_rollrows' and
+# the burst's env A/B measure it — flip the default only on a verdict.
+_ROWS_ROLL = os.environ.get("TPU_STENCIL_ROWS_ROLL", "0") == "1"
+
+
 def _rows_binomial(acc, d: int):
-    """d-fold (1,1) self-convolution down the sublane axis: d pair-adds of
-    shrinking slices — the valid binomial-row correlation."""
+    """d-fold (1,1) self-convolution down the sublane axis — the valid
+    binomial-row correlation, in either rows-pass lowering (``_ROWS_ROLL``).
+    The rotate form's end-around wrap garbage occupies exactly the last
+    ``d`` rows and is cropped by an aligned slice, so both lowerings
+    return identical values (pure integer adds, reassociated). SWAR-safe:
+    on packed values each 16-bit half sums independently within the
+    ``_pack_ok`` bounds."""
+    if _ROWS_ROLL:
+        # Mosaic's rotate is 32-bit only (same restriction sep_rep
+        # documents for lane rotates) — and the r3 op costs put int32
+        # adds AHEAD of int16 (8.9 vs 13.9 us/pass), so widening here
+        # costs nothing the measurement didn't already indict.
+        if acc.dtype != jnp.int32:
+            acc = acc.astype(jnp.int32)
+        n = acc.shape[0]
+        for _ in range(d):
+            # out[i] = x[i] + x[i+1]: +1 as the non-negative end-around
+            # rotate by rows-1 (pltpu.roll rejects negative shifts).
+            acc = acc + pltpu.roll(acc, acc.shape[0] - 1, 0)
+        return acc[0:n - d, :]
     for _ in range(d):
         n = acc.shape[0] - 1
         acc = acc[0:n, :] + acc[1:n + 1, :]
