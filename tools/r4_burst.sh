@@ -85,6 +85,28 @@ TPU_STENCIL_ROWS_ROLL=1 python -u tools/kernel_lab.py shipped \
     >> /tmp/r4_lab.log 2>&1
 echo "=== lab done $(date +%H:%M:%S) ===" | tee -a /tmp/r4_lab.log
 
+# 2.5 Self-finalize the rows-pass lowering from the shipped-kernel A/B.
+# Skipped in rehearsals (TPU_LAB_PLATFORM set). Needs BOTH shipped lines
+# (baseline from the $LAB list, then the ROWS_ROLL rerun) and a >2% win;
+# same backup/pytest-gate/revert protocol as the schedule flip.
+if [ -z "${TPU_LAB_PLATFORM:-}" ]; then
+  BASE_US=$(grep "shipped(iterate)" /tmp/r4_lab.log | awk '{print $2}' | sed -n 1p)
+  ROLL_US=$(grep "shipped(iterate)" /tmp/r4_lab.log | awk '{print $2}' | sed -n 2p)
+  if [ -n "$BASE_US" ] && [ -n "$ROLL_US" ] && python -c \
+      "import sys; sys.exit(0 if float('$ROLL_US') < 0.98*float('$BASE_US') else 1)"; then
+    cp $PS /tmp/r4_ps2_backup.py
+    sed -i 's/os.environ.get("TPU_STENCIL_ROWS_ROLL", "0")/os.environ.get("TPU_STENCIL_ROWS_ROLL", "1")/' $PS
+    if python -m pytest tests/test_pallas.py -q -x >> /tmp/r4_lab.log 2>&1; then
+      echo "ROWS_ROLL default flipped: $ROLL_US vs $BASE_US us/rep" | tee -a /tmp/r4_lab.log
+    else
+      cp /tmp/r4_ps2_backup.py $PS
+      echo "ROWS_ROLL flip REVERTED (tests failed)" | tee -a /tmp/r4_lab.log
+    fi
+  else
+    echo "rows-roll verdict: no flip (base=$BASE_US roll=$ROLL_US)" | tee -a /tmp/r4_lab.log
+  fi
+fi
+
 # 3. Autotune cache evidence — real (backend, schedule) verdicts on chip
 W=$W H=$H python -c "import numpy as np, os
 np.random.default_rng(0).integers(
