@@ -1,0 +1,45 @@
+#!/bin/bash
+# Poll the TPU tunnel; on recovery run the round's burst; verify the
+# north-star artifact actually parsed; if the tunnel died mid-burst,
+# go back to waiting and retry (a flaky tunnel must not turn one bad
+# window into an evidence-free round). Success = the preview JSON has a
+# numeric "value" measured on a TPU platform.
+set -u
+BURST=${R4_BURST:-/root/repo/tools/r4_burst.sh}
+PREVIEW=${R4_PREVIEW:-/root/repo/docs/BENCH_r04_preview.json}
+MAX_TRIES=${R4_MAX_TRIES:-5}
+
+ok() {
+  python - "$PREVIEW" <<'EOF'
+import json, sys
+try:
+    r = json.load(open(sys.argv[1]))
+    assert isinstance(r.get("value"), (int, float))
+    assert r.get("platform") in ("tpu", "axon")
+except Exception:
+    sys.exit(1)
+EOF
+}
+
+for try in $(seq 1 "$MAX_TRIES"); do
+  while true; do
+    if timeout 60 python -c "
+import jax, numpy as np
+x = jax.device_put(np.ones((8,128), np.float32))
+assert np.asarray(x).sum() == 1024
+" >/dev/null 2>&1; then
+      echo "$(date +%H:%M:%S) TPU ALIVE - burst attempt $try"
+      break
+    fi
+    echo "$(date +%H:%M:%S) down"
+    sleep 25
+  done
+  bash "$BURST"
+  if ok; then
+    echo "$(date +%H:%M:%S) burst attempt $try SUCCEEDED (preview parses)"
+    exit 0
+  fi
+  echo "$(date +%H:%M:%S) burst attempt $try left no usable capture; rewaiting"
+done
+echo "$(date +%H:%M:%S) giving up after $MAX_TRIES attempts"
+exit 1
