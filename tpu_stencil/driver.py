@@ -84,7 +84,7 @@ def _place_frames(model, imgs: np.ndarray, devices):
         frame_shape, channels, True, n_frames=-(-imgs.shape[0] // n_dev)
     )
     if n_dev > 1:
-        img_dev, bmesh = _put_batched(np.asarray(imgs), devices)
+        img_dev, bmesh = _put_batched(imgs, devices)
         if b_backend == "pallas":
             from tpu_stencil.parallel import sharded as _sharded
 

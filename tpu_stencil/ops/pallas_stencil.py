@@ -250,17 +250,11 @@ def _row_keep(gid, n_rows_real: int, frame):
     return keep
 
 
-def _binomial_chain(taps) -> Optional[int]:
-    """Chain length d when ``taps`` are the binomial coefficients C(d, i)
-    — i.e. the d-fold self-convolution of (1, 1) — else None. Binomial
-    passes then lower to d pair-adds instead of per-tap shift-add chains
-    (gaussian7's taps 6/15/20 alone cost ~20 adds the chain never pays)."""
-    from math import comb
-
-    d = len(taps) - 1
-    if tuple(taps) == tuple(comb(d, i) for i in range(d + 1)):
-        return d
-    return None
+# Binomial-row detection shared with the XLA lowering: chain length d
+# when taps are C(d, i) — binomial passes then lower to d pair-adds
+# instead of per-tap shift-add chains (gaussian7's taps 6/15/20 alone
+# cost ~20 adds the chain never pays).
+_binomial_chain = _lowering._binomial_chain
 
 
 def _clip_needed(plan: StencilPlan) -> bool:
