@@ -202,6 +202,53 @@ def test_cross_format_resume_both_directions(tmp_path, rng):
     checkpoint.clear(cfg)
 
 
+def test_cli_frames_checkpointed_run_matches_plain(tmp_path, rng):
+    # Single-host --frames + --checkpoint-every through the real CLI:
+    # chunked fused-batch iteration with mid-run checkpoints must land on
+    # the same bytes as an unchunked run, and sweep its artifacts.
+    clip = rng.integers(0, 256, size=(3, 9, 8, 3), dtype=np.uint8)
+    src = str(tmp_path / "clip.raw")
+    clip.tofile(src)
+    out = str(tmp_path / "o.raw")
+    rc = cli.main([src, "8", "9", "5", "rgb", "--frames", "3",
+                   "--backend", "xla", "--checkpoint-every", "2",
+                   "--output", out])
+    assert rc == 0
+    got = np.fromfile(out, np.uint8).reshape(3, 9, 8, 3)
+    for k in range(3):
+        want = stencil.reference_stencil_numpy(
+            clip[k], filters.get_filter("gaussian"), 5
+        )
+        np.testing.assert_array_equal(got[k], want, err_msg=f"frame {k}")
+    assert not os.path.exists(out + ".ckpt")
+    assert not os.path.exists(out + ".ckpt.json")
+
+
+def test_cli_frames_resume_continues_from_checkpoint(tmp_path, rng):
+    # --frames --resume through the real CLI: seed a rep-1 checkpoint
+    # holding a DIFFERENT clip's state; the resumed run must produce that
+    # clip's golden (continued from checkpoint bytes, not the input).
+    clip_a = rng.integers(0, 256, size=(3, 9, 8, 3), dtype=np.uint8)
+    clip_b = rng.integers(0, 256, size=(3, 9, 8, 3), dtype=np.uint8)
+    src = str(tmp_path / "clip.raw")
+    clip_a.tofile(src)
+    out = str(tmp_path / "o.raw")
+    cfg = _cfg(tmp_path, image=src, width=8, height=9, repetitions=3,
+               image_type=ImageType.RGB, frames=3, output=out)
+    g = filters.get_filter("gaussian")
+    seed = np.stack(
+        [stencil.reference_stencil_numpy(clip_b[k], g, 1) for k in range(3)]
+    )
+    checkpoint.save(cfg, 1, seed)
+    rc = cli.main([src, "8", "9", "3", "rgb", "--frames", "3",
+                   "--backend", "xla", "--resume", "--output", out])
+    assert rc == 0
+    got = np.fromfile(out, np.uint8).reshape(3, 9, 8, 3)
+    for k in range(3):
+        want = stencil.reference_stencil_numpy(clip_b[k], g, 3)
+        np.testing.assert_array_equal(got[k], want, err_msg=f"frame {k}")
+
+
 def test_frames_sharded_save_restore_round_trip(tmp_path, rng):
     # Single-process exercise of the multi-host --frames checkpoint
     # format: two "hosts" write disjoint frame byte ranges into the same
