@@ -247,6 +247,10 @@ def test_cli_frames_resume_continues_from_checkpoint(tmp_path, rng):
     for k in range(3):
         want = stencil.reference_stencil_numpy(clip_b[k], g, 3)
         np.testing.assert_array_equal(got[k], want, err_msg=f"frame {k}")
+    # The resume-only branch must sweep too: a surviving stale checkpoint
+    # would silently hijack the next --resume run.
+    assert not os.path.exists(out + ".ckpt")
+    assert not os.path.exists(out + ".ckpt.json")
 
 
 def test_frames_sharded_save_restore_round_trip(tmp_path, rng):
