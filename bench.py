@@ -106,8 +106,15 @@ def _measure_backend(backend: str) -> dict:
         log(f"{backend}: {per_rep * 1e6:.1f} us/rep")
         return {"us_per_rep": round(per_rep * 1e6, 2), "per_rep_s": per_rep}
 
+    # Optional restriction for the rows-roll probe (second child run):
+    # measure only the named schedules instead of all five.
+    only = os.environ.get("TPU_STENCIL_BENCH_SCHEDULES")
+    sched_list = (
+        tuple(only.split(",")) if only
+        else ("pad", "shrink", "strips", "pack", "pack_strips")
+    )
     schedules = {}
-    for sched in ("pad", "shrink", "strips", "pack", "pack_strips"):
+    for sched in sched_list:
         jit_fn = jax.jit(
             functools.partial(
                 pallas_stencil.iterate, plan=model.plan, schedule=sched
@@ -157,9 +164,13 @@ def child_main() -> int:
     platform = jax.default_backend()
     log(f"platform={platform} devices={jax.devices()}")
 
-    candidates = ["xla"]
-    if platform not in ("cpu",):
-        candidates.append("pallas")
+    forced_backends = os.environ.get("TPU_STENCIL_BENCH_BACKENDS")
+    if forced_backends:
+        candidates = forced_backends.split(",")
+    else:
+        candidates = ["xla"]
+        if platform not in ("cpu",):
+            candidates.append("pallas")
 
     results = {}
     for backend in candidates:
@@ -190,11 +201,17 @@ def child_main() -> int:
         "pct_hbm_peak": round(pct, 1),
         "platform": platform,
     }
-    if "schedule" in results.get(winner, {}):
-        result["pallas_schedule"] = results[winner]["schedule"]
-        result["pallas_schedules_us_per_rep"] = results[winner][
-            "schedules_us_per_rep"
-        ]
+    # Emit the pallas table whenever pallas was measured — not only when
+    # it won — so the parent's rows-roll probe can try the alternate
+    # lowering even when XLA took the primary capture, and record which
+    # rows lowering this child actually ran (the probe inverts it).
+    pal = results.get("pallas")
+    if pal and "schedule" in pal:
+        from tpu_stencil.ops import pallas_stencil
+
+        result["pallas_schedule"] = pal["schedule"]
+        result["pallas_schedules_us_per_rep"] = pal["schedules_us_per_rep"]
+        result["rows_roll"] = pallas_stencil._ROWS_ROLL
     print(json.dumps(result))
     return 0
 
@@ -259,6 +276,56 @@ def _run_child(env):
     return proc.returncode, "".join(out_chunks), "".join(err_chunks)
 
 
+def _rows_roll_probe(primary_line: str) -> str:
+    """After a successful TPU capture, spend one extra child run measuring
+    the best pallas schedule under the OTHER rows-pass lowering (the
+    inverse of the one the child reported running — import-time, hence a
+    fresh process). The official number self-selects across both
+    lowerings even when this is the round's only hardware window, and
+    regardless of which backend won the primary; any probe failure keeps
+    the primary result untouched."""
+    try:
+        primary = json.loads(primary_line)
+        scheds = primary.get("pallas_schedules_us_per_rep")
+        if primary.get("platform") not in ("tpu", "axon") or not scheds:
+            return primary_line
+        best = min(scheds, key=scheds.get)
+        alt = "0" if primary.get("rows_roll") else "1"
+        env = dict(
+            os.environ, TPU_STENCIL_BENCH_CHILD="1",
+            TPU_STENCIL_ROWS_ROLL=alt, TPU_STENCIL_BENCH_BACKENDS="pallas",
+            TPU_STENCIL_BENCH_SCHEDULES=best,
+        )
+        log(f"rows-roll probe: pallas[{best}] under "
+            f"TPU_STENCIL_ROWS_ROLL={alt}")
+        rc, out, err = _run_child(env)
+        sys.stderr.write(err)
+        lines = [l for l in out.splitlines() if l.strip()]
+        if rc != 0 or not lines:
+            log("rows-roll probe failed; keeping primary capture")
+            return primary_line
+        probe = json.loads(lines[-1])
+        probe_us = probe["backends_us_per_rep"]["pallas"]
+        if probe["value"] < primary["value"]:
+            # The probe's own JSON already carries value/roofline for its
+            # run; keep the primary's comparison tables alongside.
+            probe["rows_roll"] = alt == "1"
+            probe["pallas_schedules_us_per_rep"] = scheds
+            probe["backends_us_per_rep"] = dict(
+                primary["backends_us_per_rep"],
+                **{f"pallas[rows_roll={alt}]": probe_us},
+            )
+            log(f"rows-roll probe WON: {probe_us} vs {scheds[best]} us/rep")
+            return json.dumps(probe)
+        primary["rows_roll_probe_us_per_rep"] = probe_us
+        log(f"rows-roll probe lost: {probe_us} vs {scheds[best]} us/rep")
+        return json.dumps(primary)
+    except Exception as e:  # the probe is strictly optional
+        log(f"rows-roll probe error ({type(e).__name__}: {e}); "
+            "keeping primary capture")
+        return primary_line
+
+
 def main() -> int:
     if os.environ.get("TPU_STENCIL_BENCH_CHILD") == "1":
         return child_main()
@@ -272,7 +339,7 @@ def main() -> int:
         sys.stderr.write(err)
         lines = [l for l in out.splitlines() if l.strip()]
         if rc == 0 and lines:
-            print(lines[-1])
+            print(_rows_roll_probe(lines[-1]))
             return 0
         last_line = lines[-1] if lines else last_line
         log(f"attempt {attempt}: rc={rc}")

@@ -55,6 +55,90 @@ def test_bench_emits_single_json_line_without_failures(tmp_path):
     assert set(result) >= {"metric", "value", "unit", "vs_baseline"}
 
 
+def test_rows_roll_probe_merges_and_survives_failure(monkeypatch):
+    # The probe is strictly optional: on a TPU primary it spends one extra
+    # child run on the other rows lowering, adopts it only when faster,
+    # and any failure keeps the primary untouched.
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location("bench_mod", BENCH)
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+
+    primary = json.dumps({
+        "metric": "m", "value": 0.003388, "unit": "s", "vs_baseline": 300.0,
+        "backend": "pallas", "platform": "axon",
+        "backends_us_per_rep": {"xla": 98.5, "pallas": 84.7},
+        "pallas_schedule": "pack",
+        "pallas_schedules_us_per_rep": {"pad": 90.0, "pack": 84.7},
+    })
+
+    # Probe wins: its JSON becomes the headline, annotated.
+    probe_json = json.dumps({
+        "metric": "m", "value": 0.002448, "unit": "s", "vs_baseline": 415.0,
+        "backend": "pallas", "platform": "axon",
+        "backends_us_per_rep": {"pallas": 61.2},
+        "pallas_schedule": "pack",
+        "pallas_schedules_us_per_rep": {"pack": 61.2},
+    })
+    seen_env = {}
+
+    def fake_child(env):
+        seen_env.update(env)
+        return 0, probe_json + "\n", ""
+
+    monkeypatch.setattr(bench, "_run_child", fake_child)
+    merged = json.loads(bench._rows_roll_probe(primary))
+    assert seen_env["TPU_STENCIL_ROWS_ROLL"] == "1"
+    assert seen_env["TPU_STENCIL_BENCH_SCHEDULES"] == "pack"
+    assert merged["rows_roll"] is True
+    assert merged["value"] == 0.002448
+    assert merged["backends_us_per_rep"]["pallas[rows_roll=1]"] == 61.2
+    assert merged["backends_us_per_rep"]["xla"] == 98.5
+    assert merged["pallas_schedules_us_per_rep"]["pad"] == 90.0
+
+    # Primary already ran the roll lowering (e.g. after the burst flipped
+    # the default): the probe must invert to ROWS_ROLL=0, not re-measure
+    # the identical kernel.
+    roll_primary = json.loads(primary)
+    roll_primary["rows_roll"] = True
+    seen_env.clear()
+    bench._rows_roll_probe(json.dumps(roll_primary))
+    assert seen_env["TPU_STENCIL_ROWS_ROLL"] == "0"
+
+    # XLA-won primary (pallas table still emitted by the child): the
+    # probe must still run — the alternate lowering matters MOST when the
+    # default pallas lowering lost to XLA.
+    xla_primary = json.loads(primary)
+    xla_primary["backend"] = "xla"
+    monkeypatch.setattr(bench, "_run_child", fake_child)
+    merged = json.loads(bench._rows_roll_probe(json.dumps(xla_primary)))
+    assert merged["backend"] == "pallas" and merged["value"] == 0.002448
+
+    # Probe loses: primary kept, probe recorded.
+    slow_probe = json.loads(probe_json)
+    slow_probe["value"] = 0.004
+    slow_probe["backends_us_per_rep"] = {"pallas": 100.0}
+    monkeypatch.setattr(
+        bench, "_run_child", lambda env: (0, json.dumps(slow_probe), "")
+    )
+    kept = json.loads(bench._rows_roll_probe(primary))
+    assert kept["value"] == 0.003388
+    assert kept["rows_roll_probe_us_per_rep"] == 100.0
+
+    # Probe child dies: primary returned verbatim.
+    monkeypatch.setattr(bench, "_run_child", lambda env: (1, "", "boom"))
+    assert bench._rows_roll_probe(primary) == primary
+
+    # CPU primary: no probe at all (a child run would be wasted work).
+    def boom(env):
+        raise AssertionError("probe must not run on cpu")
+
+    monkeypatch.setattr(bench, "_run_child", boom)
+    cpu_primary = json.dumps({"value": 1.0, "platform": "cpu"})
+    assert bench._rows_roll_probe(cpu_primary) == cpu_primary
+
+
 def test_sweep_incremental_csv_and_retry(tmp_path, monkeypatch):
     # The sweep must keep already-measured rows on a crash (incremental
     # CSV) and retry a transiently-failing row instead of dying.
