@@ -1,0 +1,14 @@
+#!/bin/bash
+# One-glance round-4 status: poller alive? tunnel state? burst progress?
+P=$(pgrep -f wait_and_burst2.sh | head -1)
+echo "poller: ${P:-DEAD - restart with: nohup bash tools/wait_and_burst2.sh > /tmp/r4_wait2.log 2>&1 &}"
+echo "tunnel: $(tail -1 /tmp/r4_wait2.log 2>/dev/null)"
+if [ -f /tmp/r4_lab.log ]; then
+  echo "--- burst log tail ---"
+  tail -5 /tmp/r4_lab.log
+fi
+if [ -f /root/repo/docs/BENCH_r04_preview.json ]; then
+  echo "--- preview ---"
+  cat /root/repo/docs/BENCH_r04_preview.json
+fi
+git -C /root/repo status --short | head -5
