@@ -107,6 +107,14 @@ if [ -z "${TPU_LAB_PLATFORM:-}" ]; then
   fi
 fi
 
+# 2.6 Op-cost microbench for the decision-relevant primitives
+# (informational: the sublane-rotate number the rows-roll bet rides on,
+# the strip-residency adds, and the MXU rows-pass options).
+python -u tools/op_cost.py subroll1_add_i32 mis_slice_add_i32 \
+    roll3_add_i32 add_i32 strip_add_i32 strip128_add_i32 \
+    mxu_rows_bf16 mxu_rows_i8 >> /tmp/r4_lab.log 2>&1
+echo "=== op_cost done $(date +%H:%M:%S) ===" | tee -a /tmp/r4_lab.log
+
 # 3. Autotune cache evidence — real (backend, schedule) verdicts on chip
 W=$W H=$H python -c "import numpy as np, os
 np.random.default_rng(0).integers(
