@@ -81,7 +81,7 @@ _MAX_ROLL_HALO = 128  # cols-pass ghost width limit (halo * channels)
 #              lane-strip (the 'strips' trick on packed values).
 # The default is measured, not assumed: tools/kernel_lab.py times all
 # schedules on hardware. Env override for on-hardware A/B through the CLI.
-DEFAULT_SCHEDULE = os.environ.get("TPU_STENCIL_PALLAS_SCHEDULE", "pad")
+DEFAULT_SCHEDULE = os.environ.get("TPU_STENCIL_PALLAS_SCHEDULE", "pack")
 
 
 def _check_schedule(schedule: Optional[str]) -> str:
