@@ -1,0 +1,134 @@
+#!/bin/bash
+# Round-4 burst, part 2. Part 1 (tools/r4_burst.sh) secured the official
+# capture, the pack schedule flip, the kernel-lab attribution and the
+# rows-roll verdict before the tunnel dropped again mid-op_cost
+# (2026-07-31 ~04:05). This script finishes the round's hardware
+# checklist, fronted by the block_h/fuse A/B the lab data motivated
+# (swar_f16_b256 19.96 us/rep vs swar 35.35 at the shipped 128/8).
+# Every step is timeout-wrapped: a second mid-burst tunnel death leaves
+# the completed steps' artifacts intact instead of wedging the script.
+# Logs: /tmp/r4p2_*.log; shared journal /tmp/r4_lab.log (appended).
+set -u
+cd /root/repo
+
+W=${R4_W:-1920}; H=${R4_H:-2520}; REPS=${R4_REPS:-40}
+SWEEP_ARGS=${R4_SWEEP_ARGS:---backends xla,pallas --stress --frames 8}
+CSV=${R4_CSV:-docs/BENCHMARKS.csv}
+PREVIEW=${R4_PREVIEW:-/root/repo/docs/BENCH_r04_preview.json}
+AT_CACHE=${R4_AT_CACHE:-docs/autotune_v5e.json}
+LOG_COPY=${R4_LOG_COPY:-/root/repo/docs/r4_lab.log}
+DONE_MARK=${R4_DONE_MARK:-/tmp/r4_part2_done}
+PS=tpu_stencil/ops/pallas_stencil.py
+
+rm -f "$DONE_MARK"  # a stale marker must not report an old run as fresh
+echo "=== r4 part2 start $(date +%H:%M:%S) ===" | tee -a /tmp/r4_lab.log
+
+# 0. block_h/fuse A/B on the shipped kernel (decision column: the literal
+# 40-rep window, where non-divisor fuse pays its remainder launches).
+timeout 1500 python -u tools/bh_fuse_ab.py > /tmp/r4p2_ab.log 2>&1
+echo "=== bh/fuse A/B rc=$? $(date +%H:%M:%S) ===" | tee -a /tmp/r4_lab.log
+grep "^bh=" /tmp/r4p2_ab.log | tee -a /tmp/r4_lab.log
+
+# 0.5 Self-finalize: flip DEFAULT_BLOCK_H/DEFAULT_FUSE to the best
+# exact=True candidate by the forty column, if it beats the shipped
+# (128,8) by >2%. pytest-gated with revert, like part 1's flips.
+read -r NBH NFZ <<EOF2
+$(python - <<'EOF'
+import re
+best = None; base = None
+for ln in open("/tmp/r4p2_ab.log"):
+    m = re.match(r"bh=\s*(\d+) fuse=\s*(\d+)\s+[\d.]+ us/rep\s+"
+                 r"forty=\s*([\d.]+) us/rep\s+exact=True", ln)
+    if not m:
+        continue
+    bh, fz, forty = int(m[1]), int(m[2]), float(m[3])
+    if (bh, fz) == (128, 8):
+        base = forty
+    if best is None or forty < best[2]:
+        best = (bh, fz, forty)
+print(*(best[:2] if best and base and best[2] < 0.98 * base else ("", "")))
+EOF
+)
+EOF2
+# Platform guard (as in part 1): only a verdict measured on real TPU may
+# move the shipped default — never a CPU/interpret rehearsal number.
+if [ -n "${NBH:-}" ] && grep -q "^platform=tpu " /tmp/r4p2_ab.log \
+    && grep -q "DEFAULT_BLOCK_H = 128" $PS \
+    && grep -q "DEFAULT_FUSE = 8" $PS; then
+  cp $PS /tmp/r4p2_ps_backup.py
+  sed -i "s/DEFAULT_BLOCK_H = 128/DEFAULT_BLOCK_H = $NBH/; \
+          s/DEFAULT_FUSE = 8/DEFAULT_FUSE = $NFZ/" $PS
+  if python -m pytest tests/test_pallas.py -q -x >> /tmp/r4_lab.log 2>&1; then
+    echo "block/fuse default flipped to ($NBH,$NFZ)" | tee -a /tmp/r4_lab.log
+    # Refresh the official capture at the new defaults (bench measures
+    # iterate at module defaults; the preview must match shipped code).
+    timeout 1800 python -u bench.py > /tmp/r4p2_bench.json \
+        2> /tmp/r4p2_bench.log
+    if [ -s /tmp/r4p2_bench.json ] && python -c \
+        "import json;json.load(open('/tmp/r4p2_bench.json'))" 2>/dev/null; then
+      cp /tmp/r4p2_bench.json "$PREVIEW"
+      echo "preview refreshed at new defaults" | tee -a /tmp/r4_lab.log
+    else
+      echo "WARNING: defaults flipped to ($NBH,$NFZ) but the preview" \
+           "refresh FAILED - $PREVIEW still describes the 128/8 capture;" \
+           "rerun bench.py or revert the flip before publishing" \
+           | tee -a /tmp/r4_lab.log
+    fi
+  else
+    cp /tmp/r4p2_ps_backup.py $PS
+    echo "block/fuse flip REVERTED (tests failed)" | tee -a /tmp/r4_lab.log
+  fi
+else
+  echo "block/fuse verdict: no flip (best=${NBH:-none})" | tee -a /tmp/r4_lab.log
+fi
+
+SCHED=$(sed -n 's/.*TPU_STENCIL_PALLAS_SCHEDULE", "\([a-z_]*\)").*/\1/p' $PS)
+export TPU_STENCIL_PALLAS_SCHEDULE=${SCHED:-pack}
+
+# 1. Autotune cache evidence — real (backend, schedule) verdicts on chip
+python -c "import numpy as np
+np.random.default_rng(0).integers(0,256,($H,$W,3),
+    dtype=np.uint8).tofile('/tmp/bench_img.raw')"
+CLI_EXTRA=${R4_CLI_EXTRA:-}
+TPU_STENCIL_AUTOTUNE_CACHE=$AT_CACHE timeout 2400 \
+    python -u -m tpu_stencil /tmp/bench_img.raw $W $H $REPS rgb \
+    --backend autotune --time --output /tmp/o.raw $CLI_EXTRA \
+    > /tmp/r4_autotune.log 2>&1
+echo "=== autotune rc=$? $(date +%H:%M:%S) ===" | tee -a /tmp/r4_lab.log
+
+# 2. Sharded Pallas compiled on chip: 1x1 mesh (VERDICT r3 item 4)
+timeout 1200 python -u -m tpu_stencil /tmp/bench_img.raw $W $H $REPS rgb \
+    --mesh 1x1 --backend pallas --time --output /tmp/o2.raw $CLI_EXTRA \
+    > /tmp/r4_1x1.log 2>&1
+echo "=== 1x1 rc=$? $(date +%H:%M:%S) ===" | tee -a /tmp/r4_lab.log
+
+# 3. Full sweep incl. stress + frames (VERDICT r3 items 2/3). The sweep
+# truncates its --csv target on open, so it writes to a temp path and
+# only replaces the published CSV (and regenerates the .md) on success —
+# a mid-sweep tunnel drop must not destroy the previous table.
+timeout 3600 python -u -m tpu_stencil.runtime.bench_sweep $SWEEP_ARGS \
+    --csv /tmp/r4p2_sweep.csv > /tmp/r4_sweep.log 2>&1
+SWEEP_RC=$?
+echo "=== sweep rc=$SWEEP_RC $(date +%H:%M:%S) ===" | tee -a /tmp/r4_lab.log
+
+# 4. Publish CSV + regenerated table, only from a completed sweep
+if [ "$SWEEP_RC" -eq 0 ]; then
+  cp /tmp/r4p2_sweep.csv "$CSV"
+  python tools/gen_benchmarks_md.py "$CSV" --out "${CSV%.csv}.md" \
+      --note "round 4, one TPU v5e chip via the axon tunnel, schedule=${SCHED:-pack} ($(date +%F))" \
+      >> /tmp/r4_lab.log 2>&1
+else
+  echo "sweep incomplete: published BENCHMARKS.csv/.md left untouched" \
+      | tee -a /tmp/r4_lab.log
+fi
+
+# 5. op_cost tail (informational; part 1 died inside it)
+timeout 900 python -u tools/op_cost.py add_i32 strip_add_i32 \
+    strip128_add_i32 mxu_rows_bf16 mxu_rows_i8 >> /tmp/r4_lab.log 2>&1
+echo "=== op_cost tail rc=$? $(date +%H:%M:%S) ===" | tee -a /tmp/r4_lab.log
+
+cp /tmp/r4_lab.log "$LOG_COPY" 2>/dev/null || true
+# Success marker for the poller: the sweep (the long pole, feeding the
+# published tables) completed.
+[ "$SWEEP_RC" -eq 0 ] && touch "$DONE_MARK"
+echo "=== r4 part2 complete $(date +%H:%M:%S) ===" | tee -a /tmp/r4_lab.log

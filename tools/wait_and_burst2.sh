@@ -9,7 +9,13 @@ BURST=${R4_BURST:-/root/repo/tools/r4_burst.sh}
 PREVIEW=${R4_PREVIEW:-/root/repo/docs/BENCH_r04_preview.json}
 MAX_TRIES=${R4_MAX_TRIES:-5}
 
+# Success predicate, overridable so other bursts reuse this poll loop
+# (tools/wait_and_burst3.sh gates on a completion marker instead).
 ok() {
+  if [ -n "${R4_OK_CMD:-}" ]; then
+    eval "$R4_OK_CMD"
+    return
+  fi
   python - "$PREVIEW" <<'EOF'
 import json, sys
 try:

@@ -1,0 +1,14 @@
+#!/bin/bash
+# Poll the TPU tunnel and run the round-4 part-2 burst until its success
+# marker (sweep completed -> published tables fresh) appears. Thin
+# wrapper: the poll/retry loop lives in wait_and_burst2.sh (R4_OK_CMD
+# overrides its success predicate). The burst clears the marker at start,
+# and this clears it up front too, so a stale marker from an earlier run
+# can never report a failed attempt as fresh.
+set -u
+DONE_MARK=${R4_DONE_MARK:-/tmp/r4_part2_done}
+rm -f "$DONE_MARK"
+R4_BURST=${R4_BURST:-/root/repo/tools/r4_burst_part2.sh} \
+R4_MAX_TRIES=${R4_MAX_TRIES:-8} \
+R4_OK_CMD="[ -f $DONE_MARK ]" \
+exec bash /root/repo/tools/wait_and_burst2.sh
