@@ -185,12 +185,31 @@ def _rep_val_packed(cur, *, plan, wc, channels, opts):
     caller). Returns the un-finished cols-pass accumulator (caller does
     shift + AND-mask)."""
     strip = opts.get("strip")
+    no_rows, no_cols = opts.get("no_rows"), opts.get("no_cols")
 
     def one(x):
-        # The SHIPPED packed passes: the lab A/B must time the kernel that
-        # would actually ship (binomial chains, shift-add multiplies).
-        return ps._packed_passes(x, plan=plan, wc=x.shape[1],
-                                 channels=channels)
+        if not (no_rows or no_cols):
+            # The SHIPPED packed passes: the lab A/B must time the kernel
+            # that would actually ship (binomial chains, shift-add muls).
+            return ps._packed_passes(x, plan=plan, wc=x.shape[1],
+                                     channels=channels)
+        # Ablation: same shipped pass helpers, one pass dropped, shapes
+        # preserved (rows still contract) so the rep loop composes. The
+        # helpers cover only binomial taps (unlike _packed_passes, which
+        # also has a per-tap loop) — fail with an actionable message
+        # rather than a range(None) TypeError for other filters.
+        rch, cch = (_binomial_chain(plan.row_taps),
+                    _binomial_chain(plan.col_taps))
+        if (not no_rows and rch is None) or (not no_cols and cch is None):
+            raise NotImplementedError(
+                "abl_swar_* ablations support binomial taps only "
+                f"(row_taps={plan.row_taps}, col_taps={plan.col_taps})")
+        h = plan.halo
+        rows_out = x.shape[0] - 2 * h
+        acc = (x[h:h + rows_out, :] if no_rows
+               else ps._rows_binomial(x, rch))
+        return (acc if no_cols
+                else ps._cols_binomial(acc, cch, channels, x.shape[1]))
 
     if not strip:
         return one(cur)
@@ -312,7 +331,12 @@ def _lab_kernel(in_hbm, out_ref, s_u8, sem, *, plan, block_h, grid,
             col = _rep_val_packed(cur, plan=plan, wc=wc, channels=channels,
                                   opts=opts)
             off += plan.halo
-            cur = (col >> plan.shift) & m[off:off + col.shape[0], :]
+            if opts.get("no_finish"):
+                cur = col  # passthrough; values overflow: abl-only
+            elif not masked:
+                cur = (col >> plan.shift) & 0x00FF00FF  # byte mask only
+            else:
+                cur = (col >> plan.shift) & m[off:off + col.shape[0], :]
         # Unpack: low half serves output rows [0, block_h/2), high half
         # the rest (coverage guaranteed by halo_al >= g).
         bh2 = block_h // 2
@@ -467,6 +491,15 @@ VARIANTS = {
     "swar_strips_1024": dict(swar=True, strip=1024),
     "swar_b256": dict(swar=True, block_h=256),
     "swar_f16_b256": dict(swar=True, block_h=256, fuse=16),
+    # SWAR (pack) ablations: attribute the shipped 22.66 us/rep (r4) the
+    # way abl_no_* attributed shrink's cost in r3. dma_only bounds the
+    # DMA + pack/unpack floor; the deltas price the rows chain, the cols
+    # chain, and the per-rep boundary AND.
+    "abl_swar_no_rows": dict(swar=True, no_rows=True),
+    "abl_swar_no_cols": dict(swar=True, no_cols=True),
+    "abl_swar_no_mask": dict(swar=True, no_mask=True),
+    "abl_swar_dma_only": dict(swar=True, no_rows=True, no_cols=True,
+                              no_finish=True),
     "abl_no_mask": dict(shrink=True, pair_add=True, no_mask=True),
     "abl_no_cols": dict(shrink=True, pair_add=True, no_cols=True,
                         no_mask=True),

@@ -122,6 +122,14 @@ else
       | tee -a /tmp/r4_lab.log
 fi
 
+# 4.5 SWAR attribution: price pack's rows chain / cols chain / boundary
+# AND, plus a clean un-contended re-read of the geometry outliers (part
+# 1's lab ran concurrently with a 303-test pytest suite).
+timeout 1500 python -u tools/kernel_lab.py swar abl_swar_no_rows \
+    abl_swar_no_cols abl_swar_no_mask abl_swar_dma_only swar_strips \
+    swar_f16_b256 >> /tmp/r4_lab.log 2>&1
+echo "=== swar attribution rc=$? $(date +%H:%M:%S) ===" | tee -a /tmp/r4_lab.log
+
 # 5. op_cost tail (informational; part 1 died inside it)
 timeout 900 python -u tools/op_cost.py add_i32 strip_add_i32 \
     strip128_add_i32 mxu_rows_bf16 mxu_rows_i8 >> /tmp/r4_lab.log 2>&1
