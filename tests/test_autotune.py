@@ -280,3 +280,38 @@ def test_forced_schedule_restricts_tuning_space(plan, tmp_path, monkeypatch):
     # unforced resolution is a separate cache entry and still finds pack
     got = autotune.best_config(plan, (128, 96), 3, measure=fake_measure)
     assert got == ("pallas", "pack")
+
+
+def test_forced_geometry_keys_and_measures(plan, tmp_path, monkeypatch):
+    # --block-h/--fuse + auto: pallas candidates are measured at the
+    # forced geometry, the verdict is cached under a geometry-suffixed
+    # key, and default-geometry tuning still works with pre-geometry
+    # measure signatures (no block_h/fuse kwargs).
+    import jax
+
+    monkeypatch.setenv("TPU_STENCIL_AUTOTUNE_CACHE", str(tmp_path / "c.json"))
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    geo_calls = []
+
+    def geo_measure(plan, shape, channels, backend, reps=0, schedule=None,
+                    block_h=None, fuse=None):
+        geo_calls.append((backend, schedule, block_h, fuse))
+        return 1e-6 if backend == "pallas" else 2e-6
+
+    got = autotune.best_config(plan, (128, 96), 3, measure=geo_measure,
+                               block_h=256, fuse=16)
+    assert got[0] == "pallas"
+    assert ("xla", None, None, None) in geo_calls  # xla never gets geometry
+    assert all(bh == 256 and fz == 16
+               for b, s, bh, fz in geo_calls if b == "pallas")
+
+    # distinct cache entries: default geometry re-measures (with a
+    # pre-geometry measure signature, proving back-compat)
+    legacy_calls = []
+
+    def legacy_measure(plan, shape, channels, backend, reps=0, schedule=None):
+        legacy_calls.append((backend, schedule))
+        return 1e-6
+
+    autotune.best_config(plan, (128, 96), 3, measure=legacy_measure)
+    assert legacy_calls  # not served from the geometry-keyed entry

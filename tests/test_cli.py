@@ -350,3 +350,117 @@ def test_cli_frames_periodic(tmp_path, rng):
             frames[k], filters.get_filter("gaussian"), 3, boundary="periodic"
         )
         np.testing.assert_array_equal(got[k], want)
+
+
+def test_geometry_flags_parse_and_validate():
+    cfg, _ = parse_args(
+        ["waterfall.raw", "1920", "2520", "40", "rgb",
+         "--block-h", "256", "--fuse", "16"]
+    )
+    assert cfg.block_h == 256 and cfg.fuse == 16
+    cfg, _ = parse_args(["waterfall.raw", "1920", "2520", "40", "rgb"])
+    assert cfg.block_h is None and cfg.fuse is None
+    with pytest.raises(ValueError):
+        JobConfig("x", 5, 5, 1, ImageType.GREY, block_h=0)
+    with pytest.raises(ValueError):
+        JobConfig("x", 5, 5, 1, ImageType.GREY, fuse=-2)
+
+
+def test_geometry_flags_reach_model_and_degrade_pack():
+    from tpu_stencil.models.blur import IteratedConv2D
+
+    m = IteratedConv2D("gaussian", backend="pallas", block_h=256, fuse=16)
+    assert (m.block_h, m.fuse) == (256, 16)
+    # pack survives a 16-multiple block...
+    assert m.resolved_config((512, 128), 3) == ("pallas", "pack")
+    # ...but a forced non-16-multiple block degrades it to shrink, and the
+    # reported schedule must be the one that actually runs.
+    m2 = IteratedConv2D("gaussian", backend="pallas", block_h=24)
+    assert m2.resolved_config((512, 128), 3) == ("pallas", "shrink")
+    with pytest.raises(ValueError):
+        IteratedConv2D("gaussian", block_h=0)
+    with pytest.raises(ValueError):
+        IteratedConv2D("gaussian", fuse=0)
+
+
+def test_geometry_flags_cli_end_to_end(tmp_path, rng):
+    # Forced geometry must not change results, only the launch shape —
+    # bit-exact vs the golden model, incl. a fuse that does not divide
+    # reps (remainder single-rep launches) and a block that degrades pack.
+    # Subprocess: the in-process test env exposes 8 virtual devices, which
+    # routes bare CLI runs to the sharded mesh path; the single-device
+    # geometry path needs a 1-device env (like the schedule e2e test).
+    import subprocess, sys
+    img = rng.integers(0, 256, size=(40, 16, 3), dtype=np.uint8)
+    src = str(tmp_path / "img.raw")
+    img.tofile(src)
+    want = stencil.reference_stencil_numpy(img, filters.get_filter("gaussian"), 5)
+    for extra in (["--block-h", "16", "--fuse", "3"],
+                  ["--block-h", "24"]):
+        out = str(tmp_path / "o.raw")
+        env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+        r = subprocess.run(
+            [sys.executable, "-m", "tpu_stencil", src, "16", "40", "5",
+             "rgb", "--backend", "pallas", "--platform", "cpu",
+             "--output", out] + extra,
+            capture_output=True, text=True, timeout=300, env=env,
+        )
+        assert r.returncode == 0, r.stderr
+        got = np.fromfile(out, np.uint8).reshape(40, 16, 3)
+        np.testing.assert_array_equal(got, want)
+
+
+def test_geometry_flags_frames_end_to_end(tmp_path, rng):
+    # The fused tall-image batch path honors forced geometry too.
+    frames = rng.integers(0, 256, size=(2, 24, 16, 3), dtype=np.uint8)
+    src = str(tmp_path / "clip.raw")
+    frames.tofile(src)
+    out = str(tmp_path / "o.raw")
+    assert cli.main([src, "16", "24", "4", "rgb", "--frames", "2",
+                     "--backend", "pallas", "--mesh", "1x1",
+                     "--block-h", "16", "--fuse", "2",
+                     "--output", out]) == 0
+    got = np.fromfile(out, np.uint8).reshape(2, 24, 16, 3)
+    for k in range(2):
+        want = stencil.reference_stencil_numpy(
+            frames[k], filters.get_filter("gaussian"), 4
+        )
+        np.testing.assert_array_equal(got[k], want)
+
+
+def test_geometry_report_is_effective_not_requested(tmp_path, rng):
+    # --time must report the geometry that LAUNCHED: block rounded to the
+    # sublane multiple, fuse clamped to block/(2*halo) — never the raw
+    # requested values (report-what-ran, like the schedule field).
+    # Subprocess for a 1-device env (see test_geometry_flags_cli_end_to_end).
+    import subprocess, sys
+    img = rng.integers(0, 256, size=(40, 16, 3), dtype=np.uint8)
+    src = str(tmp_path / "img.raw")
+    img.tofile(src)
+    out = str(tmp_path / "o.raw")
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    r = subprocess.run(
+        [sys.executable, "-m", "tpu_stencil", src, "16", "40", "2", "rgb",
+         "--backend", "pallas", "--platform", "cpu", "--block-h", "20",
+         "--fuse", "64", "--time", "--output", out],
+        capture_output=True, text=True, timeout=300, env=env,
+    )
+    assert r.returncode == 0, r.stderr
+    # 20 rounds to 24; fuse clamps to 24 // (2*1) = 12
+    assert "block_h=24 fuse=12" in r.stdout, r.stdout
+
+
+def test_geometry_not_reported_on_sharded_mesh(tmp_path, rng, capsys):
+    # The spatial-mesh path sizes its own tiles: forced geometry is
+    # ignored there, must NOT appear in the report, and a stderr note
+    # says so.
+    img = rng.integers(0, 256, size=(16, 16), dtype=np.uint8)
+    src = str(tmp_path / "g.raw")
+    raw_io.write_raw(src, img[..., None])
+    out = str(tmp_path / "o.raw")
+    assert cli.main([src, "16", "16", "2", "grey", "--mesh", "2x2",
+                     "--backend", "pallas", "--block-h", "256", "--time",
+                     "--output", out]) == 0
+    cap = capsys.readouterr()
+    assert "block_h" not in cap.out, cap.out
+    assert "sizes its own tiles" in cap.err, cap.err

@@ -59,6 +59,11 @@ def main(argv=None) -> int:
             f" schedule={result.schedule or 'default'}"
             if result.backend == "pallas" else ""
         )
+        if result.block_h is not None:
+            # Effective launched geometry (post align/clamp), reported
+            # only when the user forced it on a path that honors it —
+            # never the requested values verbatim (report-what-ran).
+            sched += f" block_h={result.block_h} fuse={result.fuse}"
         print(
             f"total (incl. I/O): {result.total_seconds:.3f} sec; "
             f"backend={result.backend}{sched} mesh={result.mesh_shape}"

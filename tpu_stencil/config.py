@@ -50,6 +50,13 @@ class JobConfig:
     frames: int = 1  # >1: batched video mode (N concatenated raw frames)
     schedule: Optional[str] = None  # Pallas per-rep schedule (None = tuned)
     boundary: str = "zero"  # zero (reference semantics) | periodic
+    # Pallas kernel geometry (None = kernel defaults): rows per grid
+    # program and fused reps per HBM round-trip. Expert knobs for on-chip
+    # A/Bs and shapes whose best geometry differs from the default;
+    # single-device and --frames paths only (the sharded mesh path sizes
+    # its halo exchange from its own fuse choice).
+    block_h: Optional[int] = None
+    fuse: Optional[int] = None
     # Accumulation dtype is a property of the backend's plan, not a flag:
     # integer plans accumulate exactly (int16/int32), --backend reference
     # forces the float32 semantics of the C code. A separate dtype knob was
@@ -77,6 +84,10 @@ class JobConfig:
             raise ValueError(
                 f"unknown boundary {self.boundary!r}; expected zero|periodic"
             )
+        if self.block_h is not None and self.block_h < 1:
+            raise ValueError(f"block_h must be >= 1, got {self.block_h}")
+        if self.fuse is not None and self.fuse < 1:
+            raise ValueError(f"fuse must be >= 1, got {self.fuse}")
 
     @property
     def channels(self) -> int:
@@ -167,6 +178,21 @@ def build_parser() -> argparse.ArgumentParser:
              "run degrade to their fallback",
     )
     p.add_argument(
+        "--block-h", dest="block_h", type=int, default=None, metavar="ROWS",
+        help="force the Pallas kernel's rows-per-grid-program (rounded up "
+             "to a sublane multiple of 8; pack needs a multiple of 16 or "
+             "it degrades). Default: the kernel's measured default. "
+             "Single-device and --frames paths; the sharded mesh path "
+             "sizes its own tiles",
+    )
+    p.add_argument(
+        "--fuse", type=int, default=None, metavar="REPS",
+        help="force the Pallas kernel's fused reps per HBM round-trip "
+             "(clamped to block_h/(2*halo); reps %% fuse remainder runs "
+             "as single-rep launches). Default: the kernel's measured "
+             "default. Single-device and --frames paths only",
+    )
+    p.add_argument(
         "--platform", default=None, choices=["cpu", "tpu", "gpu"],
         help="force the JAX platform via the config API before backend "
              "init. Needed where the environment pins JAX_PLATFORMS (a "
@@ -230,6 +256,8 @@ def parse_args(argv=None) -> Tuple[JobConfig, argparse.Namespace]:
             frames=ns.frames,
             schedule=ns.schedule,
             boundary=ns.boundary,
+            block_h=ns.block_h,
+            fuse=ns.fuse,
         )
     except ValueError as e:
         parser.error(str(e))

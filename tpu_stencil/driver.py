@@ -91,6 +91,7 @@ def _place_frames(model, imgs: np.ndarray, devices):
             frames_fn = _sharded.build_batched_frames(
                 bmesh, model.plan, b_schedule,
                 interpret=jax.default_backend() == "cpu",
+                block_h=model.block_h, fuse=model.fuse,
             )
 
             def step_fn(x, n):
@@ -129,6 +130,26 @@ class JobResult:
     backend: str
     mesh_shape: Optional[tuple]
     schedule: Optional[str] = None  # pallas per-rep schedule that ran
+    # Effective Pallas kernel geometry that LAUNCHED (post align/clamp),
+    # reported only when the user forced --block-h/--fuse on a path that
+    # honors them; None otherwise (defaults, xla, or the sharded mesh
+    # path, which sizes its own tiles). Report-what-ran, like `schedule`.
+    block_h: Optional[int] = None
+    fuse: Optional[int] = None
+
+
+def _ran_geometry(cfg, model, backend: str, rows: int):
+    """The (block_h, fuse) to report for a ``rows``-tall Pallas launch:
+    the effective geometry when the user forced either knob, else
+    (None, None) — never the requested values verbatim (they align/clamp,
+    and must not be attributed to runs that ignored them)."""
+    if backend != "pallas" or (cfg.block_h is None and cfg.fuse is None):
+        return None, None
+    from tpu_stencil.ops import pallas_stencil
+
+    return pallas_stencil.effective_geometry(
+        model.plan, rows, cfg.block_h, cfg.fuse
+    )
 
 
 def _maybe_profile(profile_dir: Optional[str]):
@@ -205,7 +226,8 @@ def run_job(
         raise ValueError(f"checkpoint_every must be >= 0, got {checkpoint_every}")
     with Timer() as total_t:
         model = IteratedConv2D(cfg.filter_name, backend=cfg.backend,
-                               schedule=cfg.schedule, boundary=cfg.boundary)
+                               schedule=cfg.schedule, boundary=cfg.boundary,
+                               block_h=cfg.block_h, fuse=cfg.fuse)
 
         if devices is None:
             devices = jax.devices()
@@ -291,14 +313,19 @@ def run_job(
     # resolution (auto/autotune consult the measured cache, memoized
     # in-process).
     if cfg.frames > 1:
+        n_per = -(-cfg.frames // n_dev)
         ran_backend, ran_schedule = model.batch_config(
-            (cfg.height, cfg.width), cfg.channels, True,
-            n_frames=-(-cfg.frames // n_dev),
+            (cfg.height, cfg.width), cfg.channels, True, n_frames=n_per,
         )
+        from tpu_stencil.ops import pallas_stencil as _ps
+
+        geo_rows = n_per * _ps.frames_stride(model.plan, cfg.height)
     else:
         ran_backend, ran_schedule = model.resolved_config(
             (cfg.height, cfg.width), cfg.channels
         )
+        geo_rows = cfg.height
+    ran_bh, ran_fuse = _ran_geometry(cfg, model, ran_backend, geo_rows)
     return JobResult(
         output_path=cfg.output_path,
         compute_seconds=compute_seconds,
@@ -306,6 +333,8 @@ def run_job(
         backend=ran_backend,
         mesh_shape=None,
         schedule=ran_schedule if ran_backend == "pallas" else None,
+        block_h=ran_bh,
+        fuse=ran_fuse,
     )
 
 
@@ -391,8 +420,12 @@ def _run_frames_multihost(cfg, model, profile_dir, checkpoint_every,
         ckpt.clear(cfg)
     # Report at this host's real per-device frame count: a straggler
     # host's shorter tall launch can degrade differently than a full one.
-    backend, schedule = model.batch_config(
-        (h, w), ch, True, n_frames=-(-(n_local or per) // n_ld)
+    n_per = -(-(n_local or per) // n_ld)
+    backend, schedule = model.batch_config((h, w), ch, True, n_frames=n_per)
+    from tpu_stencil.ops import pallas_stencil as _ps
+
+    ran_bh, ran_fuse = _ran_geometry(
+        cfg, model, backend, n_per * _ps.frames_stride(model.plan, h)
     )
     return JobResult(
         output_path=cfg.output_path,
@@ -401,12 +434,25 @@ def _run_frames_multihost(cfg, model, profile_dir, checkpoint_every,
         backend=backend,
         mesh_shape=None,
         schedule=schedule if backend == "pallas" else None,
+        block_h=ran_bh,
+        fuse=ran_fuse,
     )
 
 
 def _run_sharded(cfg, model, devices, profile_dir, checkpoint_every, resume,
                  total_t) -> JobResult:
     from tpu_stencil.parallel import distributed, sharded
+
+    if cfg.block_h is not None or cfg.fuse is not None:
+        import sys
+
+        # Never silently ignore a forced knob: the mesh path sizes its
+        # own tiles (and JobResult reports no geometry for it).
+        print(
+            "note: --block-h/--fuse apply to the single-device and "
+            "--frames paths; the sharded mesh path sizes its own tiles",
+            file=sys.stderr,
+        )
 
     if jax.process_count() > 1 and not images_io.is_raw(cfg.output_path):
         # Fail before the compute, not after: fetching a global array for an

@@ -65,7 +65,9 @@ def _resolve_step(backend: str):
 def iterate(img_u8: jax.Array, repetitions: jax.Array,
             plan: _lowering.StencilPlan, backend: str = "xla",
             boundary: str = "zero",
-            schedule: Optional[str] = None) -> jax.Array:
+            schedule: Optional[str] = None,
+            block_h: Optional[int] = None,
+            fuse: Optional[int] = None) -> jax.Array:
     """Apply the stencil ``repetitions`` times; uint8 in, uint8 out.
 
     The input buffer is donated: XLA reuses it as one of the two HBM
@@ -74,22 +76,27 @@ def iterate(img_u8: jax.Array, repetitions: jax.Array,
     gets its fastest schedule (see :mod:`tpu_stencil.ops.lowering`).
     ``boundary='periodic'`` runs the wraparound semantics; the single-device
     Pallas kernel is zero-boundary only, so periodic uses the XLA schedule.
-    ``schedule`` picks the Pallas per-rep schedule (None = default; ignored
-    by the XLA backend).
+    ``schedule`` picks the Pallas per-rep schedule, ``block_h``/``fuse``
+    the kernel geometry (None = defaults; all ignored by the XLA backend).
     """
     if not (resolve_backend(backend) == "pallas" and boundary == "zero"):
-        # schedule only affects the Pallas path; normalize it out of the
-        # jit cache key so xla/periodic calls never recompile per schedule.
-        schedule = None
+        # schedule/geometry only affect the Pallas path; normalize them
+        # out of the jit cache key so xla/periodic calls never recompile.
+        schedule = block_h = fuse = None
     return _iterate_impl(img_u8, repetitions, plan=plan, backend=backend,
-                         boundary=boundary, schedule=schedule)
+                         boundary=boundary, schedule=schedule,
+                         block_h=block_h, fuse=fuse)
 
 
 @functools.partial(
-    jax.jit, static_argnames=("plan", "backend", "boundary", "schedule"),
+    jax.jit,
+    static_argnames=(
+        "plan", "backend", "boundary", "schedule", "block_h", "fuse"
+    ),
     donate_argnums=(0,),
 )
-def _iterate_impl(img_u8, repetitions, plan, backend, boundary, schedule):
+def _iterate_impl(img_u8, repetitions, plan, backend, boundary, schedule,
+                  block_h=None, fuse=None):
     if resolve_backend(backend) == "pallas" and boundary == "zero":
         from tpu_stencil.ops import pallas_stencil
 
@@ -109,6 +116,7 @@ def _iterate_impl(img_u8, repetitions, plan, backend, boundary, schedule):
         return pallas_stencil.iterate(
             img_u8, repetitions, plan, interpret=plat == "cpu",
             schedule=schedule,
+            block_h=block_h, fuse=fuse,
         )
     eff_backend = (
         "xla" if resolve_backend(backend) == "pallas" else backend
@@ -146,14 +154,17 @@ def iterate_batch(imgs_u8: jax.Array, repetitions: jax.Array,
 
 
 @functools.partial(
-    jax.jit, static_argnames=("plan", "interpret", "schedule"),
+    jax.jit,
+    static_argnames=("plan", "interpret", "schedule", "block_h", "fuse"),
     donate_argnums=(0,),
 )
-def _jit_frames(imgs_u8, repetitions, plan, interpret, schedule):
+def _jit_frames(imgs_u8, repetitions, plan, interpret, schedule,
+                block_h=None, fuse=None):
     from tpu_stencil.ops import pallas_stencil
 
     return pallas_stencil.iterate_frames(
-        imgs_u8, repetitions, plan, interpret=interpret, schedule=schedule
+        imgs_u8, repetitions, plan, interpret=interpret, schedule=schedule,
+        block_h=block_h, fuse=fuse,
     )
 
 
@@ -170,6 +181,8 @@ class IteratedConv2D:
         backend: str = "auto",
         boundary: str = "zero",
         schedule: Optional[str] = None,
+        block_h: Optional[int] = None,
+        fuse: Optional[int] = None,
     ) -> None:
         if isinstance(filt, str):
             filt = _filters.get_filter(filt)
@@ -185,6 +198,13 @@ class IteratedConv2D:
 
             pallas_stencil._check_schedule(schedule)
         self.schedule = schedule  # forced Pallas schedule (None = tuned)
+        if block_h is not None and block_h < 1:
+            raise ValueError(f"block_h must be >= 1, got {block_h}")
+        if fuse is not None and fuse < 1:
+            raise ValueError(f"fuse must be >= 1, got {fuse}")
+        # Forced Pallas kernel geometry (None = kernel defaults).
+        self.block_h = block_h
+        self.fuse = fuse
         self.plan = _lowering.plan_filter(self.filter)
         if backend == "reference":
             self.plan = _lowering.force_f32_plan(self.plan)
@@ -223,6 +243,7 @@ class IteratedConv2D:
                 self._resolved[key] = autotune.best_config(
                     self.plan, tuple(shape), channels,
                     force_schedule=self.schedule,
+                    block_h=self.block_h, fuse=self.fuse,
                 )
             backend, schedule = self._resolved[key]
         else:
@@ -242,7 +263,7 @@ class IteratedConv2D:
             # Resolve (and report) the schedule that actually runs at this
             # launch's block height — never a degraded-away name.
             schedule = pallas_stencil.effective_schedule_for(
-                self.plan, shape[0], schedule
+                self.plan, shape[0], schedule, block_h=self.block_h
             )
         return backend, schedule
 
@@ -284,7 +305,7 @@ class IteratedConv2D:
                     self.plan, frame_shape[0]
                 )
                 return backend, pallas_stencil.effective_schedule_for(
-                    self.plan, rows, schedule
+                    self.plan, rows, schedule, block_h=self.block_h
                 )
         rb = resolve_backend(self.backend)
         return ("xla" if rb == "pallas" else rb), None
@@ -305,6 +326,7 @@ class IteratedConv2D:
             return _jit_frames(
                 imgs_u8, jnp.int32(repetitions), plan=self.plan,
                 interpret=jax.default_backend() == "cpu", schedule=schedule,
+                block_h=self.block_h, fuse=self.fuse,
             )
         return iterate_batch(
             imgs_u8, jnp.int32(repetitions), plan=self.plan,
@@ -325,4 +347,5 @@ class IteratedConv2D:
         return iterate(
             img_u8, jnp.int32(repetitions), plan=self.plan, backend=resolved,
             boundary=self.boundary, schedule=schedule,
+            block_h=self.block_h, fuse=self.fuse,
         )

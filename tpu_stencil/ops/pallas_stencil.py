@@ -42,7 +42,7 @@ from __future__ import annotations
 
 import functools
 import os
-from typing import Optional
+from typing import Optional, Tuple
 
 import numpy as np
 import jax
@@ -94,12 +94,31 @@ def _check_schedule(schedule: Optional[str]) -> str:
     return schedule
 
 
-def effective_block_h(n_rows: int, block_h: int = DEFAULT_BLOCK_H) -> int:
+def effective_block_h(n_rows: int, block_h: Optional[int] = None) -> int:
     """The block height :func:`iterate` actually runs for an ``n_rows``-tall
-    image: 8-row (sublane) aligned, clamped to the padded image height.
-    Exposed so the autotuner's schedule dedup sees the same clamp."""
+    image: 8-row (sublane) aligned, clamped to the padded image height
+    (``None`` = the module default). Exposed so the autotuner's schedule
+    dedup sees the same clamp."""
+    block_h = block_h or DEFAULT_BLOCK_H
     block_h = -(-block_h // 8) * 8  # DMA descriptors need 8-row alignment
     return min(block_h, -(-n_rows // 8) * 8)
+
+
+def effective_geometry(plan: StencilPlan, n_rows: int,
+                       block_h: Optional[int] = None,
+                       fuse: Optional[int] = None) -> Tuple[int, int]:
+    """The (block_h, fuse) :func:`iterate` actually launches for an
+    ``n_rows``-tall image: the aligned/clamped block, and fuse clamped to
+    ``block_h / (2*halo)`` so the ghost bands stay a bounded fraction of
+    the block (halo-0 plans are unclamped). ``None`` = module defaults.
+    Single source of truth for the rep-loop clamp AND for reporting
+    layers — a run must never be attributed to a geometry that did not
+    launch."""
+    bh = effective_block_h(n_rows, block_h)
+    fz = fuse or DEFAULT_FUSE
+    if plan.halo:
+        fz = max(1, min(fz, bh // (2 * plan.halo)))
+    return bh, fz
 
 
 def frames_stride(plan: StencilPlan, frame_h: int) -> int:
@@ -110,13 +129,17 @@ def frames_stride(plan: StencilPlan, frame_h: int) -> int:
 
 
 def effective_schedule_for(plan: StencilPlan, n_rows: int,
-                           schedule: Optional[str] = None) -> str:
+                           schedule: Optional[str] = None,
+                           block_h: Optional[int] = None) -> str:
     """The schedule that actually runs for an ``n_rows``-tall launch —
     the requested (or default) schedule after any degrade at the block
-    height :func:`iterate`/:func:`iterate_frames` will use. Reporting
-    layers must use this so a degraded run is never attributed to a
-    schedule that could not apply."""
-    return _effective_schedule(schedule, plan, effective_block_h(n_rows))
+    height :func:`iterate`/:func:`iterate_frames` will use (``block_h``:
+    forced geometry, None = default; pack needs a 16-multiple block).
+    Reporting layers must use this so a degraded run is never attributed
+    to a schedule that could not apply."""
+    return _effective_schedule(
+        schedule, plan, effective_block_h(n_rows, block_h)
+    )
 
 
 def _pack_ok(plan: StencilPlan, block_h: int) -> bool:
@@ -890,14 +913,13 @@ def _run_rep_loop(x2, repetitions, plan: StencilPlan, rows: int,
     """Shared tail of :func:`iterate` / :func:`iterate_frames`: clamp the
     block and fuse depth, pad to block/lane multiples (>= halo*C ghost
     lanes), run ``repetitions`` as fused + remainder single-rep launches,
-    and crop. ``x2`` is the flat (rows, wc) uint8 view."""
-    bh = effective_block_h(rows, block_h)
+    and crop. ``x2`` is the flat (rows, wc) uint8 view. ``block_h`` /
+    ``fuse`` may be None (module defaults); the clamp lives in
+    :func:`effective_geometry` (fuse capped so the ghost bands stay a
+    small fraction of the block and the tile fits VMEM; halo-0 filters
+    have no ghost bands, any fuse depth is free)."""
+    bh, fuse = effective_geometry(plan, rows, block_h, fuse)
     hp = -(-rows // bh) * bh
-    # Cap fuse so the ghost bands stay a small fraction of the block
-    # (compute overhead 2*fuse*halo/block_h) and the tile fits VMEM.
-    # halo-0 (1x1) filters have no ghost bands: any fuse depth is free.
-    if plan.halo:
-        fuse = max(1, min(fuse, bh // (2 * plan.halo)))
     # Lane-aligned width with >= halo*C ghost lanes (pad doubles as ghosts).
     wcp = -(-(wc + plan.halo * channels) // 128) * 128
     if hp != rows or wcp != wc:
@@ -919,7 +941,7 @@ def _run_rep_loop(x2, repetitions, plan: StencilPlan, rows: int,
 
 
 def iterate(img_u8: jax.Array, repetitions: jax.Array, plan: StencilPlan,
-            block_h: int = DEFAULT_BLOCK_H, fuse: int = DEFAULT_FUSE,
+            block_h: Optional[int] = None, fuse: Optional[int] = None,
             interpret: bool = False, schedule: str = None) -> jax.Array:
     """Apply the Pallas stencil ``repetitions`` times (traceable/jittable).
 
@@ -945,8 +967,8 @@ def iterate(img_u8: jax.Array, repetitions: jax.Array, plan: StencilPlan,
 
 
 def iterate_frames(imgs_u8: jax.Array, repetitions: jax.Array,
-                   plan: StencilPlan, block_h: int = DEFAULT_BLOCK_H,
-                   fuse: int = DEFAULT_FUSE, interpret: bool = False,
+                   plan: StencilPlan, block_h: Optional[int] = None,
+                   fuse: Optional[int] = None, interpret: bool = False,
                    schedule: str = None, vma=None) -> jax.Array:
     """Apply the stencil ``repetitions`` times to N independent frames
     ``(N, H, W[, C])`` — the fused-kernel batch mode.

@@ -146,11 +146,13 @@ def broadcast_config(cfg: Optional[JobConfig]) -> JobConfig:
         mr, mc = cfg.mesh_shape if cfg.mesh_shape is not None else (-1, -1)
         fields = np.array(
             [cfg.width, cfg.height, cfg.repetitions,
-             0 if cfg.image_type is ImageType.GREY else 1, mr, mc, cfg.frames],
+             0 if cfg.image_type is ImageType.GREY else 1, mr, mc, cfg.frames,
+             cfg.block_h if cfg.block_h is not None else -1,
+             cfg.fuse if cfg.fuse is not None else -1],
             np.int64,
         )
     fields = multihost_utils.broadcast_one_to_all(
-        fields if fields is not None else np.zeros(7, np.int64)
+        fields if fields is not None else np.zeros(9, np.int64)
     )
     names = multihost_utils.broadcast_one_to_all(
         _encode_strs([cfg.image, cfg.filter_name, cfg.backend,
@@ -179,6 +181,8 @@ def broadcast_config(cfg: Optional[JobConfig]) -> JobConfig:
         frames=int(fields[6]),
         schedule=schedule or None,
         boundary=boundary,
+        block_h=int(fields[7]) if int(fields[7]) > 0 else None,
+        fuse=int(fields[8]) if int(fields[8]) > 0 else None,
     )
 
 

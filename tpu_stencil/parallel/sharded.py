@@ -176,7 +176,8 @@ def build_sharded_iterate(
 
 
 def build_batched_frames(mesh: Mesh, plan: _lowering.StencilPlan,
-                         schedule=None, interpret: bool = False):
+                         schedule=None, interpret: bool = False,
+                         block_h=None, fuse=None):
     """Compile-once builder for batch-axis frame parallelism with the
     fused tall-image kernel: each device runs
     :func:`pallas_stencil.iterate_frames` on its local frames — frames
@@ -191,6 +192,7 @@ def build_batched_frames(mesh: Mesh, plan: _lowering.StencilPlan,
     def local(imgs_local, reps):
         return pallas_stencil.iterate_frames(
             imgs_local, reps, plan, interpret=interpret, schedule=schedule,
+            block_h=block_h, fuse=fuse,
             vma=("b",),
         )
 

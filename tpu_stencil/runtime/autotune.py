@@ -80,9 +80,11 @@ def _store_cache(cache: dict) -> None:
 def measure_backend(
     plan: StencilPlan, shape: Tuple[int, int], channels: int, backend: str,
     reps: int = 400, schedule: Optional[str] = None,
+    block_h: Optional[int] = None, fuse: Optional[int] = None,
 ) -> float:
     """Steady-state seconds per repetition of ``backend`` on this shape
-    (``schedule`` selects the Pallas per-rep schedule; None = default)."""
+    (``schedule`` selects the Pallas per-rep schedule, ``block_h``/``fuse``
+    the kernel geometry; None = defaults)."""
     import jax
     import jax.numpy as jnp
 
@@ -97,7 +99,7 @@ def measure_backend(
         np.asarray(dev.ravel()[0])
         t0 = time.perf_counter()
         out = iterate(dev, jnp.int32(n), plan=plan, backend=backend,
-                      schedule=schedule)
+                      schedule=schedule, block_h=block_h, fuse=fuse)
         np.asarray(out.ravel()[0])
         return time.perf_counter() - t0
 
@@ -125,15 +127,17 @@ def _steady_state_per_rep(run, reps: int) -> float:
     return hi / (2 * reps)
 
 
-def _pallas_schedules(plan: StencilPlan, shape: Tuple[int, int]):
+def _pallas_schedules(plan: StencilPlan, shape: Tuple[int, int],
+                      block_h: Optional[int] = None):
     """The distinct Pallas per-rep schedules for this (plan, shape):
     schedules that would degrade (e.g. pack on gaussian7, or on a block
     clamped to an odd image height) duplicate their degradation target and
     are never measured twice. Uses the same block clamp as
-    ``pallas_stencil.iterate``."""
+    ``pallas_stencil.iterate`` (``block_h``: forced geometry, None =
+    default)."""
     from tpu_stencil.ops import pallas_stencil as ps
 
-    bh = ps.effective_block_h(shape[0])
+    bh = ps.effective_block_h(shape[0], block_h)
     return [
         s for s in ps._SCHEDULES
         if ps._effective_schedule(s, plan, bh) == s
@@ -147,6 +151,8 @@ def best_config(
     cache: bool = True,
     measure=None,
     force_schedule: Optional[str] = None,
+    block_h: Optional[int] = None,
+    fuse: Optional[int] = None,
 ) -> Tuple[str, Optional[str]]:
     """The fastest (backend, pallas_schedule) for this (platform, filter,
     shape), from the disk cache when available, measured (and cached)
@@ -157,7 +163,9 @@ def best_config(
     flag) restricts the Pallas side to that one schedule (after any
     degrade for this plan/shape), so the xla-vs-pallas verdict is decided
     by timings of the schedule that will actually run — cached under its
-    own key."""
+    own key. ``block_h``/``fuse`` (the --block-h/--fuse flags) likewise
+    force the kernel geometry: Pallas candidates are measured at it, and
+    the verdict is cached under a geometry-suffixed key."""
     import jax
 
     if jax.default_backend() not in ("tpu", "axon"):
@@ -171,9 +179,20 @@ def best_config(
     key = _key(plan, shape, channels)
     if force_schedule is not None:
         force_schedule = ps._effective_schedule(
-            force_schedule, plan, ps.effective_block_h(shape[0])
+            force_schedule, plan,
+            ps.effective_block_h(shape[0], block_h),
         )
         key += f"|forced={force_schedule}"
+    # Only passed through to measure() when set: the measure callable is
+    # monkeypatchable (12 tests) and pre-geometry signatures must keep
+    # working for default-geometry tuning.
+    geo_kw = {}
+    if block_h is not None:
+        key += f"|bh={block_h}"
+        geo_kw["block_h"] = block_h
+    if fuse is not None:
+        key += f"|fz={fuse}"
+        geo_kw["fuse"] = fuse
     store = _load_cache() if cache else {}
     hit = store.get(key)
     if (
@@ -186,14 +205,17 @@ def best_config(
         return hit["backend"], hit.get("schedule")
     pallas_scheds = (
         [force_schedule] if force_schedule is not None
-        else _pallas_schedules(plan, shape)
+        else _pallas_schedules(plan, shape, block_h)
     )
     candidates = [("xla", None)] + [("pallas", s) for s in pallas_scheds]
     timings = {}
     last_err = None
     for b, s in candidates:
         try:
-            timings[(b, s)] = measure(plan, shape, channels, b, schedule=s)
+            timings[(b, s)] = measure(
+                plan, shape, channels, b, schedule=s,
+                **(geo_kw if b == "pallas" else {}),
+            )
         except Exception as e:  # one broken schedule must not kill the tune
             last_err = e
     if not timings:
