@@ -302,8 +302,23 @@ def test_forced_geometry_keys_and_measures(plan, tmp_path, monkeypatch):
                                block_h=256, fuse=16)
     assert got[0] == "pallas"
     assert ("xla", None, None, None) in geo_calls  # xla never gets geometry
-    assert all(bh == 256 and fz == 16
+    # Measured at the EFFECTIVE geometry: 256 clamps to the 128-row image
+    # (what actually launches), fuse 16 fits 128/(2*1).
+    assert all(bh == 128 and fz == 16
                for b, s, bh, fz in geo_calls if b == "pallas")
+
+    # Requested geometries that launch identically share one cache entry:
+    # block 100 and 104 both align to 104 — the second call must be a
+    # cache hit (no new measurements).
+    n_before = len(geo_calls)
+    a = autotune.best_config(plan, (128, 96), 3, measure=geo_measure,
+                             block_h=100)
+    n_mid = len(geo_calls)
+    b = autotune.best_config(plan, (128, 96), 3, measure=geo_measure,
+                             block_h=104)
+    assert a == b
+    assert n_mid > n_before          # first geometry measured
+    assert len(geo_calls) == n_mid   # second was served from cache
 
     # distinct cache entries: default geometry re-measures (with a
     # pre-geometry measure signature, proving back-compat)

@@ -129,3 +129,33 @@ def test_fuzz_generator_covers_all_regimes():
     assert kinds >= {"sep_int", "direct_int", "direct_f32"}
     assert exacts == {True, False}
     assert True in binoms
+
+
+@pytest.mark.parametrize("case", range(10))
+def test_random_geometry_matches_golden(case):
+    # Geometry invariance by construction: random (block_h, fuse) — odd
+    # blocks (degrading pack), fuse over- and under-dividing reps — must
+    # never change results, through the product path (blur.iterate).
+    rng = np.random.default_rng(3000 + case)
+    f = _random_filter(rng, style="binomial")
+    plan = lowering.plan_filter(f)
+    h = int(rng.integers(10, 48))
+    w = int(rng.integers(6, 24))
+    ch = int(rng.choice([1, 3]))
+    reps = int(rng.integers(1, 9))
+    bh = int(rng.integers(1, 40))
+    fz = int(rng.integers(1, 12))
+    shape = (h, w) if ch == 1 else (h, w, ch)
+    img = rng.integers(0, 256, size=shape, dtype=np.uint8)
+    want = stencil.reference_stencil_numpy(img, f, reps)
+    got = np.asarray(iterate(
+        img, jnp.int32(reps), plan=plan, backend="pallas",
+        block_h=bh, fuse=fz,
+    ))
+    if f.is_exact and plan.kind != "direct_f32":
+        np.testing.assert_array_equal(
+            got, want,
+            err_msg=f"case {case}: bh={bh} fz={fz} plan={plan.kind}",
+        )
+    else:
+        assert np.abs(got.astype(int) - want.astype(int)).max() <= 1

@@ -319,7 +319,7 @@ def run_job(
         )
         from tpu_stencil.ops import pallas_stencil as _ps
 
-        geo_rows = n_per * _ps.frames_stride(model.plan, cfg.height)
+        geo_rows = _ps.frames_rows(model.plan, cfg.height, n_per)
     else:
         ran_backend, ran_schedule = model.resolved_config(
             (cfg.height, cfg.width), cfg.channels
@@ -425,7 +425,7 @@ def _run_frames_multihost(cfg, model, profile_dir, checkpoint_every,
     from tpu_stencil.ops import pallas_stencil as _ps
 
     ran_bh, ran_fuse = _ran_geometry(
-        cfg, model, backend, n_per * _ps.frames_stride(model.plan, h)
+        cfg, model, backend, _ps.frames_rows(model.plan, h, n_per)
     )
     return JobResult(
         output_path=cfg.output_path,
@@ -443,7 +443,8 @@ def _run_sharded(cfg, model, devices, profile_dir, checkpoint_every, resume,
                  total_t) -> JobResult:
     from tpu_stencil.parallel import distributed, sharded
 
-    if cfg.block_h is not None or cfg.fuse is not None:
+    if (cfg.block_h is not None or cfg.fuse is not None) \
+            and jax.process_index() == 0:
         import sys
 
         # Never silently ignore a forced knob: the mesh path sizes its

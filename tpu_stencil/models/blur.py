@@ -301,8 +301,8 @@ class IteratedConv2D:
 
                 # The tall layout's block height can degrade a schedule the
                 # single-frame launch could run; report what runs.
-                rows = n_frames * pallas_stencil.frames_stride(
-                    self.plan, frame_shape[0]
+                rows = pallas_stencil.frames_rows(
+                    self.plan, frame_shape[0], n_frames
                 )
                 return backend, pallas_stencil.effective_schedule_for(
                     self.plan, rows, schedule, block_h=self.block_h

@@ -99,7 +99,10 @@ def effective_block_h(n_rows: int, block_h: Optional[int] = None) -> int:
     image: 8-row (sublane) aligned, clamped to the padded image height
     (``None`` = the module default). Exposed so the autotuner's schedule
     dedup sees the same clamp."""
-    block_h = block_h or DEFAULT_BLOCK_H
+    # Explicit None check: a typo'd 0 must stay a loud trace-time error
+    # (zero block -> ZeroDivisionError in the grid math), not silently
+    # become the default.
+    block_h = DEFAULT_BLOCK_H if block_h is None else block_h
     block_h = -(-block_h // 8) * 8  # DMA descriptors need 8-row alignment
     return min(block_h, -(-n_rows // 8) * 8)
 
@@ -115,7 +118,7 @@ def effective_geometry(plan: StencilPlan, n_rows: int,
     layers — a run must never be attributed to a geometry that did not
     launch."""
     bh = effective_block_h(n_rows, block_h)
-    fz = fuse or DEFAULT_FUSE
+    fz = DEFAULT_FUSE if fuse is None else fuse  # 0 stays a loud error
     if plan.halo:
         fz = max(1, min(fz, bh // (2 * plan.halo)))
     return bh, fz
@@ -126,6 +129,13 @@ def frames_stride(plan: StencilPlan, frame_h: int) -> int:
     ``halo``-row zero gap (re-zeroed every rep — the inter-frame zero
     boundary)."""
     return frame_h + plan.halo
+
+
+def frames_rows(plan: StencilPlan, frame_h: int, n_frames: int) -> int:
+    """Row count of the fused tall-image launch for ``n_frames`` stacked
+    frames — the single source for every layer that reasons about the
+    tall launch (schedule degrade, geometry reporting)."""
+    return n_frames * frames_stride(plan, frame_h)
 
 
 def effective_schedule_for(plan: StencilPlan, n_rows: int,

@@ -183,16 +183,19 @@ def best_config(
             ps.effective_block_h(shape[0], block_h),
         )
         key += f"|forced={force_schedule}"
-    # Only passed through to measure() when set: the measure callable is
+    # Key and measure at the EFFECTIVE geometry (align/clamp), so
+    # requested values that launch identically (e.g. --block-h 100 vs
+    # 104) share one cache entry and one measurement sweep. Only passed
+    # through to measure() when forced: the measure callable is
     # monkeypatchable (12 tests) and pre-geometry signatures must keep
     # working for default-geometry tuning.
     geo_kw = {}
-    if block_h is not None:
-        key += f"|bh={block_h}"
-        geo_kw["block_h"] = block_h
-    if fuse is not None:
-        key += f"|fz={fuse}"
-        geo_kw["fuse"] = fuse
+    if block_h is not None or fuse is not None:
+        eff_bh, eff_fz = ps.effective_geometry(
+            plan, shape[0], block_h, fuse
+        )
+        key += f"|bh={eff_bh}|fz={eff_fz}"
+        geo_kw = {"block_h": eff_bh, "fuse": eff_fz}
     store = _load_cache() if cache else {}
     hit = store.get(key)
     if (
