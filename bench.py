@@ -212,6 +212,15 @@ def child_main() -> int:
         result["pallas_schedule"] = pal["schedule"]
         result["pallas_schedules_us_per_rep"] = pal["schedules_us_per_rep"]
         result["rows_roll"] = pallas_stencil._ROWS_ROLL
+        # Geometry provenance: the effective (block_h, fuse) the measured
+        # kernel launched at this shape (module defaults; the part-2
+        # burst may flip them, so the artifact must say what ran).
+        from tpu_stencil.models.blur import IteratedConv2D as _M
+
+        bh, fz = pallas_stencil.effective_geometry(
+            _M("gaussian").plan, H
+        )
+        result["pallas_block_h"], result["pallas_fuse"] = bh, fz
     print(json.dumps(result))
     return 0
 
