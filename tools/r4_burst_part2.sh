@@ -122,6 +122,20 @@ else
       | tee -a /tmp/r4_lab.log
 fi
 
+# 4.4 Cliff investigation (VERDICT r3 item 3): the geometry grid at the
+# two shapes whose r2 numbers were far off bytes-proportional scaling
+# (1920x5040: 739 us/rep; 8K) — if the sweep shows the cliffs persist
+# under pack, per-shape geometry is the first candidate fix and this
+# table decides it.
+AB_H=5040 timeout 1500 python -u tools/bh_fuse_ab.py \
+    128x8 256x8 256x16 512x16 > /tmp/r4p2_ab5040.log 2>&1
+echo "=== A/B 1920x5040 rc=$? $(date +%H:%M:%S) ===" | tee -a /tmp/r4_lab.log
+grep "^bh=" /tmp/r4p2_ab5040.log | tee -a /tmp/r4_lab.log
+AB_H=4320 AB_W=7680 timeout 1800 python -u tools/bh_fuse_ab.py \
+    128x8 256x8 256x16 512x16 > /tmp/r4p2_ab8k.log 2>&1
+echo "=== A/B 8K rc=$? $(date +%H:%M:%S) ===" | tee -a /tmp/r4_lab.log
+grep "^bh=" /tmp/r4p2_ab8k.log | tee -a /tmp/r4_lab.log
+
 # 4.5 SWAR attribution: price pack's rows chain / cols chain / boundary
 # AND, plus a clean un-contended re-read of the geometry outliers (part
 # 1's lab ran concurrently with a 303-test pytest suite).
