@@ -330,3 +330,105 @@ def test_forced_geometry_keys_and_measures(plan, tmp_path, monkeypatch):
 
     autotune.best_config(plan, (128, 96), 3, measure=legacy_measure)
     assert legacy_calls  # not served from the geometry-keyed entry
+
+
+def test_unforced_geometry_stage_tunes_and_caches(plan, tmp_path, monkeypatch):
+    # With no forced geometry, a pallas win triggers the geometry stage:
+    # _GEOMETRY_GRID measured at the winning schedule, winner cached and
+    # returned; launch-identical candidates dedup'd via effective_geometry.
+    import jax
+
+    monkeypatch.setenv("TPU_STENCIL_AUTOTUNE_CACHE", str(tmp_path / "c.json"))
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    geo_seen = []
+
+    def geo_measure(plan, shape, channels, backend, reps=0, schedule=None,
+                    block_h=None, fuse=None):
+        if backend == "xla":
+            return 9e-6
+        geo_seen.append((schedule, block_h, fuse))
+        if (block_h, fuse) == (256, 16):
+            return 1e-6  # the geometry winner
+        return 3e-6
+
+    got = autotune.best_full_config(plan, (512, 128), 3,
+                                    measure=geo_measure)
+    assert got[0] == "pallas" and got[2:] == (256, 16)
+    # geometry stage ran only at the winning schedule
+    win_sched = got[1]
+    assert all(s == win_sched for s, bh, fz in geo_seen if bh is not None)
+    # cached: the second resolution is a disk hit returning the geometry
+    def boom(*a, **k):
+        raise AssertionError("re-measured despite cache")
+    assert autotune.best_full_config(plan, (512, 128), 3,
+                                     measure=boom) == got
+
+
+def test_legacy_measures_skip_geometry_stage(plan, tmp_path, monkeypatch):
+    # A pre-geometry measure signature (the 12 legacy monkeypatches) must
+    # keep working: no geometry stage, geometry half of the verdict None.
+    import jax
+
+    monkeypatch.setenv("TPU_STENCIL_AUTOTUNE_CACHE", str(tmp_path / "c.json"))
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+
+    def legacy(plan, shape, channels, backend, reps=0, schedule=None):
+        return 1e-6 if backend == "pallas" else 2e-6
+
+    got = autotune.best_full_config(plan, (512, 128), 3, measure=legacy)
+    assert got[0] == "pallas" and got[2:] == (None, None)
+
+
+def test_model_applies_tuned_geometry(plan, tmp_path, monkeypatch):
+    # resolved_geometry: forced values win; otherwise the tuned verdict
+    # for the shape flows out of the same memo resolved_config filled.
+    import jax
+    from tpu_stencil.models.blur import IteratedConv2D
+    from tpu_stencil.runtime import autotune as at
+
+    monkeypatch.setattr(
+        at, "best_full_config",
+        lambda *a, **k: ("pallas", "pack", 256, 16),
+    )
+    m = IteratedConv2D("gaussian", backend="auto")
+    assert m.resolved_config((512, 128), 3) == ("pallas", "pack")
+    assert m.resolved_geometry((512, 128), 3) == (256, 16)
+    # constructor-forced geometry beats the tuned verdict
+    m2 = IteratedConv2D("gaussian", backend="auto", block_h=128, fuse=8)
+    m2.resolved_config((512, 128), 3)
+    assert m2.resolved_geometry((512, 128), 3) == (128, 8)
+    # unresolved shapes report defaults, never a stale tune
+    assert m.resolved_geometry((64, 64), 3) == (None, None)
+
+
+def test_tuned_geometry_degrading_block_reports_effective_schedule(
+        plan, tmp_path, monkeypatch):
+    # Review-found scenario: the schedule stage picks pack at the default
+    # block, the geometry stage picks a block at which pack degrades
+    # (200-row image: effective block 200 is not a 16-multiple). Both the
+    # cache entry and the model must name the schedule that launches
+    # (shrink), never the degraded-away pack.
+    import jax
+    from tpu_stencil.models.blur import IteratedConv2D
+
+    monkeypatch.setenv("TPU_STENCIL_AUTOTUNE_CACHE", str(tmp_path / "c.json"))
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+
+    def geo_measure(p, shape, channels, backend, reps=0, schedule=None,
+                    block_h=None, fuse=None):
+        if backend == "xla":
+            return 9e-6
+        if block_h == 256:
+            return 1e-6  # the degrading geometry wins
+        return 2e-6 if schedule == "pack" else 3e-6
+
+    got = autotune.best_full_config(plan, (200, 128), 3,
+                                    measure=geo_measure)
+    assert got == ("pallas", "shrink", 256, 8)
+    # the model path reports the same effective schedule
+    monkeypatch.setattr(
+        autotune, "best_full_config", lambda *a, **k: got
+    )
+    m = IteratedConv2D("gaussian", backend="auto")
+    assert m.resolved_config((200, 128), 3) == ("pallas", "shrink")
+    assert m.resolved_geometry((200, 128), 3) == (256, 8)

@@ -88,10 +88,11 @@ def _place_frames(model, imgs: np.ndarray, devices):
         if b_backend == "pallas":
             from tpu_stencil.parallel import sharded as _sharded
 
+            geo_bh, geo_fz = model.resolved_geometry(frame_shape, channels)
             frames_fn = _sharded.build_batched_frames(
                 bmesh, model.plan, b_schedule,
                 interpret=jax.default_backend() == "cpu",
-                block_h=model.block_h, fuse=model.fuse,
+                block_h=geo_bh, fuse=geo_fz,
             )
 
             def step_fn(x, n):
@@ -131,25 +132,28 @@ class JobResult:
     mesh_shape: Optional[tuple]
     schedule: Optional[str] = None  # pallas per-rep schedule that ran
     # Effective Pallas kernel geometry that LAUNCHED (post align/clamp),
-    # reported only when the user forced --block-h/--fuse on a path that
-    # honors them; None otherwise (defaults, xla, or the sharded mesh
+    # reported when a non-default geometry applied — user-forced
+    # --block-h/--fuse OR an autotuner geometry verdict — on a path that
+    # honors it; None otherwise (defaults, xla, or the sharded mesh
     # path, which sizes its own tiles). Report-what-ran, like `schedule`.
     block_h: Optional[int] = None
     fuse: Optional[int] = None
 
 
-def _ran_geometry(cfg, model, backend: str, rows: int):
+def _ran_geometry(model, backend: str, rows: int, shape, channels: int):
     """The (block_h, fuse) to report for a ``rows``-tall Pallas launch:
-    the effective geometry when the user forced either knob, else
-    (None, None) — never the requested values verbatim (they align/clamp,
-    and must not be attributed to runs that ignored them)."""
-    if backend != "pallas" or (cfg.block_h is None and cfg.fuse is None):
+    the effective geometry when the user forced either knob OR the
+    autotuner picked a non-default one for ``shape``; (None, None) for a
+    default-geometry launch — never the requested values verbatim (they
+    align/clamp, and must not be attributed to runs that ignored them)."""
+    if backend != "pallas":
+        return None, None
+    bh, fz = model.resolved_geometry(tuple(shape), channels)
+    if bh is None and fz is None:
         return None, None
     from tpu_stencil.ops import pallas_stencil
 
-    return pallas_stencil.effective_geometry(
-        model.plan, rows, cfg.block_h, cfg.fuse
-    )
+    return pallas_stencil.effective_geometry(model.plan, rows, bh, fz)
 
 
 def _maybe_profile(profile_dir: Optional[str]):
@@ -325,7 +329,9 @@ def run_job(
             (cfg.height, cfg.width), cfg.channels
         )
         geo_rows = cfg.height
-    ran_bh, ran_fuse = _ran_geometry(cfg, model, ran_backend, geo_rows)
+    ran_bh, ran_fuse = _ran_geometry(
+        model, ran_backend, geo_rows, (cfg.height, cfg.width), cfg.channels
+    )
     return JobResult(
         output_path=cfg.output_path,
         compute_seconds=compute_seconds,
@@ -425,7 +431,7 @@ def _run_frames_multihost(cfg, model, profile_dir, checkpoint_every,
     from tpu_stencil.ops import pallas_stencil as _ps
 
     ran_bh, ran_fuse = _ran_geometry(
-        cfg, model, backend, _ps.frames_rows(model.plan, h, n_per)
+        model, backend, _ps.frames_rows(model.plan, h, n_per), (h, w), ch
     )
     return JobResult(
         output_path=cfg.output_path,

@@ -239,13 +239,14 @@ class IteratedConv2D:
                 # once for the report) even when the cache dir is
                 # unwritable and the disk store silently fails. A forced
                 # schedule restricts the tuning space so the xla-vs-pallas
-                # verdict is decided by the schedule that will run.
-                self._resolved[key] = autotune.best_config(
+                # verdict is decided by the schedule that will run; the
+                # 4-tuple's geometry half feeds resolved_geometry.
+                self._resolved[key] = autotune.best_full_config(
                     self.plan, tuple(shape), channels,
                     force_schedule=self.schedule,
                     block_h=self.block_h, fuse=self.fuse,
                 )
-            backend, schedule = self._resolved[key]
+            backend, schedule = self._resolved[key][:2]
         else:
             backend, schedule = resolve_backend(self.backend), None
             if backend == "pallas":
@@ -260,16 +261,32 @@ class IteratedConv2D:
         if backend == "pallas":
             from tpu_stencil.ops import pallas_stencil
 
-            # Resolve (and report) the schedule that actually runs at this
-            # launch's block height — never a degraded-away name.
+            # Resolve (and report) the schedule that actually runs at
+            # this launch's block height — forced OR tuned, never the
+            # default's — so a degraded-away name is never reported.
+            geo_bh = self.resolved_geometry(tuple(shape), channels)[0]
             schedule = pallas_stencil.effective_schedule_for(
-                self.plan, shape[0], schedule, block_h=self.block_h
+                self.plan, shape[0], schedule, block_h=geo_bh
             )
         return backend, schedule
 
     def resolved_backend(self, shape: Tuple[int, int], channels: int) -> str:
         """Back-compat: the backend half of :meth:`resolved_config`."""
         return self.resolved_config(shape, channels)[0]
+
+    def resolved_geometry(
+        self, shape: Tuple[int, int], channels: int
+    ) -> Tuple[Optional[int], Optional[int]]:
+        """The (block_h, fuse) the launch will use: constructor-forced
+        values win; otherwise the autotuned verdict for this shape (None
+        = kernel defaults). Call after :meth:`resolved_config` — it
+        shares the same memo and never re-measures."""
+        if self.block_h is not None or self.fuse is not None:
+            return self.block_h, self.fuse
+        hit = self._resolved.get((tuple(shape), channels))
+        if hit is not None and len(hit) >= 4:
+            return hit[2], hit[3]
+        return None, None
 
     def step(self, img_u8: jax.Array) -> jax.Array:
         """A single (unjitted) filter application — the jittable unit."""
@@ -305,7 +322,8 @@ class IteratedConv2D:
                     self.plan, frame_shape[0], n_frames
                 )
                 return backend, pallas_stencil.effective_schedule_for(
-                    self.plan, rows, schedule, block_h=self.block_h
+                    self.plan, rows, schedule,
+                    block_h=self.resolved_geometry(frame_shape, channels)[0],
                 )
         rb = resolve_backend(self.backend)
         return ("xla" if rb == "pallas" else rb), None
@@ -323,10 +341,11 @@ class IteratedConv2D:
             n_frames=imgs_u8.shape[0],
         )
         if backend == "pallas":
+            bh, fz = self.resolved_geometry(tuple(imgs_u8.shape[1:3]), ch)
             return _jit_frames(
                 imgs_u8, jnp.int32(repetitions), plan=self.plan,
                 interpret=jax.default_backend() == "cpu", schedule=schedule,
-                block_h=self.block_h, fuse=self.fuse,
+                block_h=bh, fuse=fz,
             )
         return iterate_batch(
             imgs_u8, jnp.int32(repetitions), plan=self.plan,
@@ -343,9 +362,11 @@ class IteratedConv2D:
         else:
             img_u8 = jnp.asarray(img_u8, dtype=jnp.uint8)
         ch = img_u8.shape[2] if img_u8.ndim == 3 else 1
-        resolved, schedule = self.resolved_config(tuple(img_u8.shape[:2]), ch)
+        shape2 = tuple(img_u8.shape[:2])
+        resolved, schedule = self.resolved_config(shape2, ch)
+        bh, fz = self.resolved_geometry(shape2, ch)
         return iterate(
             img_u8, jnp.int32(repetitions), plan=self.plan, backend=resolved,
             boundary=self.boundary, schedule=schedule,
-            block_h=self.block_h, fuse=self.fuse,
+            block_h=bh, fuse=fz,
         )

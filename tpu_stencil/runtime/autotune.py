@@ -144,7 +144,29 @@ def _pallas_schedules(plan: StencilPlan, shape: Tuple[int, int],
     ]
 
 
-def best_config(
+# Geometry candidates the unforced tune tries ON TOP of the module
+# default, at the winning schedule only (the r4 lab attribution motivated
+# 256-row blocks / deeper fusion; candidates that launch identically to
+# the default are skipped via effective_geometry dedup).
+_GEOMETRY_GRID = ((256, 8), (256, 16))
+
+
+def _measure_takes_geometry(measure) -> bool:
+    """Whether the measure callable accepts block_h/fuse kwargs. Legacy
+    (pre-geometry) monkeypatched measures silently skip geometry tuning
+    instead of crashing on unexpected kwargs."""
+    import inspect
+
+    try:
+        params = inspect.signature(measure).parameters
+    except (TypeError, ValueError):
+        return False
+    return "block_h" in params or any(
+        p.kind is inspect.Parameter.VAR_KEYWORD for p in params.values()
+    )
+
+
+def best_full_config(
     plan: StencilPlan,
     shape: Tuple[int, int],
     channels: int,
@@ -153,25 +175,28 @@ def best_config(
     force_schedule: Optional[str] = None,
     block_h: Optional[int] = None,
     fuse: Optional[int] = None,
-) -> Tuple[str, Optional[str]]:
-    """The fastest (backend, pallas_schedule) for this (platform, filter,
-    shape), from the disk cache when available, measured (and cached)
-    otherwise — the schedule space is {XLA} + {Pallas x per-rep schedule}.
-    Platforms without a Pallas TPU path short-circuit to XLA; the schedule
-    is None for XLA (and for pre-schedule cache entries, which then run
-    the measured-default schedule). ``force_schedule`` (the --schedule
-    flag) restricts the Pallas side to that one schedule (after any
-    degrade for this plan/shape), so the xla-vs-pallas verdict is decided
-    by timings of the schedule that will actually run — cached under its
-    own key. ``block_h``/``fuse`` (the --block-h/--fuse flags) likewise
-    force the kernel geometry: Pallas candidates are measured at it, and
-    the verdict is cached under a geometry-suffixed key."""
+) -> Tuple[str, Optional[str], Optional[int], Optional[int]]:
+    """The fastest (backend, pallas_schedule, block_h, fuse) for this
+    (platform, filter, shape), from the disk cache when available,
+    measured (and cached) otherwise — the schedule space is {XLA} +
+    {Pallas x per-rep schedule}, then a geometry stage over
+    ``_GEOMETRY_GRID`` at the winning schedule (geometry None = module
+    defaults). Platforms without a Pallas TPU path short-circuit to XLA;
+    the schedule is None for XLA (and for pre-schedule cache entries,
+    which then run the measured-default schedule). ``force_schedule``
+    (the --schedule flag) restricts the Pallas side to that one schedule
+    (after any degrade for this plan/shape), so the xla-vs-pallas verdict
+    is decided by timings of the schedule that will actually run — cached
+    under its own key. ``block_h``/``fuse`` (the --block-h/--fuse flags)
+    force the kernel geometry: Pallas candidates are measured at it (no
+    geometry stage runs), and the verdict is cached under a
+    geometry-suffixed key."""
     import jax
 
     if jax.default_backend() not in ("tpu", "axon"):
-        return "xla", None
+        return "xla", None, None, None
     if plan.kind == "direct_f32":
-        return "xla", None  # pallas would fall back anyway
+        return "xla", None, None, None  # pallas would fall back anyway
     from tpu_stencil.ops import pallas_stencil as ps
 
     if measure is None:
@@ -205,7 +230,8 @@ def best_config(
         # set has since changed) must re-measure, not crash every run.
         and (hit.get("schedule") is None or hit["schedule"] in ps._SCHEDULES)
     ):
-        return hit["backend"], hit.get("schedule")
+        return (hit["backend"], hit.get("schedule"),
+                hit.get("block_h"), hit.get("fuse"))
     pallas_scheds = (
         [force_schedule] if force_schedule is not None
         else _pallas_schedules(plan, shape, block_h)
@@ -224,17 +250,77 @@ def best_config(
     if not timings:
         raise last_err
     winner, win_sched = min(timings, key=timings.get)
+
+    # Geometry stage: unforced Pallas winners try _GEOMETRY_GRID at the
+    # winning schedule. Candidates whose effective launch equals the
+    # default's (or a previous candidate's) are never measured twice.
+    win_bh = win_fuse = None
+    geo_us = {}
+    if (winner == "pallas" and not geo_kw
+            and _measure_takes_geometry(measure)):
+        geo_timings = {(None, None): timings[(winner, win_sched)]}
+        seen_eff = {ps.effective_geometry(plan, shape[0])}
+        for gbh, gfz in _GEOMETRY_GRID:
+            eff = ps.effective_geometry(plan, shape[0], gbh, gfz)
+            if eff in seen_eff:
+                continue
+            seen_eff.add(eff)
+            try:
+                geo_timings[(gbh, gfz)] = measure(
+                    plan, shape, channels, winner, schedule=win_sched,
+                    block_h=gbh, fuse=gfz,
+                )
+            except Exception:  # a too-big tile must not kill the tune
+                pass
+        win_bh, win_fuse = min(geo_timings, key=geo_timings.get)
+        if win_bh is not None or win_fuse is not None:
+            # The tuned block can degrade the winning schedule (pack
+            # needs a 16-multiple block): store the name of what the
+            # chosen geometry actually launches — the timing already
+            # measured the degraded kernel, the label must match it.
+            eff_bh, _ = ps.effective_geometry(
+                plan, shape[0], win_bh, win_fuse
+            )
+            win_sched = ps._effective_schedule(win_sched, plan, eff_bh)
+        geo_us = {
+            ("default" if g == (None, None) else f"{g[0]}x{g[1]}"):
+                round(t * 1e6, 2)
+            for g, t in geo_timings.items()
+        }
+    elif geo_kw and winner == "pallas":
+        win_bh, win_fuse = geo_kw["block_h"], geo_kw["fuse"]
     if cache:
         store[key] = {
             "backend": winner,
             "schedule": win_sched,
+            "block_h": win_bh,
+            "fuse": win_fuse,
             "us_per_rep": {
                 (b if s is None else f"{b}[{s}]"): round(t * 1e6, 2)
                 for (b, s), t in timings.items()
             },
+            **({"geometry_us_per_rep": geo_us} if geo_us else {}),
         }
         _store_cache(store)
-    return winner, win_sched
+    return winner, win_sched, win_bh, win_fuse
+
+
+def best_config(
+    plan: StencilPlan,
+    shape: Tuple[int, int],
+    channels: int,
+    cache: bool = True,
+    measure=None,
+    force_schedule: Optional[str] = None,
+    block_h: Optional[int] = None,
+    fuse: Optional[int] = None,
+) -> Tuple[str, Optional[str]]:
+    """Back-compat wrapper: the (backend, schedule) half of
+    :func:`best_full_config`."""
+    return best_full_config(
+        plan, shape, channels, cache=cache, measure=measure,
+        force_schedule=force_schedule, block_h=block_h, fuse=fuse,
+    )[:2]
 
 
 def best_backend(
