@@ -450,10 +450,11 @@ def test_geometry_report_is_effective_not_requested(tmp_path, rng):
     assert "block_h=24 fuse=12" in r.stdout, r.stdout
 
 
-def test_geometry_not_reported_on_sharded_mesh(tmp_path, rng, capsys):
-    # The spatial-mesh path sizes its own tiles: forced geometry is
-    # ignored there, must NOT appear in the report, and a stderr note
-    # says so.
+def test_geometry_reported_effective_on_sharded_mesh(tmp_path, rng, capsys):
+    # The spatial-mesh path honors forced geometry in the valid-ghost
+    # kernel and reports the EFFECTIVE launch values: a 256-row request
+    # on an 8-row tile (16 rows / 2 mesh rows) clamps to 8; the fused
+    # chunk depth is capped by the tile (8 // halo 1 = 8).
     img = rng.integers(0, 256, size=(16, 16), dtype=np.uint8)
     src = str(tmp_path / "g.raw")
     raw_io.write_raw(src, img[..., None])
@@ -462,5 +463,29 @@ def test_geometry_not_reported_on_sharded_mesh(tmp_path, rng, capsys):
                      "--backend", "pallas", "--block-h", "256", "--time",
                      "--output", out]) == 0
     cap = capsys.readouterr()
-    assert "block_h" not in cap.out, cap.out
-    assert "sizes its own tiles" in cap.err, cap.err
+    assert "block_h=8 fuse=8" in cap.out, cap.out
+    # and the output stays bit-exact under the forced geometry
+    got = raw_io.read_raw(out, 16, 16, 1)[..., 0]
+    want = stencil.reference_stencil_numpy(
+        img, filters.get_filter("gaussian"), 2
+    )
+    np.testing.assert_array_equal(got, want)
+
+
+def test_forced_fuse_caps_to_sharded_chunk(tmp_path, rng, capsys):
+    # --fuse on a mesh is the halo-exchange chunk depth, capped by the
+    # tile: fuse 64 on an 8-row tile clamps to 8; fuse 2 is honored.
+    img = rng.integers(0, 256, size=(16, 16), dtype=np.uint8)
+    src = str(tmp_path / "g.raw")
+    raw_io.write_raw(src, img[..., None])
+    for req, eff in (("64", "fuse=8"), ("2", "fuse=2")):
+        out = str(tmp_path / "o.raw")
+        assert cli.main([src, "16", "16", "4", "grey", "--mesh", "2x2",
+                         "--backend", "pallas", "--fuse", req, "--time",
+                         "--output", out]) == 0
+        assert eff in capsys.readouterr().out
+        got = raw_io.read_raw(out, 16, 16, 1)[..., 0]
+        want = stencil.reference_stencil_numpy(
+            img, filters.get_filter("gaussian"), 4
+        )
+        np.testing.assert_array_equal(got, want)

@@ -50,11 +50,11 @@ class JobConfig:
     frames: int = 1  # >1: batched video mode (N concatenated raw frames)
     schedule: Optional[str] = None  # Pallas per-rep schedule (None = tuned)
     boundary: str = "zero"  # zero (reference semantics) | periodic
-    # Pallas kernel geometry (None = kernel defaults): rows per grid
-    # program and fused reps per HBM round-trip. Expert knobs for on-chip
-    # A/Bs and shapes whose best geometry differs from the default;
-    # single-device and --frames paths only (the sharded mesh path sizes
-    # its halo exchange from its own fuse choice).
+    # Pallas kernel geometry (None = kernel defaults / autotuned): rows
+    # per grid program and fused reps per HBM round-trip (on a sharded
+    # mesh, fuse is the halo-exchange chunk depth). Expert knobs for
+    # on-chip A/Bs and shapes whose best geometry differs from the
+    # default; honored on every Pallas path.
     block_h: Optional[int] = None
     fuse: Optional[int] = None
     # Accumulation dtype is a property of the backend's plan, not a flag:
@@ -180,17 +180,19 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "--block-h", dest="block_h", type=int, default=None, metavar="ROWS",
         help="force the Pallas kernel's rows-per-grid-program (rounded up "
-             "to a sublane multiple of 8; pack needs a multiple of 16 or "
-             "it degrades). Default: the kernel's measured default. "
-             "Single-device and --frames paths; the sharded mesh path "
-             "sizes its own tiles",
+             "to a sublane multiple of 8, clamped to the image/tile; pack "
+             "needs a multiple of 16 or it degrades). Default: the "
+             "kernel's measured default, or the autotuned per-shape "
+             "verdict on the auto path",
     )
     p.add_argument(
         "--fuse", type=int, default=None, metavar="REPS",
         help="force the Pallas kernel's fused reps per HBM round-trip "
              "(clamped to block_h/(2*halo); reps %% fuse remainder runs "
-             "as single-rep launches). Default: the kernel's measured "
-             "default. Single-device and --frames paths only",
+             "as single-rep launches; on a sharded mesh this is the "
+             "halo-exchange chunk depth, capped by the tile). Default: "
+             "the kernel's measured default, or the autotuned per-shape "
+             "verdict on the auto path",
     )
     p.add_argument(
         "--platform", default=None, choices=["cpu", "tpu", "gpu"],

@@ -449,17 +449,6 @@ def _run_sharded(cfg, model, devices, profile_dir, checkpoint_every, resume,
                  total_t) -> JobResult:
     from tpu_stencil.parallel import distributed, sharded
 
-    if (cfg.block_h is not None or cfg.fuse is not None) \
-            and jax.process_index() == 0:
-        import sys
-
-        # Never silently ignore a forced knob: the mesh path sizes its
-        # own tiles (and JobResult reports no geometry for it).
-        print(
-            "note: --block-h/--fuse apply to the single-device and "
-            "--frames paths; the sharded mesh path sizes its own tiles",
-            file=sys.stderr,
-        )
 
     if jax.process_count() > 1 and not images_io.is_raw(cfg.output_path):
         # Fail before the compute, not after: fetching a global array for an
@@ -523,6 +512,18 @@ def _run_sharded(cfg, model, devices, profile_dir, checkpoint_every, resume,
     else:
         images_io.save_image(cfg.output_path, runner.fetch(out_dev))
     _clear_checkpoint(cfg, checkpoint_every, resume)
+    # Report non-default geometry (forced or tuned) as what the
+    # valid-ghost kernel launches at this tile: runner.block_h_eff plus
+    # the chunk-capped fuse.
+    sh_bh = sh_fuse = None
+    if runner.geo_applied:
+        from tpu_stencil.ops import pallas_stencil as _ps
+
+        sh_bh = (
+            runner.block_h_eff if runner.block_h_eff is not None
+            else _ps.effective_block_h(runner.tile[0])
+        )
+        sh_fuse = runner.fuse
     return JobResult(
         output_path=cfg.output_path,
         compute_seconds=compute_seconds,
@@ -530,4 +531,6 @@ def _run_sharded(cfg, model, devices, profile_dir, checkpoint_every, resume,
         backend=runner.backend,
         mesh_shape=runner.mesh_shape,
         schedule=runner.schedule if runner.backend == "pallas" else None,
+        block_h=sh_bh,
+        fuse=sh_fuse,
     )

@@ -66,7 +66,7 @@ def _local_step(tile_u8, plan, axes, mask_tile, boundary="zero"):
 
 
 def _pallas_local_chunk(tile_u8, plan, axes, fuse, global_shape, interpret,
-                        schedule=None):
+                        schedule=None, block_h=None):
     """``fuse`` repetitions for one exchange: widen the halo exchange to
     ``fuse * halo`` uint8 ghosts (2 ppermute phases per *chunk* instead of
     per rep) and run the valid-ghost Pallas kernel, whose trusted band
@@ -88,6 +88,7 @@ def _pallas_local_chunk(tile_u8, plan, axes, fuse, global_shape, interpret,
     out2 = pallas_stencil.valid_fused(
         ext2, plan, fuse, channels, row0, col0, global_shape,
         interpret=interpret, vma=(row_axis, col_axis), schedule=schedule,
+        **({"block_h": block_h} if block_h is not None else {}),
     )
     return out2.reshape(tile_u8.shape)
 
@@ -103,6 +104,7 @@ def build_sharded_iterate(
     interpret: bool = False,
     schedule=None,
     boundary: str = "zero",
+    block_h: Optional[int] = None,
 ):
     """Compile-once builder for the sharded iteration program.
 
@@ -133,7 +135,8 @@ def build_sharded_iterate(
 
         def step_chunk(x, n_fused, mask_tile):
             out = _pallas_local_chunk(
-                x, plan, axes, n_fused, global_shape, interpret, schedule
+                x, plan, axes, n_fused, global_shape, interpret, schedule,
+                block_h=block_h,
             )
             if mask_tile is not None:
                 out = out * mask_tile
@@ -237,27 +240,40 @@ def _pallas_plan_supported(plan, channels: int) -> bool:
 def _agreed_config(model, tile, channels):
     """Shape-aware auto/autotune resolution with multi-host agreement:
     rank 0 resolves (cache hit or one measurement), everyone receives the
-    (backend, pallas_schedule) verdict. Encoding: -1 = xla, otherwise an
-    index into the schedule list (len = pallas with the default schedule)
-    — every process must compile the identical program, schedule included."""
+    (backend, pallas_schedule, block_h, fuse) verdict. Encoding: vote[0]
+    -1 = xla, otherwise an index into the schedule list (len = pallas
+    with the default schedule); vote[1]/vote[2] the tuned geometry (-1 =
+    default). Every process must compile the identical program — a
+    divergent schedule OR fuse (the halo-exchange chunk depth) would
+    shear the ppermute sequences exactly like divergent argv."""
     if jax.process_count() == 1:
-        return model.resolved_config(tile, channels)
+        backend, schedule = model.resolved_config(tile, channels)
+        bh, fz = model.resolved_geometry(tile, channels)
+        return backend, schedule, bh, fz
     from jax.experimental import multihost_utils
 
     from tpu_stencil.ops import pallas_stencil
 
     scheds = list(pallas_stencil._SCHEDULES)
-    vote = np.int32(-1)
+    vote = np.full(3, -1, np.int32)
     if jax.process_index() == 0:
         backend, schedule = model.resolved_config(tile, channels)
         if backend == "pallas":
-            vote = np.int32(
+            vote[0] = (
                 scheds.index(schedule) if schedule in scheds else len(scheds)
             )
-    vote = int(multihost_utils.broadcast_one_to_all(vote))
-    if vote < 0:
-        return "xla", None
-    return "pallas", scheds[vote] if vote < len(scheds) else None
+            bh, fz = model.resolved_geometry(tile, channels)
+            vote[1] = -1 if bh is None else bh
+            vote[2] = -1 if fz is None else fz
+    vote = multihost_utils.broadcast_one_to_all(vote)
+    if int(vote[0]) < 0:
+        return "xla", None, None, None
+    return (
+        "pallas",
+        scheds[int(vote[0])] if int(vote[0]) < len(scheds) else None,
+        None if int(vote[1]) < 0 else int(vote[1]),
+        None if int(vote[2]) < 0 else int(vote[2]),
+    )
 
 
 class ShardedRunner:
@@ -283,6 +299,7 @@ class ShardedRunner:
         ph, pw = partition.pad_amounts(self.h, self.w, self.mesh_shape)
         self.padded_shape = (self.h + ph, self.w + pw)
         tile = partition.tile_shape(self.h, self.w, self.mesh_shape)
+        self.tile = tile
         self.boundary = getattr(model, "boundary", "zero")
         if self.boundary == "periodic" and (ph or pw):
             # The pad region would be wrapped into the opposite edge —
@@ -299,6 +316,7 @@ class ShardedRunner:
         # Pallas per-rep schedule: a constructor-forced one (--schedule)
         # wins; otherwise the autotuned verdict below (None = default).
         self.schedule = getattr(model, "schedule", None)
+        tuned_bh = tuned_fz = None
         if model.backend in ("auto", "autotune"):
             if not pallas_ok:
                 # Unsupported plans would be demoted below anyway — never
@@ -312,11 +330,12 @@ class ShardedRunner:
                 # autotune cache; measures once per tile shape on TPU (r2
                 # verdict item 3: the sharded runner must not silently
                 # demote the measured winner to XLA). Multi-host: rank 0's
-                # verdict is broadcast so every process compiles the same
-                # collective program — divergent winners would shear the
-                # ppermute sequences exactly like divergent argv.
-                self.backend, agreed_schedule = _agreed_config(
-                    model, tile, channels
+                # verdict — schedule AND geometry — is broadcast so every
+                # process compiles the same collective program (divergent
+                # fuse would shear the ppermute sequences like divergent
+                # argv).
+                self.backend, agreed_schedule, tuned_bh, tuned_fz = (
+                    _agreed_config(model, tile, channels)
                 )
                 if self.schedule is None:
                     self.schedule = agreed_schedule
@@ -338,6 +357,21 @@ class ShardedRunner:
         )
         self.sharding = NamedSharding(self.mesh, spec)
         self.fuse = 1
+        # Kernel geometry the valid-ghost kernel launches: user-forced
+        # --block-h/--fuse wins, else the agreed autotuned verdict for
+        # this tile (so the geometry stage's measurement is never paid
+        # and discarded). block_h_eff is the block at this tile (None =
+        # default geometry ran) — reported, never the requested value.
+        geo_bh = (
+            tuned_bh if tuned_bh is not None
+            else getattr(model, "block_h", None)
+        )
+        geo_fz = (
+            tuned_fz if tuned_fz is not None
+            else getattr(model, "fuse", None)
+        )
+        self.block_h_eff = None
+        self.geo_applied = False
         interpret = False
         if self.backend == "pallas":
             from tpu_stencil.ops import pallas_stencil
@@ -352,19 +386,26 @@ class ShardedRunner:
                 # data per hop, so the fused-chunk depth is capped by the
                 # tile; the mask path needs per-rep pad re-zeroing, which
                 # forces single-rep chunks.
+                want_fuse = (
+                    geo_fz if geo_fz is not None
+                    else pallas_stencil.DEFAULT_FUSE
+                )
                 if not self.needs_mask and model.halo:
-                    self.fuse = max(
-                        1, min(pallas_stencil.DEFAULT_FUSE,
-                               min(tile) // model.halo)
-                    )
+                    self.fuse = max(1, min(want_fuse,
+                                           min(tile) // model.halo))
                 elif not self.needs_mask:
-                    self.fuse = pallas_stencil.DEFAULT_FUSE
+                    self.fuse = want_fuse
+                if geo_bh is not None:
+                    self.block_h_eff = pallas_stencil.effective_block_h(
+                        tile[0], geo_bh
+                    )
+                self.geo_applied = geo_bh is not None or geo_fz is not None
                 interpret = jax.default_backend() == "cpu"
                 # Resolve the schedule that actually runs at the tile's
                 # block height (valid_fused may degrade e.g. pack on a
                 # short tile) so reporting never names a degraded-away one.
                 self.schedule = pallas_stencil.effective_schedule_for(
-                    model.plan, tile[0], self.schedule
+                    model.plan, tile[0], self.schedule, block_h=geo_bh
                 )
         self._fn = build_sharded_iterate(
             self.mesh, model.plan, channels, self.needs_mask,
@@ -376,6 +417,7 @@ class ShardedRunner:
             interpret=interpret,
             schedule=self.schedule,
             boundary=self.boundary,
+            block_h=geo_bh if self.backend == "pallas" else None,
         )
         if self.needs_mask:
             mask = np.zeros(self.padded_shape, np.uint8)

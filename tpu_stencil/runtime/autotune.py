@@ -229,6 +229,12 @@ def best_full_config(
         # A stale schedule name (cache written by a build whose schedule
         # set has since changed) must re-measure, not crash every run.
         and (hit.get("schedule") is None or hit["schedule"] in ps._SCHEDULES)
+        # Entries written before the geometry stage existed lack the
+        # block_h KEY (geometry-tuned entries carry it even when the
+        # default won, as None): re-measure those once so the geometry
+        # tune engages instead of being suppressed forever by an old
+        # cache file.
+        and "block_h" in hit
     ):
         return (hit["backend"], hit.get("schedule"),
                 hit.get("block_h"), hit.get("fuse"))
@@ -265,6 +271,12 @@ def best_full_config(
             if eff in seen_eff:
                 continue
             seen_eff.add(eff)
+            if force_schedule is not None and ps._effective_schedule(
+                force_schedule, plan, eff[0]
+            ) != force_schedule:
+                # A user-forced --schedule must never be degraded away by
+                # a geometry verdict: skip candidates it cannot run at.
+                continue
             try:
                 geo_timings[(gbh, gfz)] = measure(
                     plan, shape, channels, winner, schedule=win_sched,
