@@ -213,12 +213,32 @@ def main() -> None:
     # mode == "autotune": the runner's backend agreement path — rank 0
     # resolves (xla on CPU without measuring) and broadcasts its verdict;
     # both ranks must compile the same program and stay bit-exact.
+    # mode == "geom": the geometry half of the same agreement — each rank
+    # fakes a DIVERGENT pallas verdict; the broadcast must make every
+    # rank adopt rank 0's (schedule, block_h, fuse). fuse is the
+    # discriminator: it sets the halo-exchange chunk depth, so a
+    # divergent value would shear the compiled ppermute programs.
     backend = "autotune" if mode == "autotune" else "xla"
+    if mode == "geom":
+        from tpu_stencil.runtime import autotune as at
+
+        verdicts = {
+            0: ("pallas", "pack", 256, 4),
+            1: ("pallas", "shrink", 128, 8),
+        }
+        at.best_full_config = lambda *a, **k: verdicts[proc_id]
+        backend = "auto"
     model = IteratedConv2D(cfg.filter_name, backend=backend)
     runner = ShardedRunner(
         model, (cfg.height, cfg.width), cfg.channels,
         mesh_shape=cfg.mesh_shape, devices=jax.devices(),
     )
+    if mode == "geom":
+        # Both ranks must hold rank 0's vote (4), not their own fake (8)
+        # nor the local clamp of it.
+        assert runner.backend == "pallas", runner.backend
+        assert runner.fuse == 4, (proc_id, runner.fuse)
+        assert runner.geo_applied
     img_dev = distributed.read_sharded(
         cfg.image, cfg.height, cfg.width, cfg.channels, runner.sharding
     )

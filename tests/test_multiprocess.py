@@ -299,3 +299,41 @@ def test_two_process_frames_checkpointing(tmp_path, rng, mode, n_frames,
         np.testing.assert_array_equal(got[k], want, err_msg=f"frame {k}")
     leftovers = [f for f in os.listdir(tmp_path) if ".ckpt" in f]
     assert leftovers == [], f"checkpoint artifacts not swept: {leftovers}"
+
+
+def test_two_process_geometry_agreement(tmp_path, rng):
+    # The geometry half of the multi-host verdict broadcast: each rank
+    # fakes a DIVERGENT pallas (schedule, block_h, fuse); both must adopt
+    # rank 0's — a divergent fuse (the halo-exchange chunk depth) would
+    # shear the compiled ppermute programs. The worker asserts
+    # runner.fuse == rank-0's vote on BOTH ranks; the shared output must
+    # stay golden-exact under the voted geometry.
+    img = rng.integers(0, 256, size=(12, 20, 3), dtype=np.uint8)
+    src = str(tmp_path / "in.raw")
+    dst = str(tmp_path / "out.raw")
+    raw_io.write_raw(src, img)
+    coordinator = f"127.0.0.1:{_free_port()}"
+    env = dict(
+        os.environ,
+        PYTHONPATH=os.pathsep.join(
+            [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
+            + os.environ.get("PYTHONPATH", "").split(os.pathsep)
+        ),
+    )
+    procs = [
+        subprocess.Popen(
+            [sys.executable, _WORKER, str(pid), coordinator, src, dst,
+             "2", "2", "geom"],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for pid in range(2)
+    ]
+    outs = [p.communicate(timeout=300)[0] for p in procs]
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, f"worker failed:\n{out}"
+    got = raw_io.read_raw(dst, 20, 12, 3)
+    want = stencil.reference_stencil_numpy(
+        img, filters.get_filter("gaussian"), 3
+    )
+    np.testing.assert_array_equal(got, want)
