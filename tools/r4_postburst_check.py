@@ -1,0 +1,126 @@
+"""Digest the part-2 burst artifacts into a verdict summary.
+
+Run after tools/r4_burst_part2.sh completes (or partially completes) to
+answer, in one screen: did every step land, what geometry won where, do
+the large-shape cliffs persist under the measured config (VERDICT r3
+item 3: every row within ~1.5x of bytes-proportional scaling), and what
+the autotune cache recorded on chip.
+
+Pure artifact reading — no device access, safe to run while the tunnel
+is down (it reports which artifacts are missing).
+"""
+
+import csv
+import json
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _rows_of(size: str) -> int:
+    m = re.match(r"(\d+)x(\d+)", size)
+    return int(m[2]) if m else 0
+
+
+def section(title):
+    print(f"\n=== {title} ===")
+
+
+def main():
+    # 1. Official preview
+    section("north star (docs/BENCH_r04_preview.json)")
+    p = os.path.join(REPO, "docs", "BENCH_r04_preview.json")
+    try:
+        r = json.load(open(p))
+        print(f"value={r['value']}s vs_baseline={r['vs_baseline']}x "
+              f"backend={r['backend']} schedule={r.get('pallas_schedule')} "
+              f"pct_hbm_peak={r.get('pct_hbm_peak')} "
+              f"geometry={r.get('pallas_block_h')}x{r.get('pallas_fuse')}")
+        print("schedules:", r.get("pallas_schedules_us_per_rep"))
+    except Exception as e:
+        print(f"MISSING/UNPARSEABLE: {e}")
+
+    # 2. Burst journal step results
+    section("burst journal (docs/r4_lab.log rcs)")
+    lab = os.path.join(REPO, "docs", "r4_lab.log")
+    if not os.path.exists(lab):
+        lab = "/tmp/r4_lab.log"
+    try:
+        for ln in open(lab):
+            if re.search(r"rc=|flipped|verdict|REVERTED|WARNING", ln):
+                print(ln.rstrip())
+    except OSError as e:
+        print(f"MISSING: {e}")
+
+    # 3. Geometry A/B tables
+    section("geometry A/B (forty column decides the default)")
+    for name, label in (("/tmp/r4p2_ab.log", "north star"),
+                        ("/tmp/r4p2_ab5040.log", "1920x5040"),
+                        ("/tmp/r4p2_ab8k.log", "8K")):
+        print(f"-- {label}")
+        try:
+            for ln in open(name):
+                if ln.startswith(("bh=", "platform=")):
+                    print("  " + ln.rstrip())
+        except OSError:
+            print("  (missing)")
+
+    # 4. Cliff check vs bytes-proportional scaling
+    section("cliffs (VERDICT r3 item 3: each row <= ~1.5x bytes-scaled)")
+    path = os.path.join(REPO, "docs", "BENCHMARKS.csv")
+    try:
+        rows = list(csv.DictReader(open(path)))
+    except OSError as e:
+        rows = []
+        print(f"MISSING: {e}")
+    by_key = {}
+    for row in rows:
+        by_key[(row["filter"], row["mode"], row["size"])] = row
+    for filt, mode in sorted({(r["filter"], r["mode"]) for r in rows}):
+        base = by_key.get((filt, mode, "1920x2520"))
+        if base is None:
+            continue
+        base_us, base_rows = float(base["us_per_rep"]), 2520
+        for size in ("1920x5040", "7680x4320 (8K)"):
+            row = by_key.get((filt, mode, size))
+            if row is None:
+                continue
+            # bytes scale with rows (same width family for 5040; 8K is
+            # 4x width too: scale by total pixels)
+            px_ratio = (_rows_of(row["size"]) or 4320) / base_rows
+            if size.startswith("7680"):
+                px_ratio *= 7680 / 1920
+            want = base_us * px_ratio
+            got = float(row["us_per_rep"])
+            flag = "OK" if got <= 1.5 * want else "CLIFF"
+            print(f"{filt:10s} {mode:4s} {size:16s} {got:9.1f} us/rep "
+                  f"(bytes-scaled {want:8.1f}) -> {flag}")
+
+    # 5. Autotune cache
+    section("autotune cache (docs/autotune_v5e.json)")
+    try:
+        cache = json.load(open(os.path.join(REPO, "docs",
+                                            "autotune_v5e.json")))
+        for k, v in cache.items():
+            print(f"{k.split('|')[-1]}: backend={v.get('backend')} "
+                  f"schedule={v.get('schedule')} "
+                  f"geometry={v.get('block_h')}x{v.get('fuse')}")
+            if v.get("geometry_us_per_rep"):
+                print(f"  geometry timings: {v['geometry_us_per_rep']}")
+    except Exception as e:
+        print(f"MISSING/UNPARSEABLE: {e}")
+
+    # 6. 1x1 compiled sharded run
+    section("1x1 compiled sharded pallas (/tmp/r4_1x1.log tail)")
+    try:
+        lines = open("/tmp/r4_1x1.log").read().strip().splitlines()
+        print("\n".join(lines[-3:]))
+    except OSError:
+        print("(missing)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
