@@ -40,6 +40,70 @@ _FIELDS = (
     "speedup_vs_gtx970",
 )
 
+_BASE_SIZE = "1920x2520"
+
+
+def _pixels(size: str) -> int:
+    import re
+
+    m = re.match(r"(\d+)x(\d+)", size)
+    return int(m[1]) * int(m[2]) if m else 0
+
+
+def scaling_section(rows) -> str:
+    """A markdown section checking every larger-than-base row against
+    bytes-proportional scaling (us/rep should grow ~linearly with pixel
+    count for this memory/compute-proportional workload). A row >1.5x
+    its pixel-scaled prediction is flagged CLIFF — the VERDICT r3 item-3
+    acceptance bar, kept visible in the published table so a regression
+    can never hide in absolute numbers."""
+    by_key = {}
+    dup = set()
+    for r in rows:
+        key = (r["filter"], r["mode"], r.get("backend", "-"), r["size"])
+        if key in by_key:
+            # Never silently judge against the wrong row (e.g. a legacy
+            # CSV whose backend column collapsed xla+pallas): drop the
+            # ambiguous key entirely and say so.
+            dup.add(key)
+        by_key[key] = r
+    lines = []
+    for (filt, mode, backend, size), r in by_key.items():
+        if size == _BASE_SIZE or "frames" in size:
+            continue
+        key_base = (filt, mode, backend, _BASE_SIZE)
+        base = by_key.get(key_base)
+        if base is None or key_base in dup or (
+                filt, mode, backend, size) in dup:
+            continue
+        try:
+            ratio = _pixels(size) / _pixels(_BASE_SIZE)
+            want = float(base["us_per_rep"]) * ratio
+            got = float(r["us_per_rep"])
+            verdict_ratio = got / want
+        except (ValueError, ZeroDivisionError, TypeError):
+            continue
+        if ratio <= 1:
+            continue
+        flag = "OK" if got <= 1.5 * want else "**CLIFF**"
+        lines.append(
+            f"| {filt} | {mode} | {backend} | {size} | {got:.1f} "
+            f"| {want:.1f} | {verdict_ratio:.2f}x | {flag} |"
+        )
+    if dup:
+        lines.append(
+            f"| (skipped {len(dup)} ambiguous duplicate-key rows) "
+            "| | | | | | | |"
+        )
+    if not lines:
+        return ""
+    return (
+        "\n## Scaling vs bytes-proportional (base = 1920x2520)\n\n"
+        "| filter | mode | backend | size | us/rep | pixel-scaled "
+        "| ratio | verdict |\n|---|---|---|---|---|---|---|---|\n"
+        + "\n".join(lines) + "\n"
+    )
+
 
 def main() -> int:
     import sys
@@ -71,7 +135,8 @@ def main() -> int:
         f"(round 3)."
     )
     with open(ns.out, "w") as f:
-        f.write(HEADER.format(note=note) + emit_markdown(rows) + "\n")
+        f.write(HEADER.format(note=note) + emit_markdown(rows) + "\n"
+                + scaling_section(rows))
     print(f"wrote {ns.out} ({len(rows)} rows)")
     return 0
 
