@@ -159,7 +159,7 @@ def test_sweep_incremental_csv_and_retry(tmp_path, monkeypatch):
             assert len(persisted) == 1
             assert float(persisted[0]["us_per_rep"]) == 1.0
             raise RuntimeError("UNAVAILABLE: tunnel reset")
-        return 1e-6
+        return 1e-6, backend, None, None, None
 
     monkeypatch.setattr(bench_sweep, "_measure_per_rep", flaky_measure)
     monkeypatch.setattr(bench_sweep.time, "sleep", lambda s: None)
@@ -179,14 +179,15 @@ def test_sweep_frames_row(tmp_path, monkeypatch):
     from tpu_stencil.runtime import bench_sweep
 
     monkeypatch.setattr(
-        bench_sweep, "_measure_per_rep", lambda *a, **k: 1e-6
+        bench_sweep, "_measure_per_rep",
+        lambda img, f, b, backend: (1e-6, backend, None, None, None),
     )
     seen = {}
 
     def fake_batch(imgs, filter_name, budget_s, backend="xla"):
         seen["n_frames"] = imgs.shape[0]
         seen.setdefault("backends", []).append(backend)
-        return 2e-6  # per frame*rep
+        return 2e-6, backend, None, None, None  # per frame*rep
 
     monkeypatch.setattr(
         bench_sweep, "_measure_batch_per_frame_rep", fake_batch
@@ -235,3 +236,35 @@ def test_pallas_capture_geometry_stage(monkeypatch):
     monkeypatch.setenv("TPU_STENCIL_BENCH_SKIP_GEOMETRY", "1")
     got = bench._measure_backend("pallas")
     assert got["geometry"] == "default" and got["us_per_rep"] == 2.0
+
+
+def test_sweep_auto_rows_reflect_default_path(monkeypatch):
+    # --backends auto: the row resolves through the model (tuned backend,
+    # schedule, geometry), times the RESOLVED config, and labels the row
+    # with the full resolution so the table says what a bare-CLI user
+    # measures.
+    from tpu_stencil.models import blur
+    from tpu_stencil.runtime import bench_sweep
+
+    monkeypatch.setattr(
+        blur.IteratedConv2D, "resolved_config",
+        lambda self, shape, ch: ("pallas", "pack"),
+    )
+    monkeypatch.setattr(
+        blur.IteratedConv2D, "resolved_geometry",
+        lambda self, shape, ch: (256, 16),
+    )
+    seen = {}
+
+    def fake_iterate(dev, n, plan, backend, schedule=None, block_h=None,
+                     fuse=None):
+        seen["cfg"] = (backend, schedule, block_h, fuse)
+        return dev
+
+    monkeypatch.setattr(blur, "iterate", fake_iterate)
+    per, resolved, sched, bh, fz = bench_sweep._measure_per_rep(
+        __import__("numpy").zeros((16, 16, 3), "uint8"), "gaussian",
+        0.0001, "auto",
+    )
+    assert (resolved, sched, bh, fz) == ("pallas", "pack", 256, 16)
+    assert seen["cfg"] == ("pallas", "pack", 256, 16)

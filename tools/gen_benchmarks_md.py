@@ -57,10 +57,21 @@ def scaling_section(rows) -> str:
     its pixel-scaled prediction is flagged CLIFF — the VERDICT r3 item-3
     acceptance bar, kept visible in the published table so a regression
     can never hide in absolute numbers."""
+    import re as _re
+
+    def _family(label: str) -> str:
+        # Backend labels legitimately vary with size (schedule degrade,
+        # per-shape tuned geometry suffixes): key on the backend FAMILY
+        # so e.g. 'pallas[pack]' at the base still anchors a
+        # 'pallas[shrink]' large row — the scaling of one lineage.
+        m = _re.match(r"(auto:)?(pallas|xla|reference|auto)", label or "-")
+        return (m[1] or "") + m[2] if m else (label or "-")
+
     by_key = {}
     dup = set()
     for r in rows:
-        key = (r["filter"], r["mode"], r.get("backend", "-"), r["size"])
+        key = (r["filter"], r["mode"], _family(r.get("backend", "-")),
+               r["size"])
         if key in by_key:
             # Never silently judge against the wrong row (e.g. a legacy
             # CSV whose backend column collapsed xla+pallas): drop the
@@ -90,13 +101,15 @@ def scaling_section(rows) -> str:
             f"| {filt} | {mode} | {backend} | {size} | {got:.1f} "
             f"| {want:.1f} | {verdict_ratio:.2f}x | {flag} |"
         )
+    if not lines:
+        # No data rows -> no section; a header plus only a meta note
+        # would read as a (vacuously green) scaling table.
+        return ""
     if dup:
         lines.append(
             f"| (skipped {len(dup)} ambiguous duplicate-key rows) "
             "| | | | | | | |"
         )
-    if not lines:
-        return ""
     return (
         "\n## Scaling vs bytes-proportional (base = 1920x2520)\n\n"
         "| filter | mode | backend | size | us/rep | pixel-scaled "

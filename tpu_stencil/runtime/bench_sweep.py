@@ -43,9 +43,14 @@ WIDTH = 1920
 
 def _measure_per_rep(
     img: np.ndarray, filter_name: str, budget_s: float, backend: str
-) -> float:
+):
     """Steady-state seconds/rep; N scaled so each measurement runs
-    ~budget_s on device."""
+    ~budget_s on device. Returns ``(per_rep_s, resolved_backend,
+    schedule, block_h, fuse)`` — for explicit backends the last three
+    are None/None/None; ``auto``/``autotune`` rows resolve through the
+    model (the DEFAULT path: tuned backend, schedule, and geometry per
+    shape, disk-cached) and the sweep then times exactly that resolved
+    configuration, so an auto row is what a bare-CLI user measures."""
     import jax
     import jax.numpy as jnp
 
@@ -53,12 +58,20 @@ def _measure_per_rep(
     from tpu_stencil.runtime.autotune import _steady_state_per_rep
 
     model = IteratedConv2D(filter_name, backend=backend)
+    shape2 = tuple(img.shape[:2])
+    ch = img.shape[2] if img.ndim == 3 else 1
+    if backend in ("auto", "autotune"):
+        resolved, sched = model.resolved_config(shape2, ch)
+        bh, fz = model.resolved_geometry(shape2, ch)
+    else:
+        resolved, sched, bh, fz = backend, None, None, None
 
     def timed(n_reps: int) -> float:
         dev = jax.device_put(img)
         np.asarray(dev.ravel()[0])
         t0 = time.perf_counter()
-        out = iterate(dev, jnp.int32(n_reps), plan=model.plan, backend=backend)
+        out = iterate(dev, jnp.int32(n_reps), plan=model.plan,
+                      backend=resolved, schedule=sched, block_h=bh, fuse=fz)
         np.asarray(out.ravel()[0])
         return time.perf_counter() - t0
 
@@ -66,18 +79,21 @@ def _measure_per_rep(
     probe_reps = 500
     est = max(timed(probe_reps) / probe_reps, 1e-8)
     lo = min(max(int(budget_s / est), 200), 50_000)
-    return _steady_state_per_rep(timed, lo)
+    return _steady_state_per_rep(timed, lo), resolved, sched, bh, fz
 
 
 def _measure_batch_per_frame_rep(
     imgs: np.ndarray, filter_name: str, budget_s: float,
     backend: str = "xla",
-) -> float:
+):
     """Steady-state seconds per frame-repetition of the batch mode
     (``--frames``): frames are embarrassingly parallel, so the interesting
     number is us per frame*rep vs the single-frame row. ``backend='xla'``
     measures the vmapped step; ``'pallas'`` the fused tall-image kernel
-    (``pallas_stencil.iterate_frames``)."""
+    (``pallas_stencil.iterate_frames``); ``'auto'``/``'autotune'``
+    resolve through the model's batch path (tuned backend, schedule, and
+    geometry) and measure exactly that. Returns ``(per_frame_rep_s,
+    resolved_backend, schedule, block_h, fuse)``."""
     import functools
 
     import jax
@@ -87,7 +103,15 @@ def _measure_batch_per_frame_rep(
     from tpu_stencil.runtime.autotune import _steady_state_per_rep
 
     model = IteratedConv2D(filter_name, backend=backend)
-    if backend == "pallas":
+    frame_shape = tuple(imgs.shape[1:3])
+    ch = imgs.shape[3] if imgs.ndim == 4 else 1
+    resolved, sched, bh, fz = backend, None, None, None
+    if backend in ("auto", "autotune"):
+        resolved, sched = model.batch_config(
+            frame_shape, ch, True, n_frames=imgs.shape[0]
+        )
+        bh, fz = model.resolved_geometry(frame_shape, ch)
+    if resolved == "pallas":
         from tpu_stencil.ops import pallas_stencil
 
         # Mosaic compiles for TPU only; interpret is acceptable on CPU
@@ -103,13 +127,14 @@ def _measure_batch_per_frame_rep(
         fn = jax.jit(
             functools.partial(
                 pallas_stencil.iterate_frames, plan=model.plan,
-                interpret=plat == "cpu",
+                interpret=plat == "cpu", schedule=sched,
+                block_h=bh, fuse=fz,
             ),
             donate_argnums=0,
         )
     else:
         fn = functools.partial(
-            iterate_batch, plan=model.plan, backend=backend
+            iterate_batch, plan=model.plan, backend=resolved
         )
 
     def timed(n_reps: int) -> float:
@@ -124,7 +149,8 @@ def _measure_batch_per_frame_rep(
     probe = 100
     est = max(timed(probe) / probe, 1e-8)
     lo = min(max(int(budget_s / est), 100), 50_000)
-    return _steady_state_per_rep(timed, lo) / imgs.shape[0]
+    per = _steady_state_per_rep(timed, lo) / imgs.shape[0]
+    return per, resolved, sched, bh, fz
 
 
 def _pallas_label(filter_name: str, frame_h: int,
@@ -169,18 +195,27 @@ def _row(img, filter_name, mode, size_label, backend, budget_s, reps,
          base, retries: int = 2) -> dict:
     from tpu_stencil.runtime import roofline
 
-    per_rep = _with_retries(
+    per_rep, resolved, sched, bh, fz = _with_retries(
         lambda: _measure_per_rep(img, filter_name, budget_s, backend),
         f"{size_label} [{backend}]", retries,
     )
     total = per_rep * reps
+    # Roofline at the RESOLVED backend AND geometry: the traffic model
+    # (fused vs per-rep HBM, fuse depth) follows what actually ran.
     gbps, pct = roofline.achieved(
-        img.nbytes, per_rep, backend, filter_name, img.shape[0]
+        img.nbytes, per_rep, resolved, filter_name, img.shape[0],
+        block_h=bh, fuse=fz,
     )
-    label = (
-        _pallas_label(filter_name, img.shape[0])
-        if backend == "pallas" else backend
-    )
+    if backend in ("auto", "autotune"):
+        label = f"auto:{resolved}"
+        if resolved == "pallas":
+            label = f"auto:pallas[{sched}]"
+            if bh is not None or fz is not None:
+                label += f"@{bh}x{fz}"
+    elif backend == "pallas":
+        label = _pallas_label(filter_name, img.shape[0])
+    else:
+        label = backend
     return {
         "filter": filter_name, "mode": mode, "size": size_label,
         "backend": label,
@@ -238,19 +273,26 @@ def run_sweep(
         from tpu_stencil.runtime import roofline
 
         for backend in backends:
-            per_fr = _with_retries(
+            per_fr, resolved, sched, bh, fz = _with_retries(
                 lambda: _measure_batch_per_frame_rep(
                     imgs, "gaussian", budget_s, backend
                 ),
                 f"x{frames} frames [{backend}]",
             )
             gbps, pct = roofline.achieved(
-                imgs.nbytes // frames, per_fr, backend, "gaussian", 2520
+                imgs.nbytes // frames, per_fr, resolved, "gaussian", 2520,
+                block_h=bh, fuse=fz,
             )
-            label = (
-                _pallas_label("gaussian", 2520, n_frames=frames)
-                if backend == "pallas" else backend
-            )
+            if backend in ("auto", "autotune"):
+                label = f"auto:{resolved}"
+                if resolved == "pallas":
+                    label = f"auto:pallas[{sched}]"
+                    if bh is not None or fz is not None:
+                        label += f"@{bh}x{fz}"
+            elif backend == "pallas":
+                label = _pallas_label("gaussian", 2520, n_frames=frames)
+            else:
+                label = backend
             add({
                 "filter": "gaussian", "mode": "rgb",
                 "size": f"{WIDTH}x2520 x{frames} frames", "backend": label,

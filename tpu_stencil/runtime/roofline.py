@@ -15,30 +15,35 @@ from typing import Tuple
 V5E_HBM_GBPS = 819.0  # v5e HBM peak bandwidth
 
 
-def effective_fuse(filter_name: str, h_img: int) -> int:
+def effective_fuse(filter_name: str, h_img: int,
+                   block_h=None, fuse=None) -> int:
     """The fuse depth :func:`tpu_stencil.ops.pallas_stencil.iterate` will
     actually use for this (filter, image height) — HBM traffic per rep is
-    divided by it. Mirrors iterate's clamp exactly."""
+    divided by it. Mirrors iterate's clamp exactly (``block_h``/``fuse``:
+    a forced/tuned geometry; None = module defaults)."""
     from tpu_stencil.models.blur import IteratedConv2D
     from tpu_stencil.ops import pallas_stencil as ps
 
     plan = IteratedConv2D(filter_name).plan
     if not ps._supported(plan):
         return 1
-    bh = min(ps.DEFAULT_BLOCK_H, -(-h_img // 8) * 8)
-    if plan.halo:
-        return max(1, min(ps.DEFAULT_FUSE, bh // (2 * plan.halo)))
-    return ps.DEFAULT_FUSE
+    return ps.effective_geometry(plan, h_img, block_h, fuse)[1]
 
 
 def achieved(frame_bytes: int, per_rep_s: float, backend: str,
-             filter_name: str, h_img: int) -> Tuple[float, float]:
+             filter_name: str, h_img: int,
+             block_h=None, fuse=None) -> Tuple[float, float]:
     """(HBM GB/s, % of v5e peak) for one measured per-rep time.
 
     The XLA step reads + writes the frame every rep; the fused Pallas
     kernel pays HBM once per ``fuse`` reps (ghost-band overhead excluded —
-    it is compute, not extra HBM traffic).
+    it is compute, not extra HBM traffic). ``block_h``/``fuse``: the
+    geometry that ran, when non-default — the traffic model must follow
+    the launch, not the module defaults.
     """
-    fuse = effective_fuse(filter_name, h_img) if backend == "pallas" else 1
-    gbps = 2 * frame_bytes / fuse / per_rep_s / 1e9
+    eff = (
+        effective_fuse(filter_name, h_img, block_h, fuse)
+        if backend == "pallas" else 1
+    )
+    gbps = 2 * frame_bytes / eff / per_rep_s / 1e9
     return gbps, 100 * gbps / V5E_HBM_GBPS
