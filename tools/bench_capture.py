@@ -1,0 +1,53 @@
+"""Extract the canonical capture from a bench.py stdout file.
+
+bench.py's stdout contract (crash-first capture) is one or MORE JSON
+lines — an early ``"partial": true`` line as soon as the default-path
+measurement lands, then enriched lines. The canonical capture is the
+LAST line that parses; a trailing fragment from a SIGKILLed child (a
+write cut mid-line) must not invalidate the earlier complete lines.
+
+Library: ``last_capture(path) -> dict`` (raises ValueError when no line
+parses). CLI: ``python tools/bench_capture.py FILE`` prints the
+canonical capture as a single JSON object (exit 1 if none) — used by
+the burst scripts to keep ``docs/BENCH_r*_preview.json`` a plain
+one-object artifact that ``json.load`` consumers can read directly.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+
+def last_capture(path: str) -> dict:
+    best = None
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                obj = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(obj, dict) and "value" in obj:
+                best = obj
+    if best is None:
+        raise ValueError(f"no parseable capture line in {path}")
+    return best
+
+
+def main(argv) -> int:
+    if len(argv) != 2:
+        print("usage: bench_capture.py FILE", file=sys.stderr)
+        return 2
+    try:
+        print(json.dumps(last_capture(argv[1])))
+    except (OSError, ValueError) as e:
+        print(f"bench_capture: {e}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

@@ -43,6 +43,8 @@ from tpu_stencil.filters import get_filter
 from tpu_stencil.runtime.autotune import _steady_state_per_rep
 
 H, W, C = 2520, 1920, 3
+if os.environ.get("TPU_LAB_SHAPE"):  # smoke runs: e.g. "64x48"
+    H, W = (int(v) for v in os.environ["TPU_LAB_SHAPE"].split("x"))
 
 
 def _binomial_chain(taps):
@@ -178,6 +180,31 @@ def _rep_val_strips(cur, *, plan, dt, wc, channels, opts):
     return jnp.concatenate(parts, axis=1)
 
 
+def _cols_binomial_ilp(col, d: int, channels: int, wc: int):
+    """The cols binomial as a flat tap sum — ILP form. The shipped
+    ``_cols_binomial`` is a serial chain (each roll waits on the previous
+    add, depth 2d); here every roll reads the same input so all d rolls
+    are independent, and the C(d, i) coefficients become a shift-add tree
+    (depth ~log). More total ops, ~half the dependency depth — wins only
+    if the VPU is latency-bound on the chain, which is exactly what the
+    A/B measures. Even d only (gaussian<k> has d = k-1 even); coefficient
+    scaling via ``_mul_const_adds`` keeps it SWAR-safe (same bounds: the
+    flat sum equals the chain's final value, and no intermediate term
+    exceeds the full sum)."""
+    from math import comb
+
+    if d % 2:
+        raise NotImplementedError("cols_ilp supports even chains only")
+    out = None
+    for i in range(d + 1):
+        term = ps._lane_roll(col, (i - d // 2) * channels, wc)
+        c = comb(d, i)
+        if c != 1:
+            term = ps._mul_const_adds(term, c)
+        out = term if out is None else out + term
+    return out
+
+
 def _rep_val_packed(cur, *, plan, wc, channels, opts):
     """One rep on a SWAR-packed value: two image rows per i32 lane element
     (low/high 16 bits). Halves are independent bit fields — adds never
@@ -188,6 +215,14 @@ def _rep_val_packed(cur, *, plan, wc, channels, opts):
     no_rows, no_cols = opts.get("no_rows"), opts.get("no_cols")
 
     def one(x):
+        if opts.get("cols_ilp"):
+            rch, cch = (_binomial_chain(plan.row_taps),
+                        _binomial_chain(plan.col_taps))
+            if rch is None or cch is None:
+                raise NotImplementedError(
+                    "cols_ilp supports binomial taps only")
+            acc = ps._rows_binomial(x, rch)
+            return _cols_binomial_ilp(acc, cch, channels, x.shape[1])
         if not (no_rows or no_cols):
             # The SHIPPED packed passes: the lab A/B must time the kernel
             # that would actually ship (binomial chains, shift-add muls).
@@ -491,6 +526,11 @@ VARIANTS = {
     "swar_strips_1024": dict(swar=True, strip=1024),
     "swar_b256": dict(swar=True, block_h=256),
     "swar_f16_b256": dict(swar=True, block_h=256, fuse=16),
+    # Cols pass in ILP form (flat tap sum, independent rolls) vs the
+    # shipped serial chain — a depth-vs-ops bet on VPU latency.
+    "swar_cols_ilp": dict(swar=True, cols_ilp=True),
+    "swar_ilp_f16_b256": dict(swar=True, cols_ilp=True, block_h=256,
+                              fuse=16),
     # SWAR (pack) ablations: attribute the shipped 22.66 us/rep (r4) the
     # way abl_no_* attributed shrink's cost in r3. dma_only bounds the
     # DMA + pack/unpack floor; the deltas price the rows chain, the cols

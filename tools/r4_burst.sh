@@ -33,15 +33,22 @@ echo "=== r4 burst start $(date +%H:%M:%S) ===" | tee -a /tmp/r4_lab.log
 python -u bench.py > /tmp/r4_bench.json 2> /tmp/r4_bench.log
 echo "=== bench done rc=$? $(date +%H:%M:%S) ===" | tee -a /tmp/r4_lab.log
 # Commit-able preview immediately (before anything else can fail).
-cp /tmp/r4_bench.json "$PREVIEW" 2>/dev/null || true
+# bench.py stdout is one-or-more capture lines (crash-first contract);
+# canonicalize to the last parseable line so the preview artifact stays
+# a single JSON object for json.load consumers. Temp + conditional cp:
+# a failed capture must never clobber a previous good preview.
+if python tools/bench_capture.py /tmp/r4_bench.json \
+    > /tmp/r4_bench_canon.json 2>/dev/null; then
+  cp /tmp/r4_bench_canon.json "$PREVIEW"
+fi
 
 # Schedule verdict for the sweep/1x1 runs: the fastest measured schedule
 # of the shipped kernel (falls back to 'pad' if the capture failed).
 read -r SCHED PLAT <<EOF2
 $(python - <<'EOF'
-import json
 try:
-    r = json.load(open("/tmp/r4_bench.json"))
+    from tools.bench_capture import last_capture
+    r = last_capture("/tmp/r4_bench.json")
     scheds = r.get("pallas_schedules_us_per_rep") or {}
     print(min(scheds, key=scheds.get) if scheds else "pad",
           r.get("platform", "unknown"))

@@ -68,9 +68,11 @@ if [ -n "${NBH:-}" ] && grep -q "^platform=tpu " /tmp/r4p2_ab.log \
     # iterate at module defaults; the preview must match shipped code).
     timeout 1800 python -u bench.py > /tmp/r4p2_bench.json \
         2> /tmp/r4p2_bench.log
-    if [ -s /tmp/r4p2_bench.json ] && python -c \
-        "import json;json.load(open('/tmp/r4p2_bench.json'))" 2>/dev/null; then
-      cp /tmp/r4p2_bench.json "$PREVIEW"
+    # Multi-line crash-first stdout: the canonical capture is the last
+    # parseable line; canonicalize so the preview stays one JSON object.
+    if python tools/bench_capture.py /tmp/r4p2_bench.json \
+        > /tmp/r4p2_bench_canon.json 2>/dev/null; then
+      cp /tmp/r4p2_bench_canon.json "$PREVIEW"
       echo "preview refreshed at new defaults" | tee -a /tmp/r4_lab.log
     else
       echo "WARNING: defaults flipped to ($NBH,$NFZ) but the preview" \
@@ -122,7 +124,7 @@ echo "=== sweep rc=$SWEEP_RC $(date +%H:%M:%S) ===" | tee -a /tmp/r4_lab.log
 if [ "$SWEEP_RC" -eq 0 ]; then
   cp /tmp/r4p2_sweep.csv "$CSV"
   python tools/gen_benchmarks_md.py "$CSV" --out "${CSV%.csv}.md" \
-      --note "round 4, one TPU v5e chip via the axon tunnel, schedule=${SCHED:-pack} ($(date +%F))" \
+      --note "${R4_NOTE_PREFIX:-round 4}, one TPU v5e chip via the axon tunnel, schedule=${SCHED:-pack} ($(date +%F))" \
       >> /tmp/r4_lab.log 2>&1
 else
   echo "sweep incomplete: published BENCHMARKS.csv/.md left untouched" \
@@ -148,7 +150,7 @@ grep "^bh=" /tmp/r4p2_ab8k.log | tee -a /tmp/r4_lab.log
 # 1's lab ran concurrently with a 303-test pytest suite).
 timeout 1500 python -u tools/kernel_lab.py swar abl_swar_no_rows \
     abl_swar_no_cols abl_swar_no_mask abl_swar_dma_only swar_strips \
-    swar_f16_b256 >> /tmp/r4_lab.log 2>&1
+    swar_f16_b256 swar_cols_ilp swar_ilp_f16_b256 >> /tmp/r4_lab.log 2>&1
 echo "=== swar attribution rc=$? $(date +%H:%M:%S) ===" | tee -a /tmp/r4_lab.log
 
 # 5. op_cost tail (informational; part 1 died inside it)

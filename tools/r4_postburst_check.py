@@ -17,6 +17,7 @@ import re
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)  # tools.bench_capture import, cwd-independent
 
 
 def _rows_of(size: str) -> int:
@@ -33,7 +34,10 @@ def main():
     section("north star (docs/BENCH_r04_preview.json)")
     p = os.path.join(REPO, "docs", "BENCH_r04_preview.json")
     try:
-        r = json.load(open(p))
+        # Canonical previews are one object, but a raw bench.py stdout
+        # copy may be multi-line (crash-first contract) — accept both.
+        from tools.bench_capture import last_capture
+        r = last_capture(p)
         print(f"value={r['value']}s vs_baseline={r['vs_baseline']}x "
               f"backend={r['backend']} schedule={r.get('pallas_schedule')} "
               f"pct_hbm_peak={r.get('pct_hbm_peak')} "

@@ -17,9 +17,13 @@ ok() {
     return
   fi
   python - "$PREVIEW" <<'EOF'
-import json, sys
+import sys
+sys.path.insert(0, "/root/repo")
 try:
-    r = json.load(open(sys.argv[1]))
+    # last parseable line: accepts both the canonical one-object preview
+    # and a raw multi-line bench.py stdout copy (crash-first contract)
+    from tools.bench_capture import last_capture
+    r = last_capture(sys.argv[1])
     assert isinstance(r.get("value"), (int, float))
     assert r.get("platform") in ("tpu", "axon")
 except Exception:

@@ -134,8 +134,9 @@ class JobResult:
     # Effective Pallas kernel geometry that LAUNCHED (post align/clamp),
     # reported when a non-default geometry applied — user-forced
     # --block-h/--fuse OR an autotuner geometry verdict — on a path that
-    # honors it; None otherwise (defaults, xla, or the sharded mesh
-    # path, which sizes its own tiles). Report-what-ran, like `schedule`.
+    # honors it (the sharded mesh path reports its tile-effective block
+    # and chunk-capped fuse); None otherwise (defaults, or xla).
+    # Report-what-ran, like `schedule`.
     block_h: Optional[int] = None
     fuse: Optional[int] = None
 

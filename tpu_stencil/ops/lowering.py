@@ -66,8 +66,11 @@ class StencilPlan:
     # multiplies — r3 op costing: full-tile i32 multiply ~60 us/pass vs
     # ~9 for adds) instead of per-tap MACs. A plan field, not an env read
     # inside the pass, so flipping it retraces (it is part of every jit
-    # cache key). Opt-in until the hardware A/B lands (kernel_lab
-    # 'xla'/'xla_pair'; TPU_STENCIL_XLA_PAIR_ADD=1 flips new plans).
+    # cache key). Hardware A/B verdict (r4, v5e, north star): LOST 3x —
+    # 310.9 us/rep vs 99.3 for the tap form (XLA schedules the
+    # reassociated add chain far worse than per-tap MACs; docs/KERNEL.md
+    # ablation table). Stays opt-in (TPU_STENCIL_XLA_PAIR_ADD=1) as a
+    # measured-negative record, not a recommendation.
     xla_pair_add: bool = False
 
     @property

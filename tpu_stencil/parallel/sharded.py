@@ -360,16 +360,16 @@ class ShardedRunner:
         # Kernel geometry the valid-ghost kernel launches: user-forced
         # --block-h/--fuse wins, else the agreed autotuned verdict for
         # this tile (so the geometry stage's measurement is never paid
-        # and discarded). block_h_eff is the block at this tile (None =
-        # default geometry ran) — reported, never the requested value.
-        geo_bh = (
-            tuned_bh if tuned_bh is not None
-            else getattr(model, "block_h", None)
-        )
-        geo_fz = (
-            tuned_fz if tuned_fz is not None
-            else getattr(model, "fuse", None)
-        )
+        # and discarded). The precedence is enforced here, not assumed:
+        # resolved_geometry happens to echo forced knobs back as the
+        # broadcast verdict today, but this code must not depend on that
+        # non-local invariant. block_h_eff is the block at this tile
+        # (None = default geometry ran) — reported, never the requested
+        # value.
+        forced_bh = getattr(model, "block_h", None)
+        forced_fz = getattr(model, "fuse", None)
+        geo_bh = forced_bh if forced_bh is not None else tuned_bh
+        geo_fz = forced_fz if forced_fz is not None else tuned_fz
         self.block_h_eff = None
         self.geo_applied = False
         interpret = False
