@@ -175,6 +175,32 @@ def test_rows_roll_lowering_matches_golden(rng, schedule, monkeypatch):
         np.testing.assert_array_equal(got, want, err_msg=f"{name}")
 
 
+@pytest.mark.parametrize(
+    "schedule", ["pad", "shrink", "strips", "pack", "pack_strips"]
+)
+def test_cols_ilp_lowering_matches_golden(rng, schedule, monkeypatch):
+    # The alternative cols-pass lowering (flat C(d, i) tap sum with
+    # independent rolls, TPU_STENCIL_COLS_ILP): same integer sums
+    # reassociated — bit-exact for every schedule and for both binomial
+    # chain depths (gaussian d=2, gaussian5 d=4, where the 4/6
+    # coefficients exercise the shift-add scaling). Unique image shape:
+    # _COLS_ILP is read at trace time, so a shape shared with other
+    # tests could hit their cached (chain-form) programs.
+    monkeypatch.setattr(pallas_stencil, "_COLS_ILP", True)
+    img = rng.integers(0, 256, size=(68, 43, 3), dtype=np.uint8)
+    for name, reps in (("gaussian", 5), ("gaussian5", 2)):
+        plan = lowering.plan_filter(filters.get_filter(name))
+        got = np.asarray(
+            pallas_stencil.iterate(img, jnp.int32(reps), plan, block_h=32,
+                                   fuse=2, interpret=True,
+                                   schedule=schedule)
+        )
+        want = stencil.reference_stencil_numpy(
+            img, filters.get_filter(name), reps
+        )
+        np.testing.assert_array_equal(got, want, err_msg=f"{name}")
+
+
 @pytest.mark.parametrize("schedule", ["shrink", "strips", "pack", "pack_strips"])
 def test_schedules_grey_and_single_block(rng, schedule):
     img = rng.integers(0, 256, size=(40, 33), dtype=np.uint8)

@@ -181,28 +181,17 @@ def _rep_val_strips(cur, *, plan, dt, wc, channels, opts):
 
 
 def _cols_binomial_ilp(col, d: int, channels: int, wc: int):
-    """The cols binomial as a flat tap sum — ILP form. The shipped
-    ``_cols_binomial`` is a serial chain (each roll waits on the previous
-    add, depth 2d); here every roll reads the same input so all d rolls
-    are independent, and the C(d, i) coefficients become a shift-add tree
-    (depth ~log). More total ops, ~half the dependency depth — wins only
-    if the VPU is latency-bound on the chain, which is exactly what the
-    A/B measures. Even d only (gaussian<k> has d = k-1 even); coefficient
-    scaling via ``_mul_const_adds`` keeps it SWAR-safe (same bounds: the
-    flat sum equals the chain's final value, and no intermediate term
-    exceeds the full sum)."""
-    from math import comb
-
-    if d % 2:
-        raise NotImplementedError("cols_ilp supports even chains only")
-    out = None
-    for i in range(d + 1):
-        term = ps._lane_roll(col, (i - d // 2) * channels, wc)
-        c = comb(d, i)
-        if c != 1:
-            term = ps._mul_const_adds(term, c)
-        out = term if out is None else out + term
-    return out
+    """The cols binomial in ILP form — delegates to the SHIPPED branch
+    (``ps._cols_binomial`` under ``_COLS_ILP``) so the lab A/B times
+    exactly the lowering that would ship, never a drifting copy. The
+    global toggles at trace time (this runs during kernel tracing), so
+    the restore in ``finally`` cannot leak into other variants."""
+    saved = ps._COLS_ILP
+    ps._COLS_ILP = True
+    try:
+        return ps._cols_binomial(col, d, channels, wc)
+    finally:
+        ps._COLS_ILP = saved
 
 
 def _rep_val_packed(cur, *, plan, wc, channels, opts):

@@ -114,6 +114,8 @@ echo "=== 1x1 rc=$? $(date +%H:%M:%S) ===" | tee -a /tmp/r4_lab.log
 # a mid-sweep tunnel drop must not destroy the previous table. The
 # autotune cache export routes the auto rows' tuning verdicts into the
 # same committed artifact as the CLI step's.
+rm -f /tmp/r4p2_sweep.csv  # a stale CSV from an earlier burst must not
+                           # masquerade as this run's partial rows
 TPU_STENCIL_AUTOTUNE_CACHE=$AT_CACHE \
     timeout 5400 python -u -m tpu_stencil.runtime.bench_sweep $SWEEP_ARGS \
     --csv /tmp/r4p2_sweep.csv > /tmp/r4_sweep.log 2>&1
@@ -126,6 +128,19 @@ if [ "$SWEEP_RC" -eq 0 ]; then
   python tools/gen_benchmarks_md.py "$CSV" --out "${CSV%.csv}.md" \
       --note "${R4_NOTE_PREFIX:-round 4}, one TPU v5e chip via the axon tunnel, schedule=${SCHED:-pack} ($(date +%F))" \
       >> /tmp/r4_lab.log 2>&1
+  # A completed sweep supersedes any earlier partial artifact.
+  rm -f docs/BENCHMARKS_partial.csv docs/BENCHMARKS_partial.md
+elif [ -s /tmp/r4p2_sweep.csv ]; then
+  # A mid-sweep tunnel death must still convert the window: publish the
+  # rows that DID measure to a separate partial artifact — the main
+  # table is only ever replaced by a completed sweep.
+  cp /tmp/r4p2_sweep.csv docs/BENCHMARKS_partial.csv
+  python tools/gen_benchmarks_md.py docs/BENCHMARKS_partial.csv \
+      --out docs/BENCHMARKS_partial.md \
+      --note "PARTIAL SWEEP (tunnel died mid-run): only the rows below measured; ${R4_NOTE_PREFIX:-round 4}, one TPU v5e chip, schedule=${SCHED:-pack} ($(date +%F))" \
+      >> /tmp/r4_lab.log 2>&1
+  echo "sweep incomplete: partial rows -> docs/BENCHMARKS_partial.csv/.md;" \
+       "published BENCHMARKS.csv/.md left untouched" | tee -a /tmp/r4_lab.log
 else
   echo "sweep incomplete: published BENCHMARKS.csv/.md left untouched" \
       | tee -a /tmp/r4_lab.log

@@ -30,9 +30,11 @@ def section(title):
 
 
 def main():
-    # 1. Official preview
-    section("north star (docs/BENCH_r04_preview.json)")
-    p = os.path.join(REPO, "docs", "BENCH_r04_preview.json")
+    # 1. Official preview (newest round's artifact wins)
+    p = os.path.join(REPO, "docs", "BENCH_r05_preview.json")
+    if not os.path.exists(p):
+        p = os.path.join(REPO, "docs", "BENCH_r04_preview.json")
+    section(f"north star ({os.path.relpath(p, REPO)})")
     try:
         # Canonical previews are one object, but a raw bench.py stdout
         # copy may be multi-line (crash-first contract) — accept both.

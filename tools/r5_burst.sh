@@ -44,6 +44,53 @@ timeout 900 python -u tools/kernel_lab.py shipped swar \
 echo "=== reconcile rc=$? $(date +%H:%M:%S) ===" | tee -a "$JOURNAL"
 grep "us/rep" /tmp/r5_reconcile.log | tee -a "$JOURNAL"
 
+# 0.7 Cols-ILP lowering A/B on the shipped kernel (TPU_STENCIL_COLS_ILP
+# — flat tap sum, independent rolls) + gated default flip: same >2%-win
+# + pytest-gate + revert protocol as r4's rows-roll flip. The whole
+# step (timing run included — ~minutes of full-size steady-state
+# measurement) is skipped in rehearsals (TPU_LAB_PLATFORM set). Uses
+# the shipped(iterate) line from step 0.5 as the baseline.
+PS=tpu_stencil/ops/pallas_stencil.py
+if [ -z "${TPU_LAB_PLATFORM:-}" ]; then
+  echo "--- shipped kernel, cols-ILP lowering (TPU_STENCIL_COLS_ILP=1) ---" \
+      | tee -a "$JOURNAL"
+  TPU_STENCIL_COLS_ILP=1 timeout 900 python -u tools/kernel_lab.py shipped \
+      >> /tmp/r5_reconcile.log 2>&1
+  grep "shipped(iterate)" /tmp/r5_reconcile.log | tee -a "$JOURNAL"
+  BASE_US=$(grep "shipped(iterate)" /tmp/r5_reconcile.log | awk '{print $2}' | sed -n 1p)
+  ILP_US=$(grep "shipped(iterate)" /tmp/r5_reconcile.log | awk '{print $2}' | sed -n 2p)
+  if [ -n "$BASE_US" ] && [ -n "$ILP_US" ] && python -c \
+      "import sys; sys.exit(0 if float('$ILP_US') < 0.98*float('$BASE_US') else 1)"; then
+    cp $PS /tmp/r5_ps_ilp_backup.py
+    sed -i 's/os.environ.get("TPU_STENCIL_COLS_ILP", "0")/os.environ.get("TPU_STENCIL_COLS_ILP", "1")/' $PS
+    if python -m pytest tests/test_pallas.py -q -x >> "$JOURNAL" 2>&1; then
+      echo "COLS_ILP default flipped: $ILP_US vs $BASE_US us/rep" \
+          | tee -a "$JOURNAL"
+      # The preview must describe the shipped kernel: refresh it.
+      timeout 1800 python -u bench.py > /tmp/r5_bench2.json \
+          2> /tmp/r5_bench2.log
+      if python tools/bench_capture.py /tmp/r5_bench2.json \
+          > /tmp/r5_bench2_canon.json 2>/dev/null; then
+        cp /tmp/r5_bench2_canon.json "$PREVIEW"
+        echo "preview refreshed post-ILP-flip" | tee -a "$JOURNAL"
+      fi
+    else
+      cp /tmp/r5_ps_ilp_backup.py $PS
+      echo "COLS_ILP flip REVERTED (tests failed)" | tee -a "$JOURNAL"
+    fi
+  else
+    echo "cols-ILP verdict: no flip (base=$BASE_US ilp=$ILP_US)" \
+        | tee -a "$JOURNAL"
+  fi
+fi
+
+# Rehearsal stop (CPU dry-runs of steps 0-0.7 only — part 2 is hours
+# of full-size work that only makes sense on a chip).
+if [ -n "${R5_SKIP_PART2:-}" ]; then
+  echo "=== r5 rehearsal stop (R5_SKIP_PART2) ===" | tee -a "$JOURNAL"
+  exit 0
+fi
+
 # 1..5 The part-2 checklist with round-5 provenance. Its preview
 # refresh (after a geometry default flip) targets the same r5 preview;
 # its journal copy publishes the unified round-5 journal.

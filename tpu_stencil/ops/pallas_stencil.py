@@ -257,9 +257,39 @@ def _rows_binomial(acc, d: int):
     return acc
 
 
+# Cols-pass lowering knob, read at import like _ROWS_ROLL (a trace-time
+# env read would be silently defeated by the jit cache): 0 = the serial
+# pair-add chain (each roll waits on the previous add, depth 2d); 1 =
+# the ILP form — a flat C(d, i) tap sum where every roll reads the same
+# input, so all d rolls are independent and the coefficient scaling is
+# a shift-add tree (more ops, ~half the dependency depth; wins only if
+# the VPU is latency-bound on the chain). kernel_lab 'swar_cols_ilp'
+# and the burst's shipped-kernel env A/B measure it — the default flips
+# only on a >2% verdict under the pytest gate.
+_COLS_ILP = os.environ.get("TPU_STENCIL_COLS_ILP", "0") == "1"
+
+
 def _cols_binomial(col, d: int, channels: int, wc: int):
-    """d pair-adds with alternating roll direction (first half +C, second
-    -C) so the binomial result stays centered on the original lanes."""
+    """d-fold (1,1) self-convolution across the lane axis, in either
+    cols-pass lowering (``_COLS_ILP``). Chain form: d pair-adds with
+    alternating roll direction (first half +C, second -C) so the result
+    stays centered on the original lanes. ILP form (even d — every
+    gaussian<k> has d = k-1 even): the same centered taps C(d, i) at
+    offsets (i - d/2)*C summed flat. Identical integer sums reassociated
+    — bit-exact under every schedule (test_pallas.py) — and SWAR-safe:
+    pure adds, and no intermediate exceeds the final sum the chain also
+    reaches, so the ``_pack_ok`` bound covers both lowerings."""
+    if _COLS_ILP and d % 2 == 0:
+        from math import comb
+
+        out = None
+        for i in range(d + 1):
+            term = _lane_roll(col, (i - d // 2) * channels, wc)
+            c = comb(d, i)
+            if c != 1:
+                term = _mul_const_adds(term, c)
+            out = term if out is None else out + term
+        return out
     for d_i in range(d):
         off = channels if d_i < d // 2 else -channels
         col = col + _lane_roll(col, off, wc)
