@@ -241,6 +241,39 @@ def test_stale_cached_schedule_remeasures(plan, tmp_path, monkeypatch):
     )
 
 
+def test_stale_geometry_grid_remeasures(plan, tmp_path, monkeypatch):
+    # An entry tuned under an older/smaller _GEOMETRY_GRID must
+    # re-measure, or expanding the grid (the 512-row cliff candidates)
+    # would be inert for every already-cached shape.
+    import jax
+
+    path = tmp_path / "c.json"
+    monkeypatch.setenv("TPU_STENCIL_AUTOTUNE_CACHE", str(path))
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+
+    calls = []
+
+    def fake_measure(plan, shape, channels, backend, reps=0, schedule=None,
+                     block_h=None, fuse=None):
+        calls.append((backend, schedule, block_h, fuse))
+        return 1e-6 if backend == "pallas" else 2e-6
+
+    key = autotune._key(plan, (640, 640), 1)
+    path.write_text(json.dumps({key: {
+        "backend": "pallas", "schedule": "pack",
+        "block_h": None, "fuse": None,
+        "geometry_grid": [[256, 8]],  # pre-expansion grid
+    }}))
+    autotune.best_config(plan, (640, 640), 1, measure=fake_measure)
+    assert calls, "stale-grid entry must re-measure"
+    # the new grid's candidates were actually tried
+    assert any(c[2:] == (512, 16) for c in calls)
+    # ...and the refreshed entry now hits without re-measuring
+    calls.clear()
+    got = autotune.best_config(plan, (640, 640), 1, measure=fake_measure)
+    assert not calls and got[0] == "pallas"
+
+
 def test_one_broken_schedule_does_not_kill_the_tune(plan, tmp_path,
                                                     monkeypatch):
     import jax

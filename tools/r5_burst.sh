@@ -17,32 +17,76 @@ cd /root/repo
 PREVIEW=${R5_PREVIEW:-/root/repo/docs/BENCH_r05_preview.json}
 # One fresh shared journal for the whole round-5 burst: part 2 appends
 # to /tmp/r4_lab.log and publishes it, so rotate the stale round-4
-# journal away and log our own steps into the same file.
+# journal away (ONCE — retry windows must append to the round-5
+# journal, not rotate it into the round-4 backup) and log our own
+# steps into the same file.
 JOURNAL=/tmp/r4_lab.log
-[ -f "$JOURNAL" ] && mv "$JOURNAL" "$JOURNAL.r4.bak"
+if [ -f "$JOURNAL" ] && [ ! -f "$JOURNAL.r4.bak" ]; then
+  mv "$JOURNAL" "$JOURNAL.r4.bak"
+fi
 echo "=== r5 burst start $(date +%H:%M:%S) ===" | tee -a "$JOURNAL"
+
+# Window resumability (same protocol as part 2): each step marks
+# itself done and is skipped on the next window; R5_FORCE=1 re-runs.
+# Markers are tag-namespaced (part 2 derives its own tag from
+# R4_NOTE_PREFIX) so no other round's run can suppress these steps.
+MARK_TAG=r5
+step_done() { [ -z "${R5_FORCE:-}" ] && [ -f "/tmp/${MARK_TAG}_step_$1_done" ]; }
+mark_done() {
+  # Never mark from a rehearsal (TPU_LAB_PLATFORM set): CPU dry-run
+  # results must not make a real window skip a hardware step.
+  [ -z "${TPU_LAB_PLATFORM:-}" ] && touch "/tmp/${MARK_TAG}_step_$1_done" || true
+}
+# The full-capture predicate shared by step 0 and the post-flip refresh:
+# a preview may only be (re)marked/overwritten by a non-partial TPU line.
+full_capture() {
+  python - "$1" <<'EOF'
+import json, sys
+r = json.load(open(sys.argv[1]))
+ok = r.get("platform") in ("tpu", "axon") and not r.get("partial")
+sys.exit(0 if ok else 1)
+EOF
+}
 
 # 0. Official capture, crash-first. Canonicalize stdout (one-or-more
 # capture lines) to the last parseable line so the preview artifact
 # stays a single JSON object; write via temp + conditional cp so a
-# failed capture can never clobber a previous good preview.
-timeout 1800 python -u bench.py > /tmp/r5_bench.json 2> /tmp/r5_bench.log
-echo "=== bench done rc=$? $(date +%H:%M:%S) ===" | tee -a "$JOURNAL"
-if python tools/bench_capture.py /tmp/r5_bench.json \
-    > /tmp/r5_bench_canon.json 2>/dev/null; then
-  cp /tmp/r5_bench_canon.json "$PREVIEW"
-  echo "preview -> $PREVIEW" | tee -a "$JOURNAL"
+# failed capture can never clobber a previous good preview. Done only
+# when a FULL (non-partial) TPU capture landed — a window that died
+# after the early line retries the sweep next window.
+if step_done bench; then
+  echo "official capture: already done (marker)" | tee -a "$JOURNAL"
 else
-  echo "WARNING: no parseable capture; preview untouched" | tee -a "$JOURNAL"
+  timeout 1800 python -u bench.py > /tmp/r5_bench.json 2> /tmp/r5_bench.log
+  echo "=== bench done rc=$? $(date +%H:%M:%S) ===" | tee -a "$JOURNAL"
+  if python tools/bench_capture.py /tmp/r5_bench.json \
+      > /tmp/r5_bench_canon.json 2>/dev/null; then
+    cp /tmp/r5_bench_canon.json "$PREVIEW"
+    echo "preview -> $PREVIEW" | tee -a "$JOURNAL"
+    full_capture "$PREVIEW" && mark_done bench
+  else
+    echo "WARNING: no parseable capture; preview untouched" | tee -a "$JOURNAL"
+  fi
 fi
 
 # 0.5 Harness reconciliation (VERDICT r4 item 3): kernel_lab's
 # shipped(iterate) + lab swar, un-contended, right next to bench.py's
 # number from step 0 — the delta attribution goes in docs/KERNEL.md.
-timeout 900 python -u tools/kernel_lab.py shipped swar \
-    > /tmp/r5_reconcile.log 2>&1
-echo "=== reconcile rc=$? $(date +%H:%M:%S) ===" | tee -a "$JOURNAL"
-grep "us/rep" /tmp/r5_reconcile.log | tee -a "$JOURNAL"
+if step_done reconcile; then
+  echo "reconcile: already done (marker)" | tee -a "$JOURNAL"
+else
+  timeout 900 python -u tools/kernel_lab.py shipped swar \
+      > /tmp/r5_reconcile.log 2>&1
+  REC_RC=$?
+  echo "=== reconcile rc=$REC_RC $(date +%H:%M:%S) ===" | tee -a "$JOURNAL"
+  grep "us/rep" /tmp/r5_reconcile.log | tee -a "$JOURNAL"
+  # Done only when shipped(iterate) actually measured (a FAILED line —
+  # e.g. the expected CPU-rehearsal failure — is not a verdict).
+  [ "$REC_RC" -eq 0 ] \
+    && grep "shipped(iterate)" /tmp/r5_reconcile.log | grep -v FAILED \
+       | grep -q "us/rep" \
+    && mark_done reconcile
+fi
 
 # 0.7 Cols-ILP lowering A/B on the shipped kernel (TPU_STENCIL_COLS_ILP
 # — flat tap sum, independent rolls) + gated default flip: same >2%-win
@@ -51,14 +95,20 @@ grep "us/rep" /tmp/r5_reconcile.log | tee -a "$JOURNAL"
 # measurement) is skipped in rehearsals (TPU_LAB_PLATFORM set). Uses
 # the shipped(iterate) line from step 0.5 as the baseline.
 PS=tpu_stencil/ops/pallas_stencil.py
-if [ -z "${TPU_LAB_PLATFORM:-}" ]; then
+if step_done ilp_ab; then
+  echo "cols-ILP A/B: already done (marker)" | tee -a "$JOURNAL"
+elif [ -z "${TPU_LAB_PLATFORM:-}" ]; then
   echo "--- shipped kernel, cols-ILP lowering (TPU_STENCIL_COLS_ILP=1) ---" \
       | tee -a "$JOURNAL"
   TPU_STENCIL_COLS_ILP=1 timeout 900 python -u tools/kernel_lab.py shipped \
       >> /tmp/r5_reconcile.log 2>&1
   grep "shipped(iterate)" /tmp/r5_reconcile.log | tee -a "$JOURNAL"
-  BASE_US=$(grep "shipped(iterate)" /tmp/r5_reconcile.log | awk '{print $2}' | sed -n 1p)
-  ILP_US=$(grep "shipped(iterate)" /tmp/r5_reconcile.log | awk '{print $2}' | sed -n 2p)
+  # FAILED lines are not measurements — filter them before extraction,
+  # or a mid-window death would parse "FAILED:" as a timing.
+  BASE_US=$(grep "shipped(iterate)" /tmp/r5_reconcile.log | grep -v FAILED \
+            | awk '{print $2}' | sed -n 1p)
+  ILP_US=$(grep "shipped(iterate)" /tmp/r5_reconcile.log | grep -v FAILED \
+           | awk '{print $2}' | sed -n 2p)
   if [ -n "$BASE_US" ] && [ -n "$ILP_US" ] && python -c \
       "import sys; sys.exit(0 if float('$ILP_US') < 0.98*float('$BASE_US') else 1)"; then
     cp $PS /tmp/r5_ps_ilp_backup.py
@@ -66,21 +116,35 @@ if [ -z "${TPU_LAB_PLATFORM:-}" ]; then
     if python -m pytest tests/test_pallas.py -q -x >> "$JOURNAL" 2>&1; then
       echo "COLS_ILP default flipped: $ILP_US vs $BASE_US us/rep" \
           | tee -a "$JOURNAL"
-      # The preview must describe the shipped kernel: refresh it.
+      # The preview must describe the shipped kernel: refresh it, and
+      # only overwrite with a full (non-partial) TPU capture. If the
+      # refresh dies, hand the capture back to step 0 (clear its
+      # marker) so the next window re-measures the flipped kernel.
       timeout 1800 python -u bench.py > /tmp/r5_bench2.json \
           2> /tmp/r5_bench2.log
       if python tools/bench_capture.py /tmp/r5_bench2.json \
-          > /tmp/r5_bench2_canon.json 2>/dev/null; then
+          > /tmp/r5_bench2_canon.json 2>/dev/null \
+          && full_capture /tmp/r5_bench2_canon.json; then
         cp /tmp/r5_bench2_canon.json "$PREVIEW"
         echo "preview refreshed post-ILP-flip" | tee -a "$JOURNAL"
+        mark_done bench
+      else
+        rm -f "/tmp/${MARK_TAG}_step_bench_done"
+        echo "post-flip refresh incomplete: bench step re-armed" \
+            | tee -a "$JOURNAL"
       fi
+      mark_done ilp_ab
     else
       cp /tmp/r5_ps_ilp_backup.py $PS
       echo "COLS_ILP flip REVERTED (tests failed)" | tee -a "$JOURNAL"
+      mark_done ilp_ab
     fi
   else
     echo "cols-ILP verdict: no flip (base=$BASE_US ilp=$ILP_US)" \
         | tee -a "$JOURNAL"
+    # A verdict needs both numbers; missing ones mean the window died
+    # mid-measure — leave unmarked so the next window retries.
+    [ -n "$BASE_US" ] && [ -n "$ILP_US" ] && mark_done ilp_ab
   fi
 fi
 

@@ -147,8 +147,20 @@ def _pallas_schedules(plan: StencilPlan, shape: Tuple[int, int],
 # Geometry candidates the unforced tune tries ON TOP of the module
 # default, at the winning schedule only (the r4 lab attribution motivated
 # 256-row blocks / deeper fusion; candidates that launch identically to
-# the default are skipped via effective_geometry dedup).
-_GEOMETRY_GRID = ((256, 8), (256, 16))
+# the default are skipped via effective_geometry dedup). 512-row blocks
+# target the large-shape cliffs (1920x5040 / 8K rows — VERDICT r4 item
+# 2): taller blocks amortize per-program DMA ramp on tall images, and
+# per-SHAPE adoption needs the candidate in this grid (the cliff A/B in
+# tools/bh_fuse_ab.py can only flip the global default).
+_GEOMETRY_GRID = ((256, 8), (256, 16), (512, 8), (512, 16))
+
+
+def _grid_fingerprint():
+    """The geometry grid as stored in cache entries (JSON round-trips
+    tuples to lists). An entry tuned under a DIFFERENT grid must
+    re-measure — otherwise expanding the grid (e.g. the 512-row cliff
+    candidates) would be inert for every already-cached shape."""
+    return [list(g) for g in _GEOMETRY_GRID]
 
 
 def _measure_takes_geometry(measure) -> bool:
@@ -235,6 +247,11 @@ def best_full_config(
         # tune engages instead of being suppressed forever by an old
         # cache file.
         and "block_h" in hit
+        # Same staleness class for the grid itself: an entry tuned under
+        # an older/smaller _GEOMETRY_GRID must re-measure so new
+        # candidates are ever tried. Forced-geometry lookups (geo_kw)
+        # never run the grid, so they are grid-independent.
+        and (bool(geo_kw) or hit.get("geometry_grid") == _grid_fingerprint())
     ):
         return (hit["backend"], hit.get("schedule"),
                 hit.get("block_h"), hit.get("fuse"))
@@ -307,6 +324,7 @@ def best_full_config(
             "schedule": win_sched,
             "block_h": win_bh,
             "fuse": win_fuse,
+            "geometry_grid": _grid_fingerprint(),
             "us_per_rep": {
                 (b if s is None else f"{b}[{s}]"): round(t * 1e6, 2)
                 for (b, s), t in timings.items()
