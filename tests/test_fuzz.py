@@ -113,6 +113,41 @@ def test_random_filters_pair_add_lowering(case):
         assert np.abs(got.astype(int) - want.astype(int)).max() <= 1
 
 
+@pytest.mark.parametrize("case", range(10))
+def test_random_filters_cols_ilp_lowering(case, monkeypatch):
+    # The ILP cols lowering (flat tap sum, TPU_STENCIL_COLS_ILP) must
+    # agree with the golden model wherever the binomial cols chain
+    # engages — random binomial filters (3x3 and 5x5, so both chain
+    # depths and the C(4,i) coefficient scaling run), random shapes and
+    # channels, every schedule. Distinct shape range from other suites:
+    # _COLS_ILP is read at trace time, so a shared shape could hit a
+    # cached chain-form program.
+    monkeypatch.setattr(pallas_stencil, "_COLS_ILP", True)
+    rng = np.random.default_rng(4000 + case)
+    f = _random_filter(rng, style="binomial")
+    plan = lowering.plan_filter(f)
+    # Binomial outer-product taps are always exact sep_int (integer taps,
+    # bound 65280 < 2^24): the chain provably engages, and the golden
+    # comparison below can be unconditional.
+    assert plan.kind == "sep_int"
+    assert lowering._binomial_chain(plan.col_taps)
+    h = int(rng.integers(49, 90))
+    w = int(rng.integers(6, 24))
+    ch = int(rng.choice([1, 3]))
+    reps = int(rng.integers(1, 6))
+    shape = (h, w) if ch == 1 else (h, w, ch)
+    img = rng.integers(0, 256, size=shape, dtype=np.uint8)
+    want = stencil.reference_stencil_numpy(img, f, reps)
+    sched = ["pad", "shrink", "strips", "pack", "pack_strips"][case % 5]
+    got = np.asarray(pallas_stencil.iterate(
+        img, jnp.int32(reps), plan, block_h=32, fuse=2, interpret=True,
+        schedule=sched,
+    ))
+    np.testing.assert_array_equal(
+        got, want, err_msg=f"case {case}: sched={sched}"
+    )
+
+
 def test_fuzz_generator_covers_all_regimes():
     # The sweep's claims hold by construction, not by luck of the seeds:
     # assert the drawn population really contains exact and non-exact
