@@ -45,7 +45,12 @@ mark_done() {
 
 # 0. block_h/fuse A/B on the shipped kernel (decision column: the literal
 # 40-rep window, where non-divisor fuse pays its remainder launches).
-if step_done ab; then
+# The marker embeds the candidate list's fingerprint: growing the grid
+# (e.g. the fuse=20 divisor-of-40 candidates) re-arms the step instead
+# of being silently skipped by a marker from the smaller grid.
+AB_FP=$(python -c "from tools.bh_fuse_ab import DEFAULT_GRID as g; \
+import hashlib; print(hashlib.md5(str(g).encode()).hexdigest()[:8])")
+if step_done "ab_$AB_FP"; then
   echo "bh/fuse A/B: already done (marker)" | tee -a /tmp/r4_lab.log
 else
   timeout 1500 python -u tools/bh_fuse_ab.py > /tmp/r4p2_ab.log 2>&1
@@ -54,7 +59,7 @@ else
   grep "^bh=" /tmp/r4p2_ab.log | tee -a /tmp/r4_lab.log
   # Done only when the table really measured on TPU (platform line).
   [ "$AB_RC" -eq 0 ] && grep -q "^platform=tpu " /tmp/r4p2_ab.log \
-    && mark_done ab
+    && mark_done "ab_$AB_FP"
 fi
 
 # 0.5 Self-finalize: flip DEFAULT_BLOCK_H/DEFAULT_FUSE to the best
@@ -202,20 +207,22 @@ fi
 # (1920x5040: 739 us/rep; 8K) — if the sweep shows the cliffs persist
 # under pack, per-shape geometry is the first candidate fix and this
 # table decides it.
-if step_done cliffs; then
+CLIFF_CANDS="128x8 256x8 256x16 256x20 512x16 512x20"
+CLIFF_FP=$(echo "$CLIFF_CANDS" | md5sum | cut -c1-8)
+if step_done "cliffs_$CLIFF_FP"; then
   echo "cliff A/Bs: already done (marker)" | tee -a /tmp/r4_lab.log
 else
   AB_H=5040 timeout 1500 python -u tools/bh_fuse_ab.py \
-      128x8 256x8 256x16 512x16 > /tmp/r4p2_ab5040.log 2>&1
+      $CLIFF_CANDS > /tmp/r4p2_ab5040.log 2>&1
   C1_RC=$?
   echo "=== A/B 1920x5040 rc=$C1_RC $(date +%H:%M:%S) ===" | tee -a /tmp/r4_lab.log
   grep "^bh=" /tmp/r4p2_ab5040.log | tee -a /tmp/r4_lab.log
   AB_H=4320 AB_W=7680 timeout 1800 python -u tools/bh_fuse_ab.py \
-      128x8 256x8 256x16 512x16 > /tmp/r4p2_ab8k.log 2>&1
+      $CLIFF_CANDS > /tmp/r4p2_ab8k.log 2>&1
   C2_RC=$?
   echo "=== A/B 8K rc=$C2_RC $(date +%H:%M:%S) ===" | tee -a /tmp/r4_lab.log
   grep "^bh=" /tmp/r4p2_ab8k.log | tee -a /tmp/r4_lab.log
-  [ "$C1_RC" -eq 0 ] && [ "$C2_RC" -eq 0 ] && mark_done cliffs
+  [ "$C1_RC" -eq 0 ] && [ "$C2_RC" -eq 0 ] && mark_done "cliffs_$CLIFF_FP"
 fi
 
 # 4.5 SWAR attribution: price pack's rows chain / cols chain / boundary

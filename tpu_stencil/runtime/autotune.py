@@ -151,8 +151,14 @@ def _pallas_schedules(plan: StencilPlan, shape: Tuple[int, int],
 # target the large-shape cliffs (1920x5040 / 8K rows — VERDICT r4 item
 # 2): taller blocks amortize per-program DMA ramp on tall images, and
 # per-SHAPE adoption needs the candidate in this grid (the cliff A/B in
-# tools/bh_fuse_ab.py can only flip the global default).
-_GEOMETRY_GRID = ((256, 8), (256, 16), (512, 8), (512, 16))
+# tools/bh_fuse_ab.py can only flip the global default). fuse=20 rows:
+# `reps % fuse` runs as single-rep launches (repetitions is traced, so
+# the remainder depth cannot be compiled statically), which taxes
+# non-divisor fuses on the reference's 40-rep jobs — a divisor-of-40
+# fuse gets the deep traffic cut with ZERO remainder launches.
+_GEOMETRY_GRID = (
+    (256, 8), (256, 16), (256, 20), (512, 8), (512, 16), (512, 20),
+)
 
 
 def _grid_fingerprint():
