@@ -162,8 +162,11 @@ if step_done sweep; then
 else
   rm -f /tmp/r4p2_sweep.csv  # a stale CSV from an earlier burst must not
                              # masquerade as this run's partial rows
+  # 2h budget: the auto rows tune (backend x schedule x 6-entry geometry
+  # grid) per shape on first contact; the cache (AT_CACHE) persists, so
+  # a window death resumes cheaper next time.
   TPU_STENCIL_AUTOTUNE_CACHE=$AT_CACHE \
-      timeout 5400 python -u -m tpu_stencil.runtime.bench_sweep $SWEEP_ARGS \
+      timeout 7200 python -u -m tpu_stencil.runtime.bench_sweep $SWEEP_ARGS \
       --csv /tmp/r4p2_sweep.csv > /tmp/r4_sweep.log 2>&1
   SWEEP_RC=$?
   echo "=== sweep rc=$SWEEP_RC $(date +%H:%M:%S) ===" | tee -a /tmp/r4_lab.log
