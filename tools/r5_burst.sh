@@ -21,7 +21,11 @@ PREVIEW=${R5_PREVIEW:-/root/repo/docs/BENCH_r05_preview.json}
 # journal, not rotate it into the round-4 backup) and log our own
 # steps into the same file.
 JOURNAL=/tmp/r4_lab.log
-if [ -f "$JOURNAL" ] && [ ! -f "$JOURNAL.r4.bak" ]; then
+# Rehearsals write to their own journal so CPU dry-run lines never
+# pollute the published round-5 journal.
+[ -n "${TPU_LAB_PLATFORM:-}" ] && JOURNAL=/tmp/r5_rehearsal.log
+if [ -f "$JOURNAL" ] && [ ! -f "$JOURNAL.r4.bak" ] \
+    && [ "$JOURNAL" = /tmp/r4_lab.log ]; then
   mv "$JOURNAL" "$JOURNAL.r4.bak"
 fi
 echo "=== r5 burst start $(date +%H:%M:%S) ===" | tee -a "$JOURNAL"
@@ -164,5 +168,9 @@ R4_LOG_COPY=/root/repo/docs/r5_lab.log \
 bash tools/r4_burst_part2.sh
 rc=$?
 echo "=== r5 burst complete rc=$rc $(date +%H:%M:%S) ===" | tee -a "$JOURNAL"
-cp "$JOURNAL" /root/repo/docs/r5_lab.log 2>/dev/null || true
+# Publish only the REAL journal — a rehearsal's journal must never
+# clobber the published round-5 log.
+if [ "$JOURNAL" = /tmp/r4_lab.log ]; then
+  cp "$JOURNAL" /root/repo/docs/r5_lab.log 2>/dev/null || true
+fi
 exit $rc

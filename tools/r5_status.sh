@@ -1,0 +1,32 @@
+#!/bin/bash
+# One-glance round-5 status: poller alive? tunnel state? burst progress?
+P=$(pgrep -f wait_and_burst2.sh | head -1)
+if [ -n "$P" ]; then
+  # A live wait loop could be a stale round-4 one — confirm it will
+  # actually fire the round-5 burst.
+  BURST=$(tr '\0' '\n' < "/proc/$P/environ" 2>/dev/null \
+          | sed -n 's/^R4_BURST=//p')
+  if [ "$BURST" = /root/repo/tools/r5_burst.sh ]; then
+    echo "poller: $P (armed with r5_burst.sh)"
+  else
+    echo "poller: $P but R4_BURST=${BURST:-unset} — WRONG BURST; kill it and restart:"
+    echo "  R4_MAX_TRIES=40 R4_BURST=/root/repo/tools/r5_burst.sh nohup bash tools/wait_and_burst3.sh > /tmp/r5_wait.log 2>&1 &"
+  fi
+else
+  echo "poller: DEAD - restart with: R4_MAX_TRIES=40 R4_BURST=/root/repo/tools/r5_burst.sh nohup bash tools/wait_and_burst3.sh > /tmp/r5_wait.log 2>&1 &"
+fi
+echo "tunnel: $(tail -1 /tmp/r5_wait.log 2>/dev/null)"
+echo "step markers:"
+M=$(ls /tmp/r5_step_*_done /tmp/round_5_step_*_done 2>/dev/null)
+if [ -n "$M" ]; then echo "$M" | sed 's/^/  /'; else echo "  (none yet)"; fi
+if [ -f /tmp/r4_lab.log ]; then
+  echo "--- burst journal tail ---"
+  tail -6 /tmp/r4_lab.log
+fi
+if [ -f /root/repo/docs/BENCH_r05_preview.json ]; then
+  echo "--- r5 preview ---"
+  cat /root/repo/docs/BENCH_r05_preview.json
+else
+  echo "r5 preview: not yet (latest hardware evidence: docs/BENCH_r04_preview.json)"
+fi
+git -C /root/repo status --short | head -5
