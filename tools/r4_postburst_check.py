@@ -48,14 +48,27 @@ def main():
     except Exception as e:
         print(f"MISSING/UNPARSEABLE: {e}")
 
-    # 2. Burst journal step results
-    section("burst journal (docs/r4_lab.log rcs)")
-    lab = os.path.join(REPO, "docs", "r4_lab.log")
-    if not os.path.exists(lab):
-        lab = "/tmp/r4_lab.log"
+    # 1.5 Harness reconciliation (VERDICT r4 item 3): bench.py's pallas
+    # number vs kernel_lab's shipped(iterate) for the same config.
+    section("reconciliation (/tmp/r5_reconcile.log)")
+    try:
+        for ln in open("/tmp/r5_reconcile.log"):
+            if "us/rep" in ln or ln.startswith("platform="):
+                print("  " + ln.rstrip())
+    except OSError:
+        print("  (missing — step 0.5 has not run)")
+
+    # 2. Burst journal step results — newest journal wins by mtime, so a
+    # mid-window digest shows the LIVE /tmp journal, not a stale
+    # published snapshot from an earlier round.
+    cands = [p for p in (os.path.join(REPO, "docs", "r5_lab.log"),
+                         os.path.join(REPO, "docs", "r4_lab.log"),
+                         "/tmp/r4_lab.log") if os.path.exists(p)]
+    lab = max(cands, key=os.path.getmtime) if cands else "/tmp/r4_lab.log"
+    section(f"burst journal ({lab}) rcs")
     try:
         for ln in open(lab):
-            if re.search(r"rc=|flipped|verdict|REVERTED|WARNING", ln):
+            if re.search(r"rc=|flipped|verdict|REVERTED|WARNING|marker", ln):
                 print(ln.rstrip())
     except OSError as e:
         print(f"MISSING: {e}")
