@@ -372,7 +372,10 @@ def _run_child(env, stream=False):
     ``stream=True`` forwards each child stdout line to OUR stdout the
     moment it arrives — the early capture line must reach the driver's
     output file even if this parent is later SIGKILLed (rc=124 drivers
-    capture whatever was flushed)."""
+    capture whatever was flushed). Returns a 4th element: the complete
+    (newline-terminated) lines actually forwarded, so callers judge
+    success by what reached stdout — never by a trailing fragment
+    drain_out refused to stream."""
     import threading
 
     proc = subprocess.Popen(
@@ -382,7 +385,7 @@ def _run_child(env, stream=False):
     # One owner per pipe: communicate() would race the stderr drain thread
     # for the same fd and silently drop whatever its internal reader
     # consumed — the child's diagnostic trail must survive intact.
-    err_chunks, out_chunks = [], []
+    err_chunks, out_chunks, forwarded = [], [], []
     progressed = threading.Event()
 
     def drain_err():
@@ -399,6 +402,7 @@ def _run_child(env, stream=False):
             # reach our stdout (it would violate the every-line-parses
             # contract and could concatenate with a retry's line).
             if stream and line.strip() and line.endswith("\n"):
+                forwarded.append(line)
                 print(line, end="", flush=True)
 
     t_err = threading.Thread(target=drain_err, daemon=True)
@@ -417,7 +421,7 @@ def _run_child(env, stream=False):
         return None, "".join(out_chunks), "".join(err_chunks) + (
             f"\nno child output within {INIT_TIMEOUT}s "
             "(backend init hung - tunnel down?)\n"
-        )
+        ), list(forwarded)
     # The watchdog window counts against the attempt budget: total wall
     # clock per attempt stays <= CHILD_TIMEOUT, not INIT + CHILD.
     remaining = max(CHILD_TIMEOUT - (time.time() - start), 1.0)
@@ -430,10 +434,11 @@ def _run_child(env, stream=False):
         t_out.join(2)
         return None, "".join(out_chunks), "".join(err_chunks) + (
             f"\ntimed out after {CHILD_TIMEOUT}s\n"
-        )
+        ), list(forwarded)
     t_err.join(5)
     t_out.join(5)
-    return proc.returncode, "".join(out_chunks), "".join(err_chunks)
+    return (proc.returncode, "".join(out_chunks), "".join(err_chunks),
+            list(forwarded))
 
 
 def _rows_roll_probe(primary_line: str) -> str:
@@ -462,7 +467,7 @@ def _rows_roll_probe(primary_line: str) -> str:
         )
         log(f"rows-roll probe: pallas[{best}] under "
             f"TPU_STENCIL_ROWS_ROLL={alt}")
-        rc, out, err = _run_child(env)
+        rc, out, err, _fwd = _run_child(env)
         sys.stderr.write(err)
         lines = [l for l in out.splitlines() if l.strip()]
         if rc != 0 or not lines:
@@ -502,16 +507,18 @@ def main() -> int:
         # stream=True: the child's capture lines (early + enriched) hit
         # our stdout as they land, so a driver timeout that SIGKILLs this
         # parent mid-sweep still records a parseable capture.
-        rc, out, err = _run_child(env, stream=True)
+        rc, out, err, forwarded = _run_child(env, stream=True)
         # Preserve the child's trail (platform/compile/progress lines):
         # without it a hung capture is undiagnosable.
         sys.stderr.write(err)
         lines = [l for l in out.splitlines() if l.strip()]
-        # Success = a VALID capture reached stdout, not just any bytes
-        # (a truncated fragment or stray library print must not turn a
-        # failed round into rc=0 with an unparseable last line).
+        # Success = a VALID capture reached OUR stdout, judged on the
+        # newline-terminated lines drain_out actually forwarded — a
+        # capture whose newline was cut by a mid-write kill was never
+        # streamed, so it must not turn a failed round into rc=0 with
+        # nothing parseable on stdout.
         emitted_any = emitted_any or any(
-            _is_capture(line) for line in lines
+            _is_capture(line) for line in forwarded
         )
         if rc == 0 and lines:
             final = _rows_roll_probe(lines[-1])

@@ -95,6 +95,7 @@ def test_bench_capture_extractor(tmp_path):
         '{"value": 1.0, "partial": true}\n'
         "\n"
         '{"value": 2.0, "backend": "pallas"}\n'
+        '{"value": null}\n'  # stray JSON: not a capture (_is_capture parity)
         '{"value": 3.0, "backe'  # child killed mid-write
     )
     assert last_capture(str(p))["value"] == 2.0
@@ -143,7 +144,7 @@ def test_rows_roll_probe_merges_and_survives_failure(monkeypatch):
 
     def fake_child(env):
         seen_env.update(env)
-        return 0, probe_json + "\n", ""
+        return 0, probe_json + "\n", "", [probe_json + "\n"]
 
     monkeypatch.setattr(bench, "_run_child", fake_child)
     merged = json.loads(bench._rows_roll_probe(primary))
@@ -178,14 +179,18 @@ def test_rows_roll_probe_merges_and_survives_failure(monkeypatch):
     slow_probe["value"] = 0.004
     slow_probe["backends_us_per_rep"] = {"pallas": 100.0}
     monkeypatch.setattr(
-        bench, "_run_child", lambda env: (0, json.dumps(slow_probe), "")
+        bench, "_run_child",
+        lambda env: (0, json.dumps(slow_probe), "",
+                     [json.dumps(slow_probe) + "\n"]),
     )
     kept = json.loads(bench._rows_roll_probe(primary))
     assert kept["value"] == 0.003388
     assert kept["rows_roll_probe_us_per_rep"] == 100.0
 
     # Probe child dies: primary returned verbatim.
-    monkeypatch.setattr(bench, "_run_child", lambda env: (1, "", "boom"))
+    monkeypatch.setattr(
+        bench, "_run_child", lambda env: (1, "", "boom", [])
+    )
     assert bench._rows_roll_probe(primary) == primary
 
     # CPU primary: no probe at all (a child run would be wasted work).
@@ -195,6 +200,37 @@ def test_rows_roll_probe_merges_and_survives_failure(monkeypatch):
     monkeypatch.setattr(bench, "_run_child", boom)
     cpu_primary = json.dumps({"value": 1.0, "platform": "cpu"})
     assert bench._rows_roll_probe(cpu_primary) == cpu_primary
+
+
+def test_bench_rc_follows_forwarded_lines_not_raw_output(monkeypatch):
+    # rc=0 must mean "a valid capture reached stdout". A capture whose
+    # newline was cut by a mid-write kill is collected in `out` but never
+    # forwarded by drain_out — main() must judge by the forwarded lines
+    # (ADVICE.md round 5, bench.py:513).
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location("bench_mod2", BENCH)
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    monkeypatch.setattr(bench, "ATTEMPTS", 1)
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    monkeypatch.delenv("TPU_STENCIL_BENCH_CHILD", raising=False)
+
+    capture = '{"value": 1.0, "unit": "s"}'
+    # Child killed between write and flush: the only capture line has no
+    # trailing newline, so nothing was forwarded -> failure (rc=1).
+    monkeypatch.setattr(
+        bench, "_run_child", lambda env, stream=False: (None, capture, "", [])
+    )
+    assert bench.main() == 1
+
+    # Same child output but the line WAS complete and forwarded -> rc=0
+    # even though the attempt's returncode never went 0.
+    monkeypatch.setattr(
+        bench, "_run_child",
+        lambda env, stream=False: (None, capture + "\n", "", [capture + "\n"]),
+    )
+    assert bench.main() == 0
 
 
 def test_sweep_incremental_csv_and_retry(tmp_path, monkeypatch):

@@ -166,6 +166,48 @@ def test_fuzz_generator_covers_all_regimes():
     assert True in binoms
 
 
+@pytest.mark.parametrize("case", range(6))
+def test_serve_matches_run_job(case, tmp_path):
+    # The serving layer's exactness contract: for any request shape, the
+    # cropped serve output is byte-identical to a full driver.run_job of
+    # the same (image, filter, reps) — bucket padding plus the per-rep
+    # pad re-zero must be invisible. Random odd/tiny shapes, grey and
+    # rgb, including reps=0 (identity).
+    import jax
+
+    from tpu_stencil.config import ImageType, JobConfig, ServeConfig
+    from tpu_stencil.driver import run_job
+    from tpu_stencil.io import raw as raw_io
+    from tpu_stencil.serve.engine import StencilServer
+
+    rng = np.random.default_rng(5000 + case)
+    h = int(rng.integers(5, 40))
+    w = int(rng.integers(5, 40))
+    ch = int(rng.choice([1, 3]))
+    reps = int(rng.integers(0, 4))
+    shape = (h, w) if ch == 1 else (h, w, ch)
+    img = rng.integers(0, 256, size=shape, dtype=np.uint8)
+
+    src = str(tmp_path / f"in_{case}.raw")
+    img.tofile(src)
+    cfg = JobConfig(
+        image=src, width=w, height=h, repetitions=reps,
+        image_type=ImageType.GREY if ch == 1 else ImageType.RGB,
+        backend="xla", output=str(tmp_path / f"out_{case}.raw"),
+    )
+    run_job(cfg, devices=jax.devices()[:1])
+    want = raw_io.read_raw(cfg.output_path, w, h, ch)
+    if ch == 1:
+        want = want[..., 0]
+
+    with StencilServer(ServeConfig(backend="xla", max_batch=2,
+                                   bucket_edges=(8, 16, 32))) as server:
+        got = server.submit(img, reps).result(timeout=300)
+    np.testing.assert_array_equal(
+        got, want, err_msg=f"case {case}: shape={shape} reps={reps}"
+    )
+
+
 @pytest.mark.parametrize("case", range(10))
 def test_random_geometry_matches_golden(case):
     # Geometry invariance by construction: random (block_h, fuse) — odd

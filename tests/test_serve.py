@@ -1,0 +1,369 @@
+"""Serving engine: bucket policy, backpressure, cache reuse, exactness.
+
+The contract under test is docs/SERVING.md's: bounded queue (reject,
+never grow), one cached executable per (filter, shape-bucket, dtype,
+backend, reps) key, and cropped outputs byte-identical to the single-job
+path for any mix of request shapes/channels in one queue.
+"""
+
+import numpy as np
+import pytest
+
+from tpu_stencil import filters
+from tpu_stencil.config import ServeConfig
+from tpu_stencil.ops import stencil
+from tpu_stencil.serve import bucketing, loadgen
+from tpu_stencil.serve.engine import QueueFull, ServerClosed, StencilServer
+from tpu_stencil.serve.metrics import Histogram, Registry
+
+
+def _golden(img, reps, name="gaussian"):
+    return stencil.reference_stencil_numpy(img, filters.get_filter(name), reps)
+
+
+# -- bucket policy (pure, jax-free) -----------------------------------
+
+
+def test_bucket_dim_ladder_and_edges():
+    edges = (8, 16, 32)
+    assert bucketing.bucket_dim(1, edges) == 8
+    assert bucketing.bucket_dim(8, edges) == 8      # exact edge: no pad
+    assert bucketing.bucket_dim(9, edges) == 16
+    assert bucketing.bucket_dim(32, edges) == 32
+    with pytest.raises(ValueError):
+        bucketing.bucket_dim(0, edges)
+
+
+def test_bucket_dim_above_top_edge_pads_to_multiple():
+    # Requests larger than the largest bucket are never refused: they pad
+    # to the next top-edge multiple (partition.pad_amounts semantics).
+    edges = (8, 16, 32)
+    assert bucketing.bucket_dim(33, edges) == 64
+    assert bucketing.bucket_dim(64, edges) == 64
+    assert bucketing.bucket_dim(65, edges) == 96
+
+
+def test_batch_bucket_pow2_capped():
+    assert bucketing.batch_bucket(1, 8) == 1
+    assert bucketing.batch_bucket(3, 8) == 4
+    assert bucketing.batch_bucket(5, 8) == 8
+    assert bucketing.batch_bucket(7, 4) == 4  # cap wins
+    with pytest.raises(ValueError):
+        bucketing.batch_bucket(0, 8)
+
+
+def test_waste_pixels_accounting():
+    # Two 10x10 requests in a 16x16 bucket, batch padded to 4 frames:
+    # 4*256 total canvas - 200 real = 824 padded pixels.
+    assert bucketing.waste_pixels([(10, 10), (10, 10)], (16, 16), 4) == 824
+
+
+# -- metrics (pure) ---------------------------------------------------
+
+
+def test_histogram_percentiles_and_bounds():
+    h = Histogram(cap=64)
+    for v in range(1, 101):
+        h.observe(float(v))
+    assert h.count == 100
+    assert h.sum == pytest.approx(5050.0)
+    snap = h.snapshot()
+    assert snap["max"] == 100.0
+    assert 30.0 <= snap["p50"] <= 70.0     # reservoir-sampled median
+    assert snap["p99"] >= snap["p50"]
+    # Bounded memory: the reservoir never exceeds its cap.
+    assert len(h._values) == 64
+
+
+def test_registry_snapshot_schema():
+    r = Registry()
+    r.counter("a").inc(3)
+    r.gauge("g").set(5)
+    r.gauge("g").set(2)
+    r.histogram("h").observe(1.5)
+    snap = r.snapshot()
+    assert snap["counters"]["a"] == 3
+    assert snap["gauges"]["g"] == {"value": 2, "peak": 5}
+    assert snap["histograms"]["h"]["count"] == 1
+
+
+# -- engine exactness -------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def server():
+    # One module-scoped server: executables compiled by earlier tests are
+    # cache hits for later ones (and the suite stays fast).
+    with StencilServer(ServeConfig(max_queue=64, max_batch=4,
+                                   bucket_edges=(8, 16, 32))) as s:
+        yield s
+
+
+def test_serve_matches_golden_rgb(server, rng):
+    img = rng.integers(0, 256, (24, 18, 3), dtype=np.uint8)
+    got = server.submit(img, 3).result(timeout=300)
+    np.testing.assert_array_equal(got, _golden(img, 3))
+    assert got.dtype == np.uint8 and got.shape == img.shape
+
+
+def test_serve_one_pixel_image(server, rng):
+    img = rng.integers(0, 256, (1, 1), dtype=np.uint8)
+    got = server.submit(img, 2).result(timeout=300)
+    np.testing.assert_array_equal(got, _golden(img, 2))
+
+
+def test_serve_oversized_request(server, rng):
+    # 40 > the 32 top edge on both dims: pads to the next top-edge
+    # multiple (64x64), still exact.
+    img = rng.integers(0, 256, (40, 40), dtype=np.uint8)
+    assert bucketing.bucket_shape(40, 40, (8, 16, 32)) == (64, 64)
+    got = server.submit(img, 2).result(timeout=300)
+    np.testing.assert_array_equal(got, _golden(img, 2))
+
+
+def test_serve_zero_reps_identity(server, rng):
+    img = rng.integers(0, 256, (9, 13, 3), dtype=np.uint8)
+    got = server.submit(img, 0).result(timeout=300)
+    np.testing.assert_array_equal(got, img)
+
+
+def test_mixed_grey_rgb_one_queue(server, rng):
+    # Grey and RGB interleaved in one queue: distinct buckets, every
+    # output exact, no cross-contamination from batching.
+    cases = []
+    for i in range(8):
+        ch = 1 if i % 2 == 0 else 3
+        h, w = (11 + i, 17 - i)
+        shape = (h, w) if ch == 1 else (h, w, ch)
+        cases.append((rng.integers(0, 256, shape, dtype=np.uint8), 2))
+    futs = [server.submit(img, reps) for img, reps in cases]
+    for (img, reps), fut in zip(cases, futs):
+        np.testing.assert_array_equal(
+            fut.result(timeout=300), _golden(img, reps),
+            err_msg=f"shape={img.shape}",
+        )
+
+
+def test_serve_per_request_filter(server, rng):
+    img = rng.integers(0, 256, (16, 16, 3), dtype=np.uint8)
+    got = server.submit(img, 2, filter_name="box").result(timeout=300)
+    np.testing.assert_array_equal(got, _golden(img, 2, "box"))
+
+
+def test_submit_validation(server):
+    with pytest.raises(ValueError):
+        server.submit(np.zeros((4, 4), np.float32), 1)  # not uint8
+    with pytest.raises(ValueError):
+        server.submit(np.zeros(4, np.uint8), 1)         # not 2-D/3-D
+    with pytest.raises(ValueError):
+        server.submit(np.zeros((4, 4), np.uint8), -1)   # negative reps
+
+
+# -- executable cache -------------------------------------------------
+
+
+def test_executable_cache_hit_on_same_bucket(rng):
+    with StencilServer(ServeConfig(max_queue=16, max_batch=2,
+                                   bucket_edges=(8, 16))) as s:
+        a = rng.integers(0, 256, (10, 10), dtype=np.uint8)
+        b = rng.integers(0, 256, (12, 9), dtype=np.uint8)  # same 16x16 bucket
+        s.submit(a, 2).result(timeout=300)
+        s.submit(b, 2).result(timeout=300)   # sequential: second dispatch
+        snap = s.stats()
+    assert snap["counters"]["cache_misses_total"] == 1
+    assert snap["counters"]["cache_hits_total"] == 1
+    assert snap["executables_cached"] == 1
+
+
+def test_executable_cache_lru_bound(rng):
+    # The cache key space is client-controlled (reps varies per request),
+    # so the cache must evict beyond its cap — a long-running server
+    # never accumulates compiled programs without bound.
+    with StencilServer(ServeConfig(max_queue=16, max_batch=1,
+                                   max_executables=2,
+                                   bucket_edges=(8,))) as s:
+        img = rng.integers(0, 256, (6, 6), dtype=np.uint8)
+        for reps in (1, 2, 3, 4):  # 4 distinct keys through a 2-entry cap
+            s.submit(img, reps).result(timeout=300)
+        snap = s.stats()
+    assert snap["executables_cached"] <= 2
+    assert snap["counters"]["cache_evictions_total"] == 2
+    assert snap["counters"]["cache_misses_total"] == 4
+
+
+def test_submit_copies_caller_buffer(rng):
+    # The frame-loop pattern: a caller reusing its buffer after submit
+    # must not corrupt the queued request.
+    img = rng.integers(0, 256, (10, 10), dtype=np.uint8)
+    snapshot = img.copy()
+    s = StencilServer(ServeConfig(max_queue=4, bucket_edges=(8, 16)),
+                      start=False)
+    fut = s.submit(img, 2)
+    img[:] = 0  # caller clobbers its buffer before the worker runs
+    s.start()
+    np.testing.assert_array_equal(
+        fut.result(timeout=300), _golden(snapshot, 2)
+    )
+    s.close()
+
+
+def test_executable_cache_miss_on_different_reps(rng):
+    # reps is part of the cache key by contract: same bucket, different
+    # reps -> a second executable.
+    with StencilServer(ServeConfig(max_queue=16, max_batch=2,
+                                   bucket_edges=(8, 16))) as s:
+        img = rng.integers(0, 256, (10, 10), dtype=np.uint8)
+        s.submit(img, 1).result(timeout=300)
+        s.submit(img, 2).result(timeout=300)
+        snap = s.stats()
+    assert snap["counters"]["cache_misses_total"] == 2
+    assert snap["executables_cached"] == 2
+
+
+# -- backpressure -----------------------------------------------------
+
+
+def test_backpressure_rejects_when_full(rng):
+    # A parked worker (start=False) pins the queue: submissions beyond
+    # max_queue must raise immediately and be counted — the queue depth
+    # never exceeds its bound (no silent buffering, no OOM path).
+    s = StencilServer(ServeConfig(max_queue=3, max_batch=2,
+                                  bucket_edges=(8,)), start=False)
+    img = rng.integers(0, 256, (6, 6), dtype=np.uint8)
+    futs = [s.submit(img, 1) for _ in range(3)]
+    for _ in range(5):
+        with pytest.raises(QueueFull):
+            s.submit(img, 1)
+    snap = s.stats()
+    assert snap["counters"]["rejected_total"] == 5
+    assert snap["counters"]["requests_total"] == 3
+    assert snap["gauges"]["queue_depth"]["peak"] == 3
+    # Draining the queue un-sticks the clients: start late, all complete.
+    s.start()
+    for f in futs:
+        np.testing.assert_array_equal(
+            f.result(timeout=300), _golden(img, 1)
+        )
+    s.close()
+
+
+def test_submit_after_close_raises(rng):
+    s = StencilServer(ServeConfig(max_queue=4))
+    s.close()
+    with pytest.raises(ServerClosed):
+        s.submit(rng.integers(0, 256, (6, 6), np.uint8), 1)
+
+
+def test_close_unstarted_server_fails_pending_futures(rng):
+    # A queued future must never hang: close() with no live worker
+    # resolves it with ServerClosed (the post-close submit error).
+    s = StencilServer(ServeConfig(max_queue=4), start=False)
+    fut = s.submit(rng.integers(0, 256, (6, 6), np.uint8), 1)
+    s.close()
+    with pytest.raises(ServerClosed):
+        fut.result(timeout=30)
+
+
+def test_cancelled_future_does_not_poison_batch_mates(rng):
+    # Two same-key requests share a dispatch; one client cancelling its
+    # still-queued future must not turn the other's result into an error.
+    s = StencilServer(ServeConfig(max_queue=8, max_batch=4,
+                                  bucket_edges=(8,)), start=False)
+    img_a = rng.integers(0, 256, (6, 6), dtype=np.uint8)
+    img_b = rng.integers(0, 256, (7, 5), dtype=np.uint8)
+    fa = s.submit(img_a, 2)
+    fb = s.submit(img_b, 2)
+    assert fa.cancel()  # pending: cancellation succeeds
+    s.start()
+    np.testing.assert_array_equal(fb.result(timeout=300), _golden(img_b, 2))
+    s.close()
+
+
+def test_periodic_boundary_refused():
+    # Bucket padding preserves zero semantics only; periodic would wrap
+    # at the canvas edge and silently return wrong pixels — refuse at
+    # construction.
+    with pytest.raises(NotImplementedError):
+        StencilServer(ServeConfig(boundary="periodic"), start=False)
+
+
+# -- loadgen ----------------------------------------------------------
+
+
+def test_loadgen_closed_loop_reports_from_registry(rng):
+    # The acceptance-criteria run: a CPU closed-loop completes, reports
+    # throughput and p50/p99 from the metrics registry, shows cache
+    # reuse across same-bucket requests, and sheds nothing.
+    with StencilServer(ServeConfig(max_queue=32, max_batch=4,
+                                   bucket_edges=(8, 16, 32))) as s:
+        report = loadgen.run(
+            s, mode="closed", requests=16, concurrency=3, reps=2,
+            shapes=((12, 10), (10, 12)), channels=(3,), seed=1,
+        )
+    assert report["completed"] == 16
+    assert report["throughput_rps"] > 0
+    assert report["p99_s"] >= report["p50_s"] > 0
+    assert report["rejected"] == 0
+    c = report["stats"]["counters"]
+    assert c["completed_total"] == 16
+    assert c["cache_hits_total"] > 0          # executables reused
+    assert c["batches_total"] <= 16
+    assert report["stats"]["histograms"]["queue_wait_seconds"]["count"] == 16
+
+
+def test_loadgen_open_loop_sheds_under_overload(rng):
+    # Open loop at an absurd arrival rate into a 2-deep queue: the server
+    # must reject (bounded memory), not buffer. The first compile makes
+    # the overload deterministic.
+    with StencilServer(ServeConfig(max_queue=2, max_batch=2,
+                                   bucket_edges=(8, 16, 32))) as s:
+        report = loadgen.run(
+            s, mode="open", requests=30, rate=1e6, reps=40,
+            shapes=((24, 24),), channels=(3,), seed=2,
+        )
+    assert report["rejected"] > 0
+    assert report["completed"] + report["rejected"] == 30
+    assert report["stats"]["gauges"]["queue_depth"]["peak"] <= 2
+
+
+@pytest.mark.slow
+def test_loadgen_soak(rng):
+    # Sustained mixed open-loop traffic: queue stays bounded, reservoir
+    # histograms stay capped, every accepted request completes.
+    with StencilServer(ServeConfig(max_queue=64, max_batch=8)) as s:
+        report = loadgen.run(
+            s, mode="open", requests=2000, rate=500.0, reps=3,
+            shapes=((48, 36), (64, 48), (30, 50)), channels=(1, 3), seed=3,
+        )
+    assert report["completed"] + report["rejected"] == 2000
+    assert report["stats"]["gauges"]["queue_depth"]["peak"] <= 64
+
+
+# -- module-level stats + CLI ----------------------------------------
+
+
+def test_module_stats_points_at_last_server(rng):
+    import tpu_stencil.serve as serve_mod
+
+    with StencilServer(ServeConfig(max_queue=4)) as s:
+        img = rng.integers(0, 256, (8, 8), dtype=np.uint8)
+        s.submit(img, 1).result(timeout=300)
+        assert serve_mod.stats()["counters"]["completed_total"] == 1
+
+
+def test_cli_serve_self_test_subprocess(tmp_path):
+    # The verify-recipe smoke: `python -m tpu_stencil serve --self-test`
+    # must pass end to end in a fresh process.
+    import os
+    import subprocess
+    import sys
+
+    proc = subprocess.run(
+        [sys.executable, "-m", "tpu_stencil", "serve", "--self-test",
+         "--platform", "cpu"],
+        capture_output=True, text=True, timeout=580,
+        cwd=os.path.join(os.path.dirname(__file__), os.pardir),
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "serve self-test OK" in proc.stdout

@@ -30,7 +30,12 @@ def last_capture(path: str) -> dict:
                 obj = json.loads(line)
             except ValueError:
                 continue
-            if isinstance(obj, dict) and "value" in obj:
+            # Mirror bench.py's _is_capture: a numeric value is what makes
+            # a line a capture — {"value": null} or a stray JSON line must
+            # not become the canonical preview object.
+            if isinstance(obj, dict) and isinstance(
+                obj.get("value"), (int, float)
+            ):
                 best = obj
     if best is None:
         raise ValueError(f"no parseable capture line in {path}")

@@ -18,6 +18,14 @@ from tpu_stencil import driver
 
 
 def main(argv=None) -> int:
+    if argv is None:
+        argv = sys.argv[1:]
+    if argv and argv[0] == "serve":
+        # Subcommand dispatch ahead of the positional job parser: the
+        # serving engine is single-process and owns its own flags.
+        from tpu_stencil.serve import cli as serve_cli
+
+        return serve_cli.main(argv[1:])
     # parse_args does no JAX work, so parse first: --help/usage errors must
     # exit without joining a pod rendezvous.
     cfg, ns = parse_args(argv)

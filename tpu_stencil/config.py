@@ -107,6 +107,58 @@ class JobConfig:
         return self.width * self.height * self.channels * self.frames
 
 
+@dataclasses.dataclass(frozen=True)
+class ServeConfig:
+    """Configuration for the in-process serving engine
+    (:mod:`tpu_stencil.serve`). Jax-free, like :class:`JobConfig`, so the
+    ``serve`` CLI can validate flags before backend bring-up.
+
+    The queue bound is the backpressure contract: ``submit`` on a full
+    queue raises, it never buffers unboundedly. ``max_batch`` bounds one
+    scheduler dispatch; ``pipeline_depth`` bounds concurrently in-flight
+    batches (host->device transfer double-buffered against compute), so
+    peak memory is ``O(max_queue + pipeline_depth * max_batch)`` frames.
+    """
+
+    filter_name: str = "gaussian"
+    backend: str = "auto"      # same vocabulary as JobConfig.backend
+    boundary: str = "zero"
+    max_queue: int = 256       # pending requests before reject-with-error
+    max_batch: int = 8         # requests per micro-batch dispatch
+    pipeline_depth: int = 2    # in-flight batches (2 = double buffering)
+    max_executables: int = 64  # LRU cap on cached compiled programs
+    # Shape-bucket ladder override (ascending edge sizes); None = the
+    # serve default (tpu_stencil.serve.bucketing.DEFAULT_EDGES). Requests
+    # above the top edge pad to the next top-edge multiple.
+    bucket_edges: Optional[Tuple[int, ...]] = None
+
+    def __post_init__(self) -> None:
+        if self.backend not in ("auto", "xla", "pallas", "reference", "autotune"):
+            raise ValueError(f"unknown backend {self.backend!r}")
+        if self.boundary not in ("zero", "periodic"):
+            raise ValueError(f"unknown boundary {self.boundary!r}")
+        if self.max_queue < 1:
+            raise ValueError(f"max_queue must be >= 1, got {self.max_queue}")
+        if self.max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {self.max_batch}")
+        if self.pipeline_depth < 1:
+            raise ValueError(
+                f"pipeline_depth must be >= 1, got {self.pipeline_depth}"
+            )
+        if self.max_executables < 1:
+            raise ValueError(
+                f"max_executables must be >= 1, got {self.max_executables}"
+            )
+        if self.bucket_edges is not None:
+            edges = tuple(self.bucket_edges)
+            if not edges or any(e < 1 for e in edges) or list(edges) != sorted(set(edges)):
+                raise ValueError(
+                    "bucket_edges must be strictly ascending positive ints, "
+                    f"got {self.bucket_edges!r}"
+                )
+            object.__setattr__(self, "bucket_edges", edges)
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="tpu_stencil",

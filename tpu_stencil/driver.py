@@ -108,6 +108,38 @@ def _place_frames(model, imgs: np.ndarray, devices):
     return img_dev, step_fn
 
 
+def prepare_engine(model, imgs: np.ndarray, devices, frames: Optional[int] = None):
+    """The place/iterate/fetch core: place ``imgs`` on ``devices``, run
+    the warm-up compile (a 0-rep call whose output equals its input, so
+    it doubles as the timed run's input — no second transfer), and build
+    the fetch that crops any device-multiple padding.
+
+    ``frames=None`` means a single (H, W[, C]) image; an int means an
+    (N, H, W[, C]) clip with N true frames. Returns
+    ``(img_dev, step_fn, fetch)`` where ``step_fn(x, n)`` runs n reps on
+    device and ``fetch`` materializes the true-extent host array.
+
+    This is the reusable engine call under every single-host compute
+    path: ``run_job``'s single-device and frames branches, the per-host
+    half of the multi-host frames path, and the model the serving
+    engine's bucket executables mirror (serve adds pad-mask re-zeroing
+    for heterogeneous shapes; see tpu_stencil/serve/engine.py).
+    """
+    if frames is not None:
+        img_dev, step_fn = _place_frames(model, np.asarray(imgs), devices)
+        n_true = frames
+
+        def fetch(x):
+            return np.asarray(x)[:n_true]
+    else:
+        img_dev = jax.device_put(jax.numpy.asarray(imgs), devices[0])
+        step_fn = model
+        fetch = np.asarray
+    img_dev = step_fn(img_dev, 0)  # warm-up compile; output == input
+    img_dev.block_until_ready()
+    return img_dev, step_fn, fetch
+
+
 def _store_output(cfg: JobConfig, out: np.ndarray) -> None:
     """Write the result in the container format of the output path."""
     if cfg.frames > 1:
@@ -286,17 +318,9 @@ def run_job(
 
         start_rep, frame = _maybe_restore(cfg, resume)
         img = _load_input(cfg) if frame is None else frame
-        if cfg.frames > 1:
-            img_dev, step_fn = _place_frames(model, np.asarray(img), devices)
-        else:
-            img_dev = jax.device_put(jax.numpy.asarray(img), devices[0])
-            step_fn = model
-        img_dev = step_fn(img_dev, 0)  # warm-up compile; output == input
-        img_dev.block_until_ready()
-        fetch = (
-            (lambda x: np.asarray(x)[: cfg.frames])
-            if cfg.frames > 1
-            else np.asarray
+        img_dev, step_fn, fetch = prepare_engine(
+            model, img, devices,
+            frames=cfg.frames if cfg.frames > 1 else None,
         )
         def save_fn(rep, dev):
             from tpu_stencil.runtime import checkpoint as ckpt
@@ -394,16 +418,14 @@ def _run_frames_multihost(cfg, model, profile_dir, checkpoint_every,
             imgs = restored
         local_devs = jax.local_devices()
         n_ld = min(len(local_devs), n_local)
-        dev, step_fn = _place_frames(
-            model, np.asarray(imgs), local_devs[:n_ld]
+        dev, step_fn, fetch = prepare_engine(
+            model, imgs, local_devs[:n_ld], frames=n_local
         )
-        dev = step_fn(dev, 0)  # warm-up compile; output == input
-        dev.block_until_ready()
         with _maybe_profile(profile_dir):
             out_dev, compute = _checkpointed_iterate(
                 cfg, step_fn, save_fn, dev, checkpoint_every, start_rep
             )
-        out = np.asarray(out_dev)[:n_local]  # crop device-multiple padding
+        out = fetch(out_dev)  # crop device-multiple padding
     elif checkpoint_every:
         # Frame-less process: THE SAME chunk loop as the compute path (a
         # no-op run on a dummy carry) so its save/commit-barrier schedule

@@ -58,6 +58,15 @@ DEFAULT_BLOCK_H = 128
 DEFAULT_FUSE = 8
 _MAX_ROLL_HALO = 128  # cols-pass ghost width limit (halo * channels)
 
+# jax < 0.6 has no varying-manual-axes tracking: ShapeDtypeStruct rejects
+# the ``vma`` kwarg. There the legacy shard_map path runs check_rep-style
+# inference instead, so dropping the declaration is the correct degrade.
+try:
+    jax.ShapeDtypeStruct((), jnp.uint8, vma=frozenset())
+    _VMA_SUPPORTED = True
+except TypeError:
+    _VMA_SUPPORTED = False
+
 # Per-rep schedule inside the fused kernel (see _sep_kernel):
 #   'pad'    — fixed-shape carry: mask-select + jnp.pad every rep (r2).
 #   'shrink' — the carry value contracts by halo per rep (static shapes in
@@ -887,7 +896,7 @@ def valid_fused(ext_u8: jax.Array, plan: StencilPlan, fuse: int,
         # shard_map (the HLO interpreter loses vma on internal slices).
         out_shape=jax.ShapeDtypeStruct(
             (hp, wl), jnp.uint8,
-            **({"vma": frozenset(vma)} if vma else {}),
+            **({"vma": frozenset(vma)} if vma and _VMA_SUPPORTED else {}),
         ),
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),
@@ -923,7 +932,7 @@ def _build_call(plan: StencilPlan, hp: int, h_real: int, wc: int,
         # it when given (check_vma cannot infer through a pallas_call).
         out_shape=jax.ShapeDtypeStruct(
             (hp, wc), jnp.uint8,
-            **({"vma": frozenset(vma)} if vma else {}),
+            **({"vma": frozenset(vma)} if vma and _VMA_SUPPORTED else {}),
         ),
         in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
         out_specs=pl.BlockSpec((block_h, wc), lambda i: (i, 0)),

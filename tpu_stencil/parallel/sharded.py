@@ -23,7 +23,19 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+
+try:  # jax >= 0.6 exports shard_map at top level (check_vma keyword)
+    from jax import shard_map
+except ImportError:  # older jax: experimental module, check_rep keyword
+    from jax.experimental.shard_map import shard_map as _shard_map_legacy
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True):
+        # Legacy check_rep has no rule for while/pallas_call (our rep loop
+        # and kernel), and no vma declaration to consume — disable it; the
+        # modern path keeps full check_vma verification.
+        del check_vma
+        return _shard_map_legacy(f, mesh, in_specs, out_specs,
+                                 check_rep=False)
 
 from tpu_stencil.models.blur import IteratedConv2D
 from tpu_stencil.ops import lowering as _lowering

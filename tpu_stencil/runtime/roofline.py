@@ -47,3 +47,16 @@ def achieved(frame_bytes: int, per_rep_s: float, backend: str,
     )
     gbps = 2 * frame_bytes / eff / per_rep_s / 1e9
     return gbps, 100 * gbps / V5E_HBM_GBPS
+
+
+def achieved_frames(frame_bytes: int, n_frames: int, per_rep_s: float,
+                    backend: str, filter_name: str, h_img: int,
+                    block_h=None, fuse=None) -> Tuple[float, float]:
+    """(HBM GB/s, % of v5e peak) for a batched launch of ``n_frames``
+    independent frames per rep — the serving engine's micro-batches and
+    the ``--frames`` clip path. Frames are independent (no halo traffic
+    between them), so traffic is simply ``n_frames`` times one frame's;
+    ``h_img`` is the per-frame height the fused Pallas kernel tiles.
+    """
+    return achieved(frame_bytes * n_frames, per_rep_s, backend,
+                    filter_name, h_img, block_h, fuse)

@@ -1,0 +1,41 @@
+"""Async micro-batching inference service (see docs/SERVING.md).
+
+The request-level serving layer over the batch engine: a bounded queue
+with backpressure, a micro-batching scheduler over shape buckets, an
+executable cache, a double-buffered worker loop, and a metrics registry.
+
+>>> from tpu_stencil.serve import StencilServer, ServeConfig
+>>> with StencilServer(ServeConfig(max_queue=64)) as server:
+...     out = server.submit(img_u8, reps=40).result()
+
+CLI: ``python -m tpu_stencil serve --help`` (synthetic load generator,
+``--self-test``, ``--stats-json``).
+"""
+
+from tpu_stencil.config import ServeConfig
+from tpu_stencil.serve.engine import (
+    QueueFull,
+    ServerClosed,
+    StencilServer,
+    get_last_server,
+)
+from tpu_stencil.serve.metrics import Registry
+
+
+def stats() -> dict:
+    """Metrics snapshot of the most recently constructed live server."""
+    server = get_last_server()
+    if server is None:
+        raise RuntimeError("no StencilServer has been constructed")
+    return server.stats()
+
+
+__all__ = [
+    "QueueFull",
+    "Registry",
+    "ServeConfig",
+    "ServerClosed",
+    "StencilServer",
+    "get_last_server",
+    "stats",
+]

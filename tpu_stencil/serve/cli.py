@@ -1,0 +1,192 @@
+"""``python -m tpu_stencil serve`` — drive the serving engine.
+
+Runs the synthetic load generator against an in-process
+:class:`~tpu_stencil.serve.engine.StencilServer` and prints a throughput
+/ tail-latency report (the serving analog of ``bench.py``'s single-job
+capture). ``--self-test`` instead runs a deterministic correctness pass:
+a handful of mixed-shape grey+RGB requests checked byte-for-byte against
+the independent NumPy golden model, plus the backpressure and cache-hit
+invariants — the smoke probe the verify recipe invokes.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+import numpy as np
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="tpu_stencil serve",
+        description="In-process async micro-batching inference service "
+                    "driven by a synthetic load generator.",
+    )
+    p.add_argument("--self-test", action="store_true",
+                   help="run the deterministic correctness/backpressure "
+                        "smoke test and exit (0 = OK)")
+    p.add_argument("--mode", default="closed", choices=["closed", "open"],
+                   help="load model: closed (submit-and-wait workers) or "
+                        "open (fixed-rate arrivals; overload rejects)")
+    p.add_argument("--requests", type=int, default=64,
+                   help="total synthetic requests (default 64)")
+    p.add_argument("--concurrency", type=int, default=4,
+                   help="closed-loop worker count (default 4)")
+    p.add_argument("--rate", type=float, default=200.0,
+                   help="open-loop arrival rate in req/s (default 200)")
+    p.add_argument("--reps", type=int, default=5,
+                   help="filter applications per request (default 5)")
+    p.add_argument("--filter", dest="filter_name", default="gaussian",
+                   help="filter name (default gaussian)")
+    p.add_argument("--backend", default="auto",
+                   choices=["auto", "xla", "pallas", "reference", "autotune"],
+                   help="compute backend (default auto)")
+    p.add_argument("--max-queue", type=int, default=256,
+                   help="bounded queue depth; beyond it submissions are "
+                        "rejected (default 256)")
+    p.add_argument("--max-batch", type=int, default=8,
+                   help="requests per micro-batch (default 8)")
+    p.add_argument("--shapes", default="48x36,64x48,30x50",
+                   help="comma-separated HxW request shapes to cycle")
+    p.add_argument("--channels", default="3",
+                   help="comma-separated channel counts to cycle "
+                        "(1=grey, 3=rgb; default 3)")
+    p.add_argument("--seed", type=int, default=0, help="loadgen seed")
+    p.add_argument("--platform", default=None,
+                   choices=["cpu", "tpu", "gpu"],
+                   help="force the JAX platform before backend init")
+    p.add_argument("--stats-json", default=None, metavar="PATH",
+                   help="dump the report + metrics registry snapshot as "
+                        "JSON to PATH ('-' = stdout)")
+    return p
+
+
+def _parse_shapes(parser, value):
+    out = []
+    for part in value.split(","):
+        h, sep, w = part.strip().lower().partition("x")
+        if not sep or not h.isdigit() or not w.isdigit():
+            parser.error(f"--shapes must be HxW[,HxW...], got {value!r}")
+        out.append((int(h), int(w)))
+    return tuple(out)
+
+
+def self_test() -> int:
+    """Deterministic smoke: golden-model exactness over mixed shapes and
+    channel counts (including a 1-pixel image and an oversized-vs-ladder
+    request), cache reuse, and backpressure rejection."""
+    from tpu_stencil import filters
+    from tpu_stencil.config import ServeConfig
+    from tpu_stencil.ops import stencil
+    from tpu_stencil.serve.engine import QueueFull, StencilServer
+
+    rng = np.random.default_rng(7)
+    cases = [
+        (rng.integers(0, 256, (40, 30, 3), dtype=np.uint8), 3),
+        (rng.integers(0, 256, (17, 23), dtype=np.uint8), 2),     # grey
+        (rng.integers(0, 256, (1, 1), dtype=np.uint8), 1),       # 1 pixel
+        (rng.integers(0, 256, (20, 44, 3), dtype=np.uint8), 0),  # identity
+        # Sequential repeat of case 0's bucket: same executable key in a
+        # later dispatch — must be a cache HIT, not a recompile.
+        (rng.integers(0, 256, (40, 30, 3), dtype=np.uint8), 3),
+    ]
+    f = filters.get_filter("gaussian")
+    with StencilServer(ServeConfig(max_queue=16, max_batch=4,
+                                   bucket_edges=(8, 16, 32))) as server:
+        for img, reps in cases:
+            want = stencil.reference_stencil_numpy(img, f, reps)
+            got = server.submit(img, reps).result(timeout=300)
+            if not np.array_equal(got, want):
+                print(f"serve self-test FAILED: shape={img.shape} "
+                      f"reps={reps} mismatch", file=sys.stderr)
+                return 1
+        stats = server.stats()
+    if stats["counters"]["cache_hits_total"] < 1:
+        print("serve self-test FAILED: no executable-cache hit",
+              file=sys.stderr)
+        return 1
+    # Backpressure: a parked (never-started) server must reject, not grow.
+    parked = StencilServer(ServeConfig(max_queue=2), start=False)
+    img = cases[0][0]
+    parked.submit(img, 1)
+    parked.submit(img, 1)
+    try:
+        parked.submit(img, 1)
+        print("serve self-test FAILED: full queue accepted a request",
+              file=sys.stderr)
+        return 1
+    except QueueFull:
+        pass
+    if parked.stats()["counters"]["rejected_total"] != 1:
+        print("serve self-test FAILED: rejection not counted",
+              file=sys.stderr)
+        return 1
+    print(f"serve self-test OK: {len(cases)} requests exact, "
+          f"cache_hits={stats['counters']['cache_hits_total']}, "
+          f"batches={stats['counters']['batches_total']}, "
+          "backpressure rejects when full")
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = build_parser()
+    ns = parser.parse_args(argv)
+    if ns.platform:
+        import jax
+
+        jax.config.update("jax_platforms", ns.platform)
+    if ns.self_test:
+        return self_test()
+
+    from tpu_stencil.config import ServeConfig
+    from tpu_stencil.serve import loadgen
+    from tpu_stencil.serve.engine import StencilServer
+
+    shapes = _parse_shapes(parser, ns.shapes)
+    try:
+        channels = tuple(int(c) for c in ns.channels.split(","))
+        if not all(c in (1, 3) for c in channels):
+            raise ValueError
+    except ValueError:
+        parser.error(f"--channels must be 1 and/or 3, got {ns.channels!r}")
+    try:
+        cfg = ServeConfig(
+            filter_name=ns.filter_name, backend=ns.backend,
+            max_queue=ns.max_queue, max_batch=ns.max_batch,
+        )
+    except ValueError as e:
+        parser.error(str(e))
+    with StencilServer(cfg) as server:
+        report = loadgen.run(
+            server, mode=ns.mode, requests=ns.requests,
+            concurrency=ns.concurrency, rate=ns.rate, reps=ns.reps,
+            shapes=shapes, channels=channels, seed=ns.seed,
+        )
+    c = report["stats"]["counters"]
+    print(
+        f"served {report['completed']}/{report['requests']} requests "
+        f"in {report['wall_seconds']:.3f}s "
+        f"({report['throughput_rps']:.1f} req/s, {ns.mode}-loop)"
+    )
+    print(
+        f"latency p50={report['p50_s'] * 1e3:.2f}ms "
+        f"p99={report['p99_s'] * 1e3:.2f}ms; "
+        f"rejected={report['rejected']} batches={c['batches_total']} "
+        f"cache={c['cache_hits_total']}h/{c['cache_misses_total']}m "
+        f"padded_waste={c['padded_pixels_total']}px"
+    )
+    if ns.stats_json:
+        payload = json.dumps(report, indent=2, sort_keys=True)
+        if ns.stats_json == "-":
+            print(payload)
+        else:
+            with open(ns.stats_json, "w") as fh:
+                fh.write(payload + "\n")
+            print(f"wrote {ns.stats_json}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,484 @@
+"""In-process async micro-batching inference engine.
+
+The reference (and ``driver.run_job``) is a batch program: one image in,
+N reps, one image out. This module is the request-level serving layer the
+ROADMAP's "heavy traffic" north star needs, built from three bounded
+pieces:
+
+* a **bounded request queue** with backpressure: ``submit`` on a full
+  queue raises :class:`QueueFull` immediately (reject-with-error), it
+  never buffers unboundedly — peak memory is
+  ``O(max_queue + pipeline_depth * max_batch)`` frames by construction;
+* a **micro-batching scheduler**: pending requests group by executable
+  key — (filter, shape-bucket, dtype, backend, reps) — so every batch
+  hits one cached jitted executable (:mod:`.bucketing` pads H/W onto a
+  ladder and the batch axis to a power of two). Compilation and
+  host<->device transfer amortize across the stream the way the
+  persistent-MPI stencil work amortizes communication setup across
+  repeated exchanges (PAPERS.md);
+* a **double-buffered worker loop**: JAX dispatch is async, so the
+  worker keeps up to ``pipeline_depth`` batches in flight — batch i+1's
+  host-side padding + host->device transfer overlaps batch i's device
+  compute, keeping the HBM pipe fed (the workload is memory-bound;
+  throughput is pipe saturation, not per-request latency tricks).
+
+Exactness: each bucket executable is the per-rep step of the existing
+:mod:`tpu_stencil.models.blur` / :mod:`tpu_stencil.ops.pallas_stencil`
+paths (input buffer donated for HBM double-buffering) with the pad
+region re-zeroed every rep — the sharded runner's mask discipline — so
+a request's cropped output is byte-identical to ``driver.run_job`` on
+the same (image, filter, reps). ``tests/test_fuzz.py`` asserts this.
+"""
+
+from __future__ import annotations
+
+import collections
+import concurrent.futures
+import dataclasses
+import functools
+import itertools
+import threading
+import time
+import weakref
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from tpu_stencil.config import ServeConfig
+from tpu_stencil.serve import bucketing
+from tpu_stencil.serve.metrics import Registry
+
+
+class QueueFull(RuntimeError):
+    """Backpressure signal: the bounded request queue is at capacity.
+    Callers retry later or shed load — the server never buffers more."""
+
+
+class ServerClosed(RuntimeError):
+    """The server is shutting down (or closed); no new work is accepted."""
+
+
+@dataclasses.dataclass
+class Request:
+    """One queued inference request (internal)."""
+
+    req_id: int
+    image: np.ndarray          # uint8 (H, W) or (H, W, C)
+    reps: int
+    filter_name: str
+    key: tuple                 # executable-cache key (sans batch bucket)
+    bucket_hw: Tuple[int, int]
+    future: concurrent.futures.Future
+    t_submit: float
+
+
+def _mask_valid(imgs, valid_h, valid_w):
+    """Per-frame validity mask for a padded (N, BH, BW[, C]) canvas:
+    True inside each frame's true (h, w), False in the pad region."""
+    import jax
+    import jax.numpy as jnp
+
+    n, bh, bw = imgs.shape[0], imgs.shape[1], imgs.shape[2]
+    rows = jax.lax.broadcasted_iota(jnp.int32, (n, bh, bw), 1)
+    cols = jax.lax.broadcasted_iota(jnp.int32, (n, bh, bw), 2)
+    mask = (rows < valid_h[:, None, None]) & (cols < valid_w[:, None, None])
+    if imgs.ndim == 4:
+        mask = mask[..., None]
+    return mask
+
+
+def _build_bucket_executable(plan, backend: str, boundary: str,
+                             interpret: bool, reps: int):
+    """Compile-once callable for one cache key:
+    ``fn(canvas_u8, valid_h, valid_w) -> canvas_u8`` (donates canvas).
+
+    Per rep: vmapped single-application step (the XLA lowering's
+    ``padded_step``, or the Pallas kernel's when the backend resolved to
+    pallas), then the pad region re-zeroed via the validity mask —
+    without the re-zero, pad pixels contaminated by rep k would leak back
+    into the true image at rep k+1 (the same reason the sharded mesh
+    masks its tile pad every iteration).
+
+    ``reps`` is static (unlike ``blur.iterate``'s traced bound): the
+    cache is keyed on reps by contract, so one entry == one compiled
+    program and the hit/miss counters mean exactly "executable reused" /
+    "compile paid". The canvas is donated — XLA ping-pongs two HBM
+    buffers across the rep loop exactly like the single-job path.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_stencil.ops import lowering as _lowering
+
+    if backend == "pallas":
+        from tpu_stencil.ops import pallas_stencil
+
+        def step(x):
+            return pallas_stencil.padded_step(x, plan, interpret=interpret)
+    else:
+        def step(x):
+            return _lowering.padded_step(x, plan, boundary)
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def run(imgs, valid_h, valid_w):
+        if reps == 0:
+            return imgs
+        mask = _mask_valid(imgs, valid_h, valid_w)
+        vstep = jax.vmap(step)
+
+        def body(_, x):
+            return jnp.where(mask, vstep(x), jnp.uint8(0))
+
+        return jax.lax.fori_loop(0, reps, body, imgs)
+
+    return run
+
+
+class _ExecutableCache:
+    """Executable cache keyed on (filter, shape-bucket incl. batch
+    bucket, dtype, backend, reps). A hit reuses a compiled program; a
+    miss builds (and on first call compiles) a new one.
+
+    LRU-bounded: the key space is client-controlled (reps, and oversized
+    shapes pad to ever-larger top-edge multiples), so an unbounded map
+    would leak compiled programs on a long-running server. Each entry
+    owns its own ``jax.jit`` wrapper, so eviction really frees the
+    compiled executable with it."""
+
+    def __init__(self, registry: Registry, cap: int) -> None:
+        self._entries: "collections.OrderedDict" = collections.OrderedDict()
+        self._cap = cap
+        self._hits = registry.counter("cache_hits_total")
+        self._misses = registry.counter("cache_misses_total")
+        self._evictions = registry.counter("cache_evictions_total")
+
+    def get(self, key, builder):
+        entry = self._entries.get(key)
+        if entry is not None:
+            self._hits.inc()
+            self._entries.move_to_end(key)
+            return entry
+        self._misses.inc()
+        entry = self._entries[key] = builder()
+        while len(self._entries) > self._cap:
+            self._entries.popitem(last=False)
+            self._evictions.inc()
+        return entry
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+
+_last_server_ref = None  # weakref to the most recently constructed server
+
+
+class StencilServer:
+    """The serving engine. Construct, ``submit`` from any thread, read
+    ``stats()``, ``close()`` when done (also a context manager).
+
+    >>> server = StencilServer(ServeConfig(max_queue=64, max_batch=8))
+    >>> fut = server.submit(img_u8, reps=40)
+    >>> out = fut.result()      # np.uint8, same shape as img_u8
+    """
+
+    def __init__(self, cfg: Optional[ServeConfig] = None,
+                 start: bool = True) -> None:
+        self.cfg = cfg or ServeConfig()
+        if self.cfg.boundary != "zero":
+            # Bucket padding re-zeroes the pad every rep, which preserves
+            # ZERO semantics at the true edge; periodic would wrap at the
+            # bucket-canvas edge and silently return wrong pixels (the
+            # sharded runner refuses padded periodic grids for the same
+            # reason). Fail at construction, never serve wrong data.
+            raise NotImplementedError(
+                "serve supports boundary='zero' only; periodic requests "
+                "would wrap at the padded bucket edge, not the image edge"
+            )
+        self.registry = Registry()
+        self._cache = _ExecutableCache(self.registry,
+                                       self.cfg.max_executables)
+        self._models: Dict[str, object] = {}
+        self._edges = self.cfg.bucket_edges or bucketing.DEFAULT_EDGES
+        self._pending: "collections.deque[Request]" = collections.deque()
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._closing = False
+        self._ids = itertools.count()
+        self._worker: Optional[threading.Thread] = None
+        # Metric handles (names are the docs/SERVING.md schema).
+        m = self.registry
+        self._m_requests = m.counter("requests_total")
+        self._m_rejected = m.counter("rejected_total")
+        self._m_completed = m.counter("completed_total")
+        self._m_failed = m.counter("failed_total")
+        self._m_batches = m.counter("batches_total")
+        self._m_padded = m.counter("padded_pixels_total")
+        self._m_real = m.counter("image_pixels_total")
+        self._m_depth = m.gauge("queue_depth")
+        self._m_inflight = m.gauge("inflight_batches")
+        self._m_qwait = m.histogram("queue_wait_seconds")
+        self._m_blat = m.histogram("batch_latency_seconds")
+        self._m_rlat = m.histogram("request_latency_seconds")
+        self._m_bsize = m.histogram("batch_size")
+        self._m_gbps = m.histogram("batch_hbm_gbps")
+        global _last_server_ref
+        _last_server_ref = weakref.ref(self)
+        if start:
+            self.start()
+
+    # -- lifecycle -----------------------------------------------------
+
+    def start(self) -> None:
+        """Start the worker loop (idempotent). ``start=False`` at
+        construction lets tests exercise backpressure with a parked
+        queue."""
+        if self._worker is None or not self._worker.is_alive():
+            self._worker = threading.Thread(
+                target=self._worker_loop, name="tpu-stencil-serve",
+                daemon=True,
+            )
+            self._worker.start()
+
+    def close(self, timeout: Optional[float] = None) -> None:
+        """Stop accepting work, drain the queue, join the worker."""
+        with self._cond:
+            self._closing = True
+            self._cond.notify_all()
+        if self._worker is not None and self._worker.is_alive():
+            self._worker.join(timeout)
+        # No live worker to drain (never started, join timed out, or the
+        # worker already exited): a queued future must never hang — fail
+        # it with the same error a post-close submit gets.
+        with self._lock:
+            leftovers = list(self._pending)
+            self._pending.clear()
+            self._m_depth.set(0)
+        for r in leftovers:
+            if not r.future.done():
+                r.future.set_exception(ServerClosed("server closed"))
+
+    def __enter__(self) -> "StencilServer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- submission ----------------------------------------------------
+
+    def submit(self, image: np.ndarray, reps: int,
+               filter_name: Optional[str] = None
+               ) -> "concurrent.futures.Future":
+        """Enqueue one request; returns a Future resolving to the blurred
+        uint8 array (same shape as ``image``). Raises :class:`QueueFull`
+        when the queue is at capacity and :class:`ServerClosed` after
+        ``close()``."""
+        # Defensive copy: canvas assembly happens later on the worker
+        # thread, so a caller reusing its buffer (the frame-loop pattern)
+        # must not corrupt an already-queued request. Mirrors the model's
+        # __call__ copy discipline.
+        image = np.array(image, copy=True)
+        if image.dtype != np.uint8:
+            raise ValueError(f"image must be uint8, got {image.dtype}")
+        if image.ndim not in (2, 3):
+            raise ValueError(
+                f"image must be (H, W) or (H, W, C), got shape {image.shape}"
+            )
+        if reps < 0:
+            raise ValueError(f"reps must be >= 0, got {reps}")
+        fname = filter_name or self.cfg.filter_name
+        h, w = image.shape[:2]
+        channels = image.shape[2] if image.ndim == 3 else 1
+        bucket_hw = bucketing.bucket_shape(h, w, self._edges)
+        # dtype is uint8 today across the whole pipeline; it is part of
+        # the key by contract so a future f32 path can't alias entries.
+        key = (fname, bucket_hw, channels, str(image.dtype),
+               self.cfg.backend, int(reps))
+        fut: concurrent.futures.Future = concurrent.futures.Future()
+        req = Request(
+            req_id=next(self._ids), image=image, reps=int(reps),
+            filter_name=fname, key=key, bucket_hw=bucket_hw, future=fut,
+            t_submit=time.perf_counter(),
+        )
+        with self._cond:
+            if self._closing:
+                raise ServerClosed("server is closed")
+            if len(self._pending) >= self.cfg.max_queue:
+                self._m_rejected.inc()
+                raise QueueFull(
+                    f"queue full ({self.cfg.max_queue} pending); retry later"
+                )
+            self._pending.append(req)
+            self._m_requests.inc()
+            self._m_depth.set(len(self._pending))
+            self._cond.notify()
+        return fut
+
+    # -- introspection -------------------------------------------------
+
+    def stats(self) -> dict:
+        """Snapshot of the metrics registry (docs/SERVING.md schema)."""
+        snap = self.registry.snapshot()
+        snap["executables_cached"] = len(self._cache)
+        return snap
+
+    # -- scheduler / worker --------------------------------------------
+
+    def _take_batch_locked(self) -> List[Request]:
+        """Pop the next micro-batch: the oldest request's executable key
+        (FIFO fairness), joined by up to ``max_batch - 1`` same-key
+        followers. O(pending) scan — pending is bounded by max_queue."""
+        if not self._pending:
+            return []
+        key = self._pending[0].key
+        batch: List[Request] = []
+        kept: "collections.deque[Request]" = collections.deque()
+        while self._pending:
+            r = self._pending.popleft()
+            if r.key == key and len(batch) < self.cfg.max_batch:
+                batch.append(r)
+            else:
+                kept.append(r)
+        self._pending = kept
+        self._m_depth.set(len(self._pending))
+        return batch
+
+    def _model_for(self, filter_name: str):
+        from tpu_stencil.models.blur import IteratedConv2D
+
+        model = self._models.get(filter_name)
+        if model is None:
+            model = self._models[filter_name] = IteratedConv2D(
+                filter_name, backend=self.cfg.backend,
+                boundary=self.cfg.boundary,
+            )
+        return model
+
+    def _dispatch(self, batch: List[Request]):
+        """Assemble the padded canvas and launch the bucket executable
+        (async under JAX dispatch). Returns the retire closure's state:
+        (batch, out_dev, true_shapes, t_start)."""
+        import jax
+        import jax.numpy as jnp
+
+        bh, bw = batch[0].bucket_hw
+        channels = (
+            batch[0].image.shape[2] if batch[0].image.ndim == 3 else 1
+        )
+        nb = bucketing.batch_bucket(len(batch), self.cfg.max_batch)
+        shape = (nb, bh, bw) + ((channels,) if channels > 1 else ())
+        canvas = np.zeros(shape, np.uint8)
+        vh = np.zeros(nb, np.int32)
+        vw = np.zeros(nb, np.int32)
+        for i, r in enumerate(batch):
+            h, w = r.image.shape[:2]
+            canvas[i, :h, :w] = r.image
+            vh[i], vw[i] = h, w
+        true_shapes = [r.image.shape[:2] for r in batch]
+        self._m_padded.inc(bucketing.waste_pixels(true_shapes, (bh, bw), nb))
+        self._m_real.inc(sum(h * w for h, w in true_shapes))
+
+        model = self._model_for(batch[0].filter_name)
+        backend, _sched = model.resolved_config((bh, bw), channels)
+        if backend == "pallas":
+            from tpu_stencil.ops import pallas_stencil
+
+            if not pallas_stencil.plan_supported(model.plan, channels):
+                backend = "xla"
+        interpret = jax.default_backend() == "cpu"
+        reps = batch[0].reps
+        exe_key = batch[0].key + (nb,)
+        exe = self._cache.get(
+            exe_key,
+            lambda: _build_bucket_executable(
+                model.plan, backend, self.cfg.boundary, interpret, reps
+            ),
+        )
+        t0 = time.perf_counter()
+        # Explicit transfer, then launch: under async dispatch both return
+        # immediately, so the NEXT batch's host-side assembly (and its
+        # transfer) overlaps this batch's device compute.
+        canvas_dev = jax.device_put(jnp.asarray(canvas))
+        out_dev = exe(canvas_dev, jnp.asarray(vh), jnp.asarray(vw))
+        for r in batch:
+            self._m_qwait.observe(t0 - r.t_submit)
+        self._m_bsize.observe(len(batch))
+        return batch, out_dev, (bh, bw, channels, nb, backend), t0
+
+    def _retire(self, batch, out_dev, meta, t0) -> None:
+        """Block on one in-flight batch, crop per-request outputs, resolve
+        futures, record latency + achieved-bandwidth metrics."""
+        bh, bw, channels, nb, backend = meta
+        out = np.asarray(out_dev)  # blocks until the device is done
+        t1 = time.perf_counter()
+        self._m_batches.inc()
+        self._m_blat.observe(t1 - t0)
+        reps = batch[0].reps
+        if reps > 0:
+            from tpu_stencil.runtime import roofline
+
+            gbps, _pct = roofline.achieved_frames(
+                bh * bw * channels, nb, (t1 - t0) / reps, backend,
+                batch[0].filter_name, bh,
+            )
+            self._m_gbps.observe(gbps)
+        for i, r in enumerate(batch):
+            h, w = r.image.shape[:2]
+            # A client may have cancelled its (still-pending) future; the
+            # result is simply dropped — one cancellation must never
+            # poison its batch-mates' results.
+            if not r.future.done():
+                r.future.set_result(out[i, :h, :w].copy())
+                self._m_completed.inc()
+                self._m_rlat.observe(t1 - r.t_submit)
+
+    def _worker_loop(self) -> None:
+        inflight: "collections.deque" = collections.deque()
+        while True:
+            with self._cond:
+                while (not self._pending and not self._closing
+                       and not inflight):
+                    self._cond.wait()
+                batch = self._take_batch_locked()
+                closing = self._closing
+            if batch:
+                try:
+                    inflight.append(self._dispatch(batch))
+                    self._m_inflight.set(len(inflight))
+                except Exception as e:  # resolve, don't kill the loop
+                    for r in batch:
+                        if not r.future.done():
+                            r.future.set_exception(e)
+                            self._m_failed.inc()
+            # Retire when the pipeline is full (keeps depth bounded) or
+            # when there is nothing new to overlap with.
+            while inflight and (
+                len(inflight) >= self.cfg.pipeline_depth or not batch
+            ):
+                done_batch, out_dev, meta, t0 = inflight.popleft()
+                try:
+                    self._retire(done_batch, out_dev, meta, t0)
+                except Exception as e:
+                    for r in done_batch:
+                        if not r.future.done():
+                            r.future.set_exception(e)
+                            self._m_failed.inc()
+                self._m_inflight.set(len(inflight))
+                if batch:
+                    break  # go assemble the next batch for overlap
+            with self._lock:
+                drained = not self._pending
+            if closing and drained and not inflight and not batch:
+                # Reject anything that raced in after the closing flag.
+                with self._lock:
+                    leftovers = list(self._pending)
+                    self._pending.clear()
+                for r in leftovers:
+                    r.future.set_exception(ServerClosed("server closed"))
+                return
+
+
+def get_last_server() -> Optional[StencilServer]:
+    """The most recently constructed server, if still alive — backs the
+    module-level :func:`tpu_stencil.serve.stats` convenience."""
+    ref = _last_server_ref
+    return ref() if ref is not None else None

@@ -1,0 +1,159 @@
+"""Synthetic load generator for the serving engine.
+
+Two standard load models, so throughput AND tail latency are measurable
+(closed loops hide queueing delay, open loops hide service capacity —
+you need both):
+
+* **closed-loop**: ``concurrency`` workers, each submit-and-wait; offered
+  load self-throttles to service rate. Measures capacity (throughput at
+  full pipe) and in-service latency.
+* **open-loop**: submissions arrive at a fixed ``rate`` regardless of
+  completions — the "millions of users" shape. Overload surfaces as
+  :class:`~tpu_stencil.serve.engine.QueueFull` rejections (counted, never
+  buffered), exercising the backpressure contract.
+
+The report pulls latency percentiles and rejection counts from the
+server's metrics registry — the loadgen measures the server with the
+server's own instruments, so the numbers in a report are the numbers an
+operator would scrape in production.
+
+Deterministic: shapes and pixels come from a seeded generator, so a run
+is reproducible on CPU in tier-1 and on TPU via bench_sweep.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from tpu_stencil.serve.engine import QueueFull, StencilServer
+
+DEFAULT_SHAPES: Tuple[Tuple[int, int], ...] = ((48, 36), (64, 48), (30, 50))
+
+
+def synth_requests(
+    n: int, shapes: Sequence[Tuple[int, int]], channels: Sequence[int],
+    seed: int,
+) -> List[np.ndarray]:
+    """n seeded random uint8 images cycling over shapes x channels."""
+    rng = np.random.default_rng(seed)
+    out = []
+    for i in range(n):
+        h, w = shapes[i % len(shapes)]
+        ch = channels[i % len(channels)]
+        shape = (h, w) if ch == 1 else (h, w, ch)
+        out.append(rng.integers(0, 256, size=shape, dtype=np.uint8))
+    return out
+
+
+def run(
+    server: StencilServer,
+    mode: str = "closed",
+    requests: int = 64,
+    concurrency: int = 4,
+    rate: float = 200.0,
+    reps: int = 5,
+    shapes: Sequence[Tuple[int, int]] = DEFAULT_SHAPES,
+    channels: Sequence[int] = (3,),
+    seed: int = 0,
+    timeout: float = 300.0,
+) -> Dict:
+    """Drive ``server`` with synthetic load; return the report dict.
+
+    Report keys: ``mode``, ``requests``, ``completed``, ``rejected``,
+    ``wall_seconds``, ``throughput_rps``, ``p50_s``, ``p99_s`` (request
+    latency from the registry), plus the full ``stats`` snapshot.
+    """
+    if mode not in ("closed", "open"):
+        raise ValueError(f"mode must be closed|open, got {mode!r}")
+    images = synth_requests(requests, shapes, channels, seed)
+    completed = 0
+    completed_lock = threading.Lock()
+    t_start = time.perf_counter()
+
+    if mode == "closed":
+        next_i = [0]
+        errors: List[BaseException] = []
+
+        def worker():
+            nonlocal completed
+            while True:
+                with completed_lock:
+                    if errors:
+                        return  # a sibling failed; stop offering load
+                    i = next_i[0]
+                    if i >= requests:
+                        return
+                    next_i[0] = i + 1
+                try:
+                    while True:
+                        try:
+                            fut = server.submit(images[i], reps)
+                            break
+                        except QueueFull:
+                            # Closed loops retry (the client is
+                            # synchronous); the rejection is already
+                            # counted by the server — but never past the
+                            # run deadline, or a wedged server would spin
+                            # these workers forever and run() would
+                            # return a plausible-looking partial report.
+                            if time.perf_counter() > t_start + timeout:
+                                raise TimeoutError(
+                                    f"loadgen deadline ({timeout}s) hit "
+                                    "retrying a full queue"
+                                )
+                            time.sleep(0.001)
+                    fut.result(timeout=timeout)
+                except BaseException as e:  # propagate via run(), never die silently
+                    with completed_lock:
+                        errors.append(e)
+                    return
+                with completed_lock:
+                    completed += 1
+
+        threads = [
+            threading.Thread(target=worker, daemon=True)
+            for _ in range(max(1, concurrency))
+        ]
+        for t in threads:
+            t.start()
+        # One shared deadline across all joins — not timeout per thread.
+        deadline = t_start + timeout
+        for t in threads:
+            t.join(max(0.0, deadline - time.perf_counter()))
+        if errors:
+            raise errors[0]
+    else:  # open loop
+        period = 1.0 / rate if rate > 0 else 0.0
+        futures = []
+        for i in range(requests):
+            t_due = t_start + i * period
+            delay = t_due - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+            try:
+                futures.append(server.submit(images[i], reps))
+            except QueueFull:
+                pass  # counted by the server; open loops shed, not wait
+        deadline = time.perf_counter() + timeout
+        for f in futures:
+            f.result(timeout=max(0.0, deadline - time.perf_counter()))
+        completed = len(futures)
+
+    wall = time.perf_counter() - t_start
+    stats = server.stats()
+    rlat = stats["histograms"]["request_latency_seconds"]
+    return {
+        "mode": mode,
+        "requests": requests,
+        "completed": completed,
+        "rejected": stats["counters"]["rejected_total"],
+        "wall_seconds": wall,
+        "throughput_rps": completed / wall if wall > 0 else 0.0,
+        "p50_s": rlat["p50"],
+        "p99_s": rlat["p99"],
+        "stats": stats,
+    }

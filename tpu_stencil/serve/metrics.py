@@ -1,0 +1,167 @@
+"""Thread-safe metrics registry for the serving engine.
+
+The observability layer the reference program never needed (one process,
+one image, one timer): a serving loop fields a stream of heterogeneous
+requests, so the interesting numbers are *distributions* (queue wait,
+batch latency) and *rates* (requests, rejections, padded-pixel waste),
+not a single wall-clock. Everything is in-process and dependency-free:
+``Registry.snapshot()`` returns a plain dict (the ``serve.stats()`` /
+``--stats-json`` schema, documented in docs/SERVING.md).
+
+Histograms keep a bounded deterministic reservoir: past ``cap``
+observations each new sample evicts a pseudo-randomly chosen slot
+(seeded ``random.Random``), so percentile queries stay O(cap log cap)
+and memory stays bounded no matter how long the server runs — the same
+never-unbounded discipline as the request queue.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from typing import Dict, List
+
+
+class Counter:
+    """Monotonic counter."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._value = 0
+
+    def inc(self, n: int = 1) -> None:
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> int:
+        with self._lock:
+            return self._value
+
+
+class Gauge:
+    """Point-in-time value (e.g. queue depth). Tracks its high-water mark
+    so a snapshot taken after a burst still shows how deep the queue got."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._value = 0.0
+        self._peak = 0.0
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self._value = v
+            if v > self._peak:
+                self._peak = v
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+    @property
+    def peak(self) -> float:
+        with self._lock:
+            return self._peak
+
+
+class Histogram:
+    """Latency/size distribution with bounded memory.
+
+    Keeps every observation up to ``cap``, then reservoir-replaces
+    (deterministic seed: snapshots are reproducible for a given
+    observation sequence). ``count``/``sum`` stay exact regardless.
+    """
+
+    def __init__(self, cap: int = 8192) -> None:
+        self._lock = threading.Lock()
+        self._cap = cap
+        self._values: List[float] = []
+        self._count = 0
+        self._sum = 0.0
+        self._max = 0.0
+        self._rng = random.Random(0)
+
+    def observe(self, v: float) -> None:
+        v = float(v)
+        with self._lock:
+            self._count += 1
+            self._sum += v
+            if v > self._max:
+                self._max = v
+            if len(self._values) < self._cap:
+                self._values.append(v)
+            else:
+                # Classic reservoir sampling: keep each of the n seen so
+                # far with probability cap/n.
+                j = self._rng.randrange(self._count)
+                if j < self._cap:
+                    self._values[j] = v
+
+    def percentile(self, p: float) -> float:
+        """Nearest-rank percentile over the reservoir (0 when empty)."""
+        with self._lock:
+            if not self._values:
+                return 0.0
+            vals = sorted(self._values)
+        k = min(len(vals) - 1, max(0, int(round(p / 100.0 * (len(vals) - 1)))))
+        return vals[k]
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._count
+
+    @property
+    def sum(self) -> float:
+        with self._lock:
+            return self._sum
+
+    def snapshot(self) -> Dict[str, float]:
+        return {
+            "count": self.count,
+            "sum": self.sum,
+            "mean": (self.sum / self.count) if self.count else 0.0,
+            "p50": self.percentile(50),
+            "p99": self.percentile(99),
+            "max": self._max,
+        }
+
+
+class Registry:
+    """Named metric store; creation is idempotent per (kind, name)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._counters: Dict[str, Counter] = {}
+        self._gauges: Dict[str, Gauge] = {}
+        self._histograms: Dict[str, Histogram] = {}
+
+    def counter(self, name: str) -> Counter:
+        with self._lock:
+            return self._counters.setdefault(name, Counter())
+
+    def gauge(self, name: str) -> Gauge:
+        with self._lock:
+            return self._gauges.setdefault(name, Gauge())
+
+    def histogram(self, name: str, cap: int = 8192) -> Histogram:
+        with self._lock:
+            return self._histograms.setdefault(name, Histogram(cap))
+
+    def snapshot(self) -> dict:
+        """The ``serve.stats()`` schema: plain JSON-serializable dict."""
+        with self._lock:
+            counters = dict(self._counters)
+            gauges = dict(self._gauges)
+            histograms = dict(self._histograms)
+        return {
+            "counters": {k: c.value for k, c in sorted(counters.items())},
+            "gauges": {
+                k: {"value": g.value, "peak": g.peak}
+                for k, g in sorted(gauges.items())
+            },
+            "histograms": {
+                k: h.snapshot() for k, h in sorted(histograms.items())
+            },
+        }
