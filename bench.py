@@ -66,8 +66,12 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
-def _time_fn(jit_fn, img) -> float:
-    """Steady-state per-rep seconds of ``jit_fn(img_dev, n_reps)``."""
+def _time_fn(jit_fn, img, phases=None) -> float:
+    """Steady-state per-rep seconds of ``jit_fn(img_dev, n_reps)``.
+
+    ``phases`` (optional dict): records the warm-up (compile) wall clock
+    under ``"compile_seconds"`` on first use — the per-phase breakdown
+    the capture lines report alongside the headline."""
     import jax
     import jax.numpy as jnp
 
@@ -83,7 +87,11 @@ def _time_fn(jit_fn, img) -> float:
         np.asarray(out.ravel()[0])
         return time.perf_counter() - t0
 
-    run(2)  # warm-up compile (also pre-commits the donation layout)
+    compile_s = run(2)  # warm-up compile (also pre-commits donation layout)
+    if phases is not None:
+        # First measurement only: the default-path compile, matching the
+        # early capture's default-path philosophy.
+        phases.setdefault("compile_seconds", compile_s)
     # Dispatch/fence overhead (tunnel RTT can be ~50 ms) cancels in the
     # two-point differencing; 2000/4000-rep runs amortize everything else.
     # (Override for smoke tests on slow platforms.)
@@ -113,14 +121,16 @@ def _measure_backend(backend: str, on_first=None) -> dict:
     rng = np.random.default_rng(0)
     img = rng.integers(0, 256, size=(H, W, C), dtype=np.uint8)
     model = IteratedConv2D("gaussian", backend=backend)
+    phases: dict = {}
 
     if backend != "pallas":
         jit_fn = functools.partial(iterate, plan=model.plan, backend=backend)
-        per_rep = _time_fn(jit_fn, img)
+        per_rep = _time_fn(jit_fn, img, phases)
         log(f"{backend}: {per_rep * 1e6:.1f} us/rep")
         if on_first is not None:
             on_first(per_rep, None)
-        return {"us_per_rep": round(per_rep * 1e6, 2), "per_rep_s": per_rep}
+        return {"us_per_rep": round(per_rep * 1e6, 2), "per_rep_s": per_rep,
+                "phases": phases}
 
     # Optional restriction for the rows-roll probe (second child run):
     # measure only the named schedules instead of all five.
@@ -145,7 +155,7 @@ def _measure_backend(backend: str, on_first=None) -> dict:
             donate_argnums=0,
         )
         try:
-            per = _time_fn(jit_fn, img)
+            per = _time_fn(jit_fn, img, phases)
         except Exception as e:  # one broken schedule must not kill pallas
             log(f"pallas[{sched}]: FAILED {type(e).__name__}: {e}")
             continue
@@ -199,6 +209,7 @@ def _measure_backend(backend: str, on_first=None) -> dict:
     return {
         "us_per_rep": round(per_rep * 1e6, 2),
         "per_rep_s": per_rep,
+        "phases": phases,
         "schedule": best,
         "schedules_us_per_rep": {
             s: round(p * 1e6, 2) for s, p in schedules.items()
@@ -237,7 +248,39 @@ def _capture_line(per_rep_s: float, backend: str, platform: str,
         "hbm_gbps": round(gbps, 1),
         "pct_hbm_peak": round(pct, 1),
         "platform": platform,
+        # Versioned captures: consumers (tools/bench_capture.py,
+        # dashboards) dispatch on schema_version instead of guessing from
+        # key shape; ts is monotonic, so captures within one process
+        # order totally even across wall-clock adjustments.
+        "schema_version": 1,
+        "ts": round(time.monotonic(), 6),
     }
+
+
+def _phase_lines(winner: str, results: dict, platform: str) -> list:
+    """Per-phase breakdown capture lines (``phase.<name>.seconds``),
+    emitted NEXT TO the headline capture so ``BENCH_*.json`` records the
+    breakdown trajectory round over round. Each line is a valid
+    self-contained capture (numeric ``value``) carrying a ``"phase"``
+    marker so ``tools/bench_capture.py`` never promotes one to the
+    canonical headline object."""
+    win = results[winner]
+    phases = dict(win.get("phases", {}))
+    phases["iterate_seconds"] = win["per_rep_s"] * REPS
+    lines = []
+    for name, seconds in sorted(phases.items()):
+        short = name[: -len("_seconds")] if name.endswith("_seconds") else name
+        lines.append({
+            "metric": f"phase.{short}.seconds",
+            "value": round(seconds, 6),
+            "unit": "s",
+            "phase": short,
+            "backend": winner,
+            "platform": platform,
+            "schema_version": 1,
+            "ts": round(time.monotonic(), 6),
+        })
+    return lines
 
 
 def child_main() -> int:
@@ -304,6 +347,11 @@ def child_main() -> int:
 
     winner = min(results, key=lambda b: results[b]["per_rep_s"])
     per_rep = results[winner]["per_rep_s"]
+
+    # Breakdown captures land BEFORE the headline: the stdout contract
+    # keeps "last line = most complete capture" for last-line consumers.
+    for line in _phase_lines(winner, results, platform):
+        print(json.dumps(line), flush=True)
 
     # Roofline at the geometry that actually ran: when the winner is the
     # Pallas geometry-stage verdict (e.g. fuse=16), the traffic model must

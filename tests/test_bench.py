@@ -50,18 +50,42 @@ def test_bench_retries_after_transient_failure(tmp_path):
 def test_bench_stdout_contract_every_line_parses(tmp_path):
     # Crash-first capture: the early (default-path) line lands before the
     # sweep finishes; every stdout line is a valid self-contained capture
-    # and the LAST is the enriched one (no "partial" flag).
+    # and the LAST is the enriched headline (no "partial" flag). Phase
+    # breakdown lines ride along, marked with a "phase" key.
     proc = _run_bench(tmp_path, inject_failure=False)
     assert proc.returncode == 0, proc.stderr[-2000:]
     lines = [l for l in proc.stdout.splitlines() if l.strip()]
     assert len(lines) >= 2  # early + enriched
     results = [json.loads(l) for l in lines]
     for r in results:
-        assert set(r) >= {"metric", "value", "unit", "vs_baseline",
-                          "backend", "platform"}
+        assert set(r) >= {"metric", "value", "unit", "backend", "platform",
+                          "schema_version", "ts"}
         assert r["value"] > 0
+        if "phase" not in r:
+            assert "vs_baseline" in r
     assert results[0]["partial"] is True
     assert "partial" not in results[-1]
+    assert "phase" not in results[-1]  # the last line stays the headline
+
+
+def test_bench_emits_phase_breakdown_lines(tmp_path):
+    # Per-phase capture lines (phase.<name>.seconds) land next to the
+    # headline so BENCH_*.json records the breakdown trajectory; the
+    # canonical extractor must still pick the headline.
+    proc = _run_bench(tmp_path, inject_failure=False)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    results = [json.loads(l) for l in proc.stdout.splitlines() if l.strip()]
+    phases = {r["phase"]: r for r in results if "phase" in r}
+    assert {"compile", "iterate"} <= set(phases)
+    for name, r in phases.items():
+        assert r["metric"] == f"phase.{name}.seconds"
+        assert r["unit"] == "s" and r["value"] > 0
+    cap = tmp_path / "stdout.json"
+    cap.write_text(proc.stdout)
+    from tools.bench_capture import last_capture
+
+    assert "phase" not in last_capture(str(cap))
+    assert "vs_baseline" in last_capture(str(cap))
 
 
 def test_bench_mid_sweep_death_leaves_valid_capture(tmp_path):
@@ -310,7 +334,7 @@ def test_pallas_capture_geometry_stage(monkeypatch):
     sys.path.insert(0, ".")
     bench = importlib.import_module("bench")
 
-    def fake_time(jit_fn, img):
+    def fake_time(jit_fn, img, phases=None):
         kw = jit_fn.__wrapped__.keywords
         sched = kw.get("schedule")
         geo = (kw.get("block_h"), kw.get("fuse"))

@@ -75,6 +75,64 @@ def test_histogram_percentiles_and_bounds():
     assert len(h._values) == 64
 
 
+def test_histogram_empty_percentile_is_zero():
+    # Pinned: a scrape before first traffic renders 0.0, never raises —
+    # the exposition path snapshots every histogram unconditionally.
+    h = Histogram(cap=8)
+    assert h.percentile(50) == 0.0
+    assert h.percentile(99) == 0.0
+    snap = h.snapshot()
+    assert snap == {"count": 0, "sum": 0.0, "mean": 0.0, "p50": 0.0,
+                    "p99": 0.0, "max": 0.0}
+
+
+def test_histogram_single_sample_is_every_percentile():
+    h = Histogram(cap=8)
+    h.observe(3.25)
+    for p in (0, 1, 50, 99, 100):
+        assert h.percentile(p) == 3.25
+    snap = h.snapshot()
+    assert snap["count"] == 1 and snap["sum"] == 3.25
+    assert snap["mean"] == 3.25 and snap["max"] == 3.25
+
+
+def test_histogram_cap_reservoir_boundary():
+    # Pinned: exactly at cap nothing is evicted (percentiles are exact);
+    # one past cap the reservoir stays at cap while count/sum/max remain
+    # exact; the seeded reservoir makes the sampled reservoir
+    # reproducible for a given observation sequence.
+    cap = 16
+    h = Histogram(cap=cap)
+    for v in range(cap):
+        h.observe(float(v))
+    assert len(h._values) == cap
+    assert h.percentile(0) == 0.0 and h.percentile(100) == float(cap - 1)
+    h.observe(1000.0)
+    assert h.count == cap + 1
+    assert h.sum == sum(range(cap)) + 1000.0
+    assert len(h._values) == cap          # bounded at the boundary
+    assert h.snapshot()["max"] == 1000.0  # exact even if not in reservoir
+    h2 = Histogram(cap=cap)
+    for v in range(cap):
+        h2.observe(float(v))
+    h2.observe(1000.0)
+    assert h2.snapshot() == h.snapshot()  # deterministic reservoir
+
+
+def test_histogram_snapshot_through_text_exposition():
+    # The satellite contract: Histogram.snapshot() is reachable through
+    # the obs exposition and survives the render/parse round-trip.
+    from tpu_stencil.obs import exposition
+
+    r = Registry()
+    r.histogram("probe_seconds").observe(0.5)
+    r.histogram("probe_seconds").observe(1.5)
+    snap = r.snapshot()
+    text = exposition.render_text(snap, prefix="t")
+    assert 't_probe_seconds{quantile="0.5"}' in text
+    assert exposition.parse_text(text, prefix="t") == snap
+
+
 def test_registry_snapshot_schema():
     r = Registry()
     r.counter("a").inc(3)
@@ -349,6 +407,31 @@ def test_module_stats_points_at_last_server(rng):
         img = rng.integers(0, 256, (8, 8), dtype=np.uint8)
         s.submit(img, 1).result(timeout=300)
         assert serve_mod.stats()["counters"]["completed_total"] == 1
+
+
+def test_resolve_tolerates_cancel_race():
+    # A client cancel can land between the worker's done() check and its
+    # set_result (futures never enter RUNNING, so cancel() wins any
+    # time): _resolve must swallow the InvalidStateError instead of
+    # letting the worker-loop catch-all poison the whole batch.
+    import concurrent.futures
+
+    from tpu_stencil.serve.engine import _resolve
+
+    fut = concurrent.futures.Future()
+    assert _resolve(fut, 42) and fut.result() == 42
+    cancelled = concurrent.futures.Future()
+    cancelled.cancel()
+    assert not _resolve(cancelled, 42)
+    assert not _resolve(cancelled, exc=RuntimeError("x"))
+
+
+def test_cli_serve_rejects_zero_shape():
+    from tpu_stencil.serve import cli as serve_cli
+
+    with pytest.raises(SystemExit) as exc:
+        serve_cli.main(["--shapes", "0x30"])
+    assert exc.value.code == 2
 
 
 def test_cli_serve_self_test_subprocess(tmp_path):

@@ -11,6 +11,12 @@ parses). CLI: ``python tools/bench_capture.py FILE`` prints the
 canonical capture as a single JSON object (exit 1 if none) — used by
 the burst scripts to keep ``docs/BENCH_r*_preview.json`` a plain
 one-object artifact that ``json.load`` consumers can read directly.
+
+Since the obs PR, bench.py also emits per-phase breakdown lines
+(``"phase": <name>`` marker) and versions every capture
+(``schema_version``). The canonical object is a HEADLINE capture:
+phase lines never win, versioned headlines beat unversioned ones
+(pre-versioning files still resolve — tolerate, prefer).
 """
 
 from __future__ import annotations
@@ -20,7 +26,9 @@ import sys
 
 
 def last_capture(path: str) -> dict:
-    best = None
+    best = None          # last headline (non-phase) capture, any schema
+    best_versioned = None  # last headline capture with schema_version
+    best_any = None      # absolute fallback: any capture at all
     with open(path) as f:
         for line in f:
             line = line.strip()
@@ -33,13 +41,19 @@ def last_capture(path: str) -> dict:
             # Mirror bench.py's _is_capture: a numeric value is what makes
             # a line a capture — {"value": null} or a stray JSON line must
             # not become the canonical preview object.
-            if isinstance(obj, dict) and isinstance(
-                obj.get("value"), (int, float)
-            ):
-                best = obj
-    if best is None:
-        raise ValueError(f"no parseable capture line in {path}")
-    return best
+            if not (isinstance(obj, dict)
+                    and isinstance(obj.get("value"), (int, float))):
+                continue
+            best_any = obj
+            if "phase" in obj:
+                continue  # breakdown rider, never the headline
+            best = obj
+            if "schema_version" in obj:
+                best_versioned = obj
+    for obj in (best_versioned, best, best_any):
+        if obj is not None:
+            return obj
+    raise ValueError(f"no parseable capture line in {path}")
 
 
 def main(argv) -> int:

@@ -54,12 +54,33 @@ def main(argv=None) -> int:
         cfg = distributed.broadcast_config(
             cfg if jax.process_index() == 0 else None
         )
-    result = driver.run_job(
-        cfg,
-        profile_dir=ns.profile,
-        checkpoint_every=ns.checkpoint_every,
-        resume=ns.resume,
-    )
+    trace_path, breakdown = _broadcast_obs_flags(ns)
+    tracing = bool(trace_path or breakdown)
+    if tracing:
+        from tpu_stencil import obs
+
+        obs.enable()
+    try:
+        result = driver.run_job(
+            cfg,
+            profile_dir=ns.profile,
+            checkpoint_every=ns.checkpoint_every,
+            resume=ns.resume,
+        )
+        if tracing:
+            _report_observability(trace_path, breakdown, cfg, result)
+    finally:
+        if tracing:
+            from tpu_stencil import obs
+
+            obs.disable()
+    if ns.metrics_text:
+        # Process 0 only, like the trace/breakdown output: N processes
+        # racing one open(path, 'w') would interleave the exposition.
+        # (Per-rank flag is safe here — rendering a local snapshot is not
+        # a collective, unlike the trace merge.)
+        if jax.process_index() == 0:
+            _write_metrics_text(ns.metrics_text)
     # Reference-format output line (mpi/mpi_convolution.c:274 prints seconds).
     print(f"Execution time: {result.compute_seconds:.3f} sec")
     if ns.time:
@@ -78,6 +99,74 @@ def main(argv=None) -> int:
         )
     print(f"wrote {result.output_path}")
     return 0
+
+
+def _broadcast_obs_flags(ns):
+    """Rank 0's observability argv wins pod-wide — the broadcast_config
+    discipline, and here it is load-bearing for liveness, not just
+    consistency: tracing drives collectives (the trace-merge allgather,
+    the sharded phase probes, per-rep launch splitting), so divergent
+    per-rank enablement would desync every rank's collective schedule or
+    deadlock the export gather. Returns (trace_path, breakdown)."""
+    import jax
+
+    if jax.process_count() == 1:
+        return ns.trace, bool(ns.breakdown)
+    from jax.experimental import multihost_utils
+
+    from tpu_stencil.parallel.distributed import _decode_strs, _encode_strs
+
+    # The same length-prefix-free string transport broadcast_config uses:
+    # fails loudly on oversized paths instead of truncating (a silently
+    # truncated path would write the trace somewhere else, or split a
+    # multibyte char and fail to decode on every rank).
+    buf = multihost_utils.broadcast_one_to_all(_encode_strs(
+        [ns.trace or "", "1" if ns.breakdown else ""]
+        if jax.process_index() == 0 else ["", ""]
+    ))
+    path, breakdown = _decode_strs(buf)
+    return path or None, bool(breakdown)
+
+
+def _report_observability(trace_path, breakdown, cfg, result) -> None:
+    """Export the trace and/or print the breakdown for one traced run.
+    Runs while the tracer is still installed; multi-host, every process
+    joins the trace merge but only process 0 writes/prints (the flags
+    are the broadcast, pod-agreed ones — see _broadcast_obs_flags)."""
+    import jax
+
+    from tpu_stencil import obs
+
+    tracer = obs.get_tracer()
+    if trace_path:
+        wrote = obs.export.write_chrome_trace(trace_path, tracer)
+        if wrote:
+            print(f"wrote trace {wrote}")
+    if breakdown and jax.process_index() == 0:
+        # Frames are independent, so clip traffic is frames x one frame's
+        # (roofline.achieved_frames semantics); h_img stays the per-frame
+        # height the fused kernel tiles. fuse is pinned to 1: tracing
+        # (which --breakdown implies) launches one rep at a time, so a
+        # fused Pallas kernel pays HBM every rep — dividing by the
+        # full-run fuse here would under-report the traced run's
+        # bandwidth by up to that factor.
+        table = obs.breakdown.render_breakdown(tracer, roofline_info={
+            "frame_bytes": cfg.height * cfg.width * cfg.channels * cfg.frames,
+            "reps": cfg.repetitions,
+            "backend": result.backend,
+            "filter_name": cfg.filter_name,
+            "h_img": cfg.height,
+            "block_h": result.block_h,
+            "fuse": 1,
+        })
+        print(table, end="")
+
+
+def _write_metrics_text(path: str) -> None:
+    from tpu_stencil import obs
+
+    obs.exposition.write_text(path, obs.snapshot(),
+                              prefix="tpu_stencil_driver")
 
 
 if __name__ == "__main__":

@@ -259,6 +259,25 @@ def build_parser() -> argparse.ArgumentParser:
         help="write a jax.profiler trace of the compute window to DIR",
     )
     p.add_argument(
+        "--trace", default=None, metavar="PATH",
+        help="phase-level span tracing (tpu_stencil.obs): write a Chrome "
+             "trace-event JSON to PATH (load in Perfetto / "
+             "chrome://tracing). One track per process/thread; the rep "
+             "loop runs one fenced launch per rep so per-rep time is "
+             "attributed (see docs/OBSERVABILITY.md)",
+    )
+    p.add_argument(
+        "--breakdown", action="store_true",
+        help="print a per-phase time table (load/place/compile/iterate/"
+             "fetch/store) with roofline-achieved HBM GB/s for the "
+             "iterate phase; implies span tracing for this run",
+    )
+    p.add_argument(
+        "--metrics-text", default=None, metavar="PATH",
+        help="write the driver-side metrics registry as Prometheus-style "
+             "text exposition to PATH ('-' = stdout)",
+    )
+    p.add_argument(
         "--checkpoint-every", type=int, default=0, metavar="N",
         help="checkpoint the frame every N repetitions (0 = off)",
     )

@@ -17,6 +17,7 @@ from typing import Callable, Optional, Tuple
 import jax
 import numpy as np
 
+from tpu_stencil import obs
 from tpu_stencil.config import JobConfig
 from tpu_stencil.io import images as images_io
 from tpu_stencil.io import raw as raw_io
@@ -125,18 +126,19 @@ def prepare_engine(model, imgs: np.ndarray, devices, frames: Optional[int] = Non
     engine's bucket executables mirror (serve adds pad-mask re-zeroing
     for heterogeneous shapes; see tpu_stencil/serve/engine.py).
     """
-    if frames is not None:
-        img_dev, step_fn = _place_frames(model, np.asarray(imgs), devices)
-        n_true = frames
+    with obs.phase("place"):
+        if frames is not None:
+            img_dev, step_fn = _place_frames(model, np.asarray(imgs), devices)
+            n_true = frames
 
-        def fetch(x):
-            return np.asarray(x)[:n_true]
-    else:
-        img_dev = jax.device_put(jax.numpy.asarray(imgs), devices[0])
-        step_fn = model
-        fetch = np.asarray
-    img_dev = step_fn(img_dev, 0)  # warm-up compile; output == input
-    img_dev.block_until_ready()
+            def fetch(x):
+                return np.asarray(x)[:n_true]
+        else:
+            img_dev = jax.device_put(jax.numpy.asarray(imgs), devices[0])
+            step_fn = model
+            fetch = np.asarray
+    with obs.phase("compile") as s:
+        img_dev = s.fence(step_fn(img_dev, 0))  # warm-up; output == input
     return img_dev, step_fn, fetch
 
 
@@ -211,6 +213,28 @@ def _maybe_restore(cfg: JobConfig, resume: bool) -> Tuple[int, Optional[np.ndarr
     return restored
 
 
+def _reps_spanned(run_fn: Callable, img_dev, n_reps: int, rep0: int = 0):
+    """One fused device launch normally; under tracing, ``n_reps``
+    single-rep launches, each fenced and recorded as its own
+    ``iterate.rep`` span, so per-rep time is attributed to the rep that
+    spent it. ``run_fn`` takes a *traced* rep count, so the split reuses
+    the one compiled program (no recompiles) — but it does serialize the
+    rep loop at host-dispatch granularity (and runs fused-chunk paths one
+    rep at a time), which is the documented cost of span-level
+    attribution (docs/OBSERVABILITY.md).
+
+    ``rep0`` is the absolute repetition number of the first launch, so
+    span labels stay globally numbered across checkpoint chunks and
+    resumed runs (chunk 2 of --checkpoint-every 5 is rep=5.., not a
+    second rep=0..)."""
+    if n_reps <= 0 or not obs.enabled():
+        return run_fn(img_dev, n_reps)
+    for i in range(n_reps):
+        with obs.span("iterate.rep", "driver", rep=rep0 + i) as s:
+            img_dev = s.fence(run_fn(img_dev, 1))
+    return img_dev
+
+
 def _checkpointed_iterate(
     cfg: JobConfig,
     run_fn: Callable,          # (img_dev, n_reps) -> img_dev
@@ -226,7 +250,8 @@ def _checkpointed_iterate(
     the job output, not as a checkpoint."""
     if not checkpoint_every:
         with Timer() as t:
-            out = run_fn(img_dev, cfg.repetitions - start_rep)
+            out = _reps_spanned(run_fn, img_dev,
+                                cfg.repetitions - start_rep, start_rep)
             out.block_until_ready()
         return out, t.elapsed
 
@@ -235,7 +260,7 @@ def _checkpointed_iterate(
     while rep < cfg.repetitions:
         n = min(checkpoint_every, cfg.repetitions - rep)
         with Timer() as t:
-            img_dev = run_fn(img_dev, n)
+            img_dev = _reps_spanned(run_fn, img_dev, n, rep)
             img_dev.block_until_ready()
         total += t.elapsed
         rep += n
@@ -261,6 +286,7 @@ def run_job(
     """Run one iterated-convolution job end to end."""
     if checkpoint_every < 0:
         raise ValueError(f"checkpoint_every must be >= 0, got {checkpoint_every}")
+    obs.registry().counter("jobs_total").inc()
     with Timer() as total_t:
         model = IteratedConv2D(cfg.filter_name, backend=cfg.backend,
                                schedule=cfg.schedule, boundary=cfg.boundary,
@@ -317,7 +343,8 @@ def run_job(
                                 checkpoint_every, resume, total_t)
 
         start_rep, frame = _maybe_restore(cfg, resume)
-        img = _load_input(cfg) if frame is None else frame
+        with obs.phase("load"):
+            img = _load_input(cfg) if frame is None else frame
         img_dev, step_fn, fetch = prepare_engine(
             model, img, devices,
             frames=cfg.frames if cfg.frames > 1 else None,
@@ -328,13 +355,16 @@ def run_job(
             ckpt.save(cfg, rep, fetch(dev))
 
         with _maybe_profile(profile_dir):
-            out_dev, compute = _checkpointed_iterate(
-                cfg, lambda x, n: step_fn(x, n), save_fn,
-                img_dev, checkpoint_every, start_rep,
-            )
-        out = fetch(out_dev)
+            with obs.phase("iterate", reps=cfg.repetitions):
+                out_dev, compute = _checkpointed_iterate(
+                    cfg, lambda x, n: step_fn(x, n), save_fn,
+                    img_dev, checkpoint_every, start_rep,
+                )
+        with obs.phase("fetch"):
+            out = fetch(out_dev)
         compute_seconds = max_across_processes(compute)
-        _store_output(cfg, out)
+        with obs.phase("store"):
+            _store_output(cfg, out)
         _clear_checkpoint(cfg, checkpoint_every, resume)
 
     # Report what actually ran: batch mode asks the same decision helper
@@ -410,10 +440,13 @@ def _run_frames_multihost(cfg, model, profile_dir, checkpoint_every,
     n_ld = 1
     if n_local:
         if restored is None:
-            rows = raw_io.read_raw_rows(cfg.image, f0 * h, n_local * h, w, ch)
-            imgs = rows.reshape(n_local, h, w, ch)
-            if ch == 1:
-                imgs = imgs[..., 0]
+            with obs.phase("load"):
+                rows = raw_io.read_raw_rows(
+                    cfg.image, f0 * h, n_local * h, w, ch
+                )
+                imgs = rows.reshape(n_local, h, w, ch)
+                if ch == 1:
+                    imgs = imgs[..., 0]
         else:
             imgs = restored
         local_devs = jax.local_devices()
@@ -422,10 +455,12 @@ def _run_frames_multihost(cfg, model, profile_dir, checkpoint_every,
             model, imgs, local_devs[:n_ld], frames=n_local
         )
         with _maybe_profile(profile_dir):
-            out_dev, compute = _checkpointed_iterate(
-                cfg, step_fn, save_fn, dev, checkpoint_every, start_rep
-            )
-        out = fetch(out_dev)  # crop device-multiple padding
+            with obs.phase("iterate", reps=cfg.repetitions):
+                out_dev, compute = _checkpointed_iterate(
+                    cfg, step_fn, save_fn, dev, checkpoint_every, start_rep
+                )
+        with obs.phase("fetch"):
+            out = fetch(out_dev)  # crop device-multiple padding
     elif checkpoint_every:
         # Frame-less process: THE SAME chunk loop as the compute path (a
         # no-op run on a dummy carry) so its save/commit-barrier schedule
@@ -437,12 +472,13 @@ def _run_frames_multihost(cfg, model, profile_dir, checkpoint_every,
         )
     # Collective: every process participates, frame-less ones with 0.
     compute_seconds = max_across_processes(compute)
-    native.set_size(cfg.output_path, cfg.frames * h * w * ch)
-    if n_local:
-        block = out.reshape(n_local * h, w, ch)
-        raw_io.write_raw_block(
-            cfg.output_path, f0 * h, 0, block, w, ch, cfg.frames * h
-        )
+    with obs.phase("store"):
+        native.set_size(cfg.output_path, cfg.frames * h * w * ch)
+        if n_local:
+            block = out.reshape(n_local * h, w, ch)
+            raw_io.write_raw_block(
+                cfg.output_path, f0 * h, 0, block, w, ch, cfg.frames * h
+            )
     if checkpoint_every or resume:
         # Everyone is past restore and compute (the max-reduce above is a
         # collective); process 0 sweeps the checkpoint artifacts.
@@ -496,27 +532,35 @@ def _run_sharded(cfg, model, devices, profile_dir, checkpoint_every, resume,
         if restored is not None:
             start_rep, img_dev = restored
     if img_dev is None:
-        if images_io.is_raw(cfg.image, sniff=True):
-            # Per-process sharded read: each host touches only the rows its
-            # devices own (the MPI-IO pattern, mpi/mpi_convolution.c:126-141);
-            # single-process this is bit-identical to whole-file read +
-            # device_put.
-            img_dev = distributed.read_sharded(
-                cfg.image, cfg.height, cfg.width, cfg.channels, runner.sharding
-            )
-        elif jax.process_count() > 1:
-            raise NotImplementedError(
-                "multi-host jobs require .raw inputs (per-process strided "
-                "reads); convert image formats to raw first"
-            )
-        else:
-            img_dev = runner.put(_load_input(cfg))
+        with obs.phase("load"):
+            if images_io.is_raw(cfg.image, sniff=True):
+                # Per-process sharded read: each host touches only the rows
+                # its devices own (the MPI-IO pattern,
+                # mpi/mpi_convolution.c:126-141); single-process this is
+                # bit-identical to whole-file read + device_put.
+                img_dev = distributed.read_sharded(
+                    cfg.image, cfg.height, cfg.width, cfg.channels,
+                    runner.sharding,
+                )
+            elif jax.process_count() > 1:
+                raise NotImplementedError(
+                    "multi-host jobs require .raw inputs (per-process "
+                    "strided reads); convert image formats to raw first"
+                )
+            else:
+                img_dev = runner.put(_load_input(cfg))
     # Warm-up compile outside the timed window (the reference's timer also
     # excludes startup: it opens after MPI_Barrier,
     # mpi/mpi_convolution.c:151-155). A 0-rep run's output equals its input,
     # so it doubles as the timed run's input — no second transfer.
-    img_dev = runner.run(img_dev, 0)
-    img_dev.block_until_ready()
+    with obs.phase("compile") as s:
+        img_dev = s.fence(runner.run(img_dev, 0))
+    if obs.enabled():
+        # Pack/exchange/compute attribution: one measured rep each of the
+        # exchange-only and local-compute-only programs (outside the timed
+        # compute window), so the trace separates communication from
+        # interior compute the way the persistent-MPI stencil work does.
+        runner.trace_phase_probes(img_dev)
 
     def save_fn(rep, dev):
         from tpu_stencil.runtime import checkpoint as ckpt
@@ -524,16 +568,19 @@ def _run_sharded(cfg, model, devices, profile_dir, checkpoint_every, resume,
         ckpt.save_sharded(cfg, rep, dev)
 
     with _maybe_profile(profile_dir):
-        out_dev, compute = _checkpointed_iterate(
-            cfg, runner.run, save_fn, img_dev, checkpoint_every, start_rep,
-        )
+        with obs.phase("iterate", reps=cfg.repetitions):
+            out_dev, compute = _checkpointed_iterate(
+                cfg, runner.run, save_fn, img_dev, checkpoint_every,
+                start_rep,
+            )
     compute_seconds = max_across_processes(compute)
-    if images_io.is_raw(cfg.output_path):
-        distributed.write_sharded(
-            cfg.output_path, out_dev, cfg.height, cfg.width, cfg.channels
-        )
-    else:
-        images_io.save_image(cfg.output_path, runner.fetch(out_dev))
+    with obs.phase("store"):
+        if images_io.is_raw(cfg.output_path):
+            distributed.write_sharded(
+                cfg.output_path, out_dev, cfg.height, cfg.width, cfg.channels
+            )
+        else:
+            images_io.save_image(cfg.output_path, runner.fetch(out_dev))
     _clear_checkpoint(cfg, checkpoint_every, resume)
     # Report non-default geometry (forced or tuned) as what the
     # valid-ghost kernel launches at this tile: runner.block_h_eff plus

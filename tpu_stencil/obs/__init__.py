@@ -1,0 +1,58 @@
+"""Observability: span tracing, trace export, metrics exposition.
+
+The lightweight, compiled-out-unless-enabled telemetry subsystem (see
+docs/OBSERVABILITY.md). Three pieces:
+
+* :mod:`~tpu_stencil.obs.tracing` — the ``span``/``phase`` API:
+  perf_counter spans with explicit ``jax.block_until_ready`` fence
+  points, thread-safe, multi-process aware; a no-op unless
+  :func:`enable` has run.
+* :mod:`~tpu_stencil.obs.export` — Chrome trace-event JSON
+  (``--trace out.json``, loadable in Perfetto), merged across processes.
+* :mod:`~tpu_stencil.obs.exposition` — Prometheus-style text rendering
+  of any registry snapshot (serve's and the driver-side
+  :func:`registry`), with a reference parser.
+* :mod:`~tpu_stencil.obs.breakdown` — the human ``--breakdown`` table
+  with roofline GB/s annotation.
+
+>>> from tpu_stencil import obs
+>>> obs.enable()
+>>> with obs.span("load", "driver"):
+...     img = load()
+>>> obs.export.write_chrome_trace("trace.json", obs.get_tracer())
+"""
+
+from tpu_stencil.obs.tracing import (
+    Span,
+    SpanRecord,
+    Tracer,
+    disable,
+    enable,
+    enabled,
+    get_tracer,
+    phase,
+    registry,
+    reset,
+    snapshot,
+    span,
+)
+from tpu_stencil.obs import breakdown, export, exposition, tracing
+
+__all__ = [
+    "Span",
+    "SpanRecord",
+    "Tracer",
+    "breakdown",
+    "disable",
+    "enable",
+    "enabled",
+    "export",
+    "exposition",
+    "get_tracer",
+    "phase",
+    "registry",
+    "reset",
+    "snapshot",
+    "span",
+    "tracing",
+]

@@ -1,0 +1,85 @@
+"""Human-readable per-phase breakdown table (``--breakdown``).
+
+Aggregates a tracer's spans by name and renders a fixed-width table —
+seconds, share of the run, call count — annotating the iterate phase
+with achieved HBM GB/s and % of peak via the shared roofline model
+(:mod:`tpu_stencil.runtime.roofline`), so "where did the time go" and
+"was that time any good" land in one view. Nested spans (recorded
+depth > 0, e.g. ``iterate.rep`` inside ``iterate``) indent under their
+parent and are excluded from the share denominator — their time is
+already inside it. Classification is by the *recorded* nesting depth,
+not by dotted names: ``sharded.halo_exchange`` and friends are
+top-level siblings whose time must count toward the total.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from tpu_stencil.obs.tracing import Tracer
+
+
+def aggregate(tracer: Tracer) -> List[dict]:
+    """Spans grouped by name, in first-start order:
+    ``{name, seconds, count, t_first, depth}`` (depth = the minimum
+    nesting depth the name was recorded at)."""
+    agg: Dict[str, dict] = {}
+    for rec in tracer.spans():
+        row = agg.get(rec.name)
+        if row is None:
+            agg[rec.name] = {
+                "name": rec.name, "seconds": rec.seconds, "count": 1,
+                "t_first": rec.t0, "depth": rec.depth,
+            }
+        else:
+            row["seconds"] += rec.seconds
+            row["count"] += 1
+            row["t_first"] = min(row["t_first"], rec.t0)
+            row["depth"] = min(row["depth"], rec.depth)
+    return sorted(agg.values(), key=lambda r: r["t_first"])
+
+
+def render_breakdown(tracer: Tracer,
+                     roofline_info: Optional[dict] = None) -> str:
+    """The ``--breakdown`` table.
+
+    ``roofline_info`` (optional): ``{frame_bytes, reps, backend,
+    filter_name, h_img, block_h, fuse}`` — when given, the ``iterate``
+    row (and per-rep sub-row) gains achieved GB/s vs the HBM roofline.
+    """
+    rows = aggregate(tracer)
+    if not rows:
+        return "(no spans recorded)\n"
+    total = sum(r["seconds"] for r in rows if r["depth"] == 0)
+    gbps_by_name: Dict[str, str] = {}
+    if roofline_info and roofline_info.get("reps"):
+        from tpu_stencil.runtime import roofline
+
+        ri = roofline_info
+        for name in ("iterate", "iterate.rep"):
+            sec = next(
+                (r["seconds"] for r in rows if r["name"] == name), 0.0
+            )
+            if sec <= 0.0:
+                continue
+            gbps, pct = roofline.achieved(
+                ri["frame_bytes"], sec / ri["reps"], ri["backend"],
+                ri["filter_name"], ri["h_img"],
+                block_h=ri.get("block_h"), fuse=ri.get("fuse"),
+            )
+            gbps_by_name[name] = f"{gbps:8.2f} {pct:5.1f}%"
+    name_w = max(len(r["name"]) + 2 * r["depth"] for r in rows)
+    name_w = max(name_w, len("phase"))
+    head = (f"{'phase':<{name_w}}  {'seconds':>10}  {'share':>6}  "
+            f"{'calls':>6}  {'HBM GB/s':>8} {'peak':>6}")
+    lines = [head, "-" * len(head)]
+    for r in rows:
+        sub = r["depth"] > 0
+        label = "  " * r["depth"] + r["name"]
+        share = "" if sub or total <= 0 else f"{100 * r['seconds'] / total:5.1f}%"
+        lines.append(
+            f"{label:<{name_w}}  {r['seconds']:>10.6f}  {share:>6}  "
+            f"{r['count']:>6}  {gbps_by_name.get(r['name'], ''):>15}"
+        )
+    lines.append(f"{'total':<{name_w}}  {total:>10.6f}  {'100.0%':>6}")
+    return "\n".join(lines) + "\n"

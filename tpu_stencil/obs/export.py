@@ -1,0 +1,97 @@
+"""Chrome trace-event JSON export (Perfetto/chrome://tracing loadable).
+
+Span records become complete ("X") events: ``ts``/``dur`` in
+microseconds relative to the tracer's origin, one ``pid`` per JAX
+process, one ``tid`` track per recording thread (metadata events name
+both). Multi-process runs merge into ONE file: every process serializes
+its local events, the buffers are allgathered (the same
+``process_allgather`` pattern as ``utils.timing.max_across_processes``),
+and process 0 writes the merged view — a multihost job yields a single
+trace with one track group per host.
+
+The format is the stable subset Perfetto documents: a JSON object
+``{"traceEvents": [...]}`` where every event has
+``name/cat/ph/ts/dur/pid/tid``.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import List, Optional
+
+from tpu_stencil.obs.tracing import Tracer
+
+
+def chrome_events(tracer: Tracer, pid: Optional[int] = None) -> List[dict]:
+    """This process's spans as Chrome trace events (metadata included)."""
+    if pid is None:
+        pid = _process_index()
+    events: List[dict] = [{
+        "ph": "M", "name": "process_name", "pid": pid, "tid": 0,
+        "args": {"name": f"tpu_stencil p{pid}"},
+    }]
+    # Stable small tids in first-seen order: Perfetto sorts tracks by tid,
+    # so the main thread (first recorder) stays on top.
+    tids: dict = {}
+    for rec in tracer.spans():
+        tid = tids.get(rec.tid)
+        if tid is None:
+            tid = tids[rec.tid] = len(tids)
+            events.append({
+                "ph": "M", "name": "thread_name", "pid": pid, "tid": tid,
+                "args": {"name": rec.tname},
+            })
+        events.append({
+            "name": rec.name,
+            "cat": rec.cat or "tpu_stencil",
+            "ph": "X",
+            "ts": round((rec.t0 - tracer.t_origin) * 1e6, 3),
+            "dur": round(rec.seconds * 1e6, 3),
+            "pid": pid,
+            "tid": tid,
+            "args": dict(rec.args, depth=rec.depth),
+        })
+    return events
+
+
+def _process_index() -> int:
+    try:
+        import jax
+
+        return jax.process_index()
+    except Exception:  # jax absent or backend not initialized: one process
+        return 0
+
+
+def merged_events(tracer: Tracer) -> List[dict]:
+    """All processes' events, gathered to every process. Single-process:
+    just this tracer's."""
+    import jax
+
+    local = chrome_events(tracer)
+    if jax.process_count() == 1:
+        return local
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    payload = np.frombuffer(json.dumps(local).encode(), np.uint8)
+    lens = multihost_utils.process_allgather(np.int64(payload.size))
+    buf = np.zeros(int(lens.max()), np.uint8)
+    buf[: payload.size] = payload
+    gathered = multihost_utils.process_allgather(buf)
+    merged: List[dict] = []
+    for i in range(len(lens)):
+        merged.extend(json.loads(bytes(gathered[i][: int(lens[i])]).decode()))
+    return merged
+
+
+def write_chrome_trace(path: str, tracer: Tracer) -> Optional[str]:
+    """Write the merged trace; process 0 writes (every process joins the
+    gather). Returns ``path`` on the writing process, None elsewhere."""
+    events = merged_events(tracer)
+    if _process_index() != 0:
+        return None
+    with open(path, "w") as fh:
+        json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, fh)
+        fh.write("\n")
+    return path

@@ -1,0 +1,142 @@
+"""Prometheus-style text exposition for metric registry snapshots.
+
+One render path for every registry in the repo: the serve engine's
+(``StencilServer.stats()``) and the driver-side obs registry
+(``obs.snapshot()``) both produce the same
+``{counters, gauges, histograms, <scalars>}`` dict shape, and
+:func:`render_text` turns it into the text format scrapers ingest:
+
+* counters  -> ``<prefix>_<name> <int>``
+* gauges    -> value plus the high-water mark as ``{stat="peak"}``
+* histograms-> a summary: ``{quantile="0.5"|"0.99"}`` samples plus
+  ``_count``/``_sum``/``_mean``/``_max`` series
+* bare scalars (e.g. ``executables_cached``) -> an untyped gauge
+
+:func:`parse_text` is the exact inverse — ``parse_text(render_text(s))
+== s`` for any snapshot (floats are emitted with ``repr``, which
+round-trips exactly in Python) — so tests can assert no metric is
+dropped, and downstream tooling has a reference parser.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict
+
+_QUANTILES = (("0.5", "p50"), ("0.99", "p99"))
+_HIST_FIELDS = ("count", "sum", "mean", "max")
+_SAMPLE_RE = re.compile(
+    r'^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)'
+    r'(?:\{(?P<labels>[^}]*)\})?\s+(?P<value>\S+)$'
+)
+
+
+def _num(v) -> str:
+    # repr() round-trips floats exactly; ints print as ints.
+    return repr(float(v)) if isinstance(v, float) else repr(int(v))
+
+
+def render_text(snapshot: dict, prefix: str = "tpu_stencil") -> str:
+    """Render a registry snapshot dict as Prometheus-style text."""
+    out = []
+
+    def emit(kind, name, lines):
+        out.append(f"# TYPE {prefix}_{name} {kind}")
+        out.extend(lines)
+
+    for name, v in sorted(snapshot.get("counters", {}).items()):
+        emit("counter", name, [f"{prefix}_{name} {_num(v)}"])
+    for name, g in sorted(snapshot.get("gauges", {}).items()):
+        emit("gauge", name, [
+            f"{prefix}_{name} {_num(g['value'])}",
+            f'{prefix}_{name}{{stat="peak"}} {_num(g["peak"])}',
+        ])
+    for name, h in sorted(snapshot.get("histograms", {}).items()):
+        lines = [
+            f'{prefix}_{name}{{quantile="{q}"}} {_num(h[key])}'
+            for q, key in _QUANTILES
+        ]
+        lines += [
+            f"{prefix}_{name}_{field} {_num(h[field])}"
+            for field in _HIST_FIELDS
+        ]
+        emit("summary", name, lines)
+    for name, v in sorted(snapshot.items()):
+        if name in ("counters", "gauges", "histograms"):
+            continue
+        # Bare scalar riders on the snapshot (executables_cached).
+        emit("untyped", name, [f"{prefix}_{name} {_num(v)}"])
+    return "\n".join(out) + "\n"
+
+
+def write_text(path: str, snapshot: dict,
+               prefix: str = "tpu_stencil") -> None:
+    """Render ``snapshot`` and write it to ``path`` (``'-'`` = stdout,
+    with no trailing "wrote" line). The one place the CLIs' shared
+    '-'-vs-file contract lives."""
+    text = render_text(snapshot, prefix)
+    if path == "-":
+        print(text, end="")
+    else:
+        with open(path, "w") as fh:
+            fh.write(text)
+        print(f"wrote {path}")
+
+
+def parse_text(text: str, prefix: str = "tpu_stencil") -> dict:
+    """Inverse of :func:`render_text`: rebuild the snapshot dict."""
+    types: Dict[str, str] = {}
+    snap: dict = {"counters": {}, "gauges": {}, "histograms": {}}
+    strip = prefix + "_"
+
+    def short(name: str) -> str:
+        if not name.startswith(strip):
+            raise ValueError(f"metric {name!r} lacks prefix {prefix!r}")
+        return name[len(strip):]
+
+    def value(s: str):
+        f = float(s)
+        return int(f) if f.is_integer() and "." not in s else f
+
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("# TYPE "):
+            _, _, rest = line.partition("# TYPE ")
+            name, _, kind = rest.rpartition(" ")
+            types[short(name)] = kind
+            continue
+        if line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        if not m:
+            raise ValueError(f"unparseable exposition line: {line!r}")
+        name, labels, val = short(m["name"]), m["labels"], value(m["value"])
+        # A sample's base metric: the longest registered TYPE that is the
+        # name or a _field suffix of it.
+        if name in types:
+            base, field = name, None
+        else:
+            base, _, field = name.rpartition("_")
+            if base not in types:
+                raise ValueError(f"sample {name!r} has no TYPE line")
+        kind = types[base]
+        if kind == "counter":
+            snap["counters"][base] = value(m["value"])
+        elif kind == "gauge":
+            g = snap["gauges"].setdefault(base, {})
+            g["peak" if labels and "peak" in labels else "value"] = val
+        elif kind == "summary":
+            h = snap["histograms"].setdefault(base, {})
+            if labels:
+                q = dict(
+                    (kv.split("=")[0], kv.split("=")[1].strip('"'))
+                    for kv in labels.split(",")
+                )["quantile"]
+                h[{"0.5": "p50", "0.99": "p99"}[q]] = val
+            else:
+                h[field] = val
+        else:  # untyped scalar rider
+            snap[base] = val
+    return snap

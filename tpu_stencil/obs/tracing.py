@@ -1,0 +1,234 @@
+"""Phase-level span tracing: perf_counter spans with device fence points.
+
+The reference's only instrument is a barrier plus ``MPI_Wtime`` around
+the whole compute/comm loop (SURVEY.md: one number per run). Attributing
+time to phases — load, H2D place, warm-up compile, per-rep iterate, D2H
+fetch, store; pack/exchange/compute on the mesh — is what makes overlap
+tuning possible ("Persistent and Partitioned MPI for Stencil
+Communication", PAPERS.md), so this module gives every layer one span
+vocabulary:
+
+* **compiled out unless enabled**: the module-level :func:`span` /
+  :class:`phase` helpers read one global; with no tracer installed they
+  return a shared no-op object — no allocation, no clock read, no lock.
+  ``python -m tpu_stencil ... --trace out.json`` (or :func:`enable`)
+  installs a :class:`Tracer`.
+* **fence points**: JAX dispatch is async, so a span that launches
+  device work must drain it before closing or the time lands in whoever
+  blocks next. ``Span.fence(x)`` runs ``jax.block_until_ready`` and
+  returns ``x`` — the barrier-equivalent the headline timer already uses
+  (utils/timing.py), now per phase.
+* **thread-safe**: the serve worker loop and submitting threads record
+  concurrently; each thread keeps its own span stack (nesting depth) and
+  appends under one lock. Chrome/Perfetto renders one track per thread.
+* **multi-process aware**: spans record locally; export merges one view
+  across processes via the existing ``process_allgather`` pattern
+  (:mod:`tpu_stencil.obs.export`).
+
+Always-on metrics ride along: :class:`phase` additionally observes its
+duration into the process-wide registry (``obs.registry()``) as a
+``phase_<name>_seconds`` histogram, so the Prometheus-style exposition
+(:mod:`tpu_stencil.obs.exposition`) has driver-side distributions even
+when tracing is off — a few clock reads per *job*, not per rep.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Dict, List, Optional
+
+from tpu_stencil.utils.timing import Timer
+
+
+@dataclasses.dataclass
+class SpanRecord:
+    """One closed span (times are ``perf_counter`` seconds)."""
+
+    name: str
+    cat: str           # layer: driver | serve | sharded | ...
+    t0: float
+    t1: float
+    tid: int           # thread ident (one trace track per thread)
+    tname: str         # thread name at record time
+    depth: int         # nesting depth on its thread at open time
+    args: Dict
+
+    @property
+    def seconds(self) -> float:
+        return self.t1 - self.t0
+
+
+class Tracer:
+    """Thread-safe span sink. Construct via :func:`enable`."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._records: List[SpanRecord] = []
+        self._tls = threading.local()
+        self.t_origin = time.perf_counter()
+
+    def _stack(self) -> list:
+        st = getattr(self._tls, "stack", None)
+        if st is None:
+            st = self._tls.stack = []
+        return st
+
+    def record(self, rec: SpanRecord) -> None:
+        with self._lock:
+            self._records.append(rec)
+
+    def spans(self) -> List[SpanRecord]:
+        """Snapshot of all closed spans, in completion order."""
+        with self._lock:
+            return list(self._records)
+
+
+class Span:
+    """Context manager recording one span on ``tracer``. Exceptions
+    propagate; the span still closes (a failed phase is still time
+    spent)."""
+
+    __slots__ = ("name", "cat", "args", "_tracer", "_t0", "_depth")
+
+    def __init__(self, tracer: Tracer, name: str, cat: str, args: Dict):
+        self._tracer = tracer
+        self.name, self.cat, self.args = name, cat, args
+
+    def __enter__(self) -> "Span":
+        stack = self._tracer._stack()
+        self._depth = len(stack)
+        stack.append(self.name)
+        self._t0 = time.perf_counter()
+        return self
+
+    def fence(self, x):
+        """Drain pending device work launched inside this span so it is
+        attributed here, not to whoever blocks next. Returns ``x``."""
+        import jax
+
+        return jax.block_until_ready(x)
+
+    def __exit__(self, *exc) -> None:
+        t1 = time.perf_counter()
+        self._tracer._stack().pop()
+        th = threading.current_thread()
+        self._tracer.record(SpanRecord(
+            name=self.name, cat=self.cat, t0=self._t0, t1=t1,
+            tid=th.ident or 0, tname=th.name, depth=self._depth,
+            args=self.args,
+        ))
+
+
+class _NullSpan:
+    """Shared no-op span: the whole disabled-tracing code path.
+
+    ``fence`` still drains device work — call sites use it where the
+    fence is load-bearing for the surrounding measurement (e.g. keeping
+    a warm-up compile out of the timed window), so tracing state must
+    never change execution semantics, only whether a record is kept."""
+
+    __slots__ = ()
+
+    def __enter__(self) -> "_NullSpan":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        pass
+
+    def fence(self, x):
+        import jax
+
+        return jax.block_until_ready(x)
+
+
+_NULL = _NullSpan()
+_tracer: Optional[Tracer] = None
+# Created lazily: metrics.Registry lives under tpu_stencil.serve, whose
+# package __init__ imports the engine, which imports obs — an import-time
+# Registry here would close that cycle.
+_registry = None
+
+
+def enable() -> Tracer:
+    """Install a fresh process-wide tracer; returns it."""
+    global _tracer
+    _tracer = Tracer()
+    return _tracer
+
+
+def disable() -> None:
+    """Remove the tracer: span()/phase() drop back to the no-op path."""
+    global _tracer
+    _tracer = None
+
+
+def enabled() -> bool:
+    return _tracer is not None
+
+
+def get_tracer() -> Optional[Tracer]:
+    return _tracer
+
+
+def registry():
+    """The process-wide driver-side metrics registry (counters and
+    ``phase_*_seconds`` histograms) — a ``serve.metrics.Registry``,
+    rendered by the same exposition code path as the serve one."""
+    global _registry
+    if _registry is None:
+        from tpu_stencil.serve.metrics import Registry
+
+        _registry = Registry()
+    return _registry
+
+
+def snapshot() -> dict:
+    """``registry().snapshot()`` — the driver-side analog of
+    ``serve.stats()``."""
+    return registry().snapshot()
+
+
+def reset() -> None:
+    """Drop the tracer AND the accumulated metrics (tests)."""
+    global _tracer, _registry
+    _tracer = None
+    _registry = None
+
+
+def span(name: str, cat: str = "", **args):
+    """A trace span when tracing is enabled, a shared no-op otherwise."""
+    t = _tracer
+    if t is None:
+        return _NULL
+    return Span(t, name, cat, args)
+
+
+class phase:
+    """Time one named pipeline phase.
+
+    Always observes the duration into ``registry()`` as a
+    ``phase_<name>_seconds`` histogram (cheap: per-phase, not per-rep);
+    additionally emits a trace span when tracing is enabled. Wraps
+    :class:`tpu_stencil.utils.timing.Timer` (``label`` field) rather
+    than forking it — one stopwatch implementation in the repo.
+    """
+
+    __slots__ = ("name", "cat", "args", "_span", "_timer")
+
+    def __init__(self, name: str, cat: str = "driver", **args):
+        self.name, self.cat, self.args = name, cat, args
+
+    def __enter__(self):
+        self._span = span(self.name, self.cat, **self.args)
+        self._span.__enter__()
+        self._timer = Timer(label=self.name).__enter__()
+        return self._span
+
+    def __exit__(self, *exc) -> None:
+        self._timer.__exit__(*exc)
+        registry().histogram(f"phase_{self.name}_seconds").observe(
+            self._timer.elapsed
+        )
+        self._span.__exit__(*exc)

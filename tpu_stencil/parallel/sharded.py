@@ -440,6 +440,58 @@ class ShardedRunner:
         else:
             self._mask = None
 
+    def _phase_probes(self):
+        """Two compile-once probe programs over this runner's mesh:
+        ``exchange_only(img)`` runs just the halo exchange (ghosts
+        cropped back off, so specs match), ``interior_only(img)`` runs
+        just the local stencil with a tile-local zero pad instead of
+        communication. Neither donates — they run on the warmed-up input
+        without consuming it."""
+        plan = self.model.plan
+        halo = plan.halo
+        r = self.mesh.shape[ROWS_AXIS]
+        c = self.mesh.shape[COLS_AXIS]
+        axes = ((ROWS_AXIS, r, 0), (COLS_AXIS, c, 1))
+        spec = (
+            P(ROWS_AXIS, COLS_AXIS) if self.channels == 1
+            else P(ROWS_AXIS, COLS_AXIS, None)
+        )
+        boundary = self.boundary
+
+        def exchange_only(tile):
+            ext = halo_exchange(tile, halo, axes, boundary)
+            return ext[halo:halo + tile.shape[0], halo:halo + tile.shape[1]]
+
+        def interior_only(tile):
+            return _lowering.padded_step(tile, plan, boundary)
+
+        def build(f):
+            return jax.jit(shard_map(
+                f, mesh=self.mesh, in_specs=(spec,), out_specs=spec,
+            ))
+
+        return build(exchange_only), build(interior_only)
+
+    def trace_phase_probes(self, img_dev: jax.Array) -> None:
+        """Emit ``sharded.halo_exchange`` / ``sharded.interior_compute``
+        spans: one measured execution each of the probe programs (each
+        compiled untimed first, so attribution is execution, not
+        compilation). The per-rep comm-vs-compute split the fused
+        production program hides inside XLA's overlap scheduler —
+        trace-time only; the timed compute window never runs these."""
+        from tpu_stencil import obs
+
+        if not obs.enabled() or self.model.plan.halo < 1:
+            return
+        exchange_fn, interior_fn = self._phase_probes()
+        with obs.span("sharded.probe_compile", "sharded") as s:
+            s.fence(exchange_fn(img_dev))
+            s.fence(interior_fn(img_dev))
+        with obs.span("sharded.halo_exchange", "sharded") as s:
+            s.fence(exchange_fn(img_dev))
+        with obs.span("sharded.interior_compute", "sharded") as s:
+            s.fence(interior_fn(img_dev))
+
     def put(self, img: np.ndarray) -> jax.Array:
         """Pad to the tile grid and shard over the mesh — the analog of every
         rank loading its rows (``mpi/mpi_convolution.c:126-141``); with one

@@ -14,8 +14,13 @@ from __future__ import annotations
 import argparse
 import json
 import sys
+import time
 
 import numpy as np
+
+# --stats-json payload schema. 1 = the PR-1 report dict plus the
+# schema_version/ts fields themselves. Bump on breaking shape changes.
+STATS_SCHEMA_VERSION = 1
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -59,7 +64,17 @@ def build_parser() -> argparse.ArgumentParser:
                    help="force the JAX platform before backend init")
     p.add_argument("--stats-json", default=None, metavar="PATH",
                    help="dump the report + metrics registry snapshot as "
-                        "JSON to PATH ('-' = stdout)")
+                        "JSON to PATH ('-' = stdout); versioned schema "
+                        "(schema_version + monotonic ts fields)")
+    p.add_argument("--trace", default=None, metavar="PATH",
+                   help="span tracing (tpu_stencil.obs): write a Chrome "
+                        "trace-event JSON of the serve pipeline (enqueue/"
+                        "batch-form/cache/execute/drain spans, one track "
+                        "per thread) to PATH; works with --self-test too")
+    p.add_argument("--metrics-text", default=None, metavar="PATH",
+                   help="write the server's metrics registry as "
+                        "Prometheus-style text exposition to PATH "
+                        "('-' = stdout)")
     return p
 
 
@@ -67,16 +82,24 @@ def _parse_shapes(parser, value):
     out = []
     for part in value.split(","):
         h, sep, w = part.strip().lower().partition("x")
-        if not sep or not h.isdigit() or not w.isdigit():
-            parser.error(f"--shapes must be HxW[,HxW...], got {value!r}")
+        # "0".isdigit() is True: zero dims must die here as a usage error,
+        # not as a bucketing traceback out of the worker thread.
+        if (not sep or not h.isdigit() or not w.isdigit()
+                or int(h) < 1 or int(w) < 1):
+            parser.error(
+                f"--shapes must be HxW[,HxW...] with positive integers, "
+                f"got {value!r}"
+            )
         out.append((int(h), int(w)))
     return tuple(out)
 
 
-def self_test() -> int:
+def self_test(metrics_text=None) -> int:
     """Deterministic smoke: golden-model exactness over mixed shapes and
     channel counts (including a 1-pixel image and an oversized-vs-ladder
-    request), cache reuse, and backpressure rejection."""
+    request), cache reuse, and backpressure rejection. ``metrics_text``:
+    write the correctness server's registry as text exposition (the
+    ``--metrics-text`` flag works under ``--self-test`` too)."""
     from tpu_stencil import filters
     from tpu_stencil.config import ServeConfig
     from tpu_stencil.ops import stencil
@@ -103,6 +126,11 @@ def self_test() -> int:
                       f"reps={reps} mismatch", file=sys.stderr)
                 return 1
         stats = server.stats()
+    if metrics_text:
+        from tpu_stencil.obs import exposition
+
+        exposition.write_text(metrics_text, stats,
+                              prefix="tpu_stencil_serve")
     if stats["counters"]["cache_hits_total"] < 1:
         print("serve self-test FAILED: no executable-cache hit",
               file=sys.stderr)
@@ -130,6 +158,14 @@ def self_test() -> int:
     return 0
 
 
+def _export_trace(path: str) -> None:
+    from tpu_stencil import obs
+
+    wrote = obs.export.write_chrome_trace(path, obs.get_tracer())
+    if wrote:
+        print(f"wrote trace {wrote}")
+
+
 def main(argv=None) -> int:
     parser = build_parser()
     ns = parser.parse_args(argv)
@@ -137,8 +173,21 @@ def main(argv=None) -> int:
         import jax
 
         jax.config.update("jax_platforms", ns.platform)
+    if ns.trace:
+        from tpu_stencil import obs
+
+        obs.enable()
     if ns.self_test:
-        return self_test()
+        try:
+            rc = self_test(metrics_text=ns.metrics_text)
+            if ns.trace:
+                _export_trace(ns.trace)
+            return rc
+        finally:
+            if ns.trace:
+                from tpu_stencil import obs
+
+                obs.disable()
 
     from tpu_stencil.config import ServeConfig
     from tpu_stencil.serve import loadgen
@@ -158,12 +207,25 @@ def main(argv=None) -> int:
         )
     except ValueError as e:
         parser.error(str(e))
-    with StencilServer(cfg) as server:
-        report = loadgen.run(
-            server, mode=ns.mode, requests=ns.requests,
-            concurrency=ns.concurrency, rate=ns.rate, reps=ns.reps,
-            shapes=shapes, channels=channels, seed=ns.seed,
-        )
+    try:
+        with StencilServer(cfg) as server:
+            report = loadgen.run(
+                server, mode=ns.mode, requests=ns.requests,
+                concurrency=ns.concurrency, rate=ns.rate, reps=ns.reps,
+                shapes=shapes, channels=channels, seed=ns.seed,
+            )
+        if ns.trace:
+            _export_trace(ns.trace)
+    finally:
+        if ns.trace:
+            from tpu_stencil import obs
+
+            obs.disable()
+    if ns.metrics_text:
+        from tpu_stencil.obs import exposition
+
+        exposition.write_text(ns.metrics_text, report["stats"],
+                              prefix="tpu_stencil_serve")
     c = report["stats"]["counters"]
     print(
         f"served {report['completed']}/{report['requests']} requests "
@@ -178,6 +240,12 @@ def main(argv=None) -> int:
         f"padded_waste={c['padded_pixels_total']}px"
     )
     if ns.stats_json:
+        # Versioned schema: consumers (tools/bench_capture.py, dashboards)
+        # dispatch on schema_version instead of guessing from key shape;
+        # ts is monotonic so within-process captures order totally even
+        # across wall-clock adjustments.
+        report["schema_version"] = STATS_SCHEMA_VERSION
+        report["ts"] = time.monotonic()
         payload = json.dumps(report, indent=2, sort_keys=True)
         if ns.stats_json == "-":
             print(payload)

@@ -45,8 +45,28 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from tpu_stencil.config import ServeConfig
+from tpu_stencil.obs import span as _obs_span
 from tpu_stencil.serve import bucketing
 from tpu_stencil.serve.metrics import Registry
+
+
+def _resolve(fut: "concurrent.futures.Future", value=None,
+             exc: Optional[BaseException] = None) -> bool:
+    """Resolve ``fut`` with a result (or exception), tolerating a client
+    cancel that lands between a ``done()`` check and the set: futures are
+    never moved to RUNNING, so ``cancel()`` can win that race at any
+    moment, and an unguarded ``set_result`` would raise
+    InvalidStateError — which the worker loop's catch-all would then
+    spread as a failure onto the whole batch. Returns True when the
+    future actually took the value."""
+    try:
+        if exc is not None:
+            fut.set_exception(exc)
+        else:
+            fut.set_result(value)
+        return True
+    except concurrent.futures.InvalidStateError:
+        return False  # cancelled (or already resolved); drop silently
 
 
 class QueueFull(RuntimeError):
@@ -157,9 +177,15 @@ class _ExecutableCache:
         if entry is not None:
             self._hits.inc()
             self._entries.move_to_end(key)
+            with _obs_span("serve.cache_hit", "serve"):
+                pass  # zero-duration marker: this dispatch reused a program
             return entry
         self._misses.inc()
-        entry = self._entries[key] = builder()
+        # The miss span covers the builder, so the trace shows what a cold
+        # key costs (jit wrapper construction; first-call compile lands
+        # inside the batch's execute span).
+        with _obs_span("serve.cache_miss", "serve"):
+            entry = self._entries[key] = builder()
         while len(self._entries) > self._cap:
             self._entries.popitem(last=False)
             self._evictions.inc()
@@ -255,7 +281,7 @@ class StencilServer:
             self._m_depth.set(0)
         for r in leftovers:
             if not r.future.done():
-                r.future.set_exception(ServerClosed("server closed"))
+                _resolve(r.future, exc=ServerClosed("server closed"))
 
     def __enter__(self) -> "StencilServer":
         return self
@@ -272,11 +298,7 @@ class StencilServer:
         uint8 array (same shape as ``image``). Raises :class:`QueueFull`
         when the queue is at capacity and :class:`ServerClosed` after
         ``close()``."""
-        # Defensive copy: canvas assembly happens later on the worker
-        # thread, so a caller reusing its buffer (the frame-loop pattern)
-        # must not corrupt an already-queued request. Mirrors the model's
-        # __call__ copy discipline.
-        image = np.array(image, copy=True)
+        image = np.asarray(image)  # no copy yet: validate + gate first
         if image.dtype != np.uint8:
             raise ValueError(f"image must be uint8, got {image.dtype}")
         if image.ndim not in (2, 3):
@@ -285,6 +307,17 @@ class StencilServer:
             )
         if reps < 0:
             raise ValueError(f"reps must be >= 0, got {reps}")
+        # Fast-path reject before the defensive copy: overload (the exact
+        # scenario backpressure exists for) must not pay an O(H*W*C) copy
+        # per shed request. The check repeats under the lock at append
+        # time — this one only decides whether the copy is worth making.
+        with self._cond:
+            self._gate_locked()
+        # Defensive copy: canvas assembly happens later on the worker
+        # thread, so a caller reusing its buffer (the frame-loop pattern)
+        # must not corrupt an already-queued request. Mirrors the model's
+        # __call__ copy discipline.
+        image = np.array(image, copy=True)
         fname = filter_name or self.cfg.filter_name
         h, w = image.shape[:2]
         channels = image.shape[2] if image.ndim == 3 else 1
@@ -299,19 +332,26 @@ class StencilServer:
             filter_name=fname, key=key, bucket_hw=bucket_hw, future=fut,
             t_submit=time.perf_counter(),
         )
-        with self._cond:
-            if self._closing:
-                raise ServerClosed("server is closed")
-            if len(self._pending) >= self.cfg.max_queue:
-                self._m_rejected.inc()
-                raise QueueFull(
-                    f"queue full ({self.cfg.max_queue} pending); retry later"
-                )
-            self._pending.append(req)
-            self._m_requests.inc()
-            self._m_depth.set(len(self._pending))
-            self._cond.notify()
+        with _obs_span("serve.enqueue", "serve", req_id=req.req_id):
+            with self._cond:
+                self._gate_locked()  # authoritative: at append time
+                self._pending.append(req)
+                self._m_requests.inc()
+                self._m_depth.set(len(self._pending))
+                self._cond.notify()
         return fut
+
+    def _gate_locked(self) -> None:
+        """Admission gate (caller holds the lock): raises
+        :class:`ServerClosed` / :class:`QueueFull` (counted) when the
+        request must not enter."""
+        if self._closing:
+            raise ServerClosed("server is closed")
+        if len(self._pending) >= self.cfg.max_queue:
+            self._m_rejected.inc()
+            raise QueueFull(
+                f"queue full ({self.cfg.max_queue} pending); retry later"
+            )
 
     # -- introspection -------------------------------------------------
 
@@ -329,17 +369,18 @@ class StencilServer:
         followers. O(pending) scan — pending is bounded by max_queue."""
         if not self._pending:
             return []
-        key = self._pending[0].key
-        batch: List[Request] = []
-        kept: "collections.deque[Request]" = collections.deque()
-        while self._pending:
-            r = self._pending.popleft()
-            if r.key == key and len(batch) < self.cfg.max_batch:
-                batch.append(r)
-            else:
-                kept.append(r)
-        self._pending = kept
-        self._m_depth.set(len(self._pending))
+        with _obs_span("serve.batch_form", "serve"):
+            key = self._pending[0].key
+            batch: List[Request] = []
+            kept: "collections.deque[Request]" = collections.deque()
+            while self._pending:
+                r = self._pending.popleft()
+                if r.key == key and len(batch) < self.cfg.max_batch:
+                    batch.append(r)
+                else:
+                    kept.append(r)
+            self._pending = kept
+            self._m_depth.set(len(self._pending))
         return batch
 
     def _model_for(self, filter_name: str):
@@ -357,6 +398,11 @@ class StencilServer:
         """Assemble the padded canvas and launch the bucket executable
         (async under JAX dispatch). Returns the retire closure's state:
         (batch, out_dev, true_shapes, t_start)."""
+        with _obs_span("serve.execute", "serve", batch=len(batch),
+                       reps=batch[0].reps):
+            return self._dispatch_inner(batch)
+
+    def _dispatch_inner(self, batch: List[Request]):
         import jax
         import jax.numpy as jnp
 
@@ -407,6 +453,10 @@ class StencilServer:
     def _retire(self, batch, out_dev, meta, t0) -> None:
         """Block on one in-flight batch, crop per-request outputs, resolve
         futures, record latency + achieved-bandwidth metrics."""
+        with _obs_span("serve.drain", "serve", batch=len(batch)):
+            self._retire_inner(batch, out_dev, meta, t0)
+
+    def _retire_inner(self, batch, out_dev, meta, t0) -> None:
         bh, bw, channels, nb, backend = meta
         out = np.asarray(out_dev)  # blocks until the device is done
         t1 = time.perf_counter()
@@ -416,9 +466,14 @@ class StencilServer:
         if reps > 0:
             from tpu_stencil.runtime import roofline
 
+            # fuse=1: the bucket executable applies the (vmapped) step
+            # once per rep — it never runs the fused-chunk kernel, so the
+            # default-fuse traffic divisor would under-report achieved
+            # bandwidth by DEFAULT_FUSE x whenever the backend resolves
+            # to pallas.
             gbps, _pct = roofline.achieved_frames(
                 bh * bw * channels, nb, (t1 - t0) / reps, backend,
-                batch[0].filter_name, bh,
+                batch[0].filter_name, bh, fuse=1,
             )
             self._m_gbps.observe(gbps)
         for i, r in enumerate(batch):
@@ -426,8 +481,8 @@ class StencilServer:
             # A client may have cancelled its (still-pending) future; the
             # result is simply dropped — one cancellation must never
             # poison its batch-mates' results.
-            if not r.future.done():
-                r.future.set_result(out[i, :h, :w].copy())
+            if not r.future.done() and _resolve(
+                    r.future, out[i, :h, :w].copy()):
                 self._m_completed.inc()
                 self._m_rlat.observe(t1 - r.t_submit)
 
@@ -446,8 +501,7 @@ class StencilServer:
                     self._m_inflight.set(len(inflight))
                 except Exception as e:  # resolve, don't kill the loop
                     for r in batch:
-                        if not r.future.done():
-                            r.future.set_exception(e)
+                        if not r.future.done() and _resolve(r.future, exc=e):
                             self._m_failed.inc()
             # Retire when the pipeline is full (keeps depth bounded) or
             # when there is nothing new to overlap with.
@@ -459,8 +513,7 @@ class StencilServer:
                     self._retire(done_batch, out_dev, meta, t0)
                 except Exception as e:
                     for r in done_batch:
-                        if not r.future.done():
-                            r.future.set_exception(e)
+                        if not r.future.done() and _resolve(r.future, exc=e):
                             self._m_failed.inc()
                 self._m_inflight.set(len(inflight))
                 if batch:
@@ -473,7 +526,7 @@ class StencilServer:
                     leftovers = list(self._pending)
                     self._pending.clear()
                 for r in leftovers:
-                    r.future.set_exception(ServerClosed("server closed"))
+                    _resolve(r.future, exc=ServerClosed("server closed"))
                 return
 
 

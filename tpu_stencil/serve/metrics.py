@@ -98,14 +98,22 @@ class Histogram:
                 if j < self._cap:
                     self._values[j] = v
 
+    @staticmethod
+    def _nearest_rank(vals: List[float], p: float) -> float:
+        k = min(len(vals) - 1, max(0, int(round(p / 100.0 * (len(vals) - 1)))))
+        return vals[k]
+
     def percentile(self, p: float) -> float:
-        """Nearest-rank percentile over the reservoir (0 when empty)."""
+        """Nearest-rank percentile over the reservoir. Pinned edge cases
+        (tests/test_serve.py): empty histogram -> 0.0 (a scrape before
+        first traffic must render, not raise); a single sample is every
+        percentile; past ``cap`` the rank is over the reservoir while
+        count/sum/max stay exact."""
         with self._lock:
             if not self._values:
                 return 0.0
             vals = sorted(self._values)
-        k = min(len(vals) - 1, max(0, int(round(p / 100.0 * (len(vals) - 1)))))
-        return vals[k]
+        return self._nearest_rank(vals, p)
 
     @property
     def count(self) -> int:
@@ -118,13 +126,20 @@ class Histogram:
             return self._sum
 
     def snapshot(self) -> Dict[str, float]:
+        """One consistent view: every field reads under a single lock
+        acquisition, so a snapshot taken mid-burst can never pair rep
+        k's count with rep k+1's sum/max (the old per-property reads
+        could, and read ``max`` with no lock at all)."""
+        with self._lock:
+            count, total, mx = self._count, self._sum, self._max
+            vals = sorted(self._values)
         return {
-            "count": self.count,
-            "sum": self.sum,
-            "mean": (self.sum / self.count) if self.count else 0.0,
-            "p50": self.percentile(50),
-            "p99": self.percentile(99),
-            "max": self._max,
+            "count": count,
+            "sum": total,
+            "mean": (total / count) if count else 0.0,
+            "p50": self._nearest_rank(vals, 50) if vals else 0.0,
+            "p99": self._nearest_rank(vals, 99) if vals else 0.0,
+            "max": mx,
         }
 
 

@@ -11,7 +11,7 @@ processes for multi-host runs.
 from __future__ import annotations
 
 import time
-from typing import Any, Callable, Tuple
+from typing import Any, Callable, Optional, Tuple
 
 import jax
 
@@ -21,11 +21,19 @@ class Timer:
 
     ``elapsed`` is live: read inside the ``with`` block it returns the time
     accumulated so far (a return statement inside the block sees real time,
-    not 0), after exit it is frozen at the block's duration.
+    not 0), after exit it is frozen at the block's duration. Read before the
+    context is ever entered it raises :class:`RuntimeError` — an un-entered
+    timer has no elapsed time, and silently returning 0.0 turned a missing
+    ``with`` into a plausible-looking measurement.
+
+    ``label`` names what is being timed (``tpu_stencil.obs`` spans wrap a
+    labeled Timer rather than forking the stopwatch); it appears in the
+    unentered-read error so the broken call site is findable.
     """
 
-    def __init__(self) -> None:
-        self._start: float = 0.0
+    def __init__(self, label: Optional[str] = None) -> None:
+        self.label = label
+        self._start: Optional[float] = None
         self._frozen: float = -1.0
 
     def __enter__(self) -> "Timer":
@@ -40,9 +48,13 @@ class Timer:
     def elapsed(self) -> float:
         if self._frozen >= 0.0:
             return self._frozen
-        if self._start:
+        if self._start is not None:
             return time.perf_counter() - self._start
-        return 0.0
+        what = f"Timer({self.label!r})" if self.label else "Timer"
+        raise RuntimeError(
+            f"{what}.elapsed read before the context was entered; "
+            "use 'with Timer() as t: ...' and read t.elapsed inside or after"
+        )
 
 
 def max_across_processes(seconds: float) -> float:
