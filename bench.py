@@ -30,6 +30,11 @@ self-contained capture; the LAST line is the most complete one —
 consumers should parse the last non-empty line.
   {"metric": ..., "value": seconds, "unit": "s", "vs_baseline": speedup, ...}
 where vs_baseline = 1.017 / value (>1 means faster than the GTX-970).
+
+Exit codes: 0 = capture landed (even partial-only); 1 = nothing
+parseable; 3 = the perf sentry (tpu_stencil.obs.sentry) gated a
+regression against the capture history — the capture still streamed,
+and TPU_STENCIL_BENCH_SENTRY=warn|off softens the gate.
 """
 
 from __future__ import annotations
@@ -248,6 +253,12 @@ def _capture_line(per_rep_s: float, backend: str, platform: str,
         "hbm_gbps": round(gbps, 1),
         "pct_hbm_peak": round(pct, 1),
         "platform": platform,
+        # Explicit key fields so the perf sentry (tpu_stencil.obs.sentry)
+        # never has to re-parse the metric name; additive, schema 1.
+        "shape": f"{W}x{H}",
+        "reps": REPS,
+        "filter": "gaussian",
+        "dtype": "uint8",
         # Versioned captures: consumers (tools/bench_capture.py,
         # dashboards) dispatch on schema_version instead of guessing from
         # key shape; ts is monotonic, so captures within one process
@@ -545,6 +556,44 @@ def _rows_roll_probe(primary_line: str) -> str:
         return primary_line
 
 
+def _sentry_gate(final_line: str) -> int:
+    """Perf-regression sentry hook: append the round's full capture to
+    the persistent history and gate it against the same-key baseline
+    (tpu_stencil.obs.sentry; median of the last K runs). Returns the
+    extra exit code (3 = gated regression) or 0.
+
+    Scope rules: ``TPU_STENCIL_BENCH_SENTRY`` = gate (default) | warn |
+    off. Partial (early-line-only) captures are never logged — they are
+    default-path numbers that would drag the baseline median toward the
+    untuned config. CPU smoke runs never touch the hardware history
+    unless ``TPU_STENCIL_PERF_HISTORY`` points the sentry elsewhere (the
+    hook tests do). The check runs BEFORE the append, so a run never
+    dilutes its own baseline. Any sentry failure is logged and ignored —
+    the official capture already streamed, and the sentry must never
+    cost a round its number."""
+    mode = os.environ.get("TPU_STENCIL_BENCH_SENTRY", "gate")
+    if mode == "off":
+        return 0
+    try:
+        obj = json.loads(final_line)
+        if obj.get("partial"):
+            return 0
+        if (obj.get("platform") not in ("tpu", "axon")
+                and not os.environ.get("TPU_STENCIL_PERF_HISTORY")):
+            return 0
+        from tpu_stencil.obs import sentry
+
+        rec = sentry.record_from_capture(obj, source="bench")
+        verdict = sentry.check(rec)
+        sentry.append(rec)
+        log(sentry.render_verdict(verdict))
+        if verdict["status"] == "regression" and mode == "gate":
+            return 3
+    except Exception as e:
+        log(f"perf sentry skipped ({type(e).__name__}: {e})")
+    return 0
+
+
 def main() -> int:
     if os.environ.get("TPU_STENCIL_BENCH_CHILD") == "1":
         return child_main()
@@ -572,7 +621,7 @@ def main() -> int:
             final = _rows_roll_probe(lines[-1])
             if final != lines[-1]:  # already streamed; print only new info
                 print(final, flush=True)
-            return 0
+            return _sentry_gate(final)
         log(f"attempt {attempt}: rc={rc}")
         if attempt < ATTEMPTS - 1:
             backoffs = _backoffs()

@@ -1,0 +1,454 @@
+"""Compiled-artifact introspection, device-memory telemetry, sentry.
+
+Acceptance contract (ISSUE 3): introspection degrades to "unavailable"
+— ``memory_stats()`` None on CPU, ``cost_analysis()`` missing/renamed
+keys, broken lowering — without ever raising into a compute path; the
+``--breakdown`` table shows XLA bytes-accessed vs the analytic model's
+with an agreement %; all new memory/introspection gauges survive the
+``--metrics-text`` exact parse round-trip; and ``perf check`` exits
+nonzero on a 2x same-key slowdown, zero on a within-threshold run, and
+"no-baseline" (zero, ungated) on empty/short history.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from tpu_stencil import obs
+from tpu_stencil.io import raw as raw_io
+from tpu_stencil.obs import introspect, sentry
+
+
+@pytest.fixture(autouse=True)
+def _fresh_obs():
+    obs.reset()
+    yield
+    obs.reset()
+
+
+# -- guarded extraction (graceful degradation) -------------------------
+
+
+class _Broken:
+    def cost_analysis(self):
+        raise RuntimeError("backend says no")
+
+    def memory_analysis(self):
+        raise RuntimeError("backend says no")
+
+
+class _DictShaped:
+    """Newer-JAX shapes: cost dict (renamed keys), memory dict."""
+
+    def cost_analysis(self):
+        return {"bytes_accessed": 128.0, "flops": 64.0, "weird": object()}
+
+    def memory_analysis(self):
+        return {"temp_size_in_bytes": 5, "argument_size_in_bytes": 7,
+                "unrelated": "x"}
+
+
+class _ListShaped:
+    """jax<=0.4.x: one-element list of dicts, space-separated keys."""
+
+    def cost_analysis(self):
+        return [{"bytes accessed": 256.0, "flops": 32.0}]
+
+    def memory_analysis(self):
+        return None
+
+
+def test_cost_analysis_guarded_across_shapes():
+    assert introspect.cost_analysis(_Broken()) is None
+    assert introspect.cost_analysis(object()) is None  # no method at all
+    d = introspect.cost_analysis(_DictShaped())
+    # Renamed key normalized onto the canonical spelling.
+    assert d["bytes accessed"] == 128.0 and d["flops"] == 64.0
+    lst = introspect.cost_analysis(_ListShaped())
+    assert lst["bytes accessed"] == 256.0
+
+
+def test_memory_analysis_guarded():
+    assert introspect.memory_analysis(_Broken()) is None
+    assert introspect.memory_analysis(_ListShaped()) is None  # returns None
+    assert introspect.memory_analysis(object()) is None
+    m = introspect.memory_analysis(_DictShaped())
+    assert m == {"temp_size_in_bytes": 5, "argument_size_in_bytes": 7}
+
+
+def test_device_memory_stats_unavailable_on_cpu():
+    # The test harness pins the CPU backend, whose allocator reports no
+    # stats: both probes must degrade to None/no-gauges, never raise.
+    assert introspect.device_memory_stats() is None
+    from tpu_stencil.serve.metrics import Registry
+
+    reg = Registry()
+    assert introspect.record_memory_gauges(reg) is None
+    assert reg.snapshot()["gauges"] == {}
+
+
+def test_memory_sampler_never_starts_without_stats():
+    from tpu_stencil.serve.engine import _MemorySampler
+    from tpu_stencil.serve.metrics import Registry
+
+    s = _MemorySampler(Registry(), 0.01)
+    assert s.start() is False  # CPU: unavailable, no thread
+    assert _MemorySampler(Registry(), 0.0).start() is False  # disabled
+    s.stop()  # idempotent, no thread to join
+
+
+# -- capture -----------------------------------------------------------
+
+
+def test_capture_disabled_returns_none():
+    import jax
+
+    assert not introspect.enabled()
+    assert introspect.capture("x", jax.jit(lambda v: v), 1.0) is None
+    assert introspect.records() == []
+
+
+def test_capture_records_cost_and_compile_time():
+    import jax
+    import jax.numpy as jnp
+
+    introspect.enable()
+    rec = introspect.capture(
+        "unit.test", jax.jit(lambda v: v * 2 + 1),
+        jnp.ones((8, 8), jnp.float32), meta={"case": "basic"},
+    )
+    assert rec["available"] and rec["error"] is None
+    assert rec["compile_seconds"] > 0
+    assert rec["bytes_accessed"] > 0 and rec["flops"] > 0
+    assert rec["memory"]["output_size_in_bytes"] > 0
+    assert introspect.records()[-1]["meta"] == {"case": "basic"}
+    # Gauges landed in the driver registry and survive the exposition
+    # round-trip (acceptance: new gauges never fall out of the text).
+    from tpu_stencil.obs import exposition
+
+    snap = obs.snapshot()
+    assert snap["gauges"]["introspect_unit_test_xla_bytes_accessed"][
+        "value"] > 0
+    assert snap["counters"]["introspect_unit_test_captures_total"] == 1
+    text = exposition.render_text(snap, prefix="tpu_stencil_driver")
+    assert exposition.parse_text(text, prefix="tpu_stencil_driver") == snap
+
+
+def test_capture_wraps_unjitted_callables():
+    introspect.enable()
+    rec = introspect.capture("unit.plain", lambda v: v + 1, 2.0)
+    assert rec["available"]
+
+
+def test_capture_never_raises_on_untraceable():
+    introspect.enable()
+
+    def boom(v):
+        raise ValueError("not traceable at all")
+
+    rec = introspect.capture("unit.broken", boom, 1.0)
+    assert rec is not None and not rec["available"]
+    assert "ValueError" in rec["error"]
+
+
+def test_capture_hlo_dump(tmp_path):
+    import jax
+    import jax.numpy as jnp
+
+    introspect.enable(hlo_dir=str(tmp_path / "hlo"))
+    rec = introspect.capture("unit.dump", jax.jit(lambda v: v + 1),
+                             jnp.zeros((4,), jnp.float32))
+    assert rec["hlo_path"] and "HloModule" in open(rec["hlo_path"]).read()
+
+
+def test_reset_clears_records_and_disarms():
+    introspect.enable()
+    introspect.capture("unit.r", lambda v: v, 1.0)
+    assert introspect.records()
+    obs.reset()
+    assert introspect.records() == [] and not introspect.enabled()
+
+
+# -- cross-check -------------------------------------------------------
+
+
+def test_cross_check_agreement_and_drift():
+    ok = {"site": "s", "bytes_accessed": 1000.0}
+    introspect.cross_check(ok, 900.0)
+    assert ok["model_vs_xla_pct"] == pytest.approx(90.0)
+    assert ok["drift"] is False
+    drifted = {"site": "s", "bytes_accessed": 1000.0}
+    introspect.cross_check(drifted, 100.0)  # 10%: outside the 2x band
+    assert drifted["drift"] is True
+    # No XLA bytes (degraded capture): annotates None, never raises.
+    empty = {"site": "s", "bytes_accessed": None}
+    introspect.cross_check(empty, 100.0)
+    assert empty["model_vs_xla_pct"] is None and empty["drift"] is None
+
+
+def test_analytic_bytes_matches_achieved_numerator():
+    from tpu_stencil.runtime import roofline
+
+    b = roofline.analytic_bytes_per_rep(9216, "xla", "gaussian", 64)
+    assert b == 2 * 9216
+    gbps, _ = roofline.achieved(9216, 1e-3, "xla", "gaussian", 64)
+    assert gbps == pytest.approx(b / 1e-3 / 1e9)
+
+
+# -- driver / CLI integration (acceptance: --breakdown) ----------------
+
+
+def _write_raw(tmp_path, rng, h, w, c):
+    img = rng.integers(0, 256, size=(h, w, c), dtype=np.uint8)
+    p = str(tmp_path / "in.raw")
+    raw_io.write_raw(p, img)
+    return p
+
+
+def test_cli_breakdown_shows_introspection_and_memory(tmp_path, rng, capsys):
+    from tpu_stencil import cli
+
+    p = _write_raw(tmp_path, rng, 12, 10, 3)
+    rc = cli.main([p, "10", "12", "3", "rgb", "--backend", "xla",
+                   "--breakdown"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    # The acceptance surface: XLA bytes-accessed vs analytic-model bytes
+    # with an agreement/efficiency %, plus the device-memory line
+    # (explicitly "unavailable" on the CPU test harness).
+    assert "compiled artifacts (XLA introspection)" in out
+    assert "xla MB/rep" in out and "model MB/rep" in out
+    assert "model/xla" in out and "%" in out
+    assert "device memory: unavailable" in out
+    assert not introspect.enabled()  # CLI tears introspection down
+
+
+def test_driver_warmup_capture_single_device(tmp_path, rng):
+    import jax
+
+    from tpu_stencil import driver
+    from tpu_stencil.config import ImageType, JobConfig
+
+    p = _write_raw(tmp_path, rng, 8, 6, 1)
+    introspect.enable()
+    driver.run_job(JobConfig(p, 6, 8, 2, ImageType.GREY, backend="xla"),
+                   devices=jax.devices()[:1])
+    sites = [r["site"] for r in introspect.records()]
+    assert sites == ["driver.warmup"]
+    assert introspect.records()[0]["available"]
+
+
+def test_sharded_capture_and_metrics_roundtrip(tmp_path, rng):
+    import jax
+
+    from tpu_stencil import driver
+    from tpu_stencil.config import ImageType, JobConfig
+    from tpu_stencil.obs import exposition
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 virtual devices")
+    p = _write_raw(tmp_path, rng, 16, 16, 1)
+    introspect.enable()
+    driver.run_job(
+        JobConfig(p, 16, 16, 2, ImageType.GREY, backend="xla",
+                  mesh_shape=(2, 2)),
+        devices=jax.devices()[:4],
+    )
+    (rec,) = introspect.records()
+    assert rec["site"] == "sharded.iterate" and rec["available"]
+    assert rec["meta"]["mesh"] == (2, 2)
+    snap = obs.snapshot()
+    assert "introspect_sharded_iterate_compile_seconds" in snap["gauges"]
+    text = exposition.render_text(snap, prefix="tpu_stencil_driver")
+    assert exposition.parse_text(text, prefix="tpu_stencil_driver") == snap
+
+
+def test_metrics_text_notes_roundtrip():
+    from tpu_stencil.obs import exposition
+    from tpu_stencil.serve.metrics import Registry
+
+    reg = Registry()
+    # Simulate a TPU-shaped memory snapshot: the gauge names the driver
+    # and the serve sampler emit must round-trip exactly.
+    reg.gauge("device_bytes_in_use").set(123456789)
+    reg.gauge("device_peak_bytes_in_use").set(223456789)
+    reg.gauge("device_bytes_limit").set(17179869184)
+    snap = reg.snapshot()
+    text = exposition.render_text(
+        snap, prefix="tpu_stencil_driver",
+        notes=("a note the parser must ignore",),
+    )
+    assert text.startswith("# NOTE a note")
+    assert exposition.parse_text(text, prefix="tpu_stencil_driver") == snap
+
+
+# -- serve integration -------------------------------------------------
+
+
+def test_serve_per_entry_introspection():
+    from tpu_stencil.config import ServeConfig
+    from tpu_stencil.obs import exposition
+    from tpu_stencil.serve.engine import StencilServer
+
+    rng = np.random.default_rng(11)
+    introspect.enable()
+    with StencilServer(ServeConfig(max_queue=16, max_batch=4,
+                                   bucket_edges=(8, 16, 32))) as server:
+        imgs = [rng.integers(0, 256, (10, 8, 3), dtype=np.uint8),
+                rng.integers(0, 256, (17, 23), dtype=np.uint8),
+                rng.integers(0, 256, (10, 8, 3), dtype=np.uint8)]
+        for img in imgs:
+            server.submit(img, 2).result(timeout=300)
+        stats = server.stats()
+        recs = server.introspection()
+    # Two distinct cache keys -> exactly two captures (the repeat shape
+    # is a cache hit, never a second AOT compile).
+    assert stats["introspected_executables"] == 2
+    assert len(recs) == 2 and all(r["available"] for r in recs)
+    assert {r["meta"]["channels"] for r in recs} == {1, 3}
+    # Gauges live in the SERVER registry and round-trip its exposition.
+    assert "introspect_serve_bucket_compile_seconds" in stats["gauges"]
+    text = exposition.render_text(stats, prefix="tpu_stencil_serve")
+    assert exposition.parse_text(text, prefix="tpu_stencil_serve") == stats
+
+
+def test_serve_untraced_pays_no_introspection():
+    from tpu_stencil.config import ServeConfig
+    from tpu_stencil.serve.engine import StencilServer
+
+    rng = np.random.default_rng(12)
+    with StencilServer(ServeConfig(max_queue=8, max_batch=2,
+                                   bucket_edges=(8, 16))) as server:
+        server.submit(
+            rng.integers(0, 256, (8, 8), dtype=np.uint8), 1
+        ).result(timeout=300)
+        stats = server.stats()
+    assert stats["introspected_executables"] == 0
+    assert introspect.records() == []
+
+
+# -- sentry ------------------------------------------------------------
+
+
+def _rec(value, *, shape="64x48", backend="xla", fuse=None, **kw):
+    return sentry.make_record(
+        metric="compute_seconds", value=value, filter_name="gaussian",
+        shape=shape, backend=backend, platform="cpu", fuse=fuse, **kw,
+    )
+
+
+def test_sentry_empty_and_short_history_degrade():
+    assert sentry.baseline([], sentry.record_key(_rec(1.0))) is None
+    v = sentry.check(_rec(1.0), history=[])
+    assert v["status"] == "no-baseline" and v["baseline"] is None
+    # One prior run < MIN_SAMPLES: still ungated.
+    v = sentry.check(_rec(5.0), history=[_rec(1.0)])
+    assert v["status"] == "no-baseline"
+
+
+def test_sentry_regression_ok_and_improvement():
+    hist = [_rec(1.00), _rec(1.02), _rec(0.98)]
+    assert sentry.check(_rec(2.0), history=hist)["status"] == "regression"
+    assert sentry.check(_rec(1.05), history=hist)["status"] == "ok"
+    assert sentry.check(_rec(0.5), history=hist)["status"] == "improvement"
+
+
+def test_sentry_key_separates_series():
+    # A different fuse geometry (or backend) is a different series: a
+    # tuned run must never be "regressed" against by an untuned one.
+    hist = [_rec(1.0, fuse=16), _rec(1.0, fuse=16)]
+    assert sentry.check(_rec(3.0), history=hist)["status"] == "no-baseline"
+    assert sentry.check(
+        _rec(3.0, fuse=16), history=hist)["status"] == "regression"
+
+
+def test_sentry_baseline_is_median_of_last_k():
+    hist = [_rec(9.0)] + [_rec(1.0)] * 5  # old outlier ages out of K=5
+    key = sentry.record_key(_rec(1.0))
+    assert sentry.baseline(hist, key, k=5) == 1.0
+
+
+def test_sentry_load_skips_corrupt_lines(tmp_path):
+    p = tmp_path / "h.jsonl"
+    good = json.dumps(_rec(1.0))
+    p.write_text(f"{good}\nnot json at all\n{{\"value\": null}}\n{good}\n")
+    assert len(sentry.load(str(p))) == 2
+
+
+def test_sentry_record_from_capture_fields_and_fallback():
+    cap = {"metric": "1920x2520_rgb_40reps_compute_wall_clock",
+           "value": 0.8, "backend": "pallas", "platform": "tpu",
+           "shape": "1920x2520", "reps": 40, "hbm_gbps": 600.0,
+           "pallas_block_h": 64, "pallas_fuse": 16}
+    rec = sentry.record_from_capture(cap)
+    assert rec["per_rep_s"] == pytest.approx(0.02)
+    assert rec["block_h"] == 64 and rec["fuse"] == 16
+    assert rec["extra"]["hbm_gbps"] == 600.0
+    # Pre-PR-3 capture: shape/reps recovered from the metric name.
+    old = {"metric": "1920x2520_rgb_40reps_compute_wall_clock",
+           "value": 0.8, "backend": "xla", "platform": "tpu"}
+    rec = sentry.record_from_capture(old)
+    assert rec["shape"] == "1920x2520" and rec["per_rep_s"] == 0.02
+    assert rec["block_h"] is None  # xla: no pallas geometry in the key
+    with pytest.raises(ValueError):
+        sentry.record_from_capture({"metric": "x", "value": None})
+
+
+def test_sentry_cli_round_trip(tmp_path, capsys):
+    # The acceptance smoke: two logged runs, a 2x slowdown fails, a
+    # within-threshold run passes with a report.
+    h = str(tmp_path / "hist.jsonl")
+    base = ["--history", h, "--shape", "64x48"]
+    assert sentry.main(["log"] + base + ["--value", "0.010"]) == 0
+    assert sentry.main(["log"] + base + ["--value", "0.011"]) == 0
+    assert sentry.main(["check"] + base + ["--value", "0.021"]) == 1
+    assert "REGRESSION" in capsys.readouterr().out
+    assert sentry.main(["check"] + base + ["--value", "0.0105"]) == 0
+    assert "OK" in capsys.readouterr().out
+    assert sentry.main(["report", "--history", h]) == 0
+    assert "64x48" in capsys.readouterr().out
+
+
+def test_sentry_cli_no_baseline_exits_zero(tmp_path, capsys):
+    h = str(tmp_path / "empty.jsonl")
+    rc = sentry.main(["check", "--history", h, "--shape", "8x8",
+                      "--value", "1.0", "--json"])
+    assert rc == 0
+    assert json.loads(capsys.readouterr().out)["status"] == "no-baseline"
+
+
+def test_sentry_cli_from_bench_file(tmp_path, capsys):
+    h = str(tmp_path / "hist.jsonl")
+    cap = {"metric": "48x64_rgb_40reps_compute_wall_clock", "value": 0.01,
+           "unit": "s", "backend": "xla", "platform": "cpu",
+           "shape": "48x64", "reps": 40, "schema_version": 1}
+    f = tmp_path / "bench_out.json"
+    lines = [dict(cap, value=0.010), dict(cap, value=0.011)]
+    # A phase rider and a partial line must not become the record.
+    f.write_text("\n".join(
+        [json.dumps({"metric": "phase.compile.seconds", "value": 9.0,
+                     "phase": "compile"})]
+        + [json.dumps(l) for l in lines]) + "\n")
+    assert sentry.main(["log", "--history", h, "--from-bench", str(f)]) == 0
+    assert sentry.main(["log", "--history", h, "--from-bench", str(f)]) == 0
+    slow = tmp_path / "slow.json"
+    slow.write_text(json.dumps(dict(cap, value=0.03)) + "\n")
+    assert sentry.main(
+        ["check", "--history", h, "--from-bench", str(slow)]) == 1
+    capsys.readouterr()
+
+
+def test_bench_capture_log_perf(tmp_path, capsys, monkeypatch):
+    from tools import bench_capture
+
+    h = tmp_path / "hist.jsonl"
+    monkeypatch.setenv("TPU_STENCIL_PERF_HISTORY", str(h))
+    f = tmp_path / "out.json"
+    f.write_text(json.dumps(
+        {"metric": "48x64_rgb_40reps_compute_wall_clock", "value": 0.01,
+         "backend": "xla", "platform": "cpu", "shape": "48x64",
+         "reps": 40}) + "\n")
+    assert bench_capture.main(["bench_capture.py", str(f),
+                               "--log-perf"]) == 0
+    assert len(sentry.load(str(h))) == 1

@@ -7,10 +7,14 @@ LAST line that parses; a trailing fragment from a SIGKILLed child (a
 write cut mid-line) must not invalidate the earlier complete lines.
 
 Library: ``last_capture(path) -> dict`` (raises ValueError when no line
-parses). CLI: ``python tools/bench_capture.py FILE`` prints the
-canonical capture as a single JSON object (exit 1 if none) — used by
-the burst scripts to keep ``docs/BENCH_r*_preview.json`` a plain
-one-object artifact that ``json.load`` consumers can read directly.
+parses). CLI: ``python tools/bench_capture.py FILE [--log-perf]``
+prints the canonical capture as a single JSON object (exit 1 if none) —
+used by the burst scripts to keep ``docs/BENCH_r*_preview.json`` a
+plain one-object artifact that ``json.load`` consumers can read
+directly. ``--log-perf`` additionally appends the capture to the
+perf-sentry history (``tpu_stencil.obs.sentry``) — the manual path for
+back-filling a round's preview into the trajectory bench.py now feeds
+automatically.
 
 Since the obs PR, bench.py also emits per-phase breakdown lines
 (``"phase": <name>`` marker) and versions every capture
@@ -57,14 +61,30 @@ def last_capture(path: str) -> dict:
 
 
 def main(argv) -> int:
-    if len(argv) != 2:
-        print("usage: bench_capture.py FILE", file=sys.stderr)
+    args = [a for a in argv[1:] if a != "--log-perf"]
+    log_perf = "--log-perf" in argv[1:]
+    if len(args) != 1:
+        print("usage: bench_capture.py FILE [--log-perf]", file=sys.stderr)
         return 2
     try:
-        print(json.dumps(last_capture(argv[1])))
+        cap = last_capture(args[0])
+        print(json.dumps(cap))
     except (OSError, ValueError) as e:
         print(f"bench_capture: {e}", file=sys.stderr)
         return 1
+    if log_perf:
+        try:
+            from tpu_stencil.obs import sentry
+
+            path = sentry.append(sentry.record_from_capture(cap))
+            print(f"perf history += {cap.get('metric')} -> {path}",
+                  file=sys.stderr)
+        except Exception as e:
+            # Still rc=0: the canonical object already printed, and exit
+            # 1 is reserved for "no parseable capture" — a failed sentry
+            # append must never make a burst script treat the round's
+            # real capture as missing.
+            print(f"bench_capture: perf log skipped ({e})", file=sys.stderr)
     return 0
 
 

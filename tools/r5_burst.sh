@@ -15,6 +15,15 @@ set -u
 cd /root/repo
 
 PREVIEW=${R5_PREVIEW:-/root/repo/docs/BENCH_r05_preview.json}
+# Rehearsal isolation covers the preview too, not just the journal
+# (ADVICE r5): with TPU_LAB_PLATFORM set, step 0 still runs bench.py
+# and cp's any parseable capture — full_capture only gates the done
+# marker — so a CPU dry-run could clobber the published hardware
+# artifact. Default the rehearsal preview to /tmp (explicit R5_PREVIEW
+# still wins for tests that want it).
+if [ -n "${TPU_LAB_PLATFORM:-}" ] && [ -z "${R5_PREVIEW:-}" ]; then
+  PREVIEW=/tmp/r5_rehearsal_preview.json
+fi
 # One fresh shared journal for the whole round-5 burst: part 2 appends
 # to /tmp/r4_lab.log and publishes it, so rotate the stale round-4
 # journal away (ONCE — retry windows must append to the round-5

@@ -26,6 +26,10 @@ try:
     r = last_capture(sys.argv[1])
     assert isinstance(r.get("value"), (int, float))
     assert r.get("platform") in ("tpu", "axon")
+    # A partial (early default-path) capture keeps the round alive but
+    # must NOT end the poll loop: the enriched sweep stays re-armed
+    # (ADVICE r5 — a 90s window's early line used to count as success).
+    assert not r.get("partial")
 except Exception:
     sys.exit(1)
 EOF

@@ -1,3 +1,6 @@
+"""``python -m tpu_stencil`` — the job CLI, plus the ``serve`` and
+``perf`` subcommands (dispatched in :mod:`tpu_stencil.cli`)."""
+
 from tpu_stencil.cli import main
 
 if __name__ == "__main__":

@@ -7,6 +7,10 @@ Reference-compatible invocation (``mpi/mpi_convolution.c:328-348``):
 prints the compute-window wall-clock (the reference's headline metric) and
 writes ``blur_<input>``. Extra flags expose what the reference hard-codes:
 ``--filter``, ``--backend``, ``--mesh``, ``--output``.
+
+Subcommands: ``python -m tpu_stencil serve ...`` (the micro-batching
+inference service) and ``python -m tpu_stencil perf {log,check,report}``
+(the perf-regression sentry, docs/OBSERVABILITY.md).
 """
 
 from __future__ import annotations
@@ -26,6 +30,12 @@ def main(argv=None) -> int:
         from tpu_stencil.serve import cli as serve_cli
 
         return serve_cli.main(argv[1:])
+    if argv and argv[0] == "perf":
+        # The perf-regression sentry (log/check/report) is jax-free by
+        # design: a history query must exit without backend bring-up.
+        from tpu_stencil.obs import sentry
+
+        return sentry.main(argv[1:])
     # parse_args does no JAX work, so parse first: --help/usage errors must
     # exit without joining a pod rendezvous.
     cfg, ns = parse_args(argv)
@@ -56,10 +66,17 @@ def main(argv=None) -> int:
         )
     trace_path, breakdown = _broadcast_obs_flags(ns)
     tracing = bool(trace_path or breakdown)
-    if tracing:
+    # Introspection rides on any observability run (--trace/--breakdown,
+    # pod-agreed) or an explicit --hlo-dump; capture itself records on
+    # process 0 only and drives no collectives, so the per-rank
+    # --hlo-dump flag needs no broadcast.
+    introspecting = tracing or bool(ns.hlo_dump)
+    if introspecting:
         from tpu_stencil import obs
 
-        obs.enable()
+        if tracing:
+            obs.enable()
+        obs.introspect.enable(hlo_dir=ns.hlo_dump)
     try:
         result = driver.run_job(
             cfg,
@@ -69,11 +86,14 @@ def main(argv=None) -> int:
         )
         if tracing:
             _report_observability(trace_path, breakdown, cfg, result)
+        if introspecting:
+            _report_introspection(breakdown, cfg, result, ns.hlo_dump)
     finally:
-        if tracing:
+        if introspecting:
             from tpu_stencil import obs
 
             obs.disable()
+            obs.introspect.disable()
     if ns.metrics_text:
         # Process 0 only, like the trace/breakdown output: N processes
         # racing one open(path, 'w') would interleave the exposition.
@@ -162,11 +182,52 @@ def _report_observability(trace_path, breakdown, cfg, result) -> None:
         print(table, end="")
 
 
+def _report_introspection(breakdown, cfg, result, hlo_dump) -> None:
+    """Cross-check the compiled-artifact records against the analytic
+    traffic model (refreshing the ``introspect_*`` gauges BEFORE any
+    --metrics-text write) and, under --breakdown, print the
+    introspection + device-memory tables after the phase table."""
+    import jax
+
+    from tpu_stencil import obs
+
+    if jax.process_index() != 0:
+        return
+    recs = obs.introspect.records()
+    if recs:
+        from tpu_stencil.runtime import roofline
+
+        analytic = roofline.analytic_bytes_per_rep(
+            cfg.height * cfg.width * cfg.channels * cfg.frames,
+            result.backend, cfg.filter_name, cfg.height,
+            block_h=result.block_h, fuse=result.fuse,
+        )
+        for rec in recs:
+            # Driver-path sites lower the same per-rep program the
+            # traffic model describes; serve.bucket batches are keyed
+            # differently and are not cross-checked here.
+            if rec.get("site") in ("driver.warmup", "sharded.iterate"):
+                obs.introspect.cross_check(rec, analytic)
+        if breakdown:
+            print(obs.breakdown.render_introspection(recs), end="")
+    if breakdown:
+        print(obs.breakdown.render_memory(
+            obs.introspect.device_memory_stats()), end="")
+    if hlo_dump:
+        for rec in recs:
+            if rec.get("hlo_path"):
+                print(f"wrote hlo {rec['hlo_path']}")
+
+
 def _write_metrics_text(path: str) -> None:
     from tpu_stencil import obs
 
+    notes = ()
+    if obs.introspect.device_memory_stats() is None:
+        notes = ("device memory gauges unavailable: no allocator stats "
+                 "on this backend",)
     obs.exposition.write_text(path, obs.snapshot(),
-                              prefix="tpu_stencil_driver")
+                              prefix="tpu_stencil_driver", notes=notes)
 
 
 if __name__ == "__main__":

@@ -131,6 +131,11 @@ class ServeConfig:
     # serve default (tpu_stencil.serve.bucketing.DEFAULT_EDGES). Requests
     # above the top edge pad to the next top-edge multiple.
     bucket_edges: Optional[Tuple[int, ...]] = None
+    # Device-memory sampler period (seconds): a background thread
+    # gauges device.memory_stats() into the server registry
+    # (device_bytes_in_use / peak / limit). 0 disables; backends
+    # without allocator stats (CPU) never start the thread regardless.
+    mem_sample_interval_s: float = 0.5
 
     def __post_init__(self) -> None:
         if self.backend not in ("auto", "xla", "pallas", "reference", "autotune"):
@@ -148,6 +153,11 @@ class ServeConfig:
         if self.max_executables < 1:
             raise ValueError(
                 f"max_executables must be >= 1, got {self.max_executables}"
+            )
+        if self.mem_sample_interval_s < 0:
+            raise ValueError(
+                f"mem_sample_interval_s must be >= 0 (0 = off), got "
+                f"{self.mem_sample_interval_s}"
             )
         if self.bucket_edges is not None:
             edges = tuple(self.bucket_edges)
@@ -275,7 +285,17 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "--metrics-text", default=None, metavar="PATH",
         help="write the driver-side metrics registry as Prometheus-style "
-             "text exposition to PATH ('-' = stdout)",
+             "text exposition to PATH ('-' = stdout); includes the "
+             "device-memory gauges and (on introspected runs) the "
+             "introspect_* compile-site gauges",
+    )
+    p.add_argument(
+        "--hlo-dump", default=None, metavar="DIR",
+        help="arm compiled-artifact introspection and dump each compile "
+             "site's optimized HLO text into DIR (also armed implicitly "
+             "by --trace/--breakdown, without the text dump); each "
+             "introspected site pays one extra AOT compile of the same "
+             "program (see docs/OBSERVABILITY.md)",
     )
     p.add_argument(
         "--checkpoint-every", type=int, default=0, metavar="N",

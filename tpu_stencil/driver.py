@@ -139,7 +139,29 @@ def prepare_engine(model, imgs: np.ndarray, devices, frames: Optional[int] = Non
             fetch = np.asarray
     with obs.phase("compile") as s:
         img_dev = s.fence(step_fn(img_dev, 0))  # warm-up; output == input
+    if obs.introspect.enabled():
+        # AOT-introspect the program the warm-up just compiled (cost /
+        # memory analysis, compile wall-time, optional HLO dump). Pays
+        # its own compile — the AOT path does not share the jit dispatch
+        # cache — which is why it only runs on armed (--breakdown /
+        # --trace / --hlo-dump) runs. Traced at one rep: the rep count
+        # is a traced loop bound, so the lowered program is the same
+        # one the timed window runs.
+        obs.introspect.capture(
+            "driver.warmup", step_fn, img_dev, jax.numpy.int32(1),
+            meta={"shape": tuple(np.asarray(imgs).shape),
+                  "frames": frames, "devices": len(devices)},
+        )
     return img_dev, step_fn, fetch
+
+
+def _record_device_memory() -> None:
+    """Point-in-time device-memory gauges (``device_bytes_in_use`` /
+    allocator peak / limit) into the driver registry, taken right after
+    the compute window while the working set is still resident. Cheap
+    and always-on; backends without allocator stats (CPU) record
+    nothing — the documented "unavailable" degradation."""
+    obs.introspect.record_memory_gauges(obs.registry())
 
 
 def _store_output(cfg: JobConfig, out: np.ndarray) -> None:
@@ -362,6 +384,7 @@ def run_job(
                 )
         with obs.phase("fetch"):
             out = fetch(out_dev)
+        _record_device_memory()
         compute_seconds = max_across_processes(compute)
         with obs.phase("store"):
             _store_output(cfg, out)
@@ -461,6 +484,7 @@ def _run_frames_multihost(cfg, model, profile_dir, checkpoint_every,
                 )
         with obs.phase("fetch"):
             out = fetch(out_dev)  # crop device-multiple padding
+        _record_device_memory()
     elif checkpoint_every:
         # Frame-less process: THE SAME chunk loop as the compute path (a
         # no-op run on a dummy carry) so its save/commit-barrier schedule
@@ -561,6 +585,7 @@ def _run_sharded(cfg, model, devices, profile_dir, checkpoint_every, resume,
         # compute window), so the trace separates communication from
         # interior compute the way the persistent-MPI stencil work does.
         runner.trace_phase_probes(img_dev)
+    runner.introspect_warmup(img_dev, cfg.repetitions)
 
     def save_fn(rep, dev):
         from tpu_stencil.runtime import checkpoint as ckpt
@@ -573,6 +598,7 @@ def _run_sharded(cfg, model, devices, profile_dir, checkpoint_every, resume,
                 cfg, runner.run, save_fn, img_dev, checkpoint_every,
                 start_rep,
             )
+    _record_device_memory()
     compute_seconds = max_across_processes(compute)
     with obs.phase("store"):
         if images_io.is_raw(cfg.output_path):

@@ -14,6 +14,11 @@ docs/OBSERVABILITY.md). Three pieces:
   :func:`registry`), with a reference parser.
 * :mod:`~tpu_stencil.obs.breakdown` — the human ``--breakdown`` table
   with roofline GB/s annotation.
+* :mod:`~tpu_stencil.obs.introspect` — compiled-artifact introspection
+  (``cost_analysis``/``memory_analysis``, compile wall-time, HLO dump)
+  and ``device.memory_stats()`` telemetry, all degrade-to-unavailable.
+* :mod:`~tpu_stencil.obs.sentry` — the perf-regression sentry: JSONL
+  capture history + baseline gate (``python -m tpu_stencil perf``).
 
 >>> from tpu_stencil import obs
 >>> obs.enable()
@@ -32,11 +37,25 @@ from tpu_stencil.obs.tracing import (
     get_tracer,
     phase,
     registry,
-    reset,
     snapshot,
     span,
 )
-from tpu_stencil.obs import breakdown, export, exposition, tracing
+from tpu_stencil.obs import (
+    breakdown,
+    export,
+    exposition,
+    introspect,
+    sentry,
+    tracing,
+)
+
+
+def reset() -> None:
+    """Drop the tracer, the accumulated metrics, AND the introspection
+    records (tests) — one teardown for the whole obs subsystem."""
+    tracing.reset()
+    introspect.reset()
+
 
 __all__ = [
     "Span",
@@ -49,9 +68,11 @@ __all__ = [
     "export",
     "exposition",
     "get_tracer",
+    "introspect",
     "phase",
     "registry",
     "reset",
+    "sentry",
     "snapshot",
     "span",
     "tracing",

@@ -10,6 +10,13 @@ parent and are excluded from the share denominator — their time is
 already inside it. Classification is by the *recorded* nesting depth,
 not by dotted names: ``sharded.halo_exchange`` and friends are
 top-level siblings whose time must count toward the total.
+
+Two composable side tables (the CLI prints them after the phase table):
+:func:`render_introspection` — per compile site, XLA's bytes-accessed
+next to the analytic traffic model's with the model/XLA agreement %
+(:mod:`tpu_stencil.obs.introspect`); :func:`render_memory` — the
+device allocator gauges, or an explicit "unavailable" line on backends
+without them.
 """
 
 from __future__ import annotations
@@ -83,3 +90,60 @@ def render_breakdown(tracer: Tracer,
         )
     lines.append(f"{'total':<{name_w}}  {total:>10.6f}  {'100.0%':>6}")
     return "\n".join(lines) + "\n"
+
+
+def _mb(v) -> str:
+    return "" if v is None else f"{v / 1e6:.2f}"
+
+
+def render_introspection(records: List[dict]) -> str:
+    """The compiled-artifact table: one row per :func:`introspect.capture`
+    record — AOT compile seconds, XLA's bytes-accessed (≈ one rep: HLO
+    cost analysis counts loop bodies once) vs the analytic traffic
+    model's per-rep bytes, and the agreement % (``!`` marks drift
+    outside the 2x band; expected on pallas, whose kernels are opaque
+    custom calls to XLA's cost model). Sites that failed every probe
+    render as "unavailable" with the error."""
+    if not records:
+        return ""
+    head = (f"{'compile site':<18}  {'compile_s':>9}  {'xla MB/rep':>10}  "
+            f"{'model MB/rep':>12}  {'model/xla':>9}")
+    lines = ["", "compiled artifacts (XLA introspection)", head,
+             "-" * len(head)]
+    for rec in records:
+        site = rec.get("site", "?")
+        if not rec.get("available"):
+            reason = rec.get("error") or "no cost/memory analysis"
+            lines.append(f"{site:<18}  unavailable ({reason})")
+            continue
+        comp = rec.get("compile_seconds")
+        pct = rec.get("model_vs_xla_pct")
+        pct_s = "" if pct is None else (
+            f"{pct:7.1f}%" + ("!" if rec.get("drift") else " ")
+        )
+        lines.append(
+            f"{site:<18}  {comp:>9.3f}  {_mb(rec.get('bytes_accessed')):>10}  "
+            f"{_mb(rec.get('model_bytes_per_rep')):>12}  {pct_s:>9}"
+        )
+        mem = rec.get("memory")
+        if mem:
+            parts = [
+                f"{k[:-len('_size_in_bytes')]}={_mb(v)}MB"
+                for k, v in mem.items() if v
+            ]
+            if parts:
+                lines.append(f"{'':<18}  {' '.join(parts)}")
+    return "\n".join(lines) + "\n"
+
+
+def render_memory(stats: Optional[dict]) -> str:
+    """One device-memory line from ``device.memory_stats()`` output;
+    backends without allocator stats (CPU) say so explicitly instead of
+    rendering nothing — "unavailable" is a finding, not an omission."""
+    if not stats:
+        return ("device memory: unavailable "
+                "(no allocator stats on this backend)\n")
+    order = ("bytes_in_use", "peak_bytes_in_use", "bytes_limit",
+             "largest_alloc_size")
+    parts = [f"{k}={stats[k] / 1e6:.2f}MB" for k in order if k in stats]
+    return "device memory: " + " ".join(parts) + "\n"

@@ -36,9 +36,17 @@ def _num(v) -> str:
     return repr(float(v)) if isinstance(v, float) else repr(int(v))
 
 
-def render_text(snapshot: dict, prefix: str = "tpu_stencil") -> str:
-    """Render a registry snapshot dict as Prometheus-style text."""
-    out = []
+def render_text(snapshot: dict, prefix: str = "tpu_stencil",
+                notes=()) -> str:
+    """Render a registry snapshot dict as Prometheus-style text.
+
+    ``notes``: informational comment lines (``# NOTE ...``) emitted at
+    the top — used to state *why* an expected metric family is absent
+    (e.g. device-memory gauges on a backend without allocator stats),
+    so "unavailable" is visible in the scrape, not just missing.
+    Comments are ignored by :func:`parse_text`, preserving the exact
+    round-trip."""
+    out = [f"# NOTE {n}" for n in notes]
 
     def emit(kind, name, lines):
         out.append(f"# TYPE {prefix}_{name} {kind}")
@@ -70,11 +78,11 @@ def render_text(snapshot: dict, prefix: str = "tpu_stencil") -> str:
 
 
 def write_text(path: str, snapshot: dict,
-               prefix: str = "tpu_stencil") -> None:
+               prefix: str = "tpu_stencil", notes=()) -> None:
     """Render ``snapshot`` and write it to ``path`` (``'-'`` = stdout,
     with no trailing "wrote" line). The one place the CLIs' shared
     '-'-vs-file contract lives."""
-    text = render_text(snapshot, prefix)
+    text = render_text(snapshot, prefix, notes=notes)
     if path == "-":
         print(text, end="")
     else:

@@ -1,0 +1,384 @@
+"""Compiled-artifact introspection and device-memory telemetry.
+
+The spans/metrics of PR 2 answer "where did the time go"; this module
+answers "what did the compiler and the hardware actually do". Two
+instruments, both best-effort across JAX versions and backends — every
+probe degrades to "unavailable", it never raises into a compute path:
+
+* **Executable introspection** (:func:`capture`): AOT-lower and compile
+  the same program a compile site just warmed up, and record what XLA
+  says about it — ``compiled.cost_analysis()`` (flops, bytes accessed),
+  ``compiled.memory_analysis()`` (argument/output/temp/code bytes),
+  the compile wall clock, and optionally the HLO text. The paper's
+  whole argument is measured-vs-peak bandwidth (SURVEY.md §6), so the
+  roofline denominator should be cross-checkable against XLA's own
+  traffic accounting, not hand-derived constants alone:
+  :func:`cross_check` compares the analytic per-rep traffic model
+  (:mod:`tpu_stencil.runtime.roofline`) against XLA's bytes-accessed
+  and flags drift between the two.
+
+  Cost: ``jit_fn.lower(args).compile()`` does NOT share the jit
+  dispatch cache, so an introspected site pays one extra compile of an
+  equivalent program (XLA's persistent compilation cache may dedupe).
+  That is why introspection is gated behind :func:`enable` — the
+  ``--trace``/``--breakdown``/``--hlo-dump`` runs — and never on by
+  default.
+
+  Honesty caveat: Pallas kernels are opaque custom calls to XLA's cost
+  model, so ``bytes accessed`` under-counts on the pallas backend and
+  the drift flag fires by construction there — the analytic model is
+  authoritative for pallas; the cross-check is a real two-sided audit
+  on the XLA schedule.
+
+* **Device-memory telemetry** (:func:`device_memory_stats`,
+  :func:`record_memory_gauges`): ``device.memory_stats()`` gauges —
+  bytes in use, allocator peak, bytes limit. CPU backends return None
+  (no allocator stats); that renders as *absent gauges*, never an
+  error. The driver records point-in-time gauges per job; the serve
+  engine runs a background sampler thread (see
+  :mod:`tpu_stencil.serve.engine`). Both land in the existing one-path
+  exposition (:mod:`tpu_stencil.obs.exposition`).
+
+Multi-process: :func:`capture` records on process 0 only (N identical
+AOT compiles of one SPMD program would waste every non-zero rank's
+time and produce N duplicate records).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+# Fields of jaxlib's CompiledMemoryStats we record (attribute names as
+# of jax 0.4.x; future dict-shaped returns are handled too).
+_MEMORY_FIELDS = (
+    "generated_code_size_in_bytes",
+    "argument_size_in_bytes",
+    "output_size_in_bytes",
+    "alias_size_in_bytes",
+    "temp_size_in_bytes",
+)
+
+# device.memory_stats() keys worth a gauge (PJRT allocator vocabulary).
+_DEVICE_MEMORY_KEYS = (
+    "bytes_in_use",
+    "peak_bytes_in_use",
+    "bytes_limit",
+    "largest_alloc_size",
+)
+
+# Model-vs-XLA traffic agreement band: outside it the drift flag fires
+# (either the analytic model or the compiler's accounting is off 2x).
+DRIFT_BAND_PCT = (50.0, 200.0)
+
+# Record-list bound: capture sites can be client-controlled (the serve
+# cache key space is unbounded by design), so like every other store in
+# the repo the record list must never grow without limit on a
+# long-running armed process — past the cap the oldest records drop.
+MAX_RECORDS = 1024
+
+_lock = threading.Lock()
+_enabled = False
+_hlo_dir: Optional[str] = None
+_records: List[dict] = []
+
+
+def enable(hlo_dir: Optional[str] = None) -> None:
+    """Arm introspection (and optional per-site HLO text dumps into
+    ``hlo_dir``). Armed by the CLIs for ``--trace``/``--breakdown``/
+    ``--hlo-dump`` runs; compile sites then call :func:`capture`."""
+    global _enabled, _hlo_dir
+    _enabled = True
+    _hlo_dir = hlo_dir
+
+
+def disable() -> None:
+    global _enabled, _hlo_dir
+    _enabled = False
+    _hlo_dir = None
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def records() -> List[dict]:
+    """Snapshot of every capture so far, in capture order."""
+    with _lock:
+        return list(_records)
+
+
+def reset() -> None:
+    """Disarm and drop accumulated records (tests; ``obs.reset``)."""
+    global _records
+    disable()
+    with _lock:
+        _records = []
+
+
+# -- guarded extraction across JAX versions ---------------------------
+
+
+def cost_analysis(compiled) -> Optional[Dict[str, float]]:
+    """``compiled.cost_analysis()`` as a flat ``{key: float}`` dict, or
+    None. Guarded across versions: jax<=0.4.x returns a one-element
+    list of dicts, newer returns the dict directly; keys have drifted
+    (``bytes accessed`` vs ``bytes_accessed``) — both spellings are
+    normalized onto the space-separated canonical one. Never raises."""
+    try:
+        fn = getattr(compiled, "cost_analysis", None)
+        if fn is None:
+            return None
+        ca = fn()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else None
+        if not isinstance(ca, dict):
+            return None
+        out = {
+            str(k): float(v)
+            for k, v in ca.items()
+            if isinstance(v, (int, float)) and not isinstance(v, bool)
+        }
+        for canonical in ("bytes accessed", "flops"):
+            renamed = canonical.replace(" ", "_")
+            if canonical not in out and renamed in out:
+                out[canonical] = out[renamed]
+        return out or None
+    except Exception:
+        return None
+
+
+def memory_analysis(compiled) -> Optional[Dict[str, int]]:
+    """``compiled.memory_analysis()`` as ``{field: bytes}`` over
+    :data:`_MEMORY_FIELDS`, or None (CPU/older backends return None or
+    lack the method entirely). Never raises."""
+    try:
+        fn = getattr(compiled, "memory_analysis", None)
+        ma = fn() if fn is not None else None
+        if ma is None:
+            return None
+        out: Dict[str, int] = {}
+        for field in _MEMORY_FIELDS:
+            v = ma.get(field) if isinstance(ma, dict) else getattr(ma, field, None)
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                out[field] = int(v)
+        return out or None
+    except Exception:
+        return None
+
+
+def hlo_text(compiled_or_lowered) -> Optional[str]:
+    """``.as_text()`` of a compiled/lowered stage, or None."""
+    try:
+        fn = getattr(compiled_or_lowered, "as_text", None)
+        text = fn() if fn is not None else None
+        return text if isinstance(text, str) and text else None
+    except Exception:
+        return None
+
+
+# -- executable capture ------------------------------------------------
+
+
+def capture(site: str, fn, *args, meta: Optional[dict] = None,
+            registry=None) -> Optional[dict]:
+    """AOT-introspect one compile site: lower+compile ``fn(*args)``,
+    record cost/memory analyses and compile wall-time, and mirror the
+    headline numbers into ``registry`` (default: the driver-side
+    ``obs.registry()``) as ``introspect_<site>_*`` gauges so they ride
+    the existing exposition.
+
+    ``fn`` may be a ``jax.jit`` wrapper (its ``.lower`` is used) or any
+    traceable callable (wrapped in a fresh ``jax.jit``). Returns the
+    record (``available=False`` + ``error`` when every probe failed),
+    or None when introspection is disarmed or this is not process 0.
+    Never raises — a broken introspection must not cost the run."""
+    if not _enabled:
+        return None
+    rec = {
+        "site": site,
+        "meta": dict(meta or {}),
+        "available": False,
+        "compile_seconds": None,
+        "flops": None,
+        "bytes_accessed": None,
+        "memory": None,
+        "hlo_path": None,
+        "error": None,
+    }
+    try:
+        import jax
+
+        if jax.process_index() != 0:
+            return None
+        lower = getattr(fn, "lower", None)
+        if lower is None or not callable(lower):
+            lower = jax.jit(fn).lower
+        t0 = time.perf_counter()
+        lowered = lower(*args)
+        compiled = lowered.compile()
+        rec["compile_seconds"] = time.perf_counter() - t0
+        cost = cost_analysis(compiled)
+        if cost:
+            rec["flops"] = cost.get("flops")
+            rec["bytes_accessed"] = cost.get("bytes accessed")
+        rec["memory"] = memory_analysis(compiled)
+        if _hlo_dir:
+            rec["hlo_path"] = _dump_hlo(site, compiled, lowered)
+        rec["available"] = (
+            rec["bytes_accessed"] is not None or rec["memory"] is not None
+        )
+    except Exception as e:
+        rec["error"] = f"{type(e).__name__}: {e}"
+    with _lock:
+        _records.append(rec)
+        if len(_records) > MAX_RECORDS:
+            del _records[: len(_records) - MAX_RECORDS]
+    _count_capture(site, registry)
+    _set_gauges(site, rec, registry)
+    return rec
+
+
+def _count_capture(site: str, registry=None) -> None:
+    """Bump the per-site captures counter — only from :func:`capture`
+    (a :func:`cross_check` gauge refresh is not a new capture)."""
+    try:
+        if registry is None:
+            from tpu_stencil.obs import tracing
+
+            registry = tracing.registry()
+        registry.counter(f"introspect_{_slug(site)}_captures_total").inc()
+    except Exception:
+        pass
+
+
+def _dump_hlo(site: str, compiled, lowered) -> Optional[str]:
+    text = hlo_text(compiled) or hlo_text(lowered)
+    if text is None:
+        return None
+    try:
+        os.makedirs(_hlo_dir, exist_ok=True)
+        with _lock:
+            n = len(_records)  # capture ordinal keeps filenames unique
+        path = os.path.join(_hlo_dir, f"{_slug(site)}_{n}.hlo.txt")
+        with open(path, "w") as fh:
+            fh.write(text)
+        return path
+    except OSError:
+        return None
+
+
+def _slug(site: str) -> str:
+    return site.replace(".", "_").replace("-", "_")
+
+
+def _set_gauges(site: str, rec: dict, registry=None) -> None:
+    """Mirror a record's headline numbers as gauges. Per-site names;
+    repeat captures of one site overwrite (last capture wins — the
+    captures counter keeps the cardinality honest)."""
+    try:
+        if registry is None:
+            from tpu_stencil.obs import tracing
+
+            registry = tracing.registry()
+        slug = _slug(site)
+        scalars = {
+            "compile_seconds": rec.get("compile_seconds"),
+            "xla_bytes_accessed": rec.get("bytes_accessed"),
+            "xla_flops": rec.get("flops"),
+            "model_bytes_per_rep": rec.get("model_bytes_per_rep"),
+            "model_vs_xla_pct": rec.get("model_vs_xla_pct"),
+        }
+        mem = rec.get("memory") or {}
+        for field in _MEMORY_FIELDS:
+            if field in mem:
+                short = field[: -len("_in_bytes")]
+                scalars[f"{short}_bytes"] = mem[field]
+        for name, v in scalars.items():
+            if v is not None:
+                registry.gauge(f"introspect_{slug}_{name}").set(v)
+    except Exception:
+        pass  # telemetry must never take down the instrumented path
+
+
+def cross_check(rec: dict, model_bytes_per_rep: float,
+                registry=None) -> dict:
+    """Cross-check XLA's bytes-accessed against the analytic traffic
+    model (:func:`tpu_stencil.runtime.roofline.analytic_bytes_per_rep`).
+
+    XLA's HLO cost analysis counts each instruction once regardless of
+    loop trip count, so for the rep-loop programs this repo compiles
+    "bytes accessed" approximates ONE repetition's traffic — directly
+    comparable to the model's per-rep bytes. Annotates ``rec`` with
+    ``model_bytes_per_rep``, ``model_vs_xla_pct`` (100 * model / XLA;
+    ~100% = the model and the compiler agree) and ``drift`` (True when
+    the ratio leaves :data:`DRIFT_BAND_PCT` — one of the two is off by
+    2x, e.g. an opaque Pallas custom call or a stale model constant),
+    and refreshes the site gauges. Degrades to no-op fields when the
+    record has no XLA bytes. Never raises."""
+    try:
+        rec["model_bytes_per_rep"] = float(model_bytes_per_rep)
+        xla_bytes = rec.get("bytes_accessed")
+        if xla_bytes:
+            pct = 100.0 * float(model_bytes_per_rep) / float(xla_bytes)
+            rec["model_vs_xla_pct"] = pct
+            lo, hi = DRIFT_BAND_PCT
+            rec["drift"] = not (lo <= pct <= hi)
+        else:
+            rec["model_vs_xla_pct"] = None
+            rec["drift"] = None
+        _set_gauges(rec.get("site", "unknown"), rec, registry)
+    except Exception:
+        pass
+    return rec
+
+
+# -- device-memory telemetry -------------------------------------------
+
+
+def device_memory_stats(device=None) -> Optional[Dict[str, int]]:
+    """``device.memory_stats()`` filtered to numeric entries, or None
+    when the backend has no allocator stats (CPU returns None; some
+    plugins raise). Never raises, never initializes a backend twice —
+    but note the first call does trigger JAX backend init."""
+    try:
+        import jax
+
+        if device is None:
+            device = jax.local_devices()[0]
+        stats = device.memory_stats()
+        if not isinstance(stats, dict):
+            return None
+        out = {
+            str(k): int(v)
+            for k, v in stats.items()
+            if isinstance(v, (int, float)) and not isinstance(v, bool)
+        }
+        return out or None
+    except Exception:
+        return None
+
+
+def record_memory_gauges(registry=None, device=None) -> Optional[dict]:
+    """Set ``device_<key>`` gauges (bytes in use / allocator peak /
+    limit / largest alloc) from :func:`device_memory_stats` into
+    ``registry`` (default: the driver-side ``obs.registry()``). On
+    backends without stats this sets nothing and returns None — the
+    exposition simply has no such gauges, the documented "unavailable"
+    rendering. Never raises."""
+    stats = device_memory_stats(device)
+    if stats is None:
+        return None
+    try:
+        if registry is None:
+            from tpu_stencil.obs import tracing
+
+            registry = tracing.registry()
+        for key in _DEVICE_MEMORY_KEYS:
+            if key in stats:
+                registry.gauge(f"device_{key}").set(stats[key])
+    except Exception:
+        return None
+    return stats

@@ -492,6 +492,27 @@ class ShardedRunner:
         with obs.span("sharded.interior_compute", "sharded") as s:
             s.fence(interior_fn(img_dev))
 
+    def introspect_warmup(self, img_dev: jax.Array, repetitions: int):
+        """AOT-introspect the compiled sharded program the warm-up just
+        built (cost/memory analysis, compile wall-time — see
+        :mod:`tpu_stencil.obs.introspect`). No-op unless introspection
+        is armed, and single-process only: N ranks each paying a
+        redundant AOT compile of the one SPMD program would multiply
+        the (already documented) introspection compile cost by the pod
+        size for identical records."""
+        from tpu_stencil import obs
+
+        if not obs.introspect.enabled() or jax.process_count() > 1:
+            return None
+        args = (img_dev, jnp.int32(repetitions))
+        if self.needs_mask:
+            args += (self._mask,)
+        return obs.introspect.capture(
+            "sharded.iterate", self._fn, *args,
+            meta={"mesh": self.mesh_shape, "tile": self.tile,
+                  "backend": self.backend, "fuse": self.fuse},
+        )
+
     def put(self, img: np.ndarray) -> jax.Array:
         """Pad to the tile grid and shard over the mesh — the analog of every
         rank loading its rows (``mpi/mpi_convolution.c:126-141``); with one

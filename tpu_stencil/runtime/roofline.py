@@ -30,22 +30,35 @@ def effective_fuse(filter_name: str, h_img: int,
     return ps.effective_geometry(plan, h_img, block_h, fuse)[1]
 
 
+def analytic_bytes_per_rep(frame_bytes: int, backend: str,
+                           filter_name: str, h_img: int,
+                           block_h=None, fuse=None) -> float:
+    """The traffic model's HBM bytes per repetition: the XLA step reads
+    + writes the frame every rep; the fused Pallas kernel pays HBM once
+    per ``fuse`` reps (ghost-band overhead excluded — it is compute,
+    not extra HBM traffic). This is the numerator of :func:`achieved`
+    and the model side of the introspection cross-check
+    (:func:`tpu_stencil.obs.introspect.cross_check`) — one formula, so
+    the roofline and the XLA-vs-model audit can never disagree about
+    what the model claims."""
+    eff = (
+        effective_fuse(filter_name, h_img, block_h, fuse)
+        if backend == "pallas" else 1
+    )
+    return 2.0 * frame_bytes / eff
+
+
 def achieved(frame_bytes: int, per_rep_s: float, backend: str,
              filter_name: str, h_img: int,
              block_h=None, fuse=None) -> Tuple[float, float]:
     """(HBM GB/s, % of v5e peak) for one measured per-rep time.
 
-    The XLA step reads + writes the frame every rep; the fused Pallas
-    kernel pays HBM once per ``fuse`` reps (ghost-band overhead excluded —
-    it is compute, not extra HBM traffic). ``block_h``/``fuse``: the
-    geometry that ran, when non-default — the traffic model must follow
-    the launch, not the module defaults.
+    ``block_h``/``fuse``: the geometry that ran, when non-default — the
+    traffic model must follow the launch, not the module defaults.
     """
-    eff = (
-        effective_fuse(filter_name, h_img, block_h, fuse)
-        if backend == "pallas" else 1
-    )
-    gbps = 2 * frame_bytes / eff / per_rep_s / 1e9
+    gbps = analytic_bytes_per_rep(
+        frame_bytes, backend, filter_name, h_img, block_h, fuse
+    ) / per_rep_s / 1e9
     return gbps, 100 * gbps / V5E_HBM_GBPS
 
 

@@ -74,7 +74,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--metrics-text", default=None, metavar="PATH",
                    help="write the server's metrics registry as "
                         "Prometheus-style text exposition to PATH "
-                        "('-' = stdout)")
+                        "('-' = stdout); includes the device-memory "
+                        "gauges and (under --trace) the per-cache-entry "
+                        "introspect_serve_bucket_* gauges")
+    p.add_argument("--perf-log", nargs="?", const=None, default=False,
+                   metavar="PATH",
+                   help="append this loadgen run's p50 latency to the "
+                        "perf-sentry history (default path: see "
+                        "'python -m tpu_stencil perf --help'); gate "
+                        "later runs with 'perf check'")
     return p
 
 
@@ -177,6 +185,10 @@ def main(argv=None) -> int:
         from tpu_stencil import obs
 
         obs.enable()
+        # Traced serve runs also introspect each cache entry's compiled
+        # executable (cost/memory analysis into the server registry —
+        # one extra AOT compile per entry, docs/OBSERVABILITY.md).
+        obs.introspect.enable()
     if ns.self_test:
         try:
             rc = self_test(metrics_text=ns.metrics_text)
@@ -188,6 +200,7 @@ def main(argv=None) -> int:
                 from tpu_stencil import obs
 
                 obs.disable()
+                obs.introspect.disable()
 
     from tpu_stencil.config import ServeConfig
     from tpu_stencil.serve import loadgen
@@ -221,6 +234,7 @@ def main(argv=None) -> int:
             from tpu_stencil import obs
 
             obs.disable()
+            obs.introspect.disable()
     if ns.metrics_text:
         from tpu_stencil.obs import exposition
 
@@ -239,6 +253,33 @@ def main(argv=None) -> int:
         f"cache={c['cache_hits_total']}h/{c['cache_misses_total']}m "
         f"padded_waste={c['padded_pixels_total']}px"
     )
+    if ns.perf_log is not False:
+        # One sentry record per loadgen run: p50 request latency. The
+        # load model (mode, per-request reps, and the closed-loop
+        # concurrency / open-loop rate) changes what p50 *means*, so it
+        # is folded into the metric name — a key field — and different
+        # load shapes can never gate each other as false regressions.
+        import jax
+
+        from tpu_stencil.obs import sentry
+
+        load = (f"c{ns.concurrency}" if ns.mode == "closed"
+                else f"rate{ns.rate:g}")
+        metric = f"serve.p50_s.{ns.mode}.{load}.reps{ns.reps}"
+        if report["p50_s"] > 0:
+            rec = sentry.make_record(
+                metric=metric, value=report["p50_s"],
+                filter_name=ns.filter_name, shape=ns.shapes,
+                backend=ns.backend, platform=jax.default_backend(),
+                source="serve",
+                extra={"requests": report["requests"],
+                       "throughput_rps": report["throughput_rps"]},
+            )
+            print(f"perf history += {metric} {report['p50_s']:.6g}s -> "
+                  f"{sentry.append(rec, ns.perf_log)}")
+        else:
+            print("perf history not updated: no completed requests "
+                  "(p50 unavailable)")
     if ns.stats_json:
         # Versioned schema: consumers (tools/bench_capture.py, dashboards)
         # dispatch on schema_version instead of guessing from key shape;

@@ -45,6 +45,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from tpu_stencil.config import ServeConfig
+from tpu_stencil.obs import introspect as _introspect
 from tpu_stencil.obs import span as _obs_span
 from tpu_stencil.serve import bucketing
 from tpu_stencil.serve.metrics import Registry
@@ -195,6 +196,57 @@ class _ExecutableCache:
         return len(self._entries)
 
 
+class _MemorySampler:
+    """Background device-memory telemetry for a long-running server:
+    a daemon thread samples ``device.memory_stats()`` every
+    ``interval_s`` into the server registry as ``device_*`` gauges
+    (bytes in use, allocator peak, limit — the registry's own
+    high-water mark additionally tracks the sampled peak of
+    bytes-in-use, so a scrape after a burst still shows how deep HBM
+    got). On backends without allocator stats (CPU) the first probe
+    returns None and NO thread is started — "unavailable" costs
+    nothing. Started lazily from the worker thread so constructing a
+    server never forces JAX backend init."""
+
+    def __init__(self, registry: Registry, interval_s: float) -> None:
+        self._registry = registry
+        self._interval = interval_s
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> bool:
+        if self._interval <= 0 or self._thread is not None:
+            return False
+        # One synchronous probe decides availability (and seeds the
+        # gauges so even a server shorter-lived than one interval
+        # reports something).
+        if _introspect.record_memory_gauges(self._registry) is None:
+            return False
+        self._thread = threading.Thread(
+            target=self._loop, name="tpu-stencil-memsample", daemon=True,
+        )
+        self._thread.start()
+        return True
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self._interval):
+            _introspect.record_memory_gauges(self._registry)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+
+
+# Per-server bound on introspected cache keys: the key space is
+# client-controlled (reps, ever-larger oversized shapes), so the
+# bookkeeping set must not grow unboundedly on a long-armed server —
+# past the cap, new keys simply go uncaptured (the cache's own LRU cap
+# is 64 by default; 8x that covers realistic churn).
+_INTROSPECT_KEY_CAP = 512
+
+_server_serials = itertools.count()
+
 _last_server_ref = None  # weakref to the most recently constructed server
 
 
@@ -231,6 +283,17 @@ class StencilServer:
         self._closing = False
         self._ids = itertools.count()
         self._worker: Optional[threading.Thread] = None
+        # Compile-site introspection bookkeeping: cache keys whose
+        # executable has been AOT-introspected (one capture per entry,
+        # only while introspection is armed — see _dispatch_inner).
+        # The serial tags this server's records in the process-global
+        # introspect store, so introspection() never reports another
+        # server's captures.
+        self._serial = next(_server_serials)
+        self._introspected: set = set()
+        self._memsampler = _MemorySampler(
+            self.registry, self.cfg.mem_sample_interval_s
+        )
         # Metric handles (names are the docs/SERVING.md schema).
         m = self.registry
         self._m_requests = m.counter("requests_total")
@@ -272,6 +335,7 @@ class StencilServer:
             self._cond.notify_all()
         if self._worker is not None and self._worker.is_alive():
             self._worker.join(timeout)
+        self._memsampler.stop()
         # No live worker to drain (never started, join timed out, or the
         # worker already exited): a queued future must never hang — fail
         # it with the same error a post-close submit gets.
@@ -359,7 +423,18 @@ class StencilServer:
         """Snapshot of the metrics registry (docs/SERVING.md schema)."""
         snap = self.registry.snapshot()
         snap["executables_cached"] = len(self._cache)
+        snap["introspected_executables"] = len(self._introspected)
         return snap
+
+    def introspection(self) -> List[dict]:
+        """THIS server's per-cache-entry compiled-artifact records (the
+        ``serve.bucket`` site captures; see docs/OBSERVABILITY.md). The
+        introspect store is process-global, so records are filtered by
+        this server's serial — two servers in one process never see
+        each other's captures here."""
+        return [r for r in _introspect.records()
+                if r.get("site") == "serve.bucket"
+                and r.get("meta", {}).get("server") == self._serial]
 
     # -- scheduler / worker --------------------------------------------
 
@@ -444,7 +519,24 @@ class StencilServer:
         # immediately, so the NEXT batch's host-side assembly (and its
         # transfer) overlaps this batch's device compute.
         canvas_dev = jax.device_put(jnp.asarray(canvas))
-        out_dev = exe(canvas_dev, jnp.asarray(vh), jnp.asarray(vw))
+        vh_dev, vw_dev = jnp.asarray(vh), jnp.asarray(vw)
+        if (_introspect.enabled() and exe_key not in self._introspected
+                and len(self._introspected) < _INTROSPECT_KEY_CAP):
+            # One AOT capture per cache entry (cost/memory analysis,
+            # compile wall-time) into the server registry. Must lower
+            # BEFORE the launch: the executable donates the canvas, and
+            # a donated-away buffer cannot be lowered against.
+            self._introspected.add(exe_key)
+            _introspect.capture(
+                "serve.bucket", exe, canvas_dev, vh_dev, vw_dev,
+                meta={"server": self._serial,
+                      "filter": batch[0].filter_name,
+                      "bucket_hw": (bh, bw), "channels": channels,
+                      "batch_bucket": nb, "reps": reps,
+                      "backend": backend},
+                registry=self.registry,
+            )
+        out_dev = exe(canvas_dev, vh_dev, vw_dev)
         for r in batch:
             self._m_qwait.observe(t0 - r.t_submit)
         self._m_bsize.observe(len(batch))
@@ -487,6 +579,13 @@ class StencilServer:
                 self._m_rlat.observe(t1 - r.t_submit)
 
     def _worker_loop(self) -> None:
+        try:
+            # On the worker thread, not in __init__: the availability
+            # probe touches jax.local_devices(), and constructing a
+            # server must never force backend init on the caller.
+            self._memsampler.start()
+        except Exception:
+            pass  # telemetry must never take down the serving loop
         inflight: "collections.deque" = collections.deque()
         while True:
             with self._cond:
