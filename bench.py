@@ -30,11 +30,25 @@ self-contained capture; the LAST line is the most complete one —
 consumers should parse the last non-empty line.
   {"metric": ..., "value": seconds, "unit": "s", "vs_baseline": speedup, ...}
 where vs_baseline = 1.017 / value (>1 means faster than the GTX-970).
+One exception: a backend-init failure (the TPU plugin reporting
+UNAVAILABLE before any measurement can run) emits a ``"partial": true``
+error record WITHOUT a numeric value — it is an explanation, not a
+number, and ``tools/bench_capture.py`` correctly refuses to promote it.
+
+Multichip mode: ``TPU_STENCIL_BENCH_MESH=RxC`` measures the *sharded*
+path (ShardedRunner over an RxC device mesh; ``TPU_STENCIL_BENCH_OVERLAP``
+selects the interior/border overlap schedule, default off) and emits a
+versioned headline capture whose metric is suffixed with the mesh and
+the RESOLVED overlap mode — a distinct perf-sentry series per
+(mesh, overlap), so sharded runs gate regressions like single-chip ones.
 
 Exit codes: 0 = capture landed (even partial-only); 1 = nothing
-parseable; 3 = the perf sentry (tpu_stencil.obs.sentry) gated a
-regression against the capture history — the capture still streamed,
-and TPU_STENCIL_BENCH_SENTRY=warn|off softens the gate.
+parseable; 2 = the requested backend is unavailable (init failed — the
+parent does NOT retry: a 4-attempt backoff loop against a dead backend
+is how round 5 ran the harness into its rc=124 timeout); 3 = the perf
+sentry (tpu_stencil.obs.sentry) gated a regression against the capture
+history — the capture still streamed, and TPU_STENCIL_BENCH_SENTRY=
+warn|off softens the gate.
 """
 
 from __future__ import annotations
@@ -294,6 +308,76 @@ def _phase_lines(winner: str, results: dict, platform: str) -> list:
     return lines
 
 
+def _measure_multichip(mesh_shape, overlap: str, platform: str) -> dict:
+    """Sharded-path capture (``TPU_STENCIL_BENCH_MESH=RxC``): steady-state
+    per-rep seconds of the compiled mesh program on the north-star image,
+    emitted as a versioned headline capture with the mesh + resolved
+    overlap mode folded into the metric name — each (mesh, overlap)
+    combination is its own perf-sentry series (sentry keys are exact, so
+    a schedule A/B can never gate as a false regression).
+
+    Backend: first entry of ``TPU_STENCIL_BENCH_BACKENDS`` (default xla —
+    the sharded Pallas path runs interpret-mode off-TPU, which would time
+    the interpreter, not a kernel)."""
+    import jax
+
+    from tpu_stencil.models.blur import IteratedConv2D
+    from tpu_stencil.parallel import sharded
+    from tpu_stencil.runtime.autotune import _steady_state_per_rep
+
+    r, c = mesh_shape
+    n = r * c
+    devs = jax.devices()
+    if len(devs) < n:
+        raise RuntimeError(
+            f"mesh {r}x{c} needs {n} devices, have {len(devs)}"
+        )
+    backend = os.environ.get(
+        "TPU_STENCIL_BENCH_BACKENDS", "xla"
+    ).split(",")[0]
+    model = IteratedConv2D("gaussian", backend=backend)
+    runner = sharded.ShardedRunner(
+        model, (H, W), C, mesh_shape=mesh_shape, devices=devs[:n],
+        overlap=overlap,
+    )
+    rng = np.random.default_rng(0)
+    img = rng.integers(0, 256, size=(H, W, C), dtype=np.uint8)
+
+    def run(n_reps: int) -> float:
+        dev = runner.put(img)  # fresh every call: the runner donates
+        jax.block_until_ready(dev)
+        t0 = time.perf_counter()
+        out = runner.run(dev, n_reps)
+        jax.block_until_ready(out)
+        return time.perf_counter() - t0
+
+    run(2)  # compile fence
+    base_reps = int(os.environ.get("TPU_STENCIL_BENCH_REPS", "2000"))
+    per_rep = _steady_state_per_rep(run, base_reps)
+    log(f"mesh {r}x{c} [{runner.backend}, overlap={runner.overlap}]: "
+        f"{per_rep * 1e6:.1f} us/rep")
+    line = _capture_line(per_rep, runner.backend, platform)
+    line["metric"] = (
+        f"{W}x{H}_rgb_{REPS}reps_mesh{r}x{c}_"
+        f"overlap-{runner.overlap}_compute_wall_clock"
+    )
+    line["mesh"] = f"{r}x{c}"
+    line["n_devices"] = n
+    line["overlap"] = runner.overlap
+    # Per-DEVICE roofline: each chip holds 1/n of the frame, so its HBM
+    # traffic per rep is 1/n of the whole image's — _capture_line's
+    # single-chip formula would compare n-device aggregate bandwidth to
+    # one chip's 819 GB/s ceiling and overstate pct_hbm_peak by n.
+    from tpu_stencil.runtime import roofline as _roofline
+
+    gbps, pct = _roofline.achieved(
+        H * W * C / n, per_rep, runner.backend, "gaussian", H
+    )
+    line["hbm_gbps"] = round(gbps, 1)
+    line["pct_hbm_peak"] = round(pct, 1)
+    return line
+
+
 def child_main() -> int:
     # Test-only crash injection: if the marker file exists, consume it and
     # die the way a tunnel drop kills a real capture (lets the retry loop
@@ -313,8 +397,40 @@ def child_main() -> int:
     if forced:
         jax.config.update("jax_platforms", forced)
 
-    platform = jax.default_backend()
-    log(f"platform={platform} devices={jax.devices()}")
+    try:
+        platform = jax.default_backend()
+        log(f"platform={platform} devices={jax.devices()}")
+    except Exception as e:
+        # Backend init failed (the round-5 failure mode: the TPU plugin
+        # raised UNAVAILABLE at jax.default_backend() — BENCH_r05.json).
+        # Emit a partial error capture so the round's artifact records
+        # WHY there is no number, and exit rc=2 fast: the parent must
+        # not burn the harness budget retrying a dead backend.
+        print(json.dumps({
+            "metric": f"{W}x{H}_rgb_{REPS}reps_compute_wall_clock",
+            "partial": True,
+            "backend_unavailable": True,
+            "error": f"{type(e).__name__}: {e}",
+            "schema_version": 1,
+            "ts": round(time.monotonic(), 6),
+        }), flush=True)
+        log(f"backend init failed: {type(e).__name__}: {e}")
+        return 2
+
+    mesh_env = os.environ.get("TPU_STENCIL_BENCH_MESH")
+    if mesh_env:
+        try:
+            r, _, c = mesh_env.lower().partition("x")
+            result = _measure_multichip(
+                (int(r), int(c)),
+                os.environ.get("TPU_STENCIL_BENCH_OVERLAP", "off"),
+                platform,
+            )
+        except Exception as e:
+            log(f"multichip: FAILED {type(e).__name__}: {e}")
+            return 1
+        print(json.dumps(result), flush=True)
+        return 0
 
     forced_backends = os.environ.get("TPU_STENCIL_BENCH_BACKENDS")
     if forced_backends:
@@ -622,6 +738,13 @@ def main() -> int:
             if final != lines[-1]:  # already streamed; print only new info
                 print(final, flush=True)
             return _sentry_gate(final)
+        if rc == 2:
+            # Backend unavailable at init: the child already emitted its
+            # partial error capture and there is nothing a backoff loop
+            # can fix fast enough — retrying is how a dead tunnel runs
+            # the whole harness into its timeout (round 5). Fail fast.
+            log("backend unavailable; not retrying")
+            return 2
         log(f"attempt {attempt}: rc={rc}")
         if attempt < ATTEMPTS - 1:
             backoffs = _backoffs()

@@ -7,6 +7,8 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 BENCH = os.path.join(os.path.dirname(__file__), os.pardir, "bench.py")
 
 
@@ -386,3 +388,100 @@ def test_sweep_auto_rows_reflect_default_path(monkeypatch):
     )
     assert (resolved, sched, bh, fz) == ("pallas", "pack", 256, 16)
     assert seen["cfg"] == ("pallas", "pack", 256, 16)
+
+
+def test_bench_backend_unavailable_fails_fast(tmp_path):
+    # The round-5 failure mode: backend init raises UNAVAILABLE. The
+    # child must emit a partial error capture and exit rc=2, and the
+    # parent must NOT enter the retry/backoff loop (which is how the
+    # harness ran to its rc=124 timeout).
+    import time
+
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        TPU_STENCIL_BENCH_PLATFORM="bogus",
+        # Make any accidental retry path obvious in the clock.
+        TPU_STENCIL_BENCH_BACKOFFS="30,30,30",
+    )
+    env.pop("TPU_STENCIL_BENCH_CHILD", None)
+    t0 = time.time()
+    proc = subprocess.run(
+        [sys.executable, BENCH], env=env, capture_output=True, text=True,
+        timeout=600,
+    )
+    assert proc.returncode == 2, (proc.stdout, proc.stderr[-2000:])
+    assert time.time() - t0 < 60  # seconds, not the backoff ladder
+    lines = [l for l in proc.stdout.splitlines() if l.strip()]
+    assert lines, proc.stderr[-2000:]
+    err = json.loads(lines[-1])
+    assert err["partial"] is True and err["backend_unavailable"] is True
+    assert "bogus" in err["error"]
+    assert "value" not in err  # an explanation, never a number
+    assert "not retrying" in proc.stderr
+    # The extractor refuses to promote it (no numeric value).
+    cap = tmp_path / "unavail.json"
+    cap.write_text(proc.stdout)
+    from tools.bench_capture import last_capture
+
+    with pytest.raises(ValueError):
+        last_capture(str(cap))
+
+
+def test_bench_multichip_capture(tmp_path):
+    # TPU_STENCIL_BENCH_MESH runs the sharded path and emits a versioned
+    # headline capture (throughput + shape/reps/filter/dtype fields like
+    # single-chip BENCH captures) keyed per (mesh, resolved overlap) so
+    # the perf sentry can gate sharded runs.
+    proc = _run_bench(
+        tmp_path, inject_failure=False,
+        extra_env={"TPU_STENCIL_BENCH_MESH": "2x2",
+                   "TPU_STENCIL_BENCH_OVERLAP": "split",
+                   "XLA_FLAGS": "--xla_force_host_platform_device_count=4"},
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [l for l in proc.stdout.splitlines() if l.strip()]
+    cap = json.loads(lines[-1])
+    assert cap["metric"] == "48x64_rgb_40reps_mesh2x2_overlap-split_compute_wall_clock"
+    assert cap["value"] > 0 and cap["unit"] == "s"
+    assert cap["schema_version"] == 1
+    assert cap["mesh"] == "2x2" and cap["n_devices"] == 4
+    assert cap["overlap"] == "split"
+    assert {"shape", "reps", "filter", "dtype", "backend",
+            "platform"} <= set(cap)
+    # bench_capture recognises it as the canonical headline, and the
+    # sentry builds a gateable record from it (mesh/overlap as
+    # provenance, the metric name as the series key).
+    f = tmp_path / "mesh.json"
+    f.write_text(proc.stdout)
+    from tools.bench_capture import last_capture
+    from tpu_stencil.obs import sentry
+
+    got = last_capture(str(f))
+    assert got["metric"] == cap["metric"]
+    rec = sentry.record_from_capture(got)
+    assert rec["metric"] == cap["metric"]
+    assert rec["per_rep_s"] == pytest.approx(cap["value"] / 40)
+    assert rec["extra"]["mesh"] == "2x2"
+    assert rec["extra"]["overlap"] == "split"
+
+
+def test_bench_multichip_sentry_gates(tmp_path):
+    # A multichip capture series must gate like single-chip ones: two
+    # logged runs, then a 2x slower run trips the sentry (rc=3).
+    hist = str(tmp_path / "hist.jsonl")
+    env = {"TPU_STENCIL_BENCH_MESH": "2x2",
+           "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+           "TPU_STENCIL_PERF_HISTORY": hist}
+    for _ in range(2):
+        proc = _run_bench(tmp_path, inject_failure=False, extra_env=env)
+        assert proc.returncode == 0, proc.stderr[-2000:]
+    from tpu_stencil.obs import sentry
+
+    history = sentry.load(hist)
+    assert len(history) == 2
+    slow = dict(history[-1])
+    slow["value"] *= 2
+    slow["per_rep_s"] *= 2
+    verdict = sentry.check(slow, history=history)
+    assert verdict["status"] == "regression"

@@ -489,3 +489,75 @@ def test_forced_fuse_caps_to_sharded_chunk(tmp_path, rng, capsys):
             img, filters.get_filter("gaussian"), 4
         )
         np.testing.assert_array_equal(got, want)
+
+
+def test_overlap_flag_parses_and_validates():
+    cfg, _ = parse_args(["i.raw", "8", "8", "1", "grey",
+                         "--overlap", "split"])
+    assert cfg.overlap == "split"
+    cfg, _ = parse_args(["i.raw", "8", "8", "1", "grey"])
+    assert cfg.overlap == "off"
+    with pytest.raises(SystemExit):
+        parse_args(["i.raw", "8", "8", "1", "grey", "--overlap", "corner"])
+    with pytest.raises(ValueError, match="overlap"):
+        JobConfig("x", 5, 5, 1, ImageType.GREY, overlap="diagonal")
+
+
+def test_overlap_split_cli_end_to_end(tmp_path, rng, capsys):
+    # --overlap split on a mesh: bit-exact output, resolved mode in the
+    # --time report line.
+    import jax
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    img = rng.integers(0, 256, size=(32, 40), dtype=np.uint8)
+    src = str(tmp_path / "ov.raw")
+    raw_io.write_raw(src, img[..., None])
+    out = str(tmp_path / "ov_out.raw")
+    assert cli.main([src, "40", "32", "3", "grey", "--mesh", "2x4",
+                     "--backend", "xla", "--overlap", "split", "--time",
+                     "--output", out]) == 0
+    assert "overlap=split" in capsys.readouterr().out
+    got = raw_io.read_raw(out, 40, 32, 1)[..., 0]
+    want = stencil.reference_stencil_numpy(
+        img, filters.get_filter("gaussian"), 3
+    )
+    np.testing.assert_array_equal(got, want)
+
+
+def test_overlap_fused_split_cli_pallas_mesh(tmp_path, rng, capsys):
+    import jax
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 virtual devices")
+    img = rng.integers(0, 256, size=(32, 32), dtype=np.uint8)
+    src = str(tmp_path / "ovf.raw")
+    raw_io.write_raw(src, img[..., None])
+    out = str(tmp_path / "ovf_out.raw")
+    assert cli.main([src, "32", "32", "5", "grey", "--mesh", "2x2",
+                     "--backend", "pallas", "--overlap", "fused-split",
+                     "--time", "--output", out]) == 0
+    assert "overlap=fused-split" in capsys.readouterr().out
+    got = raw_io.read_raw(out, 32, 32, 1)[..., 0]
+    want = stencil.reference_stencil_numpy(
+        img, filters.get_filter("gaussian"), 5
+    )
+    np.testing.assert_array_equal(got, want)
+
+
+def test_overlap_breakdown_reports_ici_model(tmp_path, rng, capsys):
+    # --breakdown on a sharded --overlap run must print the ICI
+    # ghost-bytes model next to the exchange/interior/border spans.
+    import jax
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    img = rng.integers(0, 256, size=(32, 40), dtype=np.uint8)
+    src = str(tmp_path / "ovb.raw")
+    raw_io.write_raw(src, img[..., None])
+    out = str(tmp_path / "ovb_out.raw")
+    assert cli.main([src, "40", "32", "2", "grey", "--mesh", "2x4",
+                     "--backend", "xla", "--overlap", "split",
+                     "--breakdown", "--output", out]) == 0
+    cap = capsys.readouterr().out
+    assert "ICI ghost model" in cap
+    assert "sharded.interior_overlap" in cap
+    assert "sharded.border_compute" in cap
+    assert "probe ratio exchange/interior" in cap

@@ -450,3 +450,20 @@ def test_cli_serve_self_test_subprocess(tmp_path):
     )
     assert proc.returncode == 0, proc.stderr[-2000:]
     assert "serve self-test OK" in proc.stdout
+
+
+def test_serve_overlap_config_plumbed():
+    # ServeConfig validates the overlap vocabulary and the server records
+    # the configured mode in the overlap_mode gauge (inert today —
+    # bucket executables are single-device — but plumbed so deployment
+    # configs survive a future sharded serve path).
+    from tpu_stencil.config import ServeConfig
+    from tpu_stencil.serve.engine import StencilServer
+
+    with pytest.raises(ValueError, match="overlap"):
+        ServeConfig(overlap="diagonal")
+    srv = StencilServer(ServeConfig(overlap="split"), start=False)
+    try:
+        assert srv.stats()["gauges"]["overlap_mode"]["value"] == 1
+    finally:
+        srv.close(timeout=5)

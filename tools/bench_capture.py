@@ -21,6 +21,15 @@ Since the obs PR, bench.py also emits per-phase breakdown lines
 (``schema_version``). The canonical object is a HEADLINE capture:
 phase lines never win, versioned headlines beat unversioned ones
 (pre-versioning files still resolve — tolerate, prefer).
+
+Multichip headline captures (``TPU_STENCIL_BENCH_MESH`` runs) are
+ordinary versioned headlines with extra ``mesh``/``n_devices``/
+``overlap`` fields and a mesh+overlap-suffixed metric name — they
+resolve here like any headline, and ``--log-perf`` forwards them to
+the perf sentry as their own (metric-keyed) series. Backend-unavailable
+error records (``"partial": true`` with NO numeric value) are refused
+by the numeric-value gate below, by design: they explain a missing
+number, they are not one.
 """
 
 from __future__ import annotations

@@ -113,6 +113,10 @@ def main(argv=None) -> int:
             # only when the user forced it on a path that honors it —
             # never the requested values verbatim (report-what-ran).
             sched += f" block_h={result.block_h} fuse={result.fuse}"
+        if result.overlap is not None:
+            # The RESOLVED overlap schedule (auto/fused-split may
+            # degrade) — report-what-ran, like `schedule`.
+            sched += f" overlap={result.overlap}"
         print(
             f"total (incl. I/O): {result.total_seconds:.3f} sec; "
             f"backend={result.backend}{sched} mesh={result.mesh_shape}"
@@ -180,6 +184,33 @@ def _report_observability(trace_path, breakdown, cfg, result) -> None:
             "fuse": 1,
         })
         print(table, end="")
+        if result.mesh_shape is not None and result.overlap is not None:
+            # Sharded runs: the ICI ghost-bytes model next to the
+            # measured exchange/interior/border probe spans. fuse=1 and
+            # elem_bytes=1: the probes exchange one halo-deep ring of
+            # the *uint8* tile (the per-rep traffic of the traced
+            # launches), so the model must describe that exchange — an
+            # elem_bytes=4 production model (the monolithic XLA sep_int
+            # step's int32 phased exchange) over the uint8 probe span
+            # would inflate the implied GB/s 4x.
+            from tpu_stencil import filters as _filters
+            from tpu_stencil.ops import lowering as _lowering
+            from tpu_stencil.parallel import partition as _partition
+
+            plan = _lowering.plan_filter(
+                _filters.get_filter(cfg.filter_name)
+            )
+            print(obs.breakdown.render_overlap(tracer, {
+                "overlap": result.overlap,
+                "tile": _partition.tile_shape(
+                    cfg.height, cfg.width, result.mesh_shape
+                ),
+                "channels": cfg.channels,
+                "halo": plan.halo,
+                "mesh_shape": result.mesh_shape,
+                "fuse": 1,
+                "elem_bytes": 1,
+            }), end="")
 
 
 def _report_introspection(breakdown, cfg, result, hlo_dump) -> None:

@@ -22,6 +22,15 @@ from typing import Optional, Tuple
 # parsing/validation stays jax-free.
 PALLAS_SCHEDULES = ("pad", "shrink", "strips", "pack", "pack_strips")
 
+# Interior/border overlap schedule for the sharded path (see
+# tpu_stencil/parallel/overlap.py, which imports this tuple): "off"
+# delegates compute/comm overlap to XLA's latency-hiding scheduler,
+# "split"/"fused-split" run the explicit interior/border split, "auto"
+# resolves from the measured exchange/interior phase-probe ratio
+# (cached, runtime/autotune.py). Lives here so CLI parsing stays
+# jax-free.
+OVERLAP_MODES = ("auto", "split", "fused-split", "off")
+
 
 class ImageType(enum.Enum):
     """Pixel layout of a headerless raw image (1 or 3 bytes per pixel)."""
@@ -57,6 +66,13 @@ class JobConfig:
     # default; honored on every Pallas path.
     block_h: Optional[int] = None
     fuse: Optional[int] = None
+    # Interior/border overlap schedule for sharded (--mesh / multi-device)
+    # runs: off (XLA's scheduler owns the overlap — the pre-existing
+    # program), split (explicit per-rep interior/border split),
+    # fused-split (chunked split on the Pallas path), auto (measured
+    # phase-probe ratio, cached). Bit-exact across all modes; ignored by
+    # single-device runs (no exchange to overlap).
+    overlap: str = "off"
     # Accumulation dtype is a property of the backend's plan, not a flag:
     # integer plans accumulate exactly (int16/int32), --backend reference
     # forces the float32 semantics of the C code. A separate dtype knob was
@@ -88,6 +104,11 @@ class JobConfig:
             raise ValueError(f"block_h must be >= 1, got {self.block_h}")
         if self.fuse is not None and self.fuse < 1:
             raise ValueError(f"fuse must be >= 1, got {self.fuse}")
+        if self.overlap not in OVERLAP_MODES:
+            raise ValueError(
+                f"unknown overlap mode {self.overlap!r}; expected one of "
+                f"{'|'.join(OVERLAP_MODES)}"
+            )
 
     @property
     def channels(self) -> int:
@@ -131,6 +152,13 @@ class ServeConfig:
     # serve default (tpu_stencil.serve.bucketing.DEFAULT_EDGES). Requests
     # above the top edge pad to the next top-edge multiple.
     bucket_edges: Optional[Tuple[int, ...]] = None
+    # Interior/border overlap schedule, same vocabulary as
+    # JobConfig.overlap. Recorded (overlap_mode gauge, stats) and
+    # validated; today's bucket executables are single-device (no ghost
+    # exchange), so any mode other than "off" is accepted but inert
+    # until a spatially-sharded serve path lands — the knob is plumbed
+    # so deployment configs stay stable across that change.
+    overlap: str = "off"
     # Device-memory sampler period (seconds): a background thread
     # gauges device.memory_stats() into the server registry
     # (device_bytes_in_use / peak / limit). 0 disables; backends
@@ -153,6 +181,11 @@ class ServeConfig:
         if self.max_executables < 1:
             raise ValueError(
                 f"max_executables must be >= 1, got {self.max_executables}"
+            )
+        if self.overlap not in OVERLAP_MODES:
+            raise ValueError(
+                f"unknown overlap mode {self.overlap!r}; expected one of "
+                f"{'|'.join(OVERLAP_MODES)}"
             )
         if self.mem_sample_interval_s < 0:
             raise ValueError(
@@ -257,6 +290,20 @@ def build_parser() -> argparse.ArgumentParser:
              "verdict on the auto path",
     )
     p.add_argument(
+        "--overlap", default="off", choices=list(OVERLAP_MODES),
+        help="compute/communication overlap schedule on sharded meshes: "
+             "off delegates to XLA's latency-hiding scheduler; split "
+             "computes the ghost-free interior band concurrently with "
+             "the ppermute ghost traffic and finishes the border strips "
+             "from the arrived ghosts (the reference's hand-scheduled "
+             "inner-then-border ordering, made explicit); fused-split "
+             "widens the exchange and the border bands by fuse*halo so "
+             "one exchange covers a whole Pallas chunk; auto resolves "
+             "from the measured exchange/interior phase-probe ratio "
+             "(cached alongside the autotune verdicts). All modes are "
+             "bit-exact; single-device runs ignore this",
+    )
+    p.add_argument(
         "--platform", default=None, choices=["cpu", "tpu", "gpu"],
         help="force the JAX platform via the config API before backend "
              "init. Needed where the environment pins JAX_PLATFORMS (a "
@@ -351,6 +398,7 @@ def parse_args(argv=None) -> Tuple[JobConfig, argparse.Namespace]:
             boundary=ns.boundary,
             block_h=ns.block_h,
             fuse=ns.fuse,
+            overlap=ns.overlap,
         )
     except ValueError as e:
         parser.error(str(e))

@@ -195,6 +195,10 @@ class JobResult:
     # Report-what-ran, like `schedule`.
     block_h: Optional[int] = None
     fuse: Optional[int] = None
+    # Resolved interior/border overlap schedule of a sharded run
+    # ("off" | "split" | "fused-split" — "auto" resolves before compile);
+    # None on single-device/frames paths (no exchange to overlap).
+    overlap: Optional[str] = None
 
 
 def _ran_geometry(model, backend: str, rows: int, shape, channels: int):
@@ -544,6 +548,7 @@ def _run_sharded(cfg, model, devices, profile_dir, checkpoint_every, resume,
     runner = sharded.ShardedRunner(
         model, (cfg.height, cfg.width), cfg.channels,
         mesh_shape=cfg.mesh_shape, devices=devices,
+        overlap=cfg.overlap,
     )
     # Sharded checkpoints: every host reads/writes only its shards' byte
     # ranges of the shared .ckpt data file (requires a shared filesystem,
@@ -629,4 +634,5 @@ def _run_sharded(cfg, model, devices, profile_dir, checkpoint_every, resume,
         schedule=runner.schedule if runner.backend == "pallas" else None,
         block_h=sh_bh,
         fuse=sh_fuse,
+        overlap=runner.overlap,
     )

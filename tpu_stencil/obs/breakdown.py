@@ -92,6 +92,56 @@ def render_breakdown(tracer: Tracer,
     return "\n".join(lines) + "\n"
 
 
+def render_overlap(tracer: Tracer, info: dict) -> str:
+    """The overlap-schedule side table (sharded ``--breakdown`` runs):
+    the ICI ghost-bytes traffic model
+    (:func:`tpu_stencil.runtime.roofline.ici_ghost_bytes_per_rep`) next
+    to the measured exchange/interior/border probe spans, the exchange
+    span's implied ICI GB/s vs the v5e ceiling, and the
+    exchange/interior probe ratio ``--overlap auto`` decides on.
+
+    ``info``: ``{overlap, tile, channels, halo, mesh_shape, fuse,
+    elem_bytes}``. Renders nothing when no sharded probe spans were
+    recorded (single-device runs)."""
+    by = {r["name"]: r for r in aggregate(tracer)}
+    names = [n for n in (
+        "sharded.halo_exchange", "sharded.interior_compute",
+        "sharded.interior_overlap", "sharded.border_compute",
+    ) if n in by]
+    if not names:
+        return ""
+    from tpu_stencil.runtime import roofline
+
+    bytes_rep = roofline.ici_ghost_bytes_per_rep(
+        info["tile"], info["channels"], info["halo"], info["mesh_shape"],
+        fuse=info.get("fuse") or 1, elem_bytes=info.get("elem_bytes", 1),
+    )
+    lines = [
+        "",
+        f"overlap schedule: {info['overlap']}  "
+        f"(ICI ghost model: {bytes_rep / 1e6:.6g} MB/rep/device)",
+    ]
+    head = f"{'probe span':<26}  {'seconds':>10}  {'ICI GB/s':>8} {'peak':>6}"
+    lines += [head, "-" * len(head)]
+    for n in names:
+        sec = by[n]["seconds"] / by[n]["count"]
+        ann = ""
+        if n == "sharded.halo_exchange" and sec > 0 and bytes_rep > 0:
+            gbps = bytes_rep / sec / 1e9
+            ann = f"{gbps:8.2f} {100 * gbps / roofline.V5E_ICI_GBPS:5.1f}%"
+        lines.append(f"{n:<26}  {sec:>10.6f}  {ann:>15}")
+    ex, it = by.get("sharded.halo_exchange"), by.get("sharded.interior_compute")
+    if ex and it and it["seconds"] > 0:
+        from tpu_stencil.runtime.autotune import OVERLAP_MIN_RATIO
+
+        ratio = (ex["seconds"] / ex["count"]) / (it["seconds"] / it["count"])
+        lines.append(
+            f"probe ratio exchange/interior: {ratio:.3f} "
+            f"(--overlap auto splits above {OVERLAP_MIN_RATIO:g})"
+        )
+    return "\n".join(lines) + "\n"
+
+
 def _mb(v) -> str:
     return "" if v is None else f"{v / 1e6:.2f}"
 

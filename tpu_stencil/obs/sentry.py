@@ -128,6 +128,14 @@ def record_from_capture(obj: dict, source: str = "bench") -> dict:
     if backend == "pallas":
         block_h = obj.get("pallas_block_h")
         fuse = obj.get("pallas_fuse")
+    # Multichip headline captures (bench.py TPU_STENCIL_BENCH_MESH) carry
+    # mesh/n_devices/overlap; the mesh and resolved overlap mode are
+    # already folded into the metric name (a key field — each combination
+    # is its own series), so here they ride along as provenance only.
+    extra = {
+        k: obj[k]
+        for k in ("hbm_gbps", "mesh", "n_devices", "overlap") if k in obj
+    }
     return make_record(
         metric=metric, value=value,
         per_rep_s=(value / reps) if reps else None,
@@ -135,7 +143,7 @@ def record_from_capture(obj: dict, source: str = "bench") -> dict:
         dtype=str(obj.get("dtype", "uint8")), backend=backend,
         platform=str(obj.get("platform", "unknown")),
         block_h=block_h, fuse=fuse, source=source,
-        extra={"hbm_gbps": obj["hbm_gbps"]} if "hbm_gbps" in obj else None,
+        extra=extra or None,
     )
 
 

@@ -262,6 +262,27 @@ def valid_step(ext_u8: jax.Array, plan: StencilPlan) -> jax.Array:
     raise ValueError(f"unknown plan kind {plan.kind!r}")
 
 
+def valid_window(ext: jax.Array, plan: StencilPlan,
+                 r0: int, nr: int, c0: int, nc: int) -> jax.Array:
+    """Strip-valid pass: the ``[r0, r0+nr) x [c0, c0+nc)`` window of
+    ``valid_step(ext)``, computed by slicing the *input* window first —
+    ``(nr + 2*halo, nc + 2*halo)`` rows/cols of ``ext`` — so only the
+    strip's own work is done.
+
+    Bit-exact with slicing the full ``valid_step(ext)`` output: every
+    output pixel accumulates its taps in the same static order over the
+    same input values regardless of how the surrounding array was
+    windowed (``_sep_pass``/``valid_step``/``conv2d_valid`` are all
+    per-pixel shifted-add chains in tap order, elementwise over the
+    window). This is the unit the explicit interior/border overlap
+    schedule (:mod:`tpu_stencil.parallel.overlap`) builds its four
+    border strips from.
+    """
+    k = plan.k
+    idx = (slice(r0, r0 + nr + (k - 1)), slice(c0, c0 + nc + (k - 1)))
+    return valid_step(ext[idx], plan)
+
+
 def force_f32_plan(plan: StencilPlan) -> StencilPlan:
     """Demote any plan to the generic f32 schedule (the 'reference' backend —
     the closest analog of the C program's pre-normalized float MACs)."""

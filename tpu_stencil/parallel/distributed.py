@@ -158,11 +158,11 @@ def broadcast_config(cfg: Optional[JobConfig]) -> JobConfig:
         _encode_strs([cfg.image, cfg.filter_name, cfg.backend,
                       cfg.output if cfg.output is not None else "",
                       cfg.schedule if cfg.schedule is not None else "",
-                      cfg.boundary])
+                      cfg.boundary, cfg.overlap])
         if jax.process_index() == 0
         else np.zeros(_STR_BUF, np.uint8)
     )
-    image, filter_name, backend, output, schedule, boundary = (
+    image, filter_name, backend, output, schedule, boundary, overlap = (
         _decode_strs(names)
     )
     mesh_shape = (
@@ -183,6 +183,7 @@ def broadcast_config(cfg: Optional[JobConfig]) -> JobConfig:
         boundary=boundary,
         block_h=int(fields[7]) if int(fields[7]) > 0 else None,
         fuse=int(fields[8]) if int(fields[8]) > 0 else None,
+        overlap=overlap or "off",
     )
 
 

@@ -1,0 +1,186 @@
+"""Explicit interior/border overlap schedule for the sharded path.
+
+The reference's signature optimisation is *hand-scheduled* compute/comm
+overlap: post the nonblocking halo ``Isend/Irecv``, compute the interior
+rows (which need no ghost data) while the wires are busy, then finish the
+border rows from the arrived ghosts (``mpi/mpi_convolution.c:194-224``).
+Our sharded path historically *delegated* that overlap to XLA's
+latency-hiding scheduler (PARITY.md row C10) — with no way to express,
+measure, or force it. This module makes the schedule explicit:
+
+* :func:`split_step` — one XLA repetition as an interior/border split.
+  The tile's ghost-free interior band data-depends ONLY on the local
+  tile (never on a ``ppermute`` result), so XLA is free to run it
+  concurrently with the in-flight ghost traffic; the four narrow border
+  strips are computed from the exchanged ghosts via the strip-valid
+  pass (:func:`tpu_stencil.ops.lowering.valid_window`) and stitched
+  around it.
+* :func:`fused_split_chunk` — the fused-chunk variant: the ghost
+  exchange AND the border bands widen to ``fuse * halo`` so ONE
+  exchange covers a whole Pallas chunk, and the ghost-free interior
+  reuses the valid-ghost Pallas kernel on the *local tile alone*
+  (its outer ``fuse*halo`` rows/cols play the ghost role — local,
+  trusted data instead of exchanged data; the kernel cannot tell the
+  difference).
+
+Bit-exactness (the acceptance bar: identical output to the
+exchange-then-compute program on every plan/boundary/channels/fuse
+combination):
+
+* every border strip is a pure input-window slice of the same valid
+  computation the monolithic step runs (``valid_window``'s exactness
+  note), and the interior's input window is the local tile — the same
+  values the monolithic ghost-extended array holds at those
+  coordinates;
+* the fused interior relies on exactly the overlap-halo argument the
+  valid-ghost kernel already rests on: any radius-``fuse*halo`` input
+  window determines the ``fuse``-rep output, and the kernel's global
+  re-zero runs on *global* coordinates, which each band call passes
+  unchanged.
+
+Degenerate tiles: a tile with no ghost-free interior (min dimension
+``<= 2 * fuse * halo``) degrades to the monolithic exchange-then-compute
+step inside the same program — the split is a schedule, never a
+correctness precondition.
+
+Mode vocabulary (``--overlap``): ``off`` (delegate to XLA, the
+pre-existing program), ``split`` (per-rep split), ``fused-split``
+(chunked split; degrades to ``split`` when the backend is not Pallas),
+``auto`` (resolved by :func:`tpu_stencil.runtime.autotune.best_overlap`
+from the measured exchange/interior phase-probe ratio, cached on disk
+alongside the backend/schedule/geometry verdicts).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+from jax import lax
+
+from tpu_stencil.config import OVERLAP_MODES
+from tpu_stencil.ops import lowering as _lowering
+from tpu_stencil.parallel.halo import halo_exchange
+
+# Numeric codes the ``overlap_mode`` obs gauge reports (resolved modes
+# only — "auto" always resolves to one of these before anything runs).
+# AUTO_CODE is for contexts with no mesh to resolve against (the serve
+# engine records its *configured* mode): a requested-but-unresolved
+# "auto".
+MODE_CODES = {"off": 0, "split": 1, "fused-split": 2}
+AUTO_CODE = 3
+
+
+def check_mode(mode: str) -> str:
+    if mode not in OVERLAP_MODES:
+        raise ValueError(
+            f"unknown overlap mode {mode!r}; expected one of "
+            f"{'|'.join(OVERLAP_MODES)}"
+        )
+    return mode
+
+
+def split_step(tile_u8, plan, axes, mask_tile=None, boundary="zero"):
+    """One repetition as an explicit interior/border split (XLA path).
+
+    Same contract as the monolithic ``sharded._local_step``: halo
+    exchange + one stencil application + pad re-zero. The interior band
+    (``valid_step`` of the bare local tile) carries no data dependence on
+    the ``ppermute`` results, so XLA's scheduler can overlap it with the
+    ghost traffic; the four border strips consume the exchanged array.
+    Unlike the monolithic sep_int step (which phases int32 exchanges per
+    pass), the split exchanges the uint8 tile once in both axes — the
+    border strips need fully corner-routed 2-D ghosts.
+    """
+    h = plan.halo
+    th, tw = int(tile_u8.shape[0]), int(tile_u8.shape[1])
+    if h == 0:
+        # Halo-free plans have no ghosts at all: the whole tile is
+        # interior and no exchange is needed.
+        out = _lowering.valid_step(tile_u8, plan)
+    elif th <= 2 * h or tw <= 2 * h:
+        # No ghost-free interior: the split degrades to the monolithic
+        # exchange-then-compute program (still bit-exact).
+        ext = halo_exchange(tile_u8, h, axes, boundary)
+        out = _lowering.valid_step(ext, plan)
+    else:
+        ext = halo_exchange(tile_u8, h, axes, boundary)
+        # Interior: output rows/cols [h, t-h) depend on input rows/cols
+        # [0, t) — the bare local tile.
+        interior = _lowering.valid_step(tile_u8, plan)
+        top = _lowering.valid_window(ext, plan, 0, h, 0, tw)
+        bottom = _lowering.valid_window(ext, plan, th - h, h, 0, tw)
+        left = _lowering.valid_window(ext, plan, h, th - 2 * h, 0, h)
+        right = _lowering.valid_window(ext, plan, h, th - 2 * h, tw - h, h)
+        mid = jnp.concatenate([left, interior, right], axis=1)
+        out = jnp.concatenate([top, mid, bottom], axis=0)
+    if mask_tile is not None:
+        out = out * mask_tile
+    return out
+
+
+def fused_split_chunk(tile_u8, plan, axes, fuse, global_shape, interpret,
+                      schedule=None, block_h: Optional[int] = None):
+    """``fuse`` repetitions as an explicit interior/border split (Pallas
+    valid-ghost path).
+
+    One ``fuse * halo``-deep ghost exchange covers the whole chunk (the
+    same chunking as ``sharded._pallas_local_chunk``); the ghost-free
+    interior band runs the valid-ghost kernel on the *local tile alone*
+    — its outer ``g = fuse*halo`` rows/cols serve as the (trusted, local)
+    ghost band, so the interior launch has no data dependence on the
+    ``ppermute`` s — and four ``g``-wide border bands run the same kernel
+    on slices of the exchanged array, then stitch.
+    """
+    from tpu_stencil.ops import pallas_stencil
+
+    (row_axis, r, dim0), (col_axis, c, dim1) = axes
+    g = fuse * plan.halo
+    th, tw = int(tile_u8.shape[0]), int(tile_u8.shape[1])
+    channels = tile_u8.shape[2] if tile_u8.ndim == 3 else 1
+    row0 = lax.axis_index(row_axis) * th
+    col0 = lax.axis_index(col_axis) * (tw * channels)
+    vma = (row_axis, col_axis)
+    kw = dict(interpret=interpret, vma=vma, schedule=schedule,
+              **({"block_h": block_h} if block_h is not None else {}))
+
+    ext = halo_exchange(tile_u8, g, axes)
+    ext2 = ext.reshape(th + 2 * g, (tw + 2 * g) * channels)
+    if g == 0 or th <= 2 * g or tw <= 2 * g:
+        # No ghost-free interior at this chunk depth: monolithic chunk.
+        out2 = pallas_stencil.valid_fused(
+            ext2, plan, fuse, channels, row0, col0, global_shape, **kw
+        )
+        return out2.reshape(tile_u8.shape)
+
+    gc = g * channels
+    twc = tw * channels
+    tile2 = tile_u8.reshape(th, twc)
+    # Interior band: the local tile IS the ghost-extended input of its
+    # own (th-2g, twc-2gc) interior — no exchanged data touched.
+    interior = pallas_stencil.valid_fused(
+        tile2, plan, fuse, channels, row0 + g, col0 + gc, global_shape, **kw
+    )
+    # Border bands, each a valid-ghost launch over a slice of the
+    # exchanged array; global (row, flat-col) origins passed unchanged so
+    # the kernel's global-extent re-zero is identical to the monolithic
+    # program's.
+    top = pallas_stencil.valid_fused(
+        ext2[0:3 * g, :], plan, fuse, channels,
+        row0, col0, global_shape, **kw
+    )
+    bottom = pallas_stencil.valid_fused(
+        ext2[th - g:th + 2 * g, :], plan, fuse, channels,
+        row0 + (th - g), col0, global_shape, **kw
+    )
+    left = pallas_stencil.valid_fused(
+        ext2[g:th + g, 0:3 * gc], plan, fuse, channels,
+        row0 + g, col0, global_shape, **kw
+    )
+    right = pallas_stencil.valid_fused(
+        ext2[g:th + g, twc - gc:twc + 2 * gc], plan, fuse, channels,
+        row0 + g, col0 + (twc - gc), global_shape, **kw
+    )
+    mid = jnp.concatenate([left, interior, right], axis=1)
+    out2 = jnp.concatenate([top, mid, bottom], axis=0)
+    return out2.reshape(tile_u8.shape)

@@ -4,9 +4,12 @@ The distribution driver — TPU-native equivalent of the reference MPI
 program's hot loop (``mpi/mpi_convolution.c:156-240``): per iteration, halo
 exchange (``ppermute`` phases, :mod:`tpu_stencil.parallel.halo`) then the
 local stencil on the ghost-extended tile, double-buffered via the
-``lax.fori_loop`` carry, entirely on device. XLA's latency-hiding scheduler
-overlaps the ppermutes with interior compute (the reference's hand-written
-inner-then-border schedule, ``:194-224``).
+``lax.fori_loop`` carry, entirely on device. Compute/communication overlap
+is either delegated to XLA's latency-hiding scheduler (``--overlap off``,
+the default) or made explicit via the interior/border split of
+:mod:`tpu_stencil.parallel.overlap` (``--overlap split|fused-split|auto``)
+— the reference's hand-written inner-then-border schedule (``:194-224``),
+expressed as data dependence instead of request ordering.
 
 Non-divisible image shapes — which the reference aborts on
 (``mpi/mpi_convolution.c:54-58``) — are padded up to the tile grid and the
@@ -39,6 +42,7 @@ except ImportError:  # older jax: experimental module, check_rep keyword
 
 from tpu_stencil.models.blur import IteratedConv2D
 from tpu_stencil.ops import lowering as _lowering
+from tpu_stencil.parallel import overlap as overlap_mod
 from tpu_stencil.parallel import partition
 from tpu_stencil.parallel.halo import halo_exchange
 from tpu_stencil.parallel.mesh import make_mesh, ROWS_AXIS, COLS_AXIS
@@ -117,6 +121,7 @@ def build_sharded_iterate(
     schedule=None,
     boundary: str = "zero",
     block_h: Optional[int] = None,
+    overlap: str = "off",
 ):
     """Compile-once builder for the sharded iteration program.
 
@@ -125,7 +130,19 @@ def build_sharded_iterate(
     plan's taps are compiled in. ``backend='pallas'`` runs the fused
     valid-ghost Pallas kernel per chunk of ``fuse`` reps (``global_shape``
     = padded (rows, cols*channels) required); XLA otherwise.
+
+    ``overlap``: a *resolved* interior/border schedule — ``off`` keeps the
+    monolithic exchange-then-compute step (XLA's latency-hiding scheduler
+    owns the overlap), ``split``/``fused-split`` run the explicit split of
+    :mod:`tpu_stencil.parallel.overlap` (bit-exact with ``off`` by
+    construction). ``auto`` must be resolved by the caller
+    (:class:`ShardedRunner` does) before reaching here.
     """
+    if overlap not in ("off", "split", "fused-split"):
+        raise ValueError(
+            f"build_sharded_iterate needs a resolved overlap mode, "
+            f"got {overlap!r}"
+        )
     r = mesh.shape[ROWS_AXIS]
     c = mesh.shape[COLS_AXIS]
     axes = ((ROWS_AXIS, r, 0), (COLS_AXIS, c, 1))
@@ -145,14 +162,35 @@ def build_sharded_iterate(
                 "pallas sharded execution with a pad mask requires fuse=1"
             )
 
+        if overlap in ("split", "fused-split"):
+            # Explicit split at chunk granularity: the interior launch
+            # reads only the local tile, the border launches read the
+            # exchanged ghosts ("split" differs from "fused-split" only
+            # in the fuse depth the runner compiled in).
+            def step_chunk(x, n_fused, mask_tile):
+                out = overlap_mod.fused_split_chunk(
+                    x, plan, axes, n_fused, global_shape, interpret,
+                    schedule=schedule, block_h=block_h,
+                )
+                if mask_tile is not None:
+                    out = out * mask_tile
+                return out
+        else:
+            def step_chunk(x, n_fused, mask_tile):
+                out = _pallas_local_chunk(
+                    x, plan, axes, n_fused, global_shape, interpret,
+                    schedule, block_h=block_h,
+                )
+                if mask_tile is not None:
+                    out = out * mask_tile
+                return out
+    elif overlap in ("split", "fused-split"):
+        # fused-split needs the valid-ghost Pallas kernel; on the XLA
+        # path both modes mean the per-rep split (the runner reports the
+        # degrade via its resolved ``overlap``).
         def step_chunk(x, n_fused, mask_tile):
-            out = _pallas_local_chunk(
-                x, plan, axes, n_fused, global_shape, interpret, schedule,
-                block_h=block_h,
-            )
-            if mask_tile is not None:
-                out = out * mask_tile
-            return out
+            assert n_fused == 1
+            return overlap_mod.split_step(x, plan, axes, mask_tile, boundary)
     else:
         def step_chunk(x, n_fused, mask_tile):
             assert n_fused == 1
@@ -300,9 +338,11 @@ class ShardedRunner:
         channels: int,
         mesh_shape: Optional[Tuple[int, int]] = None,
         devices: Optional[Sequence[jax.Device]] = None,
+        overlap: str = "off",
     ) -> None:
         from tpu_stencil.models.blur import resolve_backend
 
+        overlap_mod.check_mode(overlap)
         self.model = model
         self.h, self.w = image_shape
         self.channels = channels
@@ -419,6 +459,23 @@ class ShardedRunner:
                 self.schedule = pallas_stencil.effective_schedule_for(
                     model.plan, tile[0], self.schedule, block_h=geo_bh
                 )
+        # Interior/border overlap schedule: resolve "auto" (measured
+        # phase-probe ratio, disk-cached; multi-host rank-0 verdict is
+        # broadcast — the split changes the collective program exactly
+        # like a divergent fuse would) and degrade "fused-split" to
+        # "split" off the Pallas backend. Resolved AFTER the fuse clamp:
+        # "split" means one exchange per rep, so it forces single-rep
+        # chunks; "fused-split" keeps the chunked exchange and widens the
+        # bands instead.
+        self.overlap_requested = overlap
+        self.overlap = self._resolve_overlap(overlap)
+        if self.overlap == "split":
+            self.fuse = 1
+        from tpu_stencil import obs as _obs
+
+        _obs.registry().gauge("overlap_mode").set(
+            overlap_mod.MODE_CODES[self.overlap]
+        )
         self._fn = build_sharded_iterate(
             self.mesh, model.plan, channels, self.needs_mask,
             backend=self.backend,
@@ -430,6 +487,7 @@ class ShardedRunner:
             schedule=self.schedule,
             boundary=self.boundary,
             block_h=geo_bh if self.backend == "pallas" else None,
+            overlap=self.overlap,
         )
         if self.needs_mask:
             mask = np.zeros(self.padded_shape, np.uint8)
@@ -439,6 +497,93 @@ class ShardedRunner:
             self._mask = jax.device_put(mask, self.sharding)
         else:
             self._mask = None
+
+    def _resolve_overlap(self, requested: str) -> str:
+        """Resolve the requested ``--overlap`` mode to what this runner
+        actually compiles: ``auto`` asks the autotuner (measured
+        exchange/interior phase-probe ratio, cached on disk alongside the
+        backend/schedule/geometry verdicts — a warm cache never
+        re-probes); ``fused-split`` degrades to ``split`` when the
+        interior cannot run the valid-ghost Pallas kernel."""
+        if requested == "off":
+            return "off"
+        if requested != "auto":
+            if requested == "fused-split" and self.backend != "pallas":
+                return "split"
+            return requested
+        from tpu_stencil.runtime import autotune
+
+        if jax.process_count() == 1:
+            mode = autotune.best_overlap(
+                self.model.plan, self.tile, self.channels, self.mesh_shape,
+                self.backend, measure=self._measure_overlap_probes,
+            )
+        else:
+            mode = self._agreed_overlap()
+        if mode == "fused-split" and self.backend != "pallas":
+            mode = "split"
+        return mode
+
+    def _agreed_overlap(self) -> str:
+        """Multi-host ``auto`` resolution. The probe programs are
+        collective, so every process must run them together or not at
+        all: rank 0 checks the disk cache and broadcasts hit-or-miss; on
+        a miss ALL ranks execute the probes (identical collective
+        programs), then rank 0's verdict is stored and broadcast — the
+        split changes every rank's ppermute sequence, so a divergent
+        mode would shear the job exactly like divergent argv."""
+        from jax.experimental import multihost_utils
+
+        from tpu_stencil.runtime import autotune
+
+        modes = ("off", "split", "fused-split")
+        vote = np.full(1, -1, np.int32)
+        if jax.process_index() == 0:
+            hit = autotune.cached_overlap(
+                self.model.plan, self.tile, self.channels, self.mesh_shape,
+                self.backend,
+            )
+            if hit is not None:
+                vote[0] = modes.index(hit)
+        vote = multihost_utils.broadcast_one_to_all(vote)
+        if int(vote[0]) >= 0:
+            return modes[int(vote[0])]
+        measured = self._measure_overlap_probes()  # collective: all ranks
+        vote = np.full(1, -1, np.int32)
+        if jax.process_index() == 0:
+            mode = autotune.best_overlap(
+                self.model.plan, self.tile, self.channels, self.mesh_shape,
+                self.backend, measure=lambda: measured,
+            )
+            vote[0] = modes.index(mode)
+        vote = multihost_utils.broadcast_one_to_all(vote)
+        return modes[int(vote[0])]
+
+    def _measure_overlap_probes(self) -> Tuple[float, float]:
+        """(exchange_seconds, interior_seconds): one best-of-3 execution
+        each of the exchange-only and interior-only probe programs on a
+        zero canvas of this runner's padded shape, compiles fenced out —
+        the ratio ``--overlap auto`` decides on. Collective on a
+        multi-host mesh (every process must call it together)."""
+        exchange_fn, interior_fn = self._phase_probes()
+        shape = self.padded_shape
+        if self.channels != 1:
+            shape = shape + (self.channels,)
+        img = jax.device_put(np.zeros(shape, np.uint8), self.sharding)
+        jax.block_until_ready(exchange_fn(img))  # compile fences
+        jax.block_until_ready(interior_fn(img))
+
+        def best_of(fn, n=3):
+            import time
+
+            best = float("inf")
+            for _ in range(n):
+                t0 = time.perf_counter()
+                jax.block_until_ready(fn(img))
+                best = min(best, time.perf_counter() - t0)
+            return best
+
+        return best_of(exchange_fn), best_of(interior_fn)
 
     def _phase_probes(self):
         """Two compile-once probe programs over this runner's mesh:
@@ -472,6 +617,63 @@ class ShardedRunner:
 
         return build(exchange_only), build(interior_only)
 
+    def _overlap_probes(self):
+        """Two compile-once probes of the explicit split's halves, both
+        communication-free (tile-local zero pad stands in for exchanged
+        ghosts — compute attribution only, same trick as
+        ``interior_only``): ``interior_overlap(img)`` runs the ghost-free
+        interior band (zero-padded back to tile shape so specs match),
+        ``border_compute(img)`` the four border strips stitched around a
+        zero interior. Sized with a single-halo split (``g = halo``, not
+        ``fuse * halo``): traced runs launch one rep at a time, so the
+        per-rep split these spans sit next to in the trace really is the
+        ``halo``-deep one — the untraced fused chunking is deliberately
+        not what the probes model. Returns None when the tile has no
+        single-rep ghost-free interior (the split degrades to monolithic
+        there)."""
+        plan = self.model.plan
+        h = plan.halo
+        th, tw = self.tile
+        if h < 1 or th <= 2 * h or tw <= 2 * h:
+            return None
+        spec = (
+            P(ROWS_AXIS, COLS_AXIS) if self.channels == 1
+            else P(ROWS_AXIS, COLS_AXIS, None)
+        )
+
+        def pad_spatial(x, amounts):
+            return jnp.pad(x, list(amounts) + [(0, 0)] * (x.ndim - 2))
+
+        def interior_overlap(tile):
+            return pad_spatial(
+                _lowering.valid_step(tile, plan), [(h, h), (h, h)]
+            )
+
+        def border_compute(tile):
+            ext = pad_spatial(tile, [(h, h), (h, h)])
+            top = _lowering.valid_window(ext, plan, 0, h, 0, tw)
+            bottom = _lowering.valid_window(ext, plan, th - h, h, 0, tw)
+            left = _lowering.valid_window(ext, plan, h, th - 2 * h, 0, h)
+            right = _lowering.valid_window(
+                ext, plan, h, th - 2 * h, tw - h, h
+            )
+            mid = jnp.concatenate([
+                left,
+                jnp.zeros(
+                    (th - 2 * h, tw - 2 * h) + tuple(tile.shape[2:]),
+                    tile.dtype,
+                ),
+                right,
+            ], axis=1)
+            return jnp.concatenate([top, mid, bottom], axis=0)
+
+        def build(f):
+            return jax.jit(shard_map(
+                f, mesh=self.mesh, in_specs=(spec,), out_specs=spec,
+            ))
+
+        return build(interior_overlap), build(border_compute)
+
     def trace_phase_probes(self, img_dev: jax.Array) -> None:
         """Emit ``sharded.halo_exchange`` / ``sharded.interior_compute``
         spans: one measured execution each of the probe programs (each
@@ -484,13 +686,27 @@ class ShardedRunner:
         if not obs.enabled() or self.model.plan.halo < 1:
             return
         exchange_fn, interior_fn = self._phase_probes()
+        split_probes = (
+            self._overlap_probes() if self.overlap != "off" else None
+        )
         with obs.span("sharded.probe_compile", "sharded") as s:
             s.fence(exchange_fn(img_dev))
             s.fence(interior_fn(img_dev))
+            if split_probes is not None:
+                s.fence(split_probes[0](img_dev))
+                s.fence(split_probes[1](img_dev))
         with obs.span("sharded.halo_exchange", "sharded") as s:
             s.fence(exchange_fn(img_dev))
         with obs.span("sharded.interior_compute", "sharded") as s:
             s.fence(interior_fn(img_dev))
+        if split_probes is not None:
+            # The explicit split's halves, measured separately: the
+            # interior band XLA may overlap with the exchange, and the
+            # border-strip finish that waits on the ghosts.
+            with obs.span("sharded.interior_overlap", "sharded") as s:
+                s.fence(split_probes[0](img_dev))
+            with obs.span("sharded.border_compute", "sharded") as s:
+                s.fence(split_probes[1](img_dev))
 
     def introspect_warmup(self, img_dev: jax.Array, repetitions: int):
         """AOT-introspect the compiled sharded program the warm-up just

@@ -341,6 +341,85 @@ def best_full_config(
     return winner, win_sched, win_bh, win_fuse
 
 
+# --- interior/border overlap schedule ("--overlap auto") ---------------
+#
+# The explicit split (tpu_stencil.parallel.overlap) pays a stitch + extra
+# launches to let XLA run the ghost-free interior concurrently with the
+# ppermute traffic. The persistent-MPI stencil literature (PAPERS.md) and
+# the GPU tuning study both find the explicit schedule wins when comm and
+# compute are COMPARABLE; when the exchange is a negligible sliver of the
+# interior time there is nothing to hide and the stitch overhead is pure
+# loss. The decision input is the measured exchange/interior phase-probe
+# ratio (ShardedRunner._measure_overlap_probes).
+OVERLAP_MIN_RATIO = 0.05  # exchange below 5% of interior: overlap is moot
+
+
+def overlap_from_ratio(ratio: float, backend: str) -> str:
+    """Map a measured exchange/interior time ratio to an overlap mode:
+    ``off`` below :data:`OVERLAP_MIN_RATIO`, else the chunked
+    ``fused-split`` on the Pallas backend (one widened exchange per
+    fused chunk) and the per-rep ``split`` elsewhere."""
+    if not ratio > OVERLAP_MIN_RATIO:
+        return "off"
+    return "fused-split" if backend == "pallas" else "split"
+
+
+def _overlap_key(plan: StencilPlan, tile: Tuple[int, int], channels: int,
+                 mesh_shape: Tuple[int, int], backend: str) -> str:
+    # Same identity discipline as _key, plus the mesh (the ratio depends
+    # on how many neighbors exchange) and the backend (the split flavor
+    # differs, and so does the interior's cost).
+    return "|".join([
+        "overlap", _key(plan, tuple(tile), channels),
+        f"mesh{mesh_shape[0]}x{mesh_shape[1]}", backend,
+    ])
+
+
+def cached_overlap(plan: StencilPlan, tile: Tuple[int, int], channels: int,
+                   mesh_shape: Tuple[int, int], backend: str
+                   ) -> Optional[str]:
+    """The cached overlap verdict for this key, or None (cache miss /
+    stale mode name). Read-only: multi-host rank 0 uses it to decide
+    whether the collective probe measurement must run at all."""
+    hit = _load_cache().get(
+        _overlap_key(plan, tile, channels, mesh_shape, backend)
+    )
+    if isinstance(hit, dict) and hit.get("overlap") in (
+            "off", "split", "fused-split"):
+        return hit["overlap"]
+    return None
+
+
+def best_overlap(plan: StencilPlan, tile: Tuple[int, int], channels: int,
+                 mesh_shape: Tuple[int, int], backend: str,
+                 measure, cache: bool = True) -> str:
+    """The overlap mode for this (platform, filter, tile, mesh, backend):
+    from the disk cache when available (a warm cache never re-probes),
+    measured once and cached otherwise. ``measure()`` returns
+    ``(exchange_seconds, interior_seconds)`` — the runner passes its
+    phase-probe closure, so the autotuner owns only the decision and the
+    persistence, never a mesh."""
+    if cache:
+        hit = cached_overlap(plan, tile, channels, mesh_shape, backend)
+        if hit is not None:
+            return hit
+    exchange_s, interior_s = measure()
+    ratio = (
+        exchange_s / interior_s if interior_s > 0 else float("inf")
+    )
+    mode = overlap_from_ratio(ratio, backend)
+    if cache:
+        store = _load_cache()
+        store[_overlap_key(plan, tile, channels, mesh_shape, backend)] = {
+            "overlap": mode,
+            "ratio": round(ratio, 4),
+            "exchange_us": round(exchange_s * 1e6, 2),
+            "interior_us": round(interior_s * 1e6, 2),
+        }
+        _store_cache(store)
+    return mode
+
+
 def best_config(
     plan: StencilPlan,
     shape: Tuple[int, int],

@@ -13,6 +13,39 @@ from __future__ import annotations
 from typing import Tuple
 
 V5E_HBM_GBPS = 819.0  # v5e HBM peak bandwidth
+# v5e inter-chip interconnect: 4 links x 400 Gbps = 1600 Gbps aggregate
+# per chip (the public spec sheet's number) — the ceiling the sharded
+# path's ghost traffic rides.
+V5E_ICI_GBPS = 200.0
+
+
+def ici_ghost_bytes_per_rep(tile_shape, channels: int, halo: int,
+                            mesh_shape, fuse: int = 1,
+                            elem_bytes: int = 1) -> float:
+    """Modeled ICI ghost bytes *received per device per repetition* on
+    the sharded mesh — the comm side of the interior/border overlap
+    split (:mod:`tpu_stencil.parallel.overlap`), shown by ``--breakdown``
+    next to the measured exchange/interior/border probe spans.
+
+    Model (an interior device — the bottleneck rank): the row phase
+    delivers two ``g = fuse*halo``-deep strips of the tile width; the
+    column phase runs on the row-extended array, so its two strips are
+    ``tile_h + 2*g`` tall. Axes of size 1 exchange nothing. A fused
+    chunk pays one exchange per ``fuse`` reps, so per-rep traffic
+    divides by ``fuse``. ``elem_bytes``: 1 for the uint8 exchanges (the
+    split schedules, the Pallas chunk, direct plans), 4 for the
+    monolithic XLA sep_int step's int32 phased exchange.
+    """
+    th, tw = tile_shape
+    r, c = mesh_shape
+    g = fuse * halo
+    total = 0
+    if r > 1:
+        total += 2 * g * tw * channels * elem_bytes
+    if c > 1:
+        rows = th + (2 * g if r > 1 else 0)
+        total += 2 * g * rows * channels * elem_bytes
+    return total / max(1, fuse)
 
 
 def effective_fuse(filter_name: str, h_img: int,

@@ -18,6 +18,8 @@ import time
 
 import numpy as np
 
+from tpu_stencil.config import OVERLAP_MODES
+
 # --stats-json payload schema. 1 = the PR-1 report dict plus the
 # schema_version/ts fields themselves. Bump on breaking shape changes.
 STATS_SCHEMA_VERSION = 1
@@ -48,6 +50,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--backend", default="auto",
                    choices=["auto", "xla", "pallas", "reference", "autotune"],
                    help="compute backend (default auto)")
+    p.add_argument("--overlap", default="off", choices=list(OVERLAP_MODES),
+                   help="interior/border overlap schedule (same vocabulary "
+                        "as the run CLI); recorded in the overlap_mode "
+                        "gauge. Today's bucket executables are "
+                        "single-device (no ghost exchange), so modes other "
+                        "than off are accepted but inert until a "
+                        "spatially-sharded serve path lands")
     p.add_argument("--max-queue", type=int, default=256,
                    help="bounded queue depth; beyond it submissions are "
                         "rejected (default 256)")
@@ -217,6 +226,7 @@ def main(argv=None) -> int:
         cfg = ServeConfig(
             filter_name=ns.filter_name, backend=ns.backend,
             max_queue=ns.max_queue, max_batch=ns.max_batch,
+            overlap=ns.overlap,
         )
     except ValueError as e:
         parser.error(str(e))

@@ -310,6 +310,19 @@ class StencilServer:
         self._m_rlat = m.histogram("request_latency_seconds")
         self._m_bsize = m.histogram("batch_size")
         self._m_gbps = m.histogram("batch_hbm_gbps")
+        # Configured overlap schedule, same gauge name/coding as the
+        # sharded runner's (parallel/overlap.py MODE_CODES), plus
+        # AUTO_CODE for a requested "auto" — serve has no mesh to
+        # resolve it against. Bucket executables are single-device
+        # today, so the mode is inert — recorded so dashboards see the
+        # knob the deployment set.
+        from tpu_stencil.parallel import overlap as _overlap_mod
+
+        m.gauge("overlap_mode").set(
+            _overlap_mod.MODE_CODES.get(
+                self.cfg.overlap, _overlap_mod.AUTO_CODE
+            )
+        )
         global _last_server_ref
         _last_server_ref = weakref.ref(self)
         if start:
