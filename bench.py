@@ -42,6 +42,13 @@ versioned headline capture whose metric is suffixed with the mesh and
 the RESOLVED overlap mode — a distinct perf-sentry series per
 (mesh, overlap), so sharded runs gate regressions like single-chip ones.
 
+Streaming mode: ``TPU_STENCIL_BENCH_STREAM=1`` measures the pipelined
+frame-streaming engine (``tpu_stencil.stream``, null sink, warm-up
+excluded) and emits a versioned headline capture in seconds/frame with
+the pipeline depth folded into the metric name — its own perf-sentry
+series, gateable like the mesh captures
+(``TPU_STENCIL_BENCH_STREAM_FRAMES`` / ``_DEPTH`` tune the run).
+
 Exit codes: 0 = capture landed (even partial-only); 1 = nothing
 parseable; 2 = the requested backend is unavailable (init failed — the
 parent does NOT retry: a 4-attempt backoff loop against a dead backend
@@ -378,6 +385,74 @@ def _measure_multichip(mesh_shape, overlap: str, platform: str) -> dict:
     return line
 
 
+def _measure_stream(platform: str) -> dict:
+    """Streaming-path capture (``TPU_STENCIL_BENCH_STREAM=1``): run a
+    synthetic north-star-frame stream through the pipelined engine with
+    the null sink and emit a versioned headline capture — seconds per
+    frame (so slower = larger, gating like every other sentry series)
+    with frames/s and per-stage seconds as riders. The pipeline depth
+    is folded into the metric name: a depth A/B is two series, never a
+    false regression. A 2-frame warm-up stream runs first so the
+    headline measures the steady state, not the compile.
+
+    Knobs: ``TPU_STENCIL_BENCH_STREAM_FRAMES`` (default 16),
+    ``TPU_STENCIL_BENCH_STREAM_DEPTH`` (default 2)."""
+    import tempfile
+
+    from tpu_stencil.config import ImageType, StreamConfig
+    from tpu_stencil.stream.engine import run_stream
+
+    n_frames = int(os.environ.get("TPU_STENCIL_BENCH_STREAM_FRAMES", "16"))
+    depth = int(os.environ.get("TPU_STENCIL_BENCH_STREAM_DEPTH", "2"))
+    backend = os.environ.get("TPU_STENCIL_BENCH_BACKENDS", "auto").split(",")[0]
+    rng = np.random.default_rng(0)
+    with tempfile.TemporaryDirectory(prefix="bench_stream_") as d:
+        clip = os.path.join(d, "clip.raw")
+        frame = rng.integers(0, 256, size=(H, W, C), dtype=np.uint8)
+        with open(clip, "wb") as f:
+            for _ in range(max(2, n_frames)):
+                f.write(frame.tobytes())
+
+        def cfg(frames, k):
+            return StreamConfig(
+                input=clip, width=W, height=H, repetitions=REPS,
+                image_type=ImageType.RGB, backend=backend,
+                output="null", frames=frames, pipeline_depth=k,
+            )
+
+        run_stream(cfg(2, depth))  # warm-up: compile lands in jit cache
+        res = run_stream(cfg(n_frames, depth))
+    per_frame = res.wall_seconds / max(1, res.frames)
+    log(f"stream depth={depth} [{res.backend}]: "
+        f"{res.frames_per_second:.2f} frames/s "
+        f"({per_frame * 1e3:.1f} ms/frame, {res.frames} frames)")
+    line = {
+        "metric": (
+            f"{W}x{H}_rgb_{REPS}reps_stream_depth{depth}_wall_per_frame"
+        ),
+        "value": round(per_frame, 6),
+        "unit": "s",
+        # The CUDA baseline is whole-program seconds for ONE frame at
+        # these reps — exactly one streamed frame's wall share.
+        "vs_baseline": round(BASELINE_S / per_frame, 2),
+        "backend": res.backend,
+        "platform": platform,
+        "frames_per_second": round(res.frames_per_second, 3),
+        "n_frames": res.frames,
+        "pipeline_depth": depth,
+        "stage_seconds": {
+            k: round(v, 6) for k, v in sorted(res.stage_seconds.items())
+        },
+        "shape": f"{W}x{H}",
+        "reps": REPS,
+        "filter": "gaussian",
+        "dtype": "uint8",
+        "schema_version": 1,
+        "ts": round(time.monotonic(), 6),
+    }
+    return line
+
+
 def child_main() -> int:
     # Test-only crash injection: if the marker file exists, consume it and
     # die the way a tunnel drop kills a real capture (lets the retry loop
@@ -416,6 +491,15 @@ def child_main() -> int:
         }), flush=True)
         log(f"backend init failed: {type(e).__name__}: {e}")
         return 2
+
+    if os.environ.get("TPU_STENCIL_BENCH_STREAM") == "1":
+        try:
+            result = _measure_stream(platform)
+        except Exception as e:
+            log(f"stream: FAILED {type(e).__name__}: {e}")
+            return 1
+        print(json.dumps(result), flush=True)
+        return 0
 
     mesh_env = os.environ.get("TPU_STENCIL_BENCH_MESH")
     if mesh_env:

@@ -466,6 +466,43 @@ def test_bench_multichip_capture(tmp_path):
     assert rec["extra"]["overlap"] == "split"
 
 
+def test_bench_stream_capture(tmp_path):
+    # TPU_STENCIL_BENCH_STREAM runs the pipelined streaming engine
+    # (null sink, warm-up excluded) and emits a versioned headline
+    # capture in seconds/frame with the pipeline depth folded into the
+    # metric name — its own sentry-gateable series.
+    proc = _run_bench(
+        tmp_path, inject_failure=False,
+        extra_env={"TPU_STENCIL_BENCH_STREAM": "1",
+                   "TPU_STENCIL_BENCH_STREAM_FRAMES": "4"},
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [l for l in proc.stdout.splitlines() if l.strip()]
+    cap = json.loads(lines[-1])
+    assert cap["metric"] == "48x64_rgb_40reps_stream_depth2_wall_per_frame"
+    assert cap["value"] > 0 and cap["unit"] == "s"
+    assert cap["schema_version"] == 1
+    assert cap["pipeline_depth"] == 2 and cap["n_frames"] == 4
+    assert cap["frames_per_second"] > 0
+    assert set(cap["stage_seconds"]) == {
+        "read", "h2d", "compute", "d2h", "write"
+    }
+    assert {"shape", "reps", "filter", "dtype", "backend",
+            "platform"} <= set(cap)
+    # The extractor promotes it and the sentry builds a gateable record
+    # keyed on the depth-suffixed metric name.
+    f = tmp_path / "stream.json"
+    f.write_text(proc.stdout)
+    from tools.bench_capture import last_capture
+    from tpu_stencil.obs import sentry
+
+    got = last_capture(str(f))
+    assert got["metric"] == cap["metric"]
+    rec = sentry.record_from_capture(got)
+    assert rec["metric"] == cap["metric"]
+    assert rec["value"] == cap["value"]
+
+
 def test_bench_multichip_sentry_gates(tmp_path):
     # A multichip capture series must gate like single-chip ones: two
     # logged runs, then a 2x slower run trips the sentry (rc=3).

@@ -108,3 +108,83 @@ def test_write_raw_block_out_of_bounds_cols(tmp_path, rng):
     blk = rng.integers(0, 256, size=(4, 8, 1), dtype=np.uint8)
     with pytest.raises(ValueError):
         raw_io.write_raw_block(p, 0, 5, blk, 12, 1, 4)
+
+
+def test_read_raw_rows_from_pipe(rng):
+    # FIFO/pipe sources have no meaningful size: os.path.getsize used to
+    # make every pipe read fail (or lie); non-regular files skip the
+    # size check and read sequentially (the stream stdin source's
+    # contract).
+    import threading
+
+    img = rng.integers(0, 256, size=(6, 5, 3), dtype=np.uint8)
+    r, w = os.pipe()
+
+    def feed():
+        with os.fdopen(w, "wb") as f:
+            f.write(img.tobytes())
+
+    t = threading.Thread(target=feed, daemon=True)
+    t.start()
+    try:
+        back = raw_io.read_raw_rows(f"/dev/fd/{r}", 0, 6, 5, 3)
+    finally:
+        os.close(r)
+        t.join(10)
+    np.testing.assert_array_equal(back, img)
+
+
+def test_read_raw_rows_pipe_offset_discards(rng):
+    # A row_start into a pipe reads-and-discards the offset bytes (no
+    # pread on pipes), then returns the addressed rows.
+    import threading
+
+    img = rng.integers(0, 256, size=(8, 4, 1), dtype=np.uint8)
+    r, w = os.pipe()
+
+    def feed():
+        with os.fdopen(w, "wb") as f:
+            f.write(img.tobytes())
+
+    t = threading.Thread(target=feed, daemon=True)
+    t.start()
+    try:
+        back = raw_io.read_raw_rows(f"/dev/fd/{r}", 3, 4, 4, 1)
+    finally:
+        os.close(r)
+        t.join(10)
+    np.testing.assert_array_equal(back, img[3:7])
+
+
+def test_read_raw_rows_pipe_short_read_fails_loudly():
+    # A pipe that closes mid-frame must raise, never return garbage —
+    # the fail-loudly analog of the regular-file size check.
+    import threading
+
+    r, w = os.pipe()
+
+    def feed():
+        with os.fdopen(w, "wb") as f:
+            f.write(b"\x01" * 10)
+
+    t = threading.Thread(target=feed, daemon=True)
+    t.start()
+    try:
+        with pytest.raises(IOError, match="short read"):
+            raw_io.read_raw_rows(f"/dev/fd/{r}", 0, 5, 5, 1)
+    finally:
+        os.close(r)
+        t.join(10)
+
+
+def test_require_regular_refuses_fifo(tmp_path):
+    # Multi-band callers (sharded reads) issue repeated positioned reads
+    # against one path; a FIFO would silently hand each band the wrong
+    # bytes, so they must refuse it loudly.
+    fifo = str(tmp_path / "in.fifo")
+    os.mkfifo(fifo)
+    with pytest.raises(ValueError, match="not a regular file"):
+        raw_io.require_regular(fifo, "sharded per-band input")
+    p = str(tmp_path / "ok.raw")
+    open(p, "wb").close()
+    raw_io.require_regular(p, "anything")  # regular files pass

@@ -369,6 +369,29 @@ def test_loadgen_closed_loop_reports_from_registry(rng):
     assert report["stats"]["histograms"]["queue_wait_seconds"]["count"] == 16
 
 
+def test_loadgen_fixed_frame_rate_reports_achieved_vs_requested(rng):
+    # --rate-fps: the open-loop fixed-frame-rate mode (the live-video
+    # arrival law the stream benchmarks share). Forces the open loop at
+    # that rate and reports requested vs offered vs achieved fps.
+    with StencilServer(ServeConfig(max_queue=32, max_batch=4,
+                                   bucket_edges=(8, 16, 32))) as s:
+        report = loadgen.run(
+            s, mode="closed", requests=8, reps=1, rate_fps=400.0,
+            shapes=((10, 12),), channels=(3,), seed=4,
+        )
+    assert report["mode"] == "open"  # rate_fps forces the open loop
+    assert report["requested_fps"] == 400.0
+    assert report["offered_fps"] > 0
+    assert report["achieved_fps"] > 0
+    # All 8 completed on an idle server: achieved tracks completions.
+    assert report["completed"] == 8
+    assert report["achieved_fps"] == pytest.approx(
+        report["completed"] / report["wall_seconds"])
+    with pytest.raises(ValueError, match="rate_fps"):
+        loadgen.run(StencilServer(ServeConfig(), start=False),
+                    rate_fps=0.0)
+
+
 def test_loadgen_open_loop_sheds_under_overload(rng):
     # Open loop at an absurd arrival rate into a 2-deep queue: the server
     # must reject (bounded memory), not buffer. The first compile makes

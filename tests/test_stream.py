@@ -1,0 +1,616 @@
+"""The pipelined streaming engine (tpu_stencil.stream): stream-vs-run
+equivalence, backpressure/EOF/failure semantics, resume, the pipeline
+trace ladder, and the depth-2-beats-depth-1 throughput claim."""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tpu_stencil import driver, obs
+from tpu_stencil.config import ImageType, JobConfig, StreamConfig
+from tpu_stencil.runtime import checkpoint as ckpt
+from tpu_stencil.stream import cli as stream_cli
+from tpu_stencil.stream import engine as stream_engine
+from tpu_stencil.stream import frames as frames_io
+from tpu_stencil.stream.engine import StreamFailure, run_stream
+
+
+def _make_clip(path, n, h, w, ch, seed=0):
+    """n concatenated raw frames; returns the (n, h, w[, ch]) array."""
+    rng = np.random.default_rng(seed)
+    shape = (n, h, w) if ch == 1 else (n, h, w, ch)
+    clip = rng.integers(0, 256, size=shape, dtype=np.uint8)
+    clip.tofile(path)
+    return clip
+
+
+def _golden_frames(tmp_path, clip, reps, image_type, **job_kw):
+    """Each frame through an independent run_job; returns raw bytes."""
+    h, w = clip.shape[1:3]
+    out = []
+    for i in range(clip.shape[0]):
+        src = str(tmp_path / f"golden_in_{i}.raw")
+        dst = str(tmp_path / f"golden_out_{i}.raw")
+        clip[i].tofile(src)
+        driver.run_job(JobConfig(
+            image=src, width=w, height=h, repetitions=reps,
+            image_type=image_type, output=dst, **job_kw,
+        ))
+        out.append(open(dst, "rb").read())
+    return out
+
+
+def _stream_cfg(tmp_path, clip_path, h, w, image_type, reps, **kw):
+    kw.setdefault("output", str(tmp_path / "stream_out.raw"))
+    return StreamConfig(
+        input=str(clip_path), width=w, height=h, repetitions=reps,
+        image_type=image_type, **kw,
+    )
+
+
+class _SlowSource(frames_io.FrameSource):
+    """Injected per-frame read latency — a disk/network-shaped source."""
+
+    def __init__(self, inner, delay_s):
+        self.inner, self.delay_s = inner, delay_s
+
+    def read_into(self, buf):
+        time.sleep(self.delay_s)
+        return self.inner.read_into(buf)
+
+    def skip(self, n):
+        self.inner.skip(n)
+
+    def close(self):
+        self.inner.close()
+
+
+class _FailingSink(frames_io.FrameSink):
+    def __init__(self, fail_at):
+        self.fail_at = fail_at
+        self.written = []
+
+    def write(self, index, frame):
+        if index == self.fail_at:
+            raise IOError("disk full (injected)")
+        self.written.append(index)
+
+
+# -- stream-vs-run equivalence ---------------------------------------
+
+@pytest.mark.parametrize("image_type,boundary,depth,fuse", [
+    (ImageType.RGB, "zero", 2, None),
+    (ImageType.GREY, "zero", 1, None),
+    (ImageType.RGB, "periodic", 4, None),
+    (ImageType.GREY, "periodic", 2, 2),
+    (ImageType.RGB, "zero", 3, 1),
+])
+def test_stream_matches_run_job(tmp_path, image_type, boundary, depth, fuse):
+    h, w, ch, reps, n = 20, 16, image_type.channels, 3, 4
+    clip_path = tmp_path / "clip.raw"
+    clip = _make_clip(clip_path, n, h, w, ch, seed=depth)
+    golden = _golden_frames(tmp_path, clip, reps, image_type,
+                            boundary=boundary, fuse=fuse)
+    out = str(tmp_path / "out.raw")
+    res = run_stream(_stream_cfg(
+        tmp_path, clip_path, h, w, image_type, reps, output=out,
+        frames=n, pipeline_depth=depth, boundary=boundary, fuse=fuse,
+    ))
+    assert res.frames == n
+    blob = open(out, "rb").read()
+    fb = h * w * ch
+    for i in range(n):
+        assert blob[i * fb:(i + 1) * fb] == golden[i], f"frame {i} differs"
+
+
+def test_stream_fifo_source_and_directory_sink(tmp_path):
+    # The pipe path: frames arrive through a FIFO (no size, no seek),
+    # results land as per-frame files; every frame bit-identical to an
+    # independent run_job.
+    h, w, ch, reps, n = 12, 10, 3, 2, 3
+    clip_path = tmp_path / "clip.raw"
+    clip = _make_clip(clip_path, n, h, w, ch, seed=9)
+    golden = _golden_frames(tmp_path, clip, reps, ImageType.RGB)
+    fifo = str(tmp_path / "feed.fifo")
+    os.mkfifo(fifo)
+
+    def feed():
+        with open(fifo, "wb") as f:
+            f.write(clip.tobytes())
+
+    t = threading.Thread(target=feed, daemon=True)
+    t.start()
+    sink_dir = str(tmp_path / "out_frames") + os.sep
+    res = run_stream(StreamConfig(
+        input=fifo, width=w, height=h, repetitions=reps,
+        image_type=ImageType.RGB, output=sink_dir, frames=None,
+    ))
+    t.join(10)
+    assert res.frames == n
+    for i in range(n):
+        name = os.path.join(
+            sink_dir.rstrip(os.sep), frames_io.FRAME_PATTERN.format(i)
+        )
+        assert open(name, "rb").read() == golden[i], f"frame {i} differs"
+
+
+@pytest.mark.slow
+def test_stream_matches_run_job_full_matrix(tmp_path):
+    # The soak-length sweep: every combination the tier-1 set samples.
+    h, w, reps, n = 16, 12, 2, 3
+    for image_type in (ImageType.GREY, ImageType.RGB):
+        for boundary in ("zero", "periodic"):
+            for fuse in (None, 2):
+                for depth in (1, 2, 4):
+                    ch = image_type.channels
+                    clip_path = tmp_path / f"c_{ch}_{boundary}_{fuse}_{depth}.raw"
+                    clip = _make_clip(clip_path, n, h, w, ch, seed=depth)
+                    golden = _golden_frames(
+                        tmp_path, clip, reps, image_type,
+                        boundary=boundary, fuse=fuse,
+                    )
+                    out = str(tmp_path / "out.raw")
+                    run_stream(_stream_cfg(
+                        tmp_path, clip_path, h, w, image_type, reps,
+                        output=out, frames=n, pipeline_depth=depth,
+                        boundary=boundary, fuse=fuse,
+                    ))
+                    blob = open(out, "rb").read()
+                    fb = h * w * ch
+                    for i in range(n):
+                        assert blob[i * fb:(i + 1) * fb] == golden[i]
+
+
+# -- sources and sinks ------------------------------------------------
+
+def test_directory_source(tmp_path):
+    h, w, ch, n = 8, 6, 1, 3
+    d = tmp_path / "frames_in"
+    d.mkdir()
+    rng = np.random.default_rng(3)
+    frames = [rng.integers(0, 256, (h, w), dtype=np.uint8) for _ in range(n)]
+    for i, f in enumerate(frames):
+        f.tofile(str(d / f"{i:04d}.raw"))
+    src = frames_io.open_source(str(d), h * w * ch)
+    assert isinstance(src, frames_io.RawDirectorySource)
+    buf = np.empty(h * w, np.uint8)
+    got = []
+    while src.read_into(buf):
+        got.append(buf.copy())
+    assert len(got) == n
+    for want, g in zip(frames, got):
+        np.testing.assert_array_equal(g.reshape(h, w), want)
+
+
+def test_directory_source_wrong_size_fails_loudly(tmp_path):
+    d = tmp_path / "frames_in"
+    d.mkdir()
+    (d / "0000.raw").write_bytes(b"\x00" * 10)
+    src = frames_io.open_source(str(d), 48)
+    with pytest.raises(IOError, match="10 bytes"):
+        src.read_into(np.empty(48, np.uint8))
+
+
+def test_null_sink_and_stream_sink_specs(tmp_path):
+    assert isinstance(frames_io.open_sink("null", 4), frames_io.NullSink)
+    p = str(tmp_path / "o.raw")
+    s = frames_io.open_sink(p, 4)
+    assert isinstance(s, frames_io.RawStreamSink)
+    s.close()
+    assert not frames_io.is_resumable_sink("null")
+    assert not frames_io.is_resumable_sink("-")
+    assert frames_io.is_resumable_sink(p)
+    assert frames_io.is_resumable_sink(str(tmp_path) + os.sep)
+
+
+def test_source_short_final_frame_fails_with_index(tmp_path):
+    p = str(tmp_path / "short.raw")
+    with open(p, "wb") as f:
+        f.write(b"\x01" * 10)  # 2.5 frames of 4 bytes
+    src = frames_io.RawStreamSource(p, 4)
+    buf = np.empty(4, np.uint8)
+    assert src.read_into(buf) and src.read_into(buf)
+    with pytest.raises(IOError, match="frame 2"):
+        src.read_into(buf)
+
+
+# -- failure / EOF semantics ------------------------------------------
+
+def test_eof_before_promised_frames_fails_with_index(tmp_path):
+    h, w = 8, 6
+    clip_path = tmp_path / "clip.raw"
+    _make_clip(clip_path, 2, h, w, 1)
+    with pytest.raises(StreamFailure) as ei:
+        run_stream(_stream_cfg(
+            tmp_path, clip_path, h, w, ImageType.GREY, 1, frames=5,
+        ))
+    assert ei.value.stage == "read"
+    assert ei.value.frame_index == 2
+    assert "--frames promised 5" in str(ei.value.__cause__)
+
+
+def test_failing_sink_fails_job_with_frame_index(tmp_path):
+    h, w, n = 8, 6, 5
+    clip_path = tmp_path / "clip.raw"
+    _make_clip(clip_path, n, h, w, 1)
+    sink = _FailingSink(fail_at=2)
+    obs.reset()
+    with pytest.raises(StreamFailure) as ei:
+        run_stream(
+            _stream_cfg(tmp_path, clip_path, h, w, ImageType.GREY, 1,
+                        frames=n),
+            sink=sink,
+        )
+    assert ei.value.stage == "write"
+    assert ei.value.frame_index == 2
+    assert sink.written == [0, 1]  # earlier frames drained and landed
+    # Aborted in-flight frames never pass release_window; the teardown
+    # must still zero the process-wide gauge (peak survives).
+    assert obs.snapshot()["gauges"]["stream_inflight_depth"]["value"] == 0
+
+
+def test_failure_with_reader_parked_on_silent_pipe(tmp_path):
+    # A sink failure while the reader is blocked in read() on a FIFO
+    # that will never deliver another byte: the teardown must not wait
+    # on the parked reader (it is a daemon; join is bounded) and the
+    # recorded failure must be the sink's, not a teardown artifact.
+    h, w = 10, 8
+    clip = np.random.default_rng(5).integers(
+        0, 256, (1, h, w, 3), dtype=np.uint8)
+    fifo = str(tmp_path / "silent.fifo")
+    os.mkfifo(fifo)
+    holder = {}
+
+    def feed_one_then_hang():
+        holder["fd"] = os.open(fifo, os.O_WRONLY)
+        os.write(holder["fd"], clip.tobytes())  # then silence, no EOF
+
+    t = threading.Thread(target=feed_one_then_hang, daemon=True)
+    t.start()
+    cfg = StreamConfig(fifo, w, h, 1, ImageType.RGB, output="null",
+                       frames=4)
+    t0 = time.perf_counter()
+    try:
+        with pytest.raises(StreamFailure) as ei:
+            run_stream(cfg, sink=_FailingSink(fail_at=0))
+        assert ei.value.stage == "write"
+        assert ei.value.frame_index == 0
+        assert time.perf_counter() - t0 < 30  # bounded teardown
+    finally:
+        if "fd" in holder:
+            os.close(holder["fd"])
+        t.join(10)
+
+
+def test_zero_frame_stream_is_clean(tmp_path):
+    p = tmp_path / "empty.raw"
+    p.write_bytes(b"")
+    res = run_stream(_stream_cfg(
+        tmp_path, p, 8, 6, ImageType.GREY, 1, frames=None,
+    ))
+    assert res.frames == 0
+    assert res.frames_per_second == 0.0
+
+
+# -- resume ------------------------------------------------------------
+
+def test_stream_resume_skips_completed_frames(tmp_path):
+    h, w, ch, reps, n = 10, 8, 3, 2, 5
+    clip_path = tmp_path / "clip.raw"
+    _make_clip(clip_path, n, h, w, ch)
+    out_full = str(tmp_path / "full.raw")
+    cfg_full = _stream_cfg(tmp_path, clip_path, h, w, ImageType.RGB, reps,
+                           output=out_full, frames=n)
+    run_stream(cfg_full)
+
+    # Interrupted run: frames [0, 2) are durably in the sink and the
+    # checkpoint records them.
+    out_resumed = str(tmp_path / "resumed.raw")
+    cfg = _stream_cfg(tmp_path, clip_path, h, w, ImageType.RGB, reps,
+                      output=out_resumed, frames=n, checkpoint_every=1)
+    fb = h * w * ch
+    with open(out_resumed, "wb") as f:
+        f.write(open(out_full, "rb").read()[:2 * fb])
+    ckpt.save_stream_progress(cfg, 2)
+
+    res = run_stream(cfg, resume=True)
+    assert res.skipped == 2
+    assert res.frames == n - 2
+    assert open(out_resumed, "rb").read() == open(out_full, "rb").read()
+    # A finished job sweeps its progress sidecar.
+    assert ckpt.restore_stream_progress(cfg) is None
+
+
+def test_stream_checkpoint_refuses_other_job(tmp_path):
+    clip_path = tmp_path / "clip.raw"
+    _make_clip(clip_path, 2, 8, 6, 1)
+    cfg = _stream_cfg(tmp_path, clip_path, 8, 6, ImageType.GREY, 2,
+                      frames=2)
+    ckpt.save_stream_progress(cfg, 1)
+    other = _stream_cfg(tmp_path, clip_path, 8, 6, ImageType.GREY, 3,
+                        frames=2)
+    with pytest.raises(ValueError, match="different job"):
+        ckpt.restore_stream_progress(other)
+    ckpt.clear_stream_progress(cfg)
+
+
+def test_checkpoint_needs_resumable_sink(tmp_path):
+    clip_path = tmp_path / "clip.raw"
+    _make_clip(clip_path, 2, 8, 6, 1)
+    with pytest.raises(ValueError, match="resumable sink"):
+        run_stream(_stream_cfg(
+            tmp_path, clip_path, 8, 6, ImageType.GREY, 1,
+            output="null", frames=2, checkpoint_every=1,
+        ))
+
+
+# -- observability: the pipeline ladder -------------------------------
+
+def _spans_by_frame(tracer, name):
+    return {
+        r.args.get("frame"): r for r in tracer.spans() if r.name == name
+    }
+
+
+def test_depth2_trace_shows_pipeline_overlap(tmp_path):
+    # The acceptance probe: at depth 2, frame i+1's stream.read and
+    # stream.h2d spans overlap frame i's stream.compute span. A slow
+    # source (4ms/frame) and a compute stage that measurably outlasts
+    # it (~10-30ms at this frame size and rep count on CPU) keep the
+    # overlap windows wide enough to observe deterministically: h2d of
+    # frame i+1 starts at its read's end, well inside frame i's
+    # compute.
+    h, w, n, reps = 128, 112, 4, 300
+    clip_path = tmp_path / "clip.raw"
+    _make_clip(clip_path, n, h, w, 1)
+    cfg = _stream_cfg(tmp_path, clip_path, h, w, ImageType.GREY, reps,
+                      output="null", frames=n, pipeline_depth=2)
+    src = _SlowSource(
+        frames_io.RawStreamSource(str(clip_path), cfg.frame_bytes),
+        delay_s=0.004,
+    )
+    obs.reset()  # fresh gauges/counters: peak must be THIS run's
+    tracer = obs.enable()
+    try:
+        run_stream(cfg, source=src)
+    finally:
+        obs.disable()
+    reads = _spans_by_frame(tracer, "stream.read")
+    h2ds = _spans_by_frame(tracer, "stream.h2d")
+    computes = _spans_by_frame(tracer, "stream.compute")
+    assert set(reads) == set(range(n))
+    assert set(computes) == set(range(n))
+
+    def overlaps(a, b):
+        return a is not None and b is not None and a.t0 < b.t1 and a.t1 > b.t0
+
+    assert any(
+        overlaps(reads.get(i + 1), computes.get(i)) for i in range(n - 1)
+    ), "no frame's read overlapped the previous frame's compute"
+    assert any(
+        overlaps(h2ds.get(i + 1), computes.get(i)) for i in range(n - 1)
+    ), "no frame's h2d overlapped the previous frame's compute"
+    # The dispatch window was actually exercised.
+    snap = obs.snapshot()
+    assert snap["gauges"]["stream_inflight_depth"]["peak"] == 2
+    assert snap["counters"]["stream_frames_total"] >= n
+
+
+def test_depth1_serializes_stages(tmp_path):
+    # depth 1 = no dispatch-ahead: frame i+1's read starts only after
+    # frame i drained, so no read/compute overlap is recorded.
+    h, w, n, reps = 48, 40, 3, 60
+    clip_path = tmp_path / "clip.raw"
+    _make_clip(clip_path, n, h, w, 1)
+    cfg = _stream_cfg(tmp_path, clip_path, h, w, ImageType.GREY, reps,
+                      output="null", frames=n, pipeline_depth=1)
+    obs.reset()  # fresh gauges: peak must be THIS run's
+    tracer = obs.enable()
+    try:
+        run_stream(cfg)
+    finally:
+        obs.disable()
+    reads = _spans_by_frame(tracer, "stream.read")
+    d2hs = _spans_by_frame(tracer, "stream.d2h")
+    for i in range(n - 1):
+        assert reads[i + 1].t0 >= d2hs[i].t1, (
+            f"depth-1 read of frame {i + 1} started before frame {i} drained"
+        )
+    snap = obs.snapshot()
+    assert snap["gauges"]["stream_inflight_depth"]["peak"] == 1
+
+
+@pytest.mark.timing
+def test_depth2_beats_depth1_frames_per_second(tmp_path):
+    # The pipelining claim, asserted loosely: with a read stage and a
+    # compute stage of comparable multi-millisecond cost (so thread
+    # scheduling noise is small against both), depth 2 overlaps them
+    # and beats depth 1's serial sum on the same backend and null sink.
+    h, w, n, reps = 96, 96, 12, 500
+    clip_path = tmp_path / "clip.raw"
+    _make_clip(clip_path, n, h, w, 1)
+
+    def fps(depth):
+        cfg = _stream_cfg(tmp_path, clip_path, h, w, ImageType.GREY, reps,
+                          output="null", frames=n, pipeline_depth=depth)
+        src = _SlowSource(
+            frames_io.RawStreamSource(str(clip_path), cfg.frame_bytes),
+            delay_s=0.006,
+        )
+        res = run_stream(cfg, source=src)
+        assert res.frames == n
+        return res.frames_per_second
+
+    fps(2)  # warm the jit cache so neither measured run pays the compile
+    f1, f2 = fps(1), fps(2)
+    assert f2 > f1 * 1.15, (
+        f"depth 2 ({f2:.1f} fps) not measurably faster than "
+        f"depth 1 ({f1:.1f} fps)"
+    )
+
+
+# -- CLI ---------------------------------------------------------------
+
+def test_stream_cli_stats_json(tmp_path, capsys):
+    h, w, n = 10, 8, 3
+    clip_path = tmp_path / "clip.raw"
+    _make_clip(clip_path, n, h, w, 3)
+    out = str(tmp_path / "out.raw")
+    stats = str(tmp_path / "stats.json")
+    rc = stream_cli.main([
+        str(clip_path), str(w), str(h), "2", "rgb", "--frames", str(n),
+        "--output", out, "--stats-json", stats,
+    ])
+    assert rc == 0
+    payload = json.loads(open(stats).read())
+    assert payload["schema_version"] == 1
+    assert payload["frames"] == n
+    assert payload["frames_per_second"] > 0
+    assert set(payload["stage_seconds"]) == {
+        "read", "h2d", "compute", "d2h", "write"
+    }
+    assert os.path.getsize(out) == n * h * w * 3
+    assert "streamed 3 frame(s)" in capsys.readouterr().out
+
+
+def test_stream_cli_dispatch_and_failure_rc(tmp_path, capsys):
+    # Subcommand dispatch through the top-level CLI; a short stream
+    # under --frames is a nonzero exit naming the frame.
+    from tpu_stencil import cli as top_cli
+
+    h, w = 8, 6
+    clip_path = tmp_path / "clip.raw"
+    _make_clip(clip_path, 2, h, w, 1)
+    rc = top_cli.main([
+        "stream", str(clip_path), str(w), str(h), "1", "grey",
+        "--frames", "4", "--output", str(tmp_path / "o.raw"),
+    ])
+    assert rc == 1
+    assert "failed at frame 2" in capsys.readouterr().err
+
+
+def test_stream_cli_stdout_sink_is_pure_frames(tmp_path):
+    # --output - owns stdout: the report moves to stderr and the byte
+    # stream is exactly the frames, nothing interleaved.
+    import subprocess
+    import sys as _sys
+
+    h, w, n = 8, 6, 2
+    clip_path = tmp_path / "clip.raw"
+    _make_clip(clip_path, n, h, w, 3)
+    proc = subprocess.run(
+        [_sys.executable, "-m", "tpu_stencil", "stream", str(clip_path),
+         str(w), str(h), "1", "rgb", "--frames", str(n), "--output", "-",
+         "--platform", "cpu"],
+        capture_output=True, timeout=300,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert len(proc.stdout) == n * h * w * 3, len(proc.stdout)
+    assert b"streamed 2 frame(s)" in proc.stderr
+
+
+def test_stream_cli_stdout_sink_refuses_stats_json_stdout(tmp_path, capsys):
+    clip_path = tmp_path / "clip.raw"
+    _make_clip(clip_path, 1, 8, 6, 1)
+    with pytest.raises(SystemExit):
+        stream_cli.main([str(clip_path), "6", "8", "1", "grey",
+                         "--frames", "1", "--output", "-",
+                         "--stats-json", "-"])
+    assert "owns stdout" in capsys.readouterr().err
+
+
+def test_stream_cli_runtime_usage_error_is_clean(tmp_path, capsys):
+    # Usage errors discovered at run time (here: checkpointing into a
+    # non-resumable sink) exit nonzero with a message, not a traceback.
+    clip_path = tmp_path / "clip.raw"
+    _make_clip(clip_path, 1, 8, 6, 1)
+    rc = stream_cli.main([str(clip_path), "6", "8", "1", "grey",
+                          "--frames", "1", "--output", "null",
+                          "--checkpoint-every", "1"])
+    assert rc == 2
+    assert "resumable sink" in capsys.readouterr().err
+
+
+def test_stream_cli_requires_length_contract(tmp_path, capsys):
+    clip_path = tmp_path / "clip.raw"
+    _make_clip(clip_path, 1, 8, 6, 1)
+    with pytest.raises(SystemExit):
+        stream_cli.main([str(clip_path), "6", "8", "1", "grey"])
+    assert "--frames" in capsys.readouterr().err
+
+
+def test_stream_cli_stdin_needs_output(capsys):
+    with pytest.raises(SystemExit):
+        stream_cli.main(["-", "6", "8", "1", "grey", "--until-eof"])
+    assert "--output" in capsys.readouterr().err
+
+
+def test_stream_cli_breakdown_renders_pipeline_table(tmp_path, capsys):
+    h, w, n = 10, 8, 3
+    clip_path = tmp_path / "clip.raw"
+    _make_clip(clip_path, n, h, w, 1)
+    rc = stream_cli.main([
+        str(clip_path), str(w), str(h), "2", "grey", "--frames", str(n),
+        "--output", "null", "--breakdown",
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "stream pipeline: depth=2" in out
+    assert "stream.compute" in out
+    assert "modeled device-side bound" in out
+
+
+# -- config validation -------------------------------------------------
+
+def test_stream_config_validation():
+    good = dict(input="x.raw", width=4, height=4, repetitions=1,
+                image_type=ImageType.GREY)
+    with pytest.raises(ValueError, match="pipeline_depth"):
+        StreamConfig(**good, pipeline_depth=0)
+    with pytest.raises(ValueError, match="ring_buffers"):
+        StreamConfig(**good, pipeline_depth=3, ring_buffers=3)
+    with pytest.raises(ValueError, match="frames"):
+        StreamConfig(**good, frames=-1)
+    cfg = StreamConfig(**good)
+    assert cfg.ring_size == 4  # depth 2 + 2
+    assert cfg.frame_shape == (4, 4)
+    assert cfg.output_path.endswith("blur_x.raw")
+    with pytest.raises(ValueError, match="--output"):
+        StreamConfig(**dict(good, input="-")).output_path
+
+
+def test_stream_roofline_model():
+    from tpu_stencil.runtime import roofline
+
+    stages = roofline.stream_stage_seconds(1_000_000, 10, "xla",
+                                           "gaussian", 1000)
+    assert set(stages) == {"h2d", "compute", "d2h"}
+    fps_piped = roofline.stream_frames_per_second(
+        1_000_000, 10, "xla", "gaussian", 1000, pipeline_depth=2)
+    fps_serial = roofline.stream_frames_per_second(
+        1_000_000, 10, "xla", "gaussian", 1000, pipeline_depth=1)
+    # max(stage) beats sum(stages): the bound the pipeline exists to buy.
+    assert fps_piped > fps_serial
+    assert fps_piped == pytest.approx(1.0 / max(stages.values()))
+    assert fps_serial == pytest.approx(1.0 / sum(stages.values()))
+
+
+def test_stream_checkpoint_sidecar_normalizes_dir_spelling(tmp_path):
+    # 'outdir' and 'outdir/' are the same sink: a resume spelled the
+    # other way must find the same progress sidecar.
+    clip_path = tmp_path / "clip.raw"
+    _make_clip(clip_path, 2, 8, 6, 1)
+    d = str(tmp_path / "outdir")
+    cfg_slash = _stream_cfg(tmp_path, clip_path, 8, 6, ImageType.GREY, 1,
+                            output=d + os.sep, frames=2)
+    cfg_plain = _stream_cfg(tmp_path, clip_path, 8, 6, ImageType.GREY, 1,
+                            output=d, frames=2)
+    ckpt.save_stream_progress(cfg_slash, 1)
+    assert ckpt.restore_stream_progress(cfg_plain) == 1
+    ckpt.clear_stream_progress(cfg_plain)
+    assert ckpt.restore_stream_progress(cfg_slash) is None

@@ -29,7 +29,7 @@ Metrics / timing          :mod:`tpu_stencil.utils.timing`
 ========================  =====================================================
 """
 
-from tpu_stencil.config import JobConfig, ImageType
+from tpu_stencil.config import JobConfig, ImageType, StreamConfig
 from tpu_stencil.filters import get_filter, register_filter, FILTERS
 from tpu_stencil.models.blur import IteratedConv2D
 
@@ -38,6 +38,7 @@ __version__ = "0.1.0"
 __all__ = [
     "JobConfig",
     "ImageType",
+    "StreamConfig",
     "get_filter",
     "register_filter",
     "FILTERS",

@@ -9,8 +9,10 @@ writes ``blur_<input>``. Extra flags expose what the reference hard-codes:
 ``--filter``, ``--backend``, ``--mesh``, ``--output``.
 
 Subcommands: ``python -m tpu_stencil serve ...`` (the micro-batching
-inference service) and ``python -m tpu_stencil perf {log,check,report}``
-(the perf-regression sentry, docs/OBSERVABILITY.md).
+inference service), ``python -m tpu_stencil stream ...`` (the pipelined
+multi-frame streaming engine, docs/STREAMING.md) and
+``python -m tpu_stencil perf {log,check,report}`` (the perf-regression
+sentry, docs/OBSERVABILITY.md).
 """
 
 from __future__ import annotations
@@ -30,6 +32,12 @@ def main(argv=None) -> int:
         from tpu_stencil.serve import cli as serve_cli
 
         return serve_cli.main(argv[1:])
+    if argv and argv[0] == "stream":
+        # The pipelined multi-frame streaming engine: single-process,
+        # owns its own flags (docs/STREAMING.md).
+        from tpu_stencil.stream import cli as stream_cli
+
+        return stream_cli.main(argv[1:])
     if argv and argv[0] == "perf":
         # The perf-regression sentry (log/check/report) is jax-free by
         # design: a history query must exit without backend bring-up.

@@ -22,6 +22,7 @@ semantics.
 from __future__ import annotations
 
 import os
+import stat as _stat
 
 import numpy as np
 
@@ -37,17 +38,90 @@ def read_raw(path: str, width: int, height: int, channels: int) -> np.ndarray:
     return read_raw_rows(path, 0, height, width, channels)
 
 
+def require_regular(path: str, why: str) -> None:
+    """Fail loudly when ``path`` is not a regular file. Callers that
+    issue MULTIPLE positioned reads against one path (the sharded
+    per-band pattern) must refuse pipes: every open of a FIFO continues
+    consuming the same byte stream, so a second ``read_raw_rows`` call
+    would silently discard the wrong bytes — worse than the loud size
+    check this module's non-regular branch replaced."""
+    if not _stat.S_ISREG(os.stat(path).st_mode):
+        raise ValueError(
+            f"{path}: not a regular file — {why} needs positioned "
+            "re-reads, which a FIFO/pipe cannot serve; stream inputs go "
+            "through 'python -m tpu_stencil stream' instead"
+        )
+
+
+def read_stream_into(f, view: memoryview) -> int:
+    """Fill ``view`` from a sequential stream via ``readinto``; returns
+    the bytes read, stopping early only at EOF. The shared primitive
+    under every pipe/FIFO/stdin read in the repo (here and
+    :mod:`tpu_stencil.stream.frames`) — callers decide whether a short
+    count is clean EOF or an error."""
+    got = 0
+    while got < len(view):
+        n = f.readinto(view[got:])
+        if not n:
+            break
+        got += n
+    return got
+
+
+def discard_stream_bytes(f, nbytes: int, what: str) -> None:
+    """Read and drop ``nbytes`` from a sequential stream (the seek of
+    the non-seekable world); raises naming ``what`` if the stream ends
+    first. Shared by the pipe offset path here and the streaming
+    engine's resume skip."""
+    remaining = nbytes
+    while remaining:
+        chunk = f.read(min(remaining, 1 << 20))
+        if not chunk:
+            raise IOError(
+                f"{what}: stream ended {remaining} bytes short of the "
+                f"{nbytes} to skip"
+            )
+        remaining -= len(chunk)
+
+
+def _read_stream_bytes(path: str, offset: int, nbytes: int) -> bytes:
+    """Sequential read of ``nbytes`` from a non-seekable source (FIFO /
+    pipe / character device): ``offset`` bytes are read and discarded
+    (pipes have no pread), then the payload is read to completion —
+    short reads past EOF raise, they never return garbage."""
+    with open(path, "rb", buffering=0) as f:
+        discard_stream_bytes(f, offset, path)
+        buf = bytearray(nbytes)
+        got = read_stream_into(f, memoryview(buf))
+        if got < nbytes:
+            raise IOError(
+                f"{path}: short read {got}/{nbytes} from stream "
+                f"(after {offset} skipped bytes)"
+            )
+        return bytes(buf)
+
+
 def read_raw_rows(
     path: str, row_start: int, n_rows: int, width: int, channels: int
 ) -> np.ndarray:
     """Read rows [row_start, row_start + n_rows) into (n_rows, W, C) uint8.
 
-    Validates that the file holds at least the bytes addressed, mirroring the
-    implicit trust-the-user contract of the reference (which reads garbage on
-    short files) but failing loudly instead.
+    Regular files validate that the file holds at least the bytes
+    addressed, mirroring the implicit trust-the-user contract of the
+    reference (which reads garbage on short files) but failing loudly
+    instead. Non-regular sources (FIFO/pipe/stdin — ``os.path.getsize``
+    is meaningless there and pread/seek are unsupported) skip the size
+    check and read sequentially, failing loudly on short reads — the
+    contract the streaming engine's pipe sources rely on
+    (:mod:`tpu_stencil.stream.frames`).
     """
     offset = row_start * width * channels
     nbytes = n_rows * width * channels
+    if not _stat.S_ISREG(os.stat(path).st_mode):
+        buf = _read_stream_bytes(path, offset, nbytes)
+        return np.frombuffer(buf, dtype=np.uint8).reshape(
+            n_rows, width, channels
+        )
     size = os.path.getsize(path)
     if offset + nbytes > size:
         raise ValueError(

@@ -142,6 +142,84 @@ def render_overlap(tracer: Tracer, info: dict) -> str:
     return "\n".join(lines) + "\n"
 
 
+def render_stream(tracer: Tracer, info: dict) -> str:
+    """The streaming-pipeline side table (stream ``--breakdown`` runs):
+    per-stage busy seconds/frame from the ``stream.*`` spans, the
+    measured pipeline bound (the slowest stage — what steady-state
+    frames/s is limited by once the stages overlap), and the modeled
+    device-side bound from
+    :func:`tpu_stencil.runtime.roofline.stream_frames_per_second`
+    next to the measured rate.
+
+    ``info``: ``{frame_bytes, reps, backend, filter_name, h_img,
+    block_h, fuse, pipeline_depth, frames, wall_seconds}``. Renders
+    nothing when no stream spans were recorded."""
+    by = {r["name"]: r for r in aggregate(tracer)}
+    stages = [n for n in (
+        "stream.read", "stream.h2d", "stream.compute", "stream.d2h",
+        "stream.write",
+    ) if n in by]
+    if not stages:
+        return ""
+    from tpu_stencil.runtime import roofline
+
+    model_stages = roofline.stream_stage_seconds(
+        info["frame_bytes"], info["reps"], info["backend"],
+        info["filter_name"], info["h_img"],
+        block_h=info.get("block_h"), fuse=info.get("fuse"),
+    )
+    depth = info.get("pipeline_depth", 2)
+    lines = [
+        "",
+        f"stream pipeline: depth={depth}  "
+        f"(steady state bound = {'max' if depth > 1 else 'sum'}(stage))",
+    ]
+    head = (f"{'stage':<16}  {'s/frame':>10}  {'frames':>6}  "
+            f"{'model s/frame':>13}")
+    lines += [head, "-" * len(head)]
+    slowest = ("", 0.0)
+    total = 0.0
+    for n in stages:
+        per = by[n]["seconds"] / by[n]["count"]
+        total += per
+        if per > slowest[1]:
+            slowest = (n, per)
+        model = model_stages.get(n[len("stream."):])
+        model_s = "" if model is None else f"{model:13.6f}"
+        lines.append(
+            f"{n:<16}  {per:>10.6f}  {by[n]['count']:>6}  {model_s:>13}"
+        )
+    # The measured bound follows the depth's law, like the header says:
+    # overlapped stages are limited by the slowest one; depth 1 pays
+    # the serial sum.
+    if depth > 1 and slowest[1] > 0:
+        lines.append(
+            f"pipeline bound: {slowest[0]} -> "
+            f"{1.0 / slowest[1]:.2f} frames/s"
+        )
+    elif total > 0:
+        lines.append(
+            f"pipeline bound: sum(stages) -> {1.0 / total:.2f} frames/s"
+        )
+    fps_model = roofline.stream_frames_per_second(
+        info["frame_bytes"], info["reps"], info["backend"],
+        info["filter_name"], info["h_img"],
+        block_h=info.get("block_h"), fuse=info.get("fuse"),
+        pipeline_depth=depth,
+    )
+    measured = ""
+    if info.get("frames") and info.get("wall_seconds"):
+        measured = (
+            f"measured {info['frames'] / info['wall_seconds']:.2f} "
+            f"frames/s vs "
+        )
+    lines.append(
+        f"{measured}modeled device-side bound {fps_model:.2f} frames/s "
+        "(host read/write measured, not modeled)"
+    )
+    return "\n".join(lines) + "\n"
+
+
 def _mb(v) -> str:
     return "" if v is None else f"{v / 1e6:.2f}"
 

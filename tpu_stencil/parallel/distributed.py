@@ -243,6 +243,10 @@ def read_sharded(
     process and sliced into its column tiles. Single-process this
     degenerates to a tiled read of the whole file, matching
     ``jax.device_put`` semantics bit-for-bit."""
+    # Per-band reads re-open the path once per mesh row: only regular
+    # files can serve repeated positioned reads (a FIFO would silently
+    # hand each band the wrong bytes).
+    raw_io.require_regular(path, "sharded per-band input")
     mesh = sharding.mesh
     r = mesh.shape[ROWS_AXIS]
     c = mesh.shape[COLS_AXIS]

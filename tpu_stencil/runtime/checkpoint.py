@@ -246,6 +246,71 @@ def restore_sharded(cfg: JobConfig, sharding) -> Optional[Tuple[int, "object"]]:
     return rep, arr
 
 
+def _stream_paths(cfg) -> str:
+    """The stream progress sidecar lives beside the sink (the artifact
+    it describes), like the frame checkpoints beside the job output.
+    Normalized: ``outdir`` and ``outdir/`` are the same sink and must
+    resolve to the same sidecar, or a resume spelled the other way
+    silently finds no checkpoint."""
+    return cfg.output_path.rstrip(os.sep) + ".stream.ckpt.json"
+
+
+def _stream_fingerprint(cfg) -> dict:
+    """Identity of a streaming job (:class:`~tpu_stencil.config
+    .StreamConfig`): a progress record from a different geometry,
+    filter, rep count or boundary must be refused, not resumed —
+    the same discipline as :func:`_fingerprint`. The input spec is
+    deliberately EXCLUDED: a resumed pipe has a different fd/path each
+    run, and the sink identity (where the sidecar lives) already pins
+    the artifact being continued."""
+    return {
+        "width": cfg.width,
+        "height": cfg.height,
+        "channels": cfg.channels,
+        "filter": cfg.filter_name,
+        "repetitions": cfg.repetitions,
+        "boundary": cfg.boundary,
+        "frames": cfg.frames,
+    }
+
+
+def save_stream_progress(cfg, frames_done: int) -> None:
+    """Atomically record that frames [0, frames_done) are durably in
+    the sink. No frame payload — unlike the rep checkpoints, a stream's
+    completed frames already live in the output; progress is one
+    integer plus the fingerprint."""
+    path = _stream_paths(cfg)
+    meta = dict(_stream_fingerprint(cfg), frames_done=int(frames_done))
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(meta, f)
+    os.replace(tmp, path)
+
+
+def restore_stream_progress(cfg) -> Optional[int]:
+    """Frames already completed by a matching prior run, or None. A
+    fingerprint mismatch raises (resuming a different job's sink would
+    silently mix outputs)."""
+    path = _stream_paths(cfg)
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        meta = json.load(f)
+    want = _stream_fingerprint(cfg)
+    if {k: meta.get(k) for k in want} != want:
+        raise ValueError(
+            f"stream checkpoint at {path} was written for a different "
+            f"job ({meta} != {want}); delete it or change --output"
+        )
+    return int(meta["frames_done"])
+
+
+def clear_stream_progress(cfg) -> None:
+    path = _stream_paths(cfg)
+    if os.path.exists(path):
+        os.remove(path)
+
+
 def _stale_versions(data_path: str, before_rep: Optional[int] = None):
     """Versioned data files older than ``before_rep`` (all of them when
     None). Selecting by parsed rep number — NOT by "everything except the

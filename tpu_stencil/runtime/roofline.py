@@ -17,6 +17,9 @@ V5E_HBM_GBPS = 819.0  # v5e HBM peak bandwidth
 # per chip (the public spec sheet's number) — the ceiling the sharded
 # path's ghost traffic rides.
 V5E_ICI_GBPS = 200.0
+# Host<->chip PCIe (Gen4 x16, ~32 GB/s each direction) — the ceiling
+# the streaming engine's per-frame H2D/D2H transfers ride.
+V5E_PCIE_GBPS = 32.0
 
 
 def ici_ghost_bytes_per_rep(tile_shape, channels: int, halo: int,
@@ -93,6 +96,47 @@ def achieved(frame_bytes: int, per_rep_s: float, backend: str,
         frame_bytes, backend, filter_name, h_img, block_h, fuse
     ) / per_rep_s / 1e9
     return gbps, 100 * gbps / V5E_HBM_GBPS
+
+
+def stream_stage_seconds(frame_bytes: int, reps: int, backend: str,
+                         filter_name: str, h_img: int,
+                         block_h=None, fuse=None) -> dict:
+    """Modeled per-frame seconds of the device-side streaming stages:
+    ``h2d``/``d2h`` move one frame across PCIe, ``compute`` runs
+    ``reps`` repetitions against the HBM roofline (the same
+    :func:`analytic_bytes_per_rep` formula every other roofline view
+    uses). Host ``read``/``write`` are *measured*, never modeled —
+    there is no honest constant for arbitrary disks and pipes."""
+    per_rep = analytic_bytes_per_rep(
+        frame_bytes, backend, filter_name, h_img, block_h, fuse
+    )
+    return {
+        "h2d": frame_bytes / (V5E_PCIE_GBPS * 1e9),
+        "compute": reps * per_rep / (V5E_HBM_GBPS * 1e9),
+        "d2h": frame_bytes / (V5E_PCIE_GBPS * 1e9),
+    }
+
+
+def stream_frames_per_second(frame_bytes: int, reps: int, backend: str,
+                             filter_name: str, h_img: int,
+                             block_h=None, fuse=None,
+                             pipeline_depth: int = 2) -> float:
+    """The modeled steady-state frames/s bound of the streaming
+    pipeline (:mod:`tpu_stencil.stream`): with a dispatch-ahead window
+    (``pipeline_depth`` >= 2) the stages overlap and the bound is
+    ``1 / max(stage)``; at depth 1 the stages serialize and the bound
+    degrades to ``1 / sum(stage)`` — the difference the pipeline
+    exists to buy. Rendered next to the measured rate by the stream
+    CLI's ``--breakdown`` (:func:`tpu_stencil.obs.breakdown
+    .render_stream`)."""
+    stages = stream_stage_seconds(
+        frame_bytes, reps, backend, filter_name, h_img, block_h, fuse
+    )
+    bound = (
+        sum(stages.values()) if pipeline_depth <= 1
+        else max(stages.values())
+    )
+    return 1.0 / bound if bound > 0 else float("inf")
 
 
 def achieved_frames(frame_bytes: int, n_frames: int, per_rep_s: float,

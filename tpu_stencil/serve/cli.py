@@ -43,6 +43,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="closed-loop worker count (default 4)")
     p.add_argument("--rate", type=float, default=200.0,
                    help="open-loop arrival rate in req/s (default 200)")
+    p.add_argument("--rate-fps", type=float, default=None, metavar="FPS",
+                   help="open-loop fixed-frame-rate mode: one frame due "
+                        "every 1/FPS seconds regardless of completions "
+                        "(the live-video arrival law; forces --mode "
+                        "open at FPS); reports achieved vs requested "
+                        "rate — the same loadgen shape the stream "
+                        "benchmarks use (docs/STREAMING.md)")
     p.add_argument("--reps", type=int, default=5,
                    help="filter applications per request (default 5)")
     p.add_argument("--filter", dest="filter_name", default="gaussian",
@@ -231,11 +238,14 @@ def main(argv=None) -> int:
     except ValueError as e:
         parser.error(str(e))
     try:
+        if ns.rate_fps is not None and not ns.rate_fps > 0:
+            parser.error(f"--rate-fps must be > 0, got {ns.rate_fps}")
         with StencilServer(cfg) as server:
             report = loadgen.run(
                 server, mode=ns.mode, requests=ns.requests,
                 concurrency=ns.concurrency, rate=ns.rate, reps=ns.reps,
                 shapes=shapes, channels=channels, seed=ns.seed,
+                rate_fps=ns.rate_fps,
             )
         if ns.trace:
             _export_trace(ns.trace)
@@ -254,7 +264,7 @@ def main(argv=None) -> int:
     print(
         f"served {report['completed']}/{report['requests']} requests "
         f"in {report['wall_seconds']:.3f}s "
-        f"({report['throughput_rps']:.1f} req/s, {ns.mode}-loop)"
+        f"({report['throughput_rps']:.1f} req/s, {report['mode']}-loop)"
     )
     print(
         f"latency p50={report['p50_s'] * 1e3:.2f}ms "
@@ -263,6 +273,12 @@ def main(argv=None) -> int:
         f"cache={c['cache_hits_total']}h/{c['cache_misses_total']}m "
         f"padded_waste={c['padded_pixels_total']}px"
     )
+    if "requested_fps" in report:
+        print(
+            f"frame rate: requested {report['requested_fps']:.2f} fps, "
+            f"offered {report['offered_fps']:.2f} fps, "
+            f"achieved {report['achieved_fps']:.2f} fps"
+        )
     if ns.perf_log is not False:
         # One sentry record per loadgen run: p50 request latency. The
         # load model (mode, per-request reps, and the closed-loop
@@ -273,9 +289,16 @@ def main(argv=None) -> int:
 
         from tpu_stencil.obs import sentry
 
-        load = (f"c{ns.concurrency}" if ns.mode == "closed"
-                else f"rate{ns.rate:g}")
-        metric = f"serve.p50_s.{ns.mode}.{load}.reps{ns.reps}"
+        # report["mode"] (not ns.mode): --rate-fps forces the open loop
+        # inside loadgen.run, and the sentry key must name what ran.
+        ran_mode = report["mode"]
+        if ran_mode == "closed":
+            load = f"c{ns.concurrency}"
+        elif ns.rate_fps is not None:
+            load = f"fps{ns.rate_fps:g}"
+        else:
+            load = f"rate{ns.rate:g}"
+        metric = f"serve.p50_s.{ran_mode}.{load}.reps{ns.reps}"
         if report["p50_s"] > 0:
             rec = sentry.make_record(
                 metric=metric, value=report["p50_s"],

@@ -10,7 +10,10 @@ you need both):
 * **open-loop**: submissions arrive at a fixed ``rate`` regardless of
   completions — the "millions of users" shape. Overload surfaces as
   :class:`~tpu_stencil.serve.engine.QueueFull` rejections (counted, never
-  buffered), exercising the backpressure contract.
+  buffered), exercising the backpressure contract. ``rate_fps`` is the
+  fixed-frame-rate spelling of the same loop (``--rate-fps``): the
+  arrival law of a live video feed, reporting achieved vs requested
+  frame rate — one loadgen drives stream and serve benchmarks alike.
 
 The report pulls latency percentiles and rejection counts from the
 server's metrics registry — the loadgen measures the server with the
@@ -60,13 +63,28 @@ def run(
     channels: Sequence[int] = (3,),
     seed: int = 0,
     timeout: float = 300.0,
+    rate_fps: Optional[float] = None,
 ) -> Dict:
     """Drive ``server`` with synthetic load; return the report dict.
 
     Report keys: ``mode``, ``requests``, ``completed``, ``rejected``,
     ``wall_seconds``, ``throughput_rps``, ``p50_s``, ``p99_s`` (request
     latency from the registry), plus the full ``stats`` snapshot.
+
+    ``rate_fps``: the open-loop fixed-frame-rate mode (``--rate-fps``)
+    — one frame is *due* every ``1/rate_fps`` seconds regardless of
+    completions, the arrival law of a live video feed, so the same
+    loadgen drives stream benchmarks and serve benchmarks. Forces
+    ``mode='open'`` at that rate and adds ``requested_fps`` /
+    ``offered_fps`` (submissions over the offered window, rejects
+    included) / ``achieved_fps`` (completions over the wall) to the
+    report — achieved < requested means the pipe, not the source, is
+    the bottleneck.
     """
+    if rate_fps is not None:
+        if not rate_fps > 0:
+            raise ValueError(f"rate_fps must be > 0, got {rate_fps!r}")
+        mode, rate = "open", float(rate_fps)
     if mode not in ("closed", "open"):
         raise ValueError(f"mode must be closed|open, got {mode!r}")
     images = synth_requests(requests, shapes, channels, seed)
@@ -129,15 +147,18 @@ def run(
     else:  # open loop
         period = 1.0 / rate if rate > 0 else 0.0
         futures = []
+        offered = 0
         for i in range(requests):
             t_due = t_start + i * period
             delay = t_due - time.perf_counter()
             if delay > 0:
                 time.sleep(delay)
+            offered += 1
             try:
                 futures.append(server.submit(images[i], reps))
             except QueueFull:
                 pass  # counted by the server; open loops shed, not wait
+        offer_wall = time.perf_counter() - t_start
         deadline = time.perf_counter() + timeout
         for f in futures:
             f.result(timeout=max(0.0, deadline - time.perf_counter()))
@@ -146,7 +167,7 @@ def run(
     wall = time.perf_counter() - t_start
     stats = server.stats()
     rlat = stats["histograms"]["request_latency_seconds"]
-    return {
+    report = {
         "mode": mode,
         "requests": requests,
         "completed": completed,
@@ -157,3 +178,17 @@ def run(
         "p99_s": rlat["p99"],
         "stats": stats,
     }
+    if rate_fps is not None:
+        # Achieved-vs-requested: offered over the submission window
+        # (could the source keep its schedule?) and achieved over the
+        # whole wall (did the pipe keep up, drain included?). The n
+        # offers span (n-1) inter-arrival periods, so the window gets
+        # one period added back — n offers over a bare (n-1)-period
+        # wall would read ~n/(n-1) above requested on perfect pacing.
+        report["requested_fps"] = float(rate_fps)
+        offer_window = offer_wall + period
+        report["offered_fps"] = (
+            offered / offer_window if offer_window > 0 else 0.0
+        )
+        report["achieved_fps"] = completed / wall if wall > 0 else 0.0
+    return report

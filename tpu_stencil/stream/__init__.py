@@ -1,0 +1,53 @@
+"""Pipelined multi-frame streaming: read → H2D → compute → D2H → write.
+
+The streaming analog of :func:`tpu_stencil.driver.run_job`: instead of
+one image per invocation, a whole frame stream flows through a 5-stage
+software pipeline with a depth-``k`` dispatch-ahead window, so host I/O
+and PCIe transfers overlap TPU compute and steady-state throughput is
+bounded by the slowest *stage*, not the serial *sum* of stages (see
+docs/STREAMING.md). Three pieces:
+
+* :mod:`~tpu_stencil.stream.frames` — ``FrameSource``/``FrameSink``
+  over concatenated headerless ``.raw`` streams (files, FIFOs, stdin/
+  stdout), sorted frame directories, and a null sink for benchmarking.
+* :mod:`~tpu_stencil.stream.engine` — the bounded-ring prefetch reader,
+  the dispatch-ahead compute window (reusing ``driver.prepare_engine``
+  — plans/filters/geometry apply unchanged, device buffers donated),
+  and the in-order drain/writer, with backpressure and fail-with-frame
+  -index error propagation throughout.
+* :mod:`~tpu_stencil.stream.cli` — ``python -m tpu_stencil stream``.
+
+>>> from tpu_stencil.config import ImageType, StreamConfig
+>>> from tpu_stencil.stream import run_stream
+>>> cfg = StreamConfig("clip.raw", 640, 480, 10, ImageType.RGB,
+...                    output="null", frames=None)
+>>> result = run_stream(cfg)
+"""
+
+from tpu_stencil.stream.engine import StreamFailure, StreamResult, run_stream
+from tpu_stencil.stream.frames import (
+    FrameSink,
+    FrameSource,
+    NullSink,
+    RawDirectorySink,
+    RawDirectorySource,
+    RawStreamSink,
+    RawStreamSource,
+    open_sink,
+    open_source,
+)
+
+__all__ = [
+    "FrameSink",
+    "FrameSource",
+    "NullSink",
+    "RawDirectorySink",
+    "RawDirectorySource",
+    "RawStreamSink",
+    "RawStreamSource",
+    "StreamFailure",
+    "StreamResult",
+    "open_sink",
+    "open_source",
+    "run_stream",
+]

@@ -1,0 +1,288 @@
+"""``python -m tpu_stencil stream`` — the pipelined multi-frame CLI.
+
+Reference-compatible positionals (the run CLI's contract, extended to a
+stream): ``input width height repetitions {grey,rgb}`` where ``input``
+is a concatenated headerless ``.raw`` stream (file, FIFO, or ``-`` for
+stdin) or a directory of per-frame ``.raw`` files. Exactly one of
+``--frames N`` (the stream holds N frames; ending early is an error)
+or ``--until-eof`` (process until the source runs dry) selects the
+length contract. See docs/STREAMING.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+from tpu_stencil.config import (
+    OVERLAP_MODES,  # noqa: F401  (vocabulary parity with run/serve)
+    PALLAS_SCHEDULES,
+    ImageType,
+    StreamConfig,
+)
+
+# --stats-json payload schema. 1 = the fields documented in
+# docs/STREAMING.md. Bump on breaking shape changes.
+STATS_SCHEMA_VERSION = 1
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="tpu_stencil stream",
+        description=(
+            "Pipelined multi-frame streaming: read -> H2D -> compute -> "
+            "D2H -> write with a depth-k dispatch-ahead window, so host "
+            "I/O and PCIe transfers overlap TPU compute."
+        ),
+    )
+    p.add_argument(
+        "input",
+        help="frame stream: concatenated headerless .raw (file or FIFO), "
+             "'-' for stdin, or a directory of per-frame .raw files",
+    )
+    p.add_argument("width", type=int, help="frame width in pixels")
+    p.add_argument("height", type=int, help="frame height in pixels")
+    p.add_argument("repetitions", type=int,
+                   help="filter applications per frame")
+    p.add_argument(
+        "image_type", choices=[t.value for t in ImageType],
+        help="grey (1 byte/px) or rgb (3 interleaved bytes/px)",
+    )
+    n = p.add_mutually_exclusive_group(required=True)
+    n.add_argument(
+        "--frames", type=int, default=None, metavar="N",
+        help="the stream holds exactly N frames; a stream that ends "
+             "early fails with the frame index",
+    )
+    n.add_argument(
+        "--until-eof", action="store_true",
+        help="process frames until the source reaches EOF",
+    )
+    p.add_argument(
+        "--filter", dest="filter_name", default="gaussian",
+        help="filter name (box|gaussian|edge|...); default gaussian",
+    )
+    p.add_argument(
+        "--backend", default="auto",
+        choices=["auto", "xla", "pallas", "reference", "autotune"],
+        help="compute backend, same vocabulary as the run CLI",
+    )
+    p.add_argument(
+        "--schedule", default=None, choices=list(PALLAS_SCHEDULES),
+        help="force the Pallas per-rep schedule (see docs/KERNEL.md)",
+    )
+    p.add_argument(
+        "--boundary", default="zero", choices=["zero", "periodic"],
+        help="edge semantics, same vocabulary as the run CLI",
+    )
+    p.add_argument(
+        "--block-h", dest="block_h", type=int, default=None, metavar="ROWS",
+        help="force the Pallas kernel's rows-per-grid-program",
+    )
+    p.add_argument(
+        "--fuse", type=int, default=None, metavar="REPS",
+        help="force the Pallas kernel's fused reps per HBM round-trip",
+    )
+    p.add_argument(
+        "--output", default=None,
+        help="sink: concatenated stream file, a directory (per-frame "
+             "files), '-' for stdout, or 'null' to discard (benchmark "
+             "mode); default blur_<input> beside a path input",
+    )
+    p.add_argument(
+        "--pipeline-depth", type=int, default=2, metavar="K",
+        help="dispatch-ahead window: at most K frames between the "
+             "reader and the writer queue (1 = serial stages; "
+             "default 2 = double buffering)",
+    )
+    p.add_argument(
+        "--ring", dest="ring_buffers", type=int, default=None, metavar="N",
+        help="host staging buffers the prefetch reader fills "
+             "(default pipeline_depth + 2; must be > pipeline_depth)",
+    )
+    p.add_argument(
+        "--checkpoint-every", type=int, default=0, metavar="N",
+        help="commit a frame-index checkpoint every N written frames "
+             "(0 = off); needs a resumable sink (file or directory)",
+    )
+    p.add_argument(
+        "--resume", action="store_true",
+        help="resume past the frames a matching checkpoint records",
+    )
+    p.add_argument(
+        "--progress-every", type=int, default=0, metavar="N",
+        help="print the frame index to stderr every N written frames",
+    )
+    p.add_argument(
+        "--platform", default=None, choices=["cpu", "tpu", "gpu"],
+        help="force the JAX platform via the config API before "
+             "backend init",
+    )
+    p.add_argument(
+        "--stats-json", default=None, metavar="PATH",
+        help="dump the run summary (frames, frames/s, per-stage "
+             "seconds) as versioned JSON to PATH ('-' = stdout)",
+    )
+    p.add_argument(
+        "--trace", default=None, metavar="PATH",
+        help="span tracing (tpu_stencil.obs): write a Chrome "
+             "trace-event JSON of the pipeline ladder (stream.read/"
+             "h2d/compute/d2h/write, one track per pipeline thread)",
+    )
+    p.add_argument(
+        "--breakdown", action="store_true",
+        help="print the per-stage pipeline table with the roofline "
+             "steady-state bound (max(stage), with the PCIe H2D/D2H "
+             "terms); implies span tracing for this run",
+    )
+    p.add_argument(
+        "--metrics-text", default=None, metavar="PATH",
+        help="write the driver-side metrics registry (stream_* "
+             "histograms, stream_inflight_depth gauge) as "
+             "Prometheus-style text to PATH ('-' = stdout)",
+    )
+    return p
+
+
+def main(argv=None) -> int:
+    parser = build_parser()
+    ns = parser.parse_args(argv)
+    try:
+        cfg = StreamConfig(
+            input=ns.input,
+            width=ns.width,
+            height=ns.height,
+            repetitions=ns.repetitions,
+            image_type=ImageType(ns.image_type),
+            filter_name=ns.filter_name,
+            backend=ns.backend,
+            output=ns.output,
+            frames=ns.frames,
+            schedule=ns.schedule,
+            boundary=ns.boundary,
+            block_h=ns.block_h,
+            fuse=ns.fuse,
+            pipeline_depth=ns.pipeline_depth,
+            ring_buffers=ns.ring_buffers,
+            checkpoint_every=ns.checkpoint_every,
+            progress_every=ns.progress_every,
+        )
+        out_spec = cfg.output_path  # stdin + no --output dies here, pre-jax
+    except ValueError as e:
+        parser.error(str(e))
+    # A stdout sink owns stdout: the binary frame stream must never be
+    # interleaved with report text (a consumer piping '--output -' would
+    # read corrupted frames), so the human summary moves to stderr and
+    # the other stdout writers are refused.
+    to_stdout_sink = out_spec == "-"
+    if to_stdout_sink and ("-" in (ns.stats_json, ns.metrics_text)):
+        parser.error(
+            "--output - owns stdout; write --stats-json/--metrics-text "
+            "to a file instead of '-'"
+        )
+    report_out = sys.stderr if to_stdout_sink else sys.stdout
+    if ns.platform:
+        import jax
+
+        jax.config.update("jax_platforms", ns.platform)
+    tracing = bool(ns.trace or ns.breakdown)
+    if tracing:
+        from tpu_stencil import obs
+
+        obs.enable()
+    try:
+        from tpu_stencil.stream.engine import StreamFailure, run_stream
+
+        try:
+            result = run_stream(cfg, resume=ns.resume)
+        except StreamFailure as e:
+            print(f"stream FAILED: {e}", file=sys.stderr)
+            return 1
+        except ValueError as e:
+            # Runtime-discovered usage errors (non-resumable sink with
+            # --checkpoint-every, a checkpoint from a different job on
+            # --resume): clean message + nonzero, never a traceback.
+            print(f"stream: {e}", file=sys.stderr)
+            return 2
+        if tracing:
+            _report_observability(ns, cfg, result, report_out)
+    finally:
+        if tracing:
+            from tpu_stencil import obs
+
+            obs.disable()
+    if ns.metrics_text:
+        from tpu_stencil import obs
+
+        obs.exposition.write_text(
+            ns.metrics_text, obs.snapshot(), prefix="tpu_stencil_driver"
+        )
+    stages = " ".join(
+        f"{k}={v:.3f}s" for k, v in sorted(result.stage_seconds.items())
+        if v > 0
+    )
+    print(
+        f"streamed {result.frames} frame(s)"
+        + (f" (+{result.skipped} resumed)" if result.skipped else "")
+        + f" in {result.wall_seconds:.3f}s "
+        f"({result.frames_per_second:.2f} frames/s, "
+        f"depth={result.pipeline_depth}, backend={result.backend}"
+        + (f" schedule={result.schedule}" if result.schedule else "")
+        + ")", file=report_out,
+    )
+    if stages:
+        print(f"stage seconds: {stages}", file=report_out)
+    print(f"wrote {out_spec}" if out_spec != "null" else "sink: null",
+          file=report_out)
+    if ns.stats_json:
+        payload = {
+            "schema_version": STATS_SCHEMA_VERSION,
+            "ts": time.monotonic(),
+            "frames": result.frames,
+            "skipped": result.skipped,
+            "wall_seconds": result.wall_seconds,
+            "frames_per_second": result.frames_per_second,
+            "stage_seconds": result.stage_seconds,
+            "backend": result.backend,
+            "schedule": result.schedule,
+            "pipeline_depth": result.pipeline_depth,
+            "output": out_spec,
+        }
+        text = json.dumps(payload, indent=2, sort_keys=True)
+        if ns.stats_json == "-":
+            print(text)
+        else:
+            with open(ns.stats_json, "w") as fh:
+                fh.write(text + "\n")
+            print(f"wrote {ns.stats_json}", file=report_out)
+    return 0
+
+
+def _report_observability(ns, cfg: StreamConfig, result, out) -> None:
+    from tpu_stencil import obs
+
+    tracer = obs.get_tracer()
+    if ns.trace:
+        wrote = obs.export.write_chrome_trace(ns.trace, tracer)
+        if wrote:
+            print(f"wrote trace {wrote}", file=out)
+    if ns.breakdown:
+        print(obs.breakdown.render_breakdown(tracer), end="", file=out)
+        print(obs.breakdown.render_stream(tracer, {
+            "frame_bytes": cfg.frame_bytes,
+            "reps": cfg.repetitions,
+            "backend": result.backend,
+            "filter_name": cfg.filter_name,
+            "h_img": cfg.height,
+            "block_h": cfg.block_h,
+            "fuse": cfg.fuse,
+            "pipeline_depth": result.pipeline_depth,
+            "frames": result.frames,
+            "wall_seconds": result.wall_seconds,
+        }), end="", file=out)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

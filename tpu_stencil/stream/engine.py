@@ -1,0 +1,528 @@
+"""The pipelined streaming engine: read → H2D → compute → D2H → write.
+
+``run_job`` is the serial shape — load, iterate, store, one frame per
+invocation, throughput bounded by the *sum* of the stages. This engine
+is the software-pipelined shape the paper's MPI variant applies at the
+halo boundary (overlap communication with interior compute,
+``mpi/mpi_convolution.c:194-224``; PR 4), applied at the host↔device
+boundary for frame streams: persistent channels amortized across
+iterations (arXiv:2508.13370) and stage-pipelined execution
+(arXiv:1907.06154). Steady-state throughput is bounded by the slowest
+*stage* (:func:`tpu_stencil.runtime.roofline.stream_frames_per_second`).
+
+Shape of the machine (docs/STREAMING.md has the diagram):
+
+* **reader thread** — fills reusable host staging buffers from the
+  :class:`~tpu_stencil.stream.frames.FrameSource`. The buffers form a
+  bounded ring: the reader blocks when every buffer is in flight
+  (backpressure, never unbounded buffering).
+* **dispatch window** — the main thread takes filled buffers in order,
+  ``jax.device_put``\\ s them and launches the compiled step (the SAME
+  program ``driver.prepare_engine`` warm-compiles — plans, filters,
+  schedules, fuse and geometry all apply unchanged; the device input is
+  donated, so XLA reuses it for the output and steady state allocates
+  nothing new on device). At most ``pipeline_depth`` frames may be past
+  the reader and not yet drained: depth 1 degenerates to the serial
+  stage chain, depth k overlaps frame i+1's read/H2D/compute with frame
+  i's drain.
+* **drain thread** — fences each frame's compute in dispatch order
+  (``stream.compute`` spans dispatch → device finished, so overlapped
+  compute is attributed to compute, not to whichever drain wait
+  observed it), copies the result D2H, releases the frame's window
+  slot. (The staging buffer already returned to the ring when the
+  fenced H2D span closed.)
+* **writer thread** — writes results in order to the
+  :class:`~tpu_stencil.stream.frames.FrameSink`, commits the
+  frame-index checkpoint (``runtime/checkpoint.py``) and emits the
+  progress heartbeat.
+
+Failure semantics: the first failing stage records (stage, frame index,
+exception) and stops the pipeline; already-dispatched frames drain,
+already-written frames stay written (with ``--checkpoint-every`` the
+job resumes past them), and :func:`run_stream` raises
+:class:`StreamFailure` naming the frame. Clean EOF propagates as
+sentinels through every queue.
+
+Observability (PR 2 machinery): ``stream.read`` / ``stream.h2d`` /
+``stream.compute`` / ``stream.d2h`` / ``stream.write`` spans (one trace
+track per pipeline thread — a ``--trace`` of a depth-2 run shows the
+pipeline ladder), a ``stream_inflight_depth`` gauge, per-stage
+``stream_<stage>_seconds`` histograms and a ``stream_frames_total``
+counter in the driver registry.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue
+import sys
+import threading
+import time
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from tpu_stencil import obs
+from tpu_stencil.config import StreamConfig
+from tpu_stencil.stream import frames as frames_io
+
+_EOF = object()          # clean end-of-stream sentinel
+_STAGES = ("read", "h2d", "compute", "d2h", "write")
+
+
+class StreamFailure(RuntimeError):
+    """A stage failed on a specific frame; the pipeline drained and
+    stopped. ``stage`` names the failing stage, ``frame_index`` the
+    frame (global index, resume-aware), ``__cause__`` the original
+    exception."""
+
+    def __init__(self, stage: str, frame_index: int, cause: BaseException):
+        super().__init__(
+            f"stream {stage} failed at frame {frame_index}: "
+            f"{type(cause).__name__}: {cause}"
+        )
+        self.stage = stage
+        self.frame_index = frame_index
+
+
+@dataclasses.dataclass
+class StreamResult:
+    """One finished (or resumed-and-finished) streaming job."""
+
+    frames: int              # frames processed THIS run
+    skipped: int             # frames skipped by --resume
+    wall_seconds: float      # whole run incl. warm-up compile
+    frames_per_second: float # frames / wall_seconds
+    stage_seconds: Dict[str, float]  # total busy seconds per stage
+    backend: str             # report-what-ran, like JobResult
+    schedule: Optional[str]
+    pipeline_depth: int
+    output: str
+
+
+class _Abort(Exception):
+    """Internal: a sibling stage failed; unwind quietly."""
+
+
+class _Pipeline:
+    """Shared state of one run: queues, window, failure slot, clocks."""
+
+    def __init__(self, cfg: StreamConfig):
+        self.cfg = cfg
+        n_ring = cfg.ring_size
+        self.ring = [
+            np.empty(cfg.frame_bytes, np.uint8) for _ in range(n_ring)
+        ]
+        self.free_q: queue.Queue = queue.Queue()
+        for i in range(n_ring):
+            self.free_q.put(i)
+        self.filled_q: queue.Queue = queue.Queue(maxsize=n_ring)
+        self.inflight_q: queue.Queue = queue.Queue(maxsize=cfg.pipeline_depth)
+        self.write_q: queue.Queue = queue.Queue(maxsize=cfg.pipeline_depth + 1)
+        # The dispatch-ahead window: a frame holds a slot from read start
+        # until its D2H completes, so at most pipeline_depth frames are
+        # anywhere between the source and the writer queue.
+        self.window = threading.Semaphore(cfg.pipeline_depth)
+        self.stop = threading.Event()
+        self._fail_lock = threading.Lock()
+        self.failure: Optional[Tuple[str, int, BaseException]] = None
+        self._stage_lock = threading.Lock()
+        self.stage_seconds: Dict[str, float] = {s: 0.0 for s in _STAGES}
+        self._inflight = 0
+        self._inflight_lock = threading.Lock()
+        self._gauge = obs.registry().gauge("stream_inflight_depth")
+
+    def fail(self, stage: str, frame_index: int, exc: BaseException) -> None:
+        with self._fail_lock:
+            if self.failure is None:
+                self.failure = (stage, frame_index, exc)
+        self.stop.set()
+
+    def _check(self) -> None:
+        if self.stop.is_set():
+            raise _Abort()
+
+    def put(self, q: queue.Queue, item) -> None:
+        """Blocking put that aborts when a sibling stage failed — a
+        stalled downstream queue must not deadlock the teardown."""
+        while True:
+            self._check()
+            try:
+                q.put(item, timeout=0.05)
+                return
+            except queue.Full:
+                pass
+
+    def get(self, q: queue.Queue):
+        while True:
+            self._check()
+            try:
+                return q.get(timeout=0.05)
+            except queue.Empty:
+                pass
+
+    def acquire_window(self) -> None:
+        while not self.window.acquire(timeout=0.05):
+            self._check()
+        with self._inflight_lock:
+            self._inflight += 1
+            self._gauge.set(self._inflight)
+
+    def release_window(self) -> None:
+        with self._inflight_lock:
+            self._inflight -= 1
+            self._gauge.set(self._inflight)
+        self.window.release()
+
+    def zero_gauge(self) -> None:
+        """Teardown: a failed run's aborted in-flight frames never pass
+        release_window, and the process-wide gauge must not keep
+        reporting them forever (peak survives, as for every gauge)."""
+        with self._inflight_lock:
+            self._inflight = 0
+            self._gauge.set(0)
+
+    def stage(self, name: str, frame_index: int, t0: float = None):
+        """Span + per-stage clock for one frame in one stage. ``t0``
+        backdates the span's open (and the clock) to when the stage's
+        work really began — the compute stage runs on-device from its
+        *dispatch*, not from when the drain thread gets around to
+        fencing it, and an open-at-fence span would under-measure
+        compute by however long it overlapped the previous frame's
+        drain (misnaming the bottleneck stage in ``--breakdown``)."""
+        return _StageSpan(self, name, frame_index, t0)
+
+
+class _StageSpan:
+    __slots__ = ("_pl", "name", "frame_index", "_span", "_t0")
+
+    def __init__(self, pl: _Pipeline, name: str, frame_index: int,
+                 t0: float = None):
+        self._pl, self.name, self.frame_index = pl, name, frame_index
+        self._t0 = t0
+
+    def __enter__(self):
+        self._span = obs.span(
+            f"stream.{self.name}", "stream", frame=self.frame_index
+        )
+        self._span.__enter__()
+        if self._t0 is None:
+            self._t0 = time.perf_counter()
+        elif hasattr(self._span, "_t0"):
+            # Backdate the trace record too (no-op span when disabled).
+            self._span._t0 = self._t0
+        return self._span
+
+    def __exit__(self, *exc) -> None:
+        dt = time.perf_counter() - self._t0
+        self._span.__exit__(*exc)
+        with self._pl._stage_lock:
+            self._pl.stage_seconds[self.name] += dt
+        obs.registry().histogram(
+            f"stream_{self.name}_seconds"
+        ).observe(dt)
+
+
+def _reader(pl: _Pipeline, source, start_frame: int) -> None:
+    """Prefetch frames into the staging ring, honoring the dispatch
+    window (a frame occupies a window slot from read start)."""
+    cfg = pl.cfg
+    idx = start_frame
+    try:
+        while cfg.frames is None or idx < cfg.frames:
+            pl.acquire_window()
+            buf_i = pl.get(pl.free_q)
+            with pl.stage("read", idx):
+                ok = source.read_into(pl.ring[buf_i])
+            if not ok:
+                if cfg.frames is not None:
+                    raise IOError(
+                        f"stream ended after {idx} frame(s); "
+                        f"--frames promised {cfg.frames}"
+                    )
+                pl.free_q.put(buf_i)
+                pl.release_window()
+                break
+            pl.put(pl.filled_q, (idx, buf_i))
+            idx += 1
+        pl.put(pl.filled_q, _EOF)
+    except _Abort:
+        pass
+    except BaseException as e:
+        pl.fail("read", idx, e)
+
+
+def _drain(pl: _Pipeline, eng: dict) -> None:
+    """Fence compute in dispatch order, copy D2H, free the window slot,
+    hand off to the writer. ``eng['fetch']`` is installed by the
+    dispatcher's bootstrap before the first in-flight item is enqueued
+    (the queue's lock orders the publication)."""
+    idx, stage = -1, "compute"
+    try:
+        while True:
+            item = pl.get(pl.inflight_q)
+            if item is _EOF:
+                pl.put(pl.write_q, _EOF)
+                return
+            idx, out_dev, t_disp = item
+            stage = "compute"
+            with pl.stage("compute", idx, t0=t_disp) as s:
+                s.fence(out_dev)
+            stage = "d2h"
+            with pl.stage("d2h", idx):
+                arr = eng["fetch"](out_dev)
+            pl.release_window()
+            pl.put(pl.write_q, (idx, arr))
+    except _Abort:
+        pass
+    except BaseException as e:
+        pl.fail(stage, max(idx, 0), e)
+
+
+def _writer(pl: _Pipeline, sink, done: list) -> None:
+    """Write results in order; commit the frame-index checkpoint and the
+    progress heartbeat. ``done[0]`` tracks frames fully written."""
+    cfg = pl.cfg
+    idx = -1
+    try:
+        while True:
+            item = pl.get(pl.write_q)
+            if item is _EOF:
+                return
+            idx, arr = item
+            with pl.stage("write", idx):
+                sink.write(idx, arr)
+            done[0] = idx + 1
+            obs.registry().counter("stream_frames_total").inc()
+            if cfg.checkpoint_every and done[0] % cfg.checkpoint_every == 0:
+                from tpu_stencil.runtime import checkpoint as ckpt
+
+                sink.flush()
+                ckpt.save_stream_progress(cfg, done[0])
+            if cfg.progress_every and done[0] % cfg.progress_every == 0:
+                print(f"stream: frame {done[0]}", file=sys.stderr, flush=True)
+    except _Abort:
+        pass
+    except BaseException as e:
+        pl.fail("write", max(idx, 0), e)
+
+
+def _build_launch(model, cfg: StreamConfig):
+    """The donated per-frame launcher — the exact program
+    ``prepare_engine``'s warm-up compiled (same jit cache entry), called
+    directly so the device input buffer is donated instead of
+    defensively copied (``IteratedConv2D.__call__`` copies to protect
+    callers; a stream frame has no other owner)."""
+    import jax.numpy as jnp
+
+    from tpu_stencil.models import blur
+
+    resolved, schedule = model.resolved_config(
+        (cfg.height, cfg.width), cfg.channels
+    )
+    bh, fz = model.resolved_geometry((cfg.height, cfg.width), cfg.channels)
+    reps = jnp.int32(cfg.repetitions)
+
+    def launch(dev):
+        return blur.iterate(
+            dev, reps, plan=model.plan, backend=resolved,
+            boundary=cfg.boundary, schedule=schedule,
+            block_h=bh, fuse=fz,
+        )
+
+    return launch, resolved, schedule
+
+
+def _dispatch(pl: _Pipeline, model, devices, eng: dict) -> None:
+    """The main-thread dispatch loop: bootstrap the engine on frame 0
+    (``prepare_engine``'s warm-up compile overlaps the reader's
+    prefetch of the following frames), then H2D + launch each filled
+    frame inside the depth-``k`` window. Publishes ``fetch``/``backend``
+    /``schedule`` into ``eng`` before the first in-flight item."""
+    import jax
+
+    from tpu_stencil import driver
+
+    cfg = pl.cfg
+    idx, stage = -1, "compute"  # bootstrap failures are compile/compute
+    try:
+        first = pl.get(pl.filled_q)
+        if first is _EOF:
+            pl.put(pl.inflight_q, _EOF)
+            return
+        idx, b0 = first
+        # First frame bootstraps the engine: prepare_engine places it
+        # and runs the 0-rep warm-up compile whose output equals its
+        # input — the warm device array IS frame 0's input, no second
+        # transfer (the run_job discipline).
+        frame0 = pl.ring[b0].reshape(cfg.frame_shape)
+        img_dev, _step_fn, fetch = driver.prepare_engine(
+            model, frame0, devices
+        )
+        launch, backend, schedule = _build_launch(model, cfg)
+        eng["fetch"] = fetch
+        eng["backend"] = backend
+        eng["schedule"] = schedule
+        # prepare_engine fenced the warm-up, so frame 0's staging buffer
+        # is already transferred: recycle its ring slot now and mark the
+        # in-flight record bufferless.
+        pl.free_q.put(b0)
+        t_disp = time.perf_counter()
+        out0 = launch(img_dev)
+        pl.put(pl.inflight_q, (idx, out0, t_disp))
+        while True:
+            item = pl.get(pl.filled_q)
+            if item is _EOF:
+                break
+            idx, bi = item
+            stage = "h2d"
+            with pl.stage("h2d", idx) as s:
+                # Fenced: device_put returns before the PCIe copy
+                # lands, and an unfenced span would misattribute the
+                # transfer to whoever blocks next (the drain's compute
+                # fence) — the measured-vs-model PCIe comparison in
+                # --breakdown depends on this attribution. The fence
+                # only holds THIS frame's pre-compute path; earlier
+                # frames keep computing on device.
+                dev = s.fence(jax.device_put(
+                    pl.ring[bi].reshape(cfg.frame_shape), devices[0]
+                ))
+            pl.free_q.put(bi)  # fenced H2D consumed the staging buffer
+            stage = "compute"
+            t_disp = time.perf_counter()
+            out = launch(dev)  # async dispatch; donates dev
+            pl.put(pl.inflight_q, (idx, out, t_disp))
+        pl.put(pl.inflight_q, _EOF)
+    except _Abort:
+        pass
+    except BaseException as e:
+        pl.fail(stage, max(idx, 0), e)
+
+
+def run_stream(
+    cfg: StreamConfig,
+    devices: Optional[list] = None,
+    resume: bool = False,
+    source: Optional[frames_io.FrameSource] = None,
+    sink: Optional[frames_io.FrameSink] = None,
+) -> StreamResult:
+    """Run one streaming job end to end; returns :class:`StreamResult`
+    or raises :class:`StreamFailure`. ``source``/``sink`` override the
+    config's specs (tests and benchmarks inject synthetic stages)."""
+    import jax
+
+    from tpu_stencil.models.blur import IteratedConv2D
+
+    obs.registry().counter("stream_jobs_total").inc()
+    t_start = time.perf_counter()
+    model = IteratedConv2D(cfg.filter_name, backend=cfg.backend,
+                           schedule=cfg.schedule, boundary=cfg.boundary,
+                           block_h=cfg.block_h, fuse=cfg.fuse)
+    if devices is None:
+        devices = jax.devices()
+    devices = devices[:1]  # frame-serial streaming is single-device today
+
+    start_frame = 0
+    if resume:
+        from tpu_stencil.runtime import checkpoint as ckpt
+
+        restored = ckpt.restore_stream_progress(cfg)
+        if restored is not None:
+            start_frame = restored
+    if cfg.frames is not None and start_frame > cfg.frames:
+        raise ValueError(
+            f"checkpoint records {start_frame} frames done but --frames "
+            f"is {cfg.frames}"
+        )
+    out_spec = cfg.output_path if sink is None else "<injected>"
+    if cfg.checkpoint_every and sink is None and (
+        not frames_io.is_resumable_sink(out_spec)
+    ):
+        raise ValueError(
+            f"--checkpoint-every needs a resumable sink (a file or "
+            f"directory), not {out_spec!r}"
+        )
+
+    own_source = source is None
+    own_sink = sink is None
+    if own_source:
+        source = frames_io.open_source(cfg.input, cfg.frame_bytes)
+    try:
+        if start_frame:
+            source.skip(start_frame)
+        if own_sink:
+            sink = frames_io.open_sink(
+                out_spec, cfg.frame_bytes, start_frame
+            )
+    except BaseException:
+        if own_source:
+            source.close()
+        raise
+
+    pl = _Pipeline(cfg)
+    done = [start_frame]
+    eng: dict = {}
+    threads = [
+        threading.Thread(target=_reader, args=(pl, source, start_frame),
+                         name="stream-reader", daemon=True),
+        threading.Thread(target=_drain, args=(pl, eng),
+                         name="stream-drain", daemon=True),
+        threading.Thread(target=_writer, args=(pl, sink, done),
+                         name="stream-writer", daemon=True),
+    ]
+    try:
+        for t in threads:
+            t.start()
+        _dispatch(pl, model, devices, eng)
+        # Clean runs end via the sentinel cascade; failed runs via the
+        # stop flag (queue waits unwind within their 50ms poll). One
+        # stage can NOT unwind that way: a reader parked in a blocking
+        # read() on a silent pipe — never wait on it indefinitely.
+        for t in threads:
+            while t.is_alive() and not pl.stop.is_set():
+                t.join(timeout=0.1)
+    finally:
+        pl.stop.set()  # unstick any straggler stage before closing I/O
+        for t in threads:
+            t.join(timeout=1.0)
+        pl.zero_gauge()  # aborted frames never pass release_window
+        # Closing the source can race a reader still parked in read();
+        # the failure is already recorded (first-wins), so a close-time
+        # error must not mask it. The reader thread is a daemon either
+        # way.
+        if own_source:
+            try:
+                source.close()
+            except OSError:
+                pass
+        if own_sink and sink is not None:
+            try:
+                sink.close()
+            except OSError:
+                if pl.failure is None:
+                    raise
+
+    if pl.failure is not None:
+        stage, frame_index, cause = pl.failure
+        raise StreamFailure(stage, frame_index, cause) from cause
+
+    n = done[0] - start_frame
+    if cfg.checkpoint_every or resume:
+        from tpu_stencil.runtime import checkpoint as ckpt
+
+        ckpt.clear_stream_progress(cfg)
+    wall = time.perf_counter() - t_start
+    from tpu_stencil.models.blur import resolve_backend
+
+    backend = eng.get("backend", resolve_backend(cfg.backend))
+    return StreamResult(
+        frames=n,
+        skipped=start_frame,
+        wall_seconds=wall,
+        frames_per_second=n / wall if wall > 0 else 0.0,
+        stage_seconds=dict(pl.stage_seconds),
+        backend=backend,
+        schedule=eng.get("schedule") if backend == "pallas" else None,
+        pipeline_depth=cfg.pipeline_depth,
+        output=out_spec,
+    )

@@ -1,0 +1,283 @@
+"""Frame sources and sinks for the streaming engine.
+
+The container contract is ``io/raw.py``'s, lifted to streams: a frame is
+``H*W*C`` headerless bytes (trust-the-geometry — width/height/channels
+are supplied out of band), and a *stream* is either
+
+* one concatenated ``.raw`` stream — a regular file, a FIFO/pipe, or
+  stdin/stdout (``"-"``); no header, no framing, EOF is the only
+  terminator; or
+* a directory of per-frame ``.raw`` files, consumed/produced in sorted
+  name order (``frame_000000.raw`` ...).
+
+Sources fill caller-owned staging buffers (``read_into`` — the engine's
+ring reuses them, so steady state allocates nothing on the host) and
+fail loudly on short reads: a stream that ends mid-frame is an error
+with the frame index, never silent garbage (the same discipline
+``io/raw.py`` applies to short files). A :class:`NullSink` discards
+output for benchmarking the pipeline without a disk-write stage.
+"""
+
+from __future__ import annotations
+
+import os
+import stat as _stat
+import sys
+from typing import BinaryIO, List, Optional
+
+import numpy as np
+
+from tpu_stencil.io.raw import discard_stream_bytes, read_stream_into
+
+FRAME_PATTERN = "frame_{:06d}.raw"
+
+
+class FrameSource:
+    """Sequential frame producer. Context-managed; single consumer."""
+
+    def read_into(self, buf: np.ndarray) -> bool:
+        """Fill ``buf`` (1-D uint8, one frame) with the next frame.
+        Returns False on clean EOF (no bytes read); raises ``IOError``
+        on a short read (stream ended mid-frame)."""
+        raise NotImplementedError
+
+    def skip(self, n: int) -> None:
+        """Advance past ``n`` frames (resume support). Seekable sources
+        seek; pipes read and discard."""
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+    def __enter__(self) -> "FrameSource":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+class FrameSink:
+    """In-order frame consumer. Context-managed; single producer. The
+    engine guarantees ``write`` is called with strictly increasing
+    frame indices starting at the resume point."""
+
+    def write(self, index: int, frame: np.ndarray) -> None:
+        raise NotImplementedError
+
+    def flush(self) -> None:
+        """Durability point before a progress checkpoint commits."""
+        pass
+
+    def close(self) -> None:
+        pass
+
+    def __enter__(self) -> "FrameSink":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+class RawStreamSource(FrameSource):
+    """Concatenated headerless frames from one byte stream: a regular
+    file, a FIFO/pipe path, or stdin (``"-"``). Regular files validate
+    total size divisibility lazily (EOF mid-frame raises); pipes are
+    pure sequential reads — the contract ``io/raw.py:read_raw_rows``
+    applies to non-regular files."""
+
+    def __init__(self, path: str, frame_bytes: int):
+        self.path = path
+        self.frame_bytes = frame_bytes
+        self._frames_read = 0
+        if path == "-":
+            self._f: BinaryIO = sys.stdin.buffer
+            self._owns = False
+        else:
+            self._f = open(path, "rb", buffering=0)
+            self._owns = True
+
+    def read_into(self, buf: np.ndarray) -> bool:
+        view = memoryview(buf).cast("B")
+        assert len(view) == self.frame_bytes
+        got = read_stream_into(self._f, view)
+        if got == 0:
+            return False
+        if got < self.frame_bytes:
+            raise IOError(
+                f"{self.path}: stream ended mid-frame "
+                f"(frame {self._frames_read}: {got}/{self.frame_bytes} bytes)"
+            )
+        self._frames_read += 1
+        return True
+
+    def skip(self, n: int) -> None:
+        if n <= 0:
+            return
+        nbytes = n * self.frame_bytes
+        if self._f.seekable():
+            self._f.seek(nbytes, os.SEEK_CUR)
+        else:
+            discard_stream_bytes(
+                self._f, nbytes, f"{self.path} (skipping {n} resumed frames)"
+            )
+        self._frames_read += n
+
+    def close(self) -> None:
+        if self._owns:
+            self._f.close()
+
+
+class RawDirectorySource(FrameSource):
+    """A sorted directory of per-frame ``.raw`` files. Each file must be
+    exactly one frame; a wrong-sized file fails loudly with its name
+    (the directory analog of the short-read contract)."""
+
+    def __init__(self, path: str, frame_bytes: int):
+        self.path = path
+        self.frame_bytes = frame_bytes
+        self._names: List[str] = sorted(
+            n for n in os.listdir(path) if n.endswith(".raw")
+        )
+        self._i = 0
+
+    def __len__(self) -> int:
+        return len(self._names)
+
+    def read_into(self, buf: np.ndarray) -> bool:
+        if self._i >= len(self._names):
+            return False
+        name = os.path.join(self.path, self._names[self._i])
+        size = os.path.getsize(name)
+        if size != self.frame_bytes:
+            raise IOError(
+                f"{name}: frame file holds {size} bytes, "
+                f"expected {self.frame_bytes}"
+            )
+        view = memoryview(buf).cast("B")
+        with open(name, "rb", buffering=0) as f:
+            got = read_stream_into(f, view)
+        if got != self.frame_bytes:
+            raise IOError(f"{name}: short read {got}/{self.frame_bytes}")
+        self._i += 1
+        return True
+
+    def skip(self, n: int) -> None:
+        self._i += max(0, n)
+
+
+class RawStreamSink(FrameSink):
+    """Concatenated headerless frames to one byte stream: a regular
+    file, a FIFO/pipe path, or stdout (``"-"``). ``start_frame``
+    (resume) positions a regular file at the resume offset; pipes
+    cannot resume mid-stream and refuse."""
+
+    def __init__(self, path: str, frame_bytes: int, start_frame: int = 0):
+        self.path = path
+        self.frame_bytes = frame_bytes
+        if path == "-":
+            self._f: BinaryIO = sys.stdout.buffer
+            self._owns = False
+            if start_frame:
+                raise ValueError("cannot resume a stream into stdout")
+        else:
+            exists = os.path.exists(path)
+            if start_frame and not exists:
+                raise ValueError(
+                    f"cannot resume: sink {path} does not exist"
+                )
+            self._f = open(path, "r+b" if (start_frame and exists) else "wb")
+            self._owns = True
+            if start_frame:
+                if not self._f.seekable():
+                    self._f.close()
+                    raise ValueError(
+                        f"cannot resume a stream into non-seekable {path}"
+                    )
+                self._f.seek(start_frame * frame_bytes)
+                self._f.truncate()
+
+    def write(self, index: int, frame: np.ndarray) -> None:
+        # Buffer-protocol write: ascontiguousarray is a no-op view for
+        # the already-contiguous uint8 arrays the engine drains, so a
+        # frame is NOT copied again on its way out (tobytes() would
+        # memcpy every frame inside the stage that bounds a write-bound
+        # stream's throughput).
+        arr = np.ascontiguousarray(frame, dtype=np.uint8)
+        self._f.write(memoryview(arr).cast("B"))
+
+    def flush(self) -> None:
+        self._f.flush()
+
+    def close(self) -> None:
+        self._f.flush()
+        if self._owns:
+            self._f.close()
+
+
+class RawDirectorySink(FrameSink):
+    """One ``frame_%06d.raw`` file per frame, atomic per frame: bytes
+    land in a tmp file and ``os.replace`` publishes the final name
+    (the ``runtime/checkpoint.py`` discipline), so a crash mid-write
+    can never leave a torn frame under a complete-looking name. Resume
+    is natural — frame files are keyed by index, rewrites idempotent."""
+
+    def __init__(self, path: str, frame_bytes: int, start_frame: int = 0):
+        self.path = path
+        self.frame_bytes = frame_bytes
+        os.makedirs(path, exist_ok=True)
+
+    def write(self, index: int, frame: np.ndarray) -> None:
+        name = os.path.join(self.path, FRAME_PATTERN.format(index))
+        arr = np.ascontiguousarray(frame, dtype=np.uint8)
+        tmp = name + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(memoryview(arr).cast("B"))
+        os.replace(tmp, name)
+
+
+class NullSink(FrameSink):
+    """Discard frames — benchmark the pipeline without a write stage."""
+
+    def __init__(self, *a, **k):
+        self.frames_written = 0
+
+    def write(self, index: int, frame: np.ndarray) -> None:
+        self.frames_written += 1
+
+
+def _is_dir_spec(spec: str) -> bool:
+    return spec.endswith(os.sep) or os.path.isdir(spec)
+
+
+def open_source(spec: str, frame_bytes: int) -> FrameSource:
+    """Resolve a source spec: ``"-"`` = stdin, an existing directory =
+    sorted per-frame files, anything else = one concatenated byte
+    stream (regular file or FIFO — non-regular paths are read purely
+    sequentially)."""
+    if spec != "-" and _is_dir_spec(spec):
+        return RawDirectorySource(spec.rstrip(os.sep), frame_bytes)
+    return RawStreamSource(spec, frame_bytes)
+
+
+def open_sink(spec: str, frame_bytes: int, start_frame: int = 0) -> FrameSink:
+    """Resolve a sink spec: ``"null"`` = discard, ``"-"`` = stdout, a
+    directory (existing, or a trailing-separator path) = per-frame
+    files, anything else = one concatenated stream file/pipe."""
+    if spec == "null":
+        return NullSink()
+    if spec != "-" and _is_dir_spec(spec):
+        return RawDirectorySink(spec.rstrip(os.sep), frame_bytes, start_frame)
+    return RawStreamSink(spec, frame_bytes, start_frame)
+
+
+def is_resumable_sink(spec: str) -> bool:
+    """True when progress into this sink survives a restart (a real
+    filesystem artifact): checkpointing into 'null', stdout, or a FIFO
+    would record progress no one can resume from."""
+    if spec in ("null", "-"):
+        return False
+    if _is_dir_spec(spec):
+        return True
+    if os.path.exists(spec):
+        return _stat.S_ISREG(os.stat(spec).st_mode)
+    return True  # a not-yet-created regular stream file
