@@ -42,6 +42,12 @@ versioned headline capture whose metric is suffixed with the mesh and
 the RESOLVED overlap mode — a distinct perf-sentry series per
 (mesh, overlap), so sharded runs gate regressions like single-chip ones.
 
+Per-schedule mode: ``TPU_STENCIL_BENCH_SCHEDULE=s1,s2,...`` emits one
+versioned headline capture PER named Pallas schedule (metric suffixed
+``_sched-<name>`` — each its own perf-sentry series, gated
+independently), so a schedule A/B (the r02 pad baseline next to the
+deep-blocked number) lands in one burst without false regressions.
+
 Streaming mode: ``TPU_STENCIL_BENCH_STREAM=1`` measures the pipelined
 frame-streaming engine (``tpu_stencil.stream``, null sink, warm-up
 excluded) and emits a versioned headline capture in seconds/frame with
@@ -125,6 +131,28 @@ def _time_fn(jit_fn, img, phases=None) -> float:
     return _steady_state_per_rep(run, base_reps)
 
 
+def _time_pallas_schedule(plan, img, schedule, phases=None, block_h=None,
+                          fuse=None, interpret=False):
+    """Steady-state per-rep seconds of one Pallas schedule/geometry —
+    the single measurement step the default sweep, its geometry stage,
+    and the per-schedule headline mode all share, so the measurement
+    protocol can never drift between them."""
+    import functools
+
+    import jax
+
+    from tpu_stencil.ops import pallas_stencil
+
+    jit_fn = jax.jit(
+        functools.partial(
+            pallas_stencil.iterate, plan=plan, schedule=schedule,
+            block_h=block_h, fuse=fuse, interpret=interpret,
+        ),
+        donate_argnums=0,
+    )
+    return _time_fn(jit_fn, img, phases)
+
+
 def _measure_backend(backend: str, on_first=None) -> dict:
     """Steady-state per-rep seconds for one backend on the north star.
 
@@ -138,8 +166,6 @@ def _measure_backend(backend: str, on_first=None) -> dict:
     default schedule is measured first so the early line reflects what a
     bare-CLI user gets)."""
     import functools
-
-    import jax
 
     from tpu_stencil.models.blur import IteratedConv2D, iterate
     from tpu_stencil.ops import pallas_stencil
@@ -159,11 +185,13 @@ def _measure_backend(backend: str, on_first=None) -> dict:
                 "phases": phases}
 
     # Optional restriction for the rows-roll probe (second child run):
-    # measure only the named schedules instead of all five.
+    # measure only the named schedules instead of the full sweep. NOT
+    # the singular TPU_STENCIL_BENCH_SCHEDULE, which switches to the
+    # per-schedule headline mode instead.
     only = os.environ.get("TPU_STENCIL_BENCH_SCHEDULES")
     sched_list = (
         tuple(only.split(",")) if only
-        else ("pad", "shrink", "strips", "pack", "pack_strips")
+        else ("pad", "shrink", "strips", "pack", "pack_strips", "deep")
     )
     # Measure the shipped default first: the early capture line must
     # reflect the default path, and if the tunnel dies mid-sweep the one
@@ -174,14 +202,8 @@ def _measure_backend(backend: str, on_first=None) -> dict:
         )
     schedules = {}
     for sched in sched_list:
-        jit_fn = jax.jit(
-            functools.partial(
-                pallas_stencil.iterate, plan=model.plan, schedule=sched
-            ),
-            donate_argnums=0,
-        )
         try:
-            per = _time_fn(jit_fn, img, phases)
+            per = _time_pallas_schedule(model.plan, img, sched, phases)
         except Exception as e:  # one broken schedule must not kill pallas
             log(f"pallas[{sched}]: FAILED {type(e).__name__}: {e}")
             continue
@@ -199,11 +221,25 @@ def _measure_backend(backend: str, on_first=None) -> dict:
     # schedule sweep — the artifact reflects the kernel's best available
     # RUNTIME-SELECTABLE configuration (autotune applies the winning
     # geometry on the default path), even if no default has been flipped.
-    from tpu_stencil.runtime.autotune import _GEOMETRY_GRID
+    from tpu_stencil.runtime.autotune import (
+        _GEOMETRY_GRID, _VMEM_PRUNE_SLACK,
+    )
 
     geometries = {(None, None): per_rep}
-    seen = {pallas_stencil.effective_geometry(model.plan, H)}
-    skip_geo = os.environ.get("TPU_STENCIL_BENCH_SKIP_GEOMETRY") == "1"
+    # Seed the dedup with the winning schedule's NATURAL geometry (deep
+    # defaults to the feasibility-model depth, not DEFAULT_FUSE), so a
+    # grid candidate that launches identically is never measured twice.
+    wcp = pallas_stencil.padded_lanes(model.plan, W * C, C)
+    seen = {pallas_stencil.effective_geometry(
+        model.plan, H, schedule=best, wc=wcp,
+    )}
+    # A deep win on a resident-feasible shape has no static geometry to
+    # tune (every candidate would launch the identical grid-of-one
+    # resident kernel) — same guard the autotuner applies.
+    skip_geo = os.environ.get("TPU_STENCIL_BENCH_SKIP_GEOMETRY") == "1" or (
+        best == "deep"
+        and pallas_stencil.resident_feasible(model.plan, H, wcp)
+    )
     for gbh, gfz in () if skip_geo else _GEOMETRY_GRID:
         eff = pallas_stencil.effective_geometry(model.plan, H, gbh, gfz)
         if eff in seen:
@@ -215,15 +251,17 @@ def _measure_backend(backend: str, on_first=None) -> dict:
             # timed as one kernel and attributed to another — skip it
             # (latent with today's grid; guards future grid entries).
             continue
-        jit_fn = jax.jit(
-            functools.partial(
-                pallas_stencil.iterate, plan=model.plan, schedule=best,
-                block_h=gbh, fuse=gfz,
-            ),
-            donate_argnums=0,
-        )
+        if pallas_stencil.vmem_tile_bytes(
+                model.plan, eff[0], eff[1], wcp,
+                pallas_stencil._kernel_schedule(best, model.plan, eff[0]),
+        ) > _VMEM_PRUNE_SLACK * pallas_stencil._vmem_budget():
+            # Same feasibility prune (and slack) as the autotuner's
+            # geometry stage — bench must never report a winner the
+            # default autotune path is forbidden from adopting.
+            continue
         try:
-            per = _time_fn(jit_fn, img)
+            per = _time_pallas_schedule(model.plan, img, best,
+                                        block_h=gbh, fuse=gfz)
         except Exception as e:
             log(f"pallas[{best}@{gbh}x{gfz}]: FAILED "
                 f"{type(e).__name__}: {e}")
@@ -253,17 +291,19 @@ def _measure_backend(backend: str, on_first=None) -> dict:
 
 
 def _capture_line(per_rep_s: float, backend: str, platform: str,
-                  block_h=None, fuse=None) -> dict:
+                  block_h=None, fuse=None, schedule=None) -> dict:
     """The shared core of every capture line (early and enriched): both
     must stay interchangeable self-contained captures, so the fields are
-    built in exactly one place. ``block_h``/``fuse``: the geometry that
-    ran, for the roofline traffic model (None = module defaults)."""
+    built in exactly one place. ``block_h``/``fuse``/``schedule``: what
+    ran, for the roofline traffic model (None = module defaults; a
+    'deep' schedule divides bytes/rep by the full in-VMEM depth)."""
     from tpu_stencil.runtime import roofline
 
     value = per_rep_s * REPS
     gbps, pct = roofline.achieved(
         H * W * C, per_rep_s, backend, "gaussian", H,
-        block_h=block_h, fuse=fuse,
+        block_h=block_h, fuse=fuse, schedule=schedule,
+        w_img=W, channels=C, reps=REPS,
     )
     return {
         "metric": f"{W}x{H}_rgb_{REPS}reps_compute_wall_clock",
@@ -453,6 +493,58 @@ def _measure_stream(platform: str) -> dict:
     return line
 
 
+def _measure_schedule_headlines(schedules, platform: str) -> list:
+    """Per-schedule headline mode (``TPU_STENCIL_BENCH_SCHEDULE=s1,s2``):
+    one versioned capture line PER named Pallas schedule, the schedule
+    folded into the metric name so each is its own perf-sentry series —
+    a schedule A/B (e.g. the r02 pad baseline next to the deep-blocked
+    number) is two gateable series captured in one burst, never a false
+    regression against each other. Lines carry the effective schedule
+    plus the (block_h, fuse) that launched (deep reports its trapezoid
+    depth; the resident form has no static geometry). CPU smokes run
+    interpret mode — platform-tagged, and the sentry never logs them to
+    the hardware history."""
+    from tpu_stencil.models.blur import IteratedConv2D
+    from tpu_stencil.ops import pallas_stencil
+
+    rng = np.random.default_rng(0)
+    img = rng.integers(0, 256, size=(H, W, C), dtype=np.uint8)
+    model = IteratedConv2D("gaussian")
+    interpret = platform == "cpu"
+    lines = []
+    seen_eff = set()
+    for sched in (s.strip() for s in schedules):
+        eff = pallas_stencil.effective_schedule_for(model.plan, H, sched)
+        if eff in seen_eff:
+            # Two requested names degrading to one effective schedule
+            # would emit two lines on the SAME sentry series in one
+            # burst (double-weighting its baseline median) — the metric
+            # carries the effective name, so measure each series once.
+            log(f"pallas[{sched}]: skipped (degrades to already-measured "
+                f"'{eff}')")
+            continue
+        seen_eff.add(eff)
+        try:
+            per = _time_pallas_schedule(model.plan, img, sched,
+                                        interpret=interpret)
+        except Exception as e:  # one broken schedule must not kill the rest
+            log(f"pallas[{sched}]: FAILED {type(e).__name__}: {e}")
+            continue
+        log(f"pallas[{sched}]: {per * 1e6:.1f} us/rep")
+        line = _capture_line(per, "pallas", platform, schedule=eff)
+        line["metric"] = (
+            f"{W}x{H}_rgb_{REPS}reps_sched-{eff}_compute_wall_clock"
+        )
+        line["pallas_schedule"] = eff
+        if eff == "deep":
+            bh, fz = pallas_stencil.deep_geometry(model.plan, H, W, C)
+        else:
+            bh, fz = pallas_stencil.effective_geometry(model.plan, H)
+        line["pallas_block_h"], line["pallas_fuse"] = bh, fz
+        lines.append(line)
+    return lines
+
+
 def child_main() -> int:
     # Test-only crash injection: if the marker file exists, consume it and
     # die the way a tunnel drop kills a real capture (lets the retry loop
@@ -500,6 +592,24 @@ def child_main() -> int:
             return 1
         print(json.dumps(result), flush=True)
         return 0
+
+    sched_env = os.environ.get("TPU_STENCIL_BENCH_SCHEDULE")
+    if sched_env:
+        # One character away from TPU_STENCIL_BENCH_SCHEDULES (which
+        # restricts the normal sweep) — announce loudly which mode this
+        # run is in, so a mistyped knob is visible in the burst log.
+        log(f"per-schedule headline mode (TPU_STENCIL_BENCH_SCHEDULE="
+            f"{sched_env}): one sentry series per schedule, normal "
+            f"capture skipped (use TPU_STENCIL_BENCH_SCHEDULES — plural "
+            f"— to restrict the default sweep instead)")
+        try:
+            lines = _measure_schedule_headlines(sched_env.split(","), platform)
+        except Exception as e:
+            log(f"schedule capture: FAILED {type(e).__name__}: {e}")
+            return 1
+        for line in lines:
+            print(json.dumps(line), flush=True)
+        return 0 if lines else 1
 
     mesh_env = os.environ.get("TPU_STENCIL_BENCH_MESH")
     if mesh_env:
@@ -564,15 +674,19 @@ def child_main() -> int:
     for line in _phase_lines(winner, results, platform):
         print(json.dumps(line), flush=True)
 
-    # Roofline at the geometry that actually ran: when the winner is the
-    # Pallas geometry-stage verdict (e.g. fuse=16), the traffic model must
-    # follow that launch, not DEFAULT_FUSE (advisor r4, medium).
+    # Roofline at the config that actually ran: when the winner is the
+    # Pallas geometry-stage verdict (e.g. fuse=16) or the deep schedule,
+    # the traffic model must follow that launch, not DEFAULT_FUSE
+    # (advisor r4, medium; the deep model divides by the in-VMEM depth).
     win_geo = (None, None)
+    win_sched = None
     if winner == "pallas":
         geo = results["pallas"].get("geometry", "default")
         if geo != "default":
             win_geo = tuple(int(v) for v in geo.split("x"))
-    result = _capture_line(per_rep, winner, platform, *win_geo)
+        win_sched = results["pallas"].get("schedule")
+    result = _capture_line(per_rep, winner, platform, *win_geo,
+                           schedule=win_sched)
     result["backends_us_per_rep"] = {
         b: r["us_per_rep"] for b, r in results.items()
     }
@@ -598,9 +712,17 @@ def child_main() -> int:
             (None, None) if geo == "default"
             else tuple(int(v) for v in geo.split("x"))
         )
-        bh, fz = pallas_stencil.effective_geometry(
-            _M("gaussian").plan, H, *req
-        )
+        if pal["schedule"] == "deep":
+            # Deep launches report what temporal blocking ran: the
+            # trapezoid's effective (block, depth), or no static
+            # geometry for the resident kernel — never DEFAULT_FUSE.
+            bh, fz = pallas_stencil.deep_geometry(
+                _M("gaussian").plan, H, W, C, *req
+            )
+        else:
+            bh, fz = pallas_stencil.effective_geometry(
+                _M("gaussian").plan, H, *req
+            )
         result["pallas_block_h"], result["pallas_fuse"] = bh, fz
         if "geometries_us_per_rep" in pal:
             result["pallas_geometries_us_per_rep"] = (
@@ -818,6 +940,12 @@ def main() -> int:
             _is_capture(line) for line in forwarded
         )
         if rc == 0 and lines:
+            if os.environ.get("TPU_STENCIL_BENCH_SCHEDULE"):
+                # Per-schedule headline mode: every line is its own
+                # sentry series — gate each independently, worst verdict
+                # wins the exit code.
+                rcs = [_sentry_gate(l) for l in lines if _is_capture(l)]
+                return max(rcs) if rcs else 0
             final = _rows_roll_probe(lines[-1])
             if final != lines[-1]:  # already streamed; print only new info
                 print(final, flush=True)

@@ -79,8 +79,9 @@ def test_measures_once_then_caches(plan, tmp_path, monkeypatch):
         "pallas", "pack"
     )
     assert autotune.best_backend(plan, (128, 96), 3, measure=boom) == "pallas"
-    cache = json.load(open(str(tmp_path / "c.json")))
-    (entry,) = cache.values()
+    raw = json.load(open(str(tmp_path / "c.json")))
+    assert raw["schema_version"] == autotune.SCHEMA_VERSION
+    (entry,) = raw["entries"].values()
     assert entry["backend"] == "pallas"
     assert entry["schedule"] == "pack"
     assert entry["us_per_rep"]["xla"] == 2.0
@@ -104,7 +105,7 @@ def test_cache_roundtrips_with_real_measurement(plan, tmp_path, monkeypatch):
     got = autotune.best_config(plan, (32, 24), 1)
     assert got == ("xla", None)  # the only candidate that runs on CPU
     cache = json.load(open(str(path)))
-    (entry,) = cache.values()
+    (entry,) = cache["entries"].values()
     assert entry["backend"] == "xla"
     assert entry["us_per_rep"]["xla"] > 0  # a real, nonzero timing
 
@@ -129,7 +130,7 @@ def test_distinct_shapes_get_distinct_keys(plan, tmp_path, monkeypatch):
     assert autotune.best_backend(plan, (5040, 1920), 3, measure=fake_measure) == "pallas"
     assert autotune.best_backend(plan, (630, 1920), 3, measure=fake_measure) == "xla"
     cache = json.load(open(str(tmp_path / "c.json")))
-    assert len(cache) == 2
+    assert len(cache["entries"]) == 2
 
 
 def test_direct_f32_plans_never_tune(tmp_path, monkeypatch):
@@ -499,3 +500,223 @@ def test_sharded_runner_applies_tuned_geometry(rng, monkeypatch, tmp_path):
         img, filters.get_filter("gaussian"), 3
     )
     np.testing.assert_array_equal(out, want)
+
+
+# -- versioned cache hygiene (schema_version / jax-version eviction) ----
+
+
+def test_cache_file_is_versioned_and_migrates_legacy(plan, tmp_path,
+                                                     monkeypatch):
+    # Migration path: a pre-versioned (flat key->entry) cache file must
+    # keep answering — its entries are read as-is — and the next store
+    # rewrites the versioned wrapper.
+    import jax
+
+    path = tmp_path / "c.json"
+    monkeypatch.setenv("TPU_STENCIL_AUTOTUNE_CACHE", str(path))
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    key = autotune._key(plan, (64, 64), 1)
+    legacy_entry = {"backend": "xla", "schedule": None, "block_h": None,
+                    "fuse": None,
+                    "geometry_grid": autotune._grid_fingerprint()}
+    path.write_text(json.dumps({key: legacy_entry}))
+
+    def boom(*a, **k):
+        raise AssertionError("legacy entry must hit, not re-measure")
+
+    assert autotune.best_full_config(plan, (64, 64), 1, measure=boom) == (
+        "xla", None, None, None
+    )
+    # a store (new shape tuned) rewrites the versioned wrapper, legacy
+    # entry carried over
+    def fake(plan, shape, channels, backend, reps=0, schedule=None,
+             block_h=None, fuse=None):
+        return 1e-6
+
+    autotune.best_full_config(plan, (128, 64), 1, measure=fake)
+    raw = json.load(open(str(path)))
+    assert raw["schema_version"] == autotune.SCHEMA_VERSION
+    assert raw["jax_version"] == jax.__version__
+    assert key in raw["entries"]
+
+
+def test_stale_jax_version_entries_evicted(plan, tmp_path, monkeypatch):
+    # Entries keyed under a different jax version are dropped at load
+    # (they must neither answer nor accumulate forever) while
+    # current-version entries survive.
+    import jax
+
+    path = tmp_path / "c.json"
+    monkeypatch.setenv("TPU_STENCIL_AUTOTUNE_CACHE", str(path))
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    cur_key = autotune._key(plan, (64, 64), 1)
+    stale_key = cur_key.replace(jax.__version__, "0.0.0-stale")
+    entry = {"backend": "xla", "schedule": None, "block_h": None,
+             "fuse": None, "geometry_grid": autotune._grid_fingerprint()}
+    path.write_text(json.dumps({
+        "schema_version": autotune.SCHEMA_VERSION,
+        "entries": {cur_key: entry, stale_key: dict(entry)},
+    }))
+    assert set(autotune._load_cache()) == {cur_key}
+    # overlap-prefixed keys carry the version one segment later
+    overlap_stale = "overlap|" + stale_key + "|mesh2x2|xla"
+    path.write_text(json.dumps({
+        "schema_version": autotune.SCHEMA_VERSION,
+        "entries": {overlap_stale: {"overlap": "off"}},
+    }))
+    assert autotune._load_cache() == {}
+    # the stale entry forces a re-measure (it can no longer answer)
+    calls = []
+
+    def fake(plan, shape, channels, backend, reps=0, schedule=None,
+             block_h=None, fuse=None):
+        calls.append(backend)
+        return 1e-6
+
+    path.write_text(json.dumps({
+        "schema_version": autotune.SCHEMA_VERSION,
+        "entries": {stale_key: entry},
+    }))
+    autotune.best_full_config(plan, (64, 64), 1, measure=fake)
+    assert calls, "stale-version entry must re-measure"
+    # ...and the rewritten file no longer contains the stale key
+    raw = json.load(open(str(path)))
+    assert stale_key not in raw["entries"]
+
+
+# -- full schedule-grid search (deep candidates + VMEM pruning) ---------
+
+
+def test_grid_measures_deep_and_can_pick_it(plan, tmp_path, monkeypatch):
+    # The schedule axis includes 'deep'; when it measures fastest the
+    # verdict names it, and a warm cache replays it with ZERO probes.
+    import jax
+
+    monkeypatch.setenv("TPU_STENCIL_AUTOTUNE_CACHE", str(tmp_path / "c.json"))
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    calls = []
+
+    def fake(plan, shape, channels, backend, reps=0, schedule=None,
+             block_h=None, fuse=None):
+        calls.append((backend, schedule, block_h, fuse))
+        if backend == "xla":
+            return 5e-6
+        return 1e-6 if schedule == "deep" else 3e-6
+
+    got = autotune.best_full_config(plan, (2520, 1920), 3, measure=fake)
+    assert got[:2] == ("pallas", "deep")
+    assert ("pallas", "deep", None, None) in calls
+    calls.clear()
+
+    def boom(*a, **k):
+        raise AssertionError("warm cache must perform zero probes")
+
+    assert autotune.best_full_config(plan, (2520, 1920), 3,
+                                     measure=boom) == got
+    assert calls == []
+
+
+def test_grid_prunes_vmem_infeasible_geometry(plan, tmp_path, monkeypatch):
+    # Geometry candidates whose modeled VMEM footprint exceeds the
+    # budget are never measured (the feasibility-model prune).
+    import jax
+    from tpu_stencil.ops import pallas_stencil as ps
+
+    monkeypatch.setenv("TPU_STENCIL_AUTOTUNE_CACHE", str(tmp_path / "c.json"))
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    geo_seen = []
+
+    def fake(plan, shape, channels, backend, reps=0, schedule=None,
+             block_h=None, fuse=None):
+        if block_h is not None:
+            geo_seen.append((block_h, fuse))
+        if backend == "xla":
+            return 2e-6
+        return 1e-6 if schedule == "pack" else 1.5e-6
+
+    shape = (2520, 1920)
+    autotune.best_full_config(plan, shape, 3, measure=fake)
+    wcp = ps.padded_lanes(plan, shape[1] * 3, 3)
+    bound = autotune._VMEM_PRUNE_SLACK * ps._vmem_budget()
+    for gbh, gfz in geo_seen:
+        eff = ps.effective_geometry(plan, shape[0], gbh, gfz)
+        assert ps.vmem_tile_bytes(
+            plan, eff[0], eff[1], wcp, "pack"
+        ) <= bound, f"infeasible candidate {gbh}x{gfz} was measured"
+    # at the north-star width the deepest 512-row candidate exceeds even
+    # the slackened bound — the prune must have dropped it...
+    assert (512, 64) not in geo_seen
+    # ...while the historically-measured 512-row cliff candidates (the
+    # model over-counts; see _VMEM_PRUNE_SLACK) stay in the grid
+    assert any(bh == 512 for bh, fz in geo_seen)
+
+
+def test_deep_resident_verdict_skips_geometry_stage(plan, tmp_path,
+                                                    monkeypatch):
+    # A resident-feasible shape winning on 'deep' has no static geometry
+    # to tune: the stage must not run (the resident kernel ignores
+    # block_h/fuse entirely).
+    import jax
+
+    monkeypatch.setenv("TPU_STENCIL_AUTOTUNE_CACHE", str(tmp_path / "c.json"))
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    geo_calls = []
+
+    def fake(plan, shape, channels, backend, reps=0, schedule=None,
+             block_h=None, fuse=None):
+        if block_h is not None:
+            geo_calls.append((block_h, fuse))
+        if backend == "xla":
+            return 5e-6
+        return 1e-6 if schedule == "deep" else 3e-6
+
+    got = autotune.best_full_config(plan, (64, 48), 1, measure=fake)
+    assert got == ("pallas", "deep", None, None)
+    assert geo_calls == []
+
+
+@pytest.mark.timing
+def test_deep_never_gated_on_when_measured_slower(plan, tmp_path,
+                                                  monkeypatch):
+    # A/B probe: feed the tuner REAL interpret-mode timings of the deep
+    # and pack schedules on a tiny image; whichever measures slower must
+    # not win the verdict — deep is gated by measurement, never assumed.
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_stencil.ops import pallas_stencil as ps
+
+    monkeypatch.setenv("TPU_STENCIL_AUTOTUNE_CACHE", str(tmp_path / "c.json"))
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    shape = (32, 24)
+    img = np.random.default_rng(0).integers(
+        0, 256, size=shape, dtype=np.uint8
+    )
+
+    def real_measure(plan, shp, channels, backend, reps=8, schedule=None,
+                     block_h=None, fuse=None):
+        if backend != "pallas" or schedule not in ("deep", "pack"):
+            return float("inf")  # restrict the A/B to the two schedules
+        fn = jax.jit(
+            lambda x, n: ps.iterate(x, n, plan, interpret=True,
+                                    schedule=schedule, block_h=block_h,
+                                    fuse=fuse),
+            donate_argnums=0,
+        )
+        np.asarray(fn(jnp.asarray(img), jnp.int32(2)))  # compile fence
+        t0 = _time.perf_counter()
+        for _ in range(3):
+            np.asarray(fn(jnp.asarray(img), jnp.int32(reps)))
+        return (_time.perf_counter() - t0) / (3 * reps)
+
+    timed = {
+        s: real_measure(plan, shape, 1, "pallas", schedule=s)
+        for s in ("deep", "pack")
+    }
+    got = autotune.best_full_config(plan, shape, 1, measure=real_measure)
+    slower = max(timed, key=timed.get)
+    assert got[1] != slower, (
+        f"autotune gated on {got[1]} but it measured slower: {timed}"
+    )

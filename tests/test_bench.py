@@ -522,3 +522,56 @@ def test_bench_multichip_sentry_gates(tmp_path):
     slow["per_rep_s"] *= 2
     verdict = sentry.check(slow, history=history)
     assert verdict["status"] == "regression"
+
+
+def test_bench_per_schedule_capture_mode(tmp_path):
+    # TPU_STENCIL_BENCH_SCHEDULE=s1,s2: one versioned headline capture
+    # PER named schedule, metric suffixed with the schedule (own sentry
+    # series each), carrying the (schedule, block_h, fuse) that ran —
+    # the burst shape that re-captures the pad baseline alongside the
+    # deep-blocked number without false regressions.
+    proc = _run_bench(tmp_path, inject_failure=False, extra_env={
+        "TPU_STENCIL_BENCH_SCHEDULE": "pack,deep",
+        "TPU_STENCIL_BENCH_SENTRY": "off",
+    })
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [json.loads(l) for l in proc.stdout.splitlines() if l.strip()]
+    by_metric = {l["metric"]: l for l in lines}
+    assert len(by_metric) == 2
+    pack = next(l for m, l in by_metric.items() if "_sched-pack_" in m)
+    deep = next(l for m, l in by_metric.items() if "_sched-deep_" in m)
+    for line in (pack, deep):
+        assert line["value"] > 0 and line["unit"] == "s"
+        assert line["backend"] == "pallas"
+        assert line["schema_version"] == 1
+    assert pack["pallas_schedule"] == "pack"
+    assert deep["pallas_schedule"] == "deep"
+    # 64x48 fits VMEM: deep runs the resident kernel, no static geometry
+    assert deep["pallas_block_h"] is None and deep["pallas_fuse"] is None
+    assert pack["pallas_block_h"] is not None
+
+
+def test_bench_per_schedule_mode_gates_each_series(tmp_path):
+    # Each per-schedule line is its own sentry series: a history primed
+    # with fast deep runs must gate a slow deep capture (rc 3) even when
+    # the sibling schedule's series is clean.
+    hist = str(tmp_path / "hist.jsonl")
+    env = {
+        "TPU_STENCIL_BENCH_SCHEDULE": "deep",
+        "TPU_STENCIL_PERF_HISTORY": hist,
+    }
+    # two clean runs build the baseline
+    for _ in range(2):
+        proc = _run_bench(tmp_path, inject_failure=False, extra_env=env)
+        assert proc.returncode == 0, proc.stderr[-2000:]
+    # a 100x slower synthetic capture against the same series must gate
+    from tpu_stencil.obs import sentry
+
+    line = json.loads(
+        [l for l in proc.stdout.splitlines() if l.strip()][-1]
+    )
+    rec = sentry.record_from_capture(
+        dict(line, value=line["value"] * 100), source="bench"
+    )
+    verdict = sentry.check(rec, path=hist)
+    assert verdict["status"] == "regression"

@@ -429,9 +429,10 @@ def test_geometry_flags_frames_end_to_end(tmp_path, rng):
 
 
 def test_geometry_report_is_effective_not_requested(tmp_path, rng):
-    # --time must report the geometry that LAUNCHED: block rounded to the
-    # sublane multiple, fuse clamped to block/(2*halo) — never the raw
-    # requested values (report-what-ran, like the schedule field).
+    # --time must report the geometry that LAUNCHED: fuse clamped to
+    # block/(2*halo) — never the raw requested values (report-what-ran,
+    # like the schedule field). Non-multiple-of-8 blocks no longer round
+    # silently: they are rejected jax-free at config validation.
     # Subprocess for a 1-device env (see test_geometry_flags_cli_end_to_end).
     import subprocess, sys
     img = rng.integers(0, 256, size=(40, 16, 3), dtype=np.uint8)
@@ -441,13 +442,37 @@ def test_geometry_report_is_effective_not_requested(tmp_path, rng):
     env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
     r = subprocess.run(
         [sys.executable, "-m", "tpu_stencil", src, "16", "40", "2", "rgb",
-         "--backend", "pallas", "--platform", "cpu", "--block-h", "20",
+         "--backend", "pallas", "--platform", "cpu", "--block-h", "24",
          "--fuse", "64", "--time", "--output", out],
         capture_output=True, text=True, timeout=300, env=env,
     )
     assert r.returncode == 0, r.stderr
-    # 20 rounds to 24; fuse clamps to 24 // (2*1) = 12
+    # fuse clamps to 24 // (2*1) = 12
     assert "block_h=24 fuse=12" in r.stdout, r.stdout
+
+
+def test_block_h_rejected_jax_free_with_actionable_message():
+    # Satellite: 0 / negative / non-multiple-of-8 --block-h must fail at
+    # config validation (before any jax import) with a message that names
+    # the constraint and the nearest valid value — not surface later as a
+    # geometry error inside the traced kernel build.
+    for bad, nearest in ((0, 8), (-8, 8), (20, 24), (7, 8)):
+        with pytest.raises(ValueError) as ei:
+            JobConfig("x", 5, 5, 1, ImageType.GREY, block_h=bad)
+        assert "multiple of 8" in str(ei.value)
+        if bad > 0:
+            assert str(nearest) in str(ei.value)
+    with pytest.raises(ValueError) as ei:
+        JobConfig("x", 5, 5, 1, ImageType.GREY, fuse=0)
+    assert "fuse" in str(ei.value)
+    # StreamConfig shares the same validation vocabulary
+    from tpu_stencil.config import StreamConfig
+
+    with pytest.raises(ValueError):
+        StreamConfig("x", 5, 5, 1, ImageType.GREY, block_h=12)
+    # valid multiples pass through untouched
+    cfg = JobConfig("x", 5, 5, 1, ImageType.GREY, block_h=64, fuse=16)
+    assert (cfg.block_h, cfg.fuse) == (64, 16)
 
 
 def test_geometry_reported_effective_on_sharded_mesh(tmp_path, rng, capsys):

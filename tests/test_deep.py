@@ -1,0 +1,273 @@
+"""Deep in-VMEM temporal blocking: the resident kernel (whole image in
+VMEM across the traced rep loop) and the trapezoid stripe variant, held
+bit-exact against the golden model across the full fuzz grid — grey/RGB
+x zero/periodic x separable/direct plans x depths, including the
+degenerate tiles the sharded path feeds the valid-ghost kernel."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from tpu_stencil import filters
+from tpu_stencil.models.blur import IteratedConv2D
+from tpu_stencil.ops import lowering, pallas_stencil, stencil
+
+
+def _golden(img, name, reps, boundary="zero"):
+    return stencil.reference_stencil_numpy(
+        img, filters.get_filter(name), reps, boundary=boundary
+    )
+
+
+# -- bit-exactness fuzz grid --------------------------------------------
+
+
+@pytest.mark.parametrize("name", ["gaussian", "edge", "gaussian5"])
+@pytest.mark.parametrize("channels", [1, 3])
+@pytest.mark.parametrize("reps", [0, 1, 3, 7])
+def test_deep_resident_matches_golden(rng, name, channels, reps):
+    # Small images fit the VMEM budget: the resident kernel runs the
+    # whole rep loop in one launch (sep_int and direct_int plans both).
+    plan = lowering.plan_filter(filters.get_filter(name))
+    shape = (37, 23) if channels == 1 else (40, 16, 3)
+    img = rng.integers(0, 256, size=shape, dtype=np.uint8)
+    wcp = pallas_stencil.padded_lanes(
+        plan, shape[1] * channels, channels
+    )
+    assert pallas_stencil.resident_feasible(plan, shape[0], wcp)
+    got = np.asarray(pallas_stencil.iterate(
+        jnp.asarray(img), jnp.int32(reps), plan, interpret=True,
+        schedule="deep",
+    ))
+    np.testing.assert_array_equal(got, _golden(img, name, reps))
+
+
+@pytest.mark.parametrize("name", ["gaussian", "edge"])
+@pytest.mark.parametrize("channels", [1, 3])
+@pytest.mark.parametrize("reps", [1, 5, 11])
+def test_deep_trapezoid_matches_golden(rng, monkeypatch, name, channels,
+                                       reps):
+    # A narrowed VMEM budget forces the trapezoid path (resident
+    # infeasible): the grid kernel at the feasibility-chosen depth, with
+    # `reps % depth` remainder single-rep launches.
+    monkeypatch.setenv("TPU_STENCIL_VMEM_BYTES", "20000")
+    plan = lowering.plan_filter(filters.get_filter(name))
+    shape = (64, 24) if channels == 1 else (64, 16, 3)
+    img = rng.integers(0, 256, size=shape, dtype=np.uint8)
+    wcp = pallas_stencil.padded_lanes(
+        plan, shape[1] * channels, channels
+    )
+    assert not pallas_stencil.resident_feasible(plan, shape[0], wcp)
+    got = np.asarray(pallas_stencil.iterate(
+        jnp.asarray(img), jnp.int32(reps), plan, interpret=True,
+        schedule="deep",
+    ))
+    np.testing.assert_array_equal(got, _golden(img, name, reps))
+
+
+def test_deep_forced_geometry_matches_golden(rng):
+    # Explicit --block-h/--fuse on a deep run: the trapezoid launches the
+    # forced geometry (clamped), bit-exact.
+    plan = lowering.plan_filter(filters.get_filter("gaussian"))
+    img = rng.integers(0, 256, size=(80, 24), dtype=np.uint8)
+    got = np.asarray(pallas_stencil.iterate(
+        jnp.asarray(img), jnp.int32(6), plan, interpret=True,
+        schedule="deep", block_h=16, fuse=4,
+    ))
+    np.testing.assert_array_equal(got, _golden(img, "gaussian", 6))
+
+
+@pytest.mark.parametrize("reps", [0, 2, 5])
+def test_deep_frames_matches_per_frame_golden(rng, reps):
+    # Batch mode: the fused tall-image layout under deep — frames must
+    # never mix (the inter-frame gap re-zero holds inside the resident
+    # fori_loop body too).
+    plan = lowering.plan_filter(filters.get_filter("gaussian"))
+    frames = rng.integers(0, 256, size=(3, 24, 16, 3), dtype=np.uint8)
+    got = np.asarray(pallas_stencil.iterate_frames(
+        jnp.asarray(frames), jnp.int32(reps), plan, interpret=True,
+        schedule="deep",
+    ))
+    for k in range(frames.shape[0]):
+        np.testing.assert_array_equal(
+            got[k], _golden(frames[k], "gaussian", reps), err_msg=f"frame {k}"
+        )
+
+
+def test_deep_periodic_boundary_runs_xla_and_matches(rng):
+    # The Pallas kernels are zero-boundary only: a periodic deep request
+    # must resolve (and report) the XLA schedule, bit-exact vs golden.
+    model = IteratedConv2D("gaussian", backend="pallas", schedule="deep",
+                           boundary="periodic")
+    assert model.resolved_config((24, 16), 1) == ("xla", None)
+    img = rng.integers(0, 256, size=(24, 16), dtype=np.uint8)
+    out = np.asarray(model(img, 3))
+    np.testing.assert_array_equal(
+        out, _golden(img, "gaussian", 3, boundary="periodic")
+    )
+
+
+def test_deep_sharded_degenerate_tiles_match_golden(rng):
+    # The sharded path under a deep verdict: tiny per-device tiles (the
+    # degenerate case the valid-ghost kernel must survive) — deep maps to
+    # its inner body with a deepened exchange chunk, bit-exact.
+    from tpu_stencil.parallel.sharded import ShardedRunner
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 virtual devices")
+    model = IteratedConv2D("gaussian", backend="pallas", schedule="deep")
+    runner = ShardedRunner(model, (16, 16), 1, mesh_shape=(2, 2),
+                           devices=jax.devices()[:4])
+    assert runner.backend == "pallas"
+    # the valid-ghost kernel has no resident form: deep degrades to its
+    # inner body and the REPORTED schedule is the one that launches
+    assert runner.schedule in ("pack", "shrink")
+    img = rng.integers(0, 256, size=(16, 16), dtype=np.uint8)
+    out = runner.fetch(runner.run(runner.put(img), 3))
+    np.testing.assert_array_equal(out, _golden(img, "gaussian", 3))
+
+
+def test_deep_sharded_rgb_matches_golden(rng):
+    from tpu_stencil.parallel.sharded import ShardedRunner
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 virtual devices")
+    model = IteratedConv2D("gaussian", backend="pallas", schedule="deep")
+    runner = ShardedRunner(model, (32, 24), 3, mesh_shape=(2, 2),
+                           devices=jax.devices()[:4])
+    img = rng.integers(0, 256, size=(32, 24, 3), dtype=np.uint8)
+    out = runner.fetch(runner.run(runner.put(img), 4))
+    np.testing.assert_array_equal(out, _golden(img, "gaussian", 4))
+
+
+# -- schedule resolution / geometry semantics ---------------------------
+
+
+def test_deep_never_degrades_at_effective_schedule():
+    plan = lowering.plan_filter(filters.get_filter("gaussian"))
+    assert pallas_stencil.effective_schedule_for(plan, 64, "deep") == "deep"
+    assert pallas_stencil.effective_schedule_for(
+        plan, 5000, "deep", block_h=256
+    ) == "deep"
+    # the kernel-level resolution maps deep to its inner body
+    assert pallas_stencil._kernel_schedule("deep", plan, 128) == "pack"
+    g7 = lowering.plan_filter(filters.get_filter("gaussian7"))
+    assert pallas_stencil._kernel_schedule("deep", g7, 128) == "shrink"
+
+
+def test_deep_fuse_for_caps_and_prunes():
+    plan = lowering.plan_filter(filters.get_filter("gaussian"))
+    # ghost-overhead cap: depth <= block_h / (4*halo)
+    assert pallas_stencil.deep_fuse_for(plan, 128) == 32
+    assert pallas_stencil.deep_fuse_for(plan, 32) == 8
+    # VMEM prune: a wide image shrinks the feasible depth at tall blocks
+    wcp_wide = pallas_stencil.padded_lanes(plan, 1920 * 3, 3)
+    assert pallas_stencil.deep_fuse_for(plan, 128, wcp_wide) == 32
+    assert pallas_stencil.deep_fuse_for(plan, 256, wcp_wide) < 32
+    # halo-5 plans (gaussian' wider cousins) cap harder
+    g5 = lowering.plan_filter(filters.get_filter("gaussian5"))
+    assert pallas_stencil.deep_fuse_for(g5, 128) == 16
+
+
+def test_deep_effective_geometry_deepens_unforced_fuse():
+    plan = lowering.plan_filter(filters.get_filter("gaussian"))
+    # unforced fuse under deep = the feasibility depth, clamped as usual
+    assert pallas_stencil.effective_geometry(
+        plan, 1024, schedule="deep"
+    ) == (128, 32)
+    # a forced fuse always wins over the deep default
+    assert pallas_stencil.effective_geometry(
+        plan, 1024, fuse=4, schedule="deep"
+    ) == (128, 4)
+    # non-deep schedules keep DEFAULT_FUSE
+    assert pallas_stencil.effective_geometry(plan, 1024) == (
+        128, pallas_stencil.DEFAULT_FUSE
+    )
+
+
+def test_in_vmem_depth_resident_vs_trapezoid(monkeypatch):
+    plan = lowering.plan_filter(filters.get_filter("gaussian"))
+    # resident: depth = the full rep count
+    assert pallas_stencil.in_vmem_depth(
+        plan, 64, 48, 1, schedule="deep", reps=40
+    ) == 40
+    # trapezoid (north-star shape): the feasibility-model depth
+    assert pallas_stencil.in_vmem_depth(
+        plan, 2520, 1920, 3, schedule="deep", reps=40
+    ) == 32
+    # non-deep schedules: the effective fuse
+    assert pallas_stencil.in_vmem_depth(plan, 2520, 1920, 3) == (
+        pallas_stencil.DEFAULT_FUSE
+    )
+    # a narrowed budget demotes resident to trapezoid
+    monkeypatch.setenv("TPU_STENCIL_VMEM_BYTES", "20000")
+    assert pallas_stencil.in_vmem_depth(
+        plan, 64, 48, 1, schedule="deep", reps=40
+    ) < 40
+
+
+def test_deep_geometry_reporting():
+    plan = lowering.plan_filter(filters.get_filter("gaussian"))
+    # resident: no static geometry to attribute
+    assert pallas_stencil.deep_geometry(plan, 64, 48, 1) == (None, None)
+    # trapezoid: the effective (block, depth)
+    assert pallas_stencil.deep_geometry(plan, 2520, 1920, 3) == (128, 32)
+
+
+def test_vmem_tile_bytes_model_shape():
+    plan = lowering.plan_filter(filters.get_filter("gaussian"))
+    small = pallas_stencil.vmem_tile_bytes(plan, 128, 8, 2048, "pack")
+    deep = pallas_stencil.vmem_tile_bytes(plan, 128, 32, 2048, "pack")
+    assert deep > small  # deeper ghosts cost VMEM
+    # pack halves the working rows vs shrink
+    assert pallas_stencil.vmem_tile_bytes(
+        plan, 128, 8, 2048, "pack"
+    ) < pallas_stencil.vmem_tile_bytes(plan, 128, 8, 2048, "shrink")
+
+
+# -- driver / CLI integration -------------------------------------------
+
+
+def test_run_job_reports_deep_schedule(tmp_path, rng, monkeypatch):
+    # End-to-end through run_job on one device: schedule=deep reported,
+    # resident launch reports no static geometry, output bit-exact.
+    from tpu_stencil import driver
+    from tpu_stencil.config import ImageType, JobConfig
+    from tpu_stencil.io import raw as raw_io
+
+    img = rng.integers(0, 256, size=(40, 16, 3), dtype=np.uint8)
+    src = str(tmp_path / "img.raw")
+    img.tofile(src)
+    cfg = JobConfig(src, 16, 40, 4, ImageType.RGB, backend="pallas",
+                    schedule="deep", output=str(tmp_path / "o.raw"))
+    result = driver.run_job(cfg, devices=jax.devices()[:1])
+    assert result.backend == "pallas"
+    assert result.schedule == "deep"
+    assert result.block_h is None and result.fuse is None  # resident
+    got = raw_io.read_raw(str(tmp_path / "o.raw"), 16, 40, 3)
+    np.testing.assert_array_equal(got, _golden(img, "gaussian", 4))
+
+
+def test_run_job_reports_deep_trapezoid_geometry(tmp_path, rng, monkeypatch):
+    # With residency infeasible, the report carries the trapezoid's
+    # effective (block, depth) — report-what-ran.
+    from tpu_stencil import driver
+    from tpu_stencil.config import ImageType, JobConfig
+
+    monkeypatch.setenv("TPU_STENCIL_VMEM_BYTES", "20000")
+    img = rng.integers(0, 256, size=(64, 24), dtype=np.uint8)
+    src = str(tmp_path / "img.raw")
+    img.tofile(src)
+    cfg = JobConfig(src, 24, 64, 3, ImageType.GREY, backend="pallas",
+                    schedule="deep", output=str(tmp_path / "o.raw"))
+    result = driver.run_job(cfg, devices=jax.devices()[:1])
+    assert result.schedule == "deep"
+    assert result.block_h is not None and result.fuse is not None
+    plan = lowering.plan_filter(filters.get_filter("gaussian"))
+    wcp = pallas_stencil.padded_lanes(plan, 24, 1)
+    assert (result.block_h, result.fuse) == (
+        pallas_stencil.effective_geometry(plan, 64, schedule="deep",
+                                          wc=wcp)
+    )

@@ -138,7 +138,8 @@ def test_random_filters_cols_ilp_lowering(case, monkeypatch):
     shape = (h, w) if ch == 1 else (h, w, ch)
     img = rng.integers(0, 256, size=shape, dtype=np.uint8)
     want = stencil.reference_stencil_numpy(img, f, reps)
-    sched = ["pad", "shrink", "strips", "pack", "pack_strips"][case % 5]
+    sched = ["pad", "shrink", "strips", "pack", "pack_strips",
+             "deep"][case % 6]
     got = np.asarray(pallas_stencil.iterate(
         img, jnp.int32(reps), plan, block_h=32, fuse=2, interpret=True,
         schedule=sched,

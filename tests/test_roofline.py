@@ -1,0 +1,95 @@
+"""Roofline traffic-model edge cases: fuse=1, RGB channel scaling, and
+the deep-blocking in-VMEM depth term — pure model tests, no hardware."""
+
+import pytest
+
+from tpu_stencil.runtime import roofline
+
+
+def test_xla_backend_pays_hbm_every_rep():
+    # The XLA step reads + writes the frame every rep regardless of any
+    # pallas geometry hints.
+    assert roofline.analytic_bytes_per_rep(
+        1000, "xla", "gaussian", 64
+    ) == 2000.0
+    assert roofline.analytic_bytes_per_rep(
+        1000, "xla", "gaussian", 64, fuse=8, schedule="deep", reps=40,
+        w_img=64,
+    ) == 2000.0
+
+
+def test_fuse_one_equals_xla_traffic():
+    # fuse=1 on pallas: one HBM round-trip per rep — identical traffic
+    # to the XLA model (the degenerate fusion depth must not divide).
+    assert roofline.analytic_bytes_per_rep(
+        1000, "pallas", "gaussian", 64, fuse=1
+    ) == 2000.0
+
+
+def test_rgb_channel_scaling_is_linear():
+    # frame_bytes carries the channel factor; the model is linear in it
+    # and the divisor (the effective fuse) is channel-independent at a
+    # fixed height.
+    grey = roofline.analytic_bytes_per_rep(100 * 64, "pallas",
+                                           "gaussian", 64)
+    rgb = roofline.analytic_bytes_per_rep(100 * 64 * 3, "pallas",
+                                          "gaussian", 64)
+    assert rgb == pytest.approx(3 * grey)
+
+
+def test_effective_fuse_mirrors_kernel_clamp():
+    # 64-row image at halo 1: fuse clamps to 64 // (2*1) = 32.
+    assert roofline.effective_fuse("gaussian", 64, fuse=100) == 32
+    # halo-2 filter clamps twice as hard; halo-3 harder still
+    assert roofline.effective_fuse("gaussian5", 64, fuse=100) == 16
+    assert roofline.effective_fuse("gaussian7", 64, fuse=100) == 10
+
+
+def test_deep_depth_term_resident():
+    # Resident deep: bytes/rep divides by the FULL rep count — one load
+    # + one store for the whole loop.
+    frame = 64 * 48
+    b = roofline.analytic_bytes_per_rep(
+        frame, "pallas", "gaussian", 64, schedule="deep", w_img=48,
+        channels=1, reps=40,
+    )
+    assert b == pytest.approx(2.0 * frame / 40)
+
+
+def test_deep_depth_term_trapezoid_beats_default_4x():
+    # Acceptance: at the BENCH_r02 north-star shape the tuned deep model
+    # is >= 4x below the fuse=8 model.
+    frame = 1920 * 2520 * 3
+    base = roofline.analytic_bytes_per_rep(
+        frame, "pallas", "gaussian", 2520, fuse=8
+    )
+    deep = roofline.analytic_bytes_per_rep(
+        frame, "pallas", "gaussian", 2520, schedule="deep", w_img=1920,
+        channels=3, reps=40,
+    )
+    assert base / deep >= 4.0
+
+
+def test_deep_without_width_degrades_to_geometry_depth():
+    # No width -> the resident feasibility check cannot run; the model
+    # falls back to the schedule-aware effective geometry (never raises).
+    d = roofline.effective_fuse("gaussian", 2520, schedule="deep")
+    assert d >= 8
+
+
+def test_achieved_follows_depth():
+    frame = 1000
+    g_deep, pct_deep = roofline.achieved(
+        frame, 1e-6, "pallas", "gaussian", 64, schedule="deep", w_img=64,
+        channels=1, reps=50,
+    )
+    g_xla, pct_xla = roofline.achieved(frame, 1e-6, "xla", "gaussian", 64)
+    # same wall time, 50x less modeled traffic -> 50x lower achieved GB/s
+    assert g_xla == pytest.approx(50 * g_deep)
+    assert pct_xla == pytest.approx(100 * g_xla / roofline.V5E_HBM_GBPS)
+
+
+def test_achieved_frames_scales_with_batch():
+    g1, _ = roofline.achieved_frames(1000, 1, 1e-6, "xla", "gaussian", 64)
+    g4, _ = roofline.achieved_frames(1000, 4, 1e-6, "xla", "gaussian", 64)
+    assert g4 == pytest.approx(4 * g1)

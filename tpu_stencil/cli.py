@@ -133,6 +133,23 @@ def main(argv=None) -> int:
     return 0
 
 
+def _frames_per_device(cfg) -> int:
+    """The frames each device's fused tall-image kernel stacks — the
+    row count the deep-blocking depth model must reason about. Mirrors
+    ``run_job``'s single-host device selection (``--mesh`` RxC selects
+    R*C devices for batch sharding, else min(devices, frames))."""
+    if cfg.frames <= 1:
+        return 1
+    import jax
+
+    n_b = (
+        cfg.mesh_shape[0] * cfg.mesh_shape[1]
+        if cfg.mesh_shape is not None
+        else min(len(jax.devices()), cfg.frames)
+    )
+    return -(-cfg.frames // max(1, n_b))
+
+
 def _broadcast_obs_flags(ns):
     """Rank 0's observability argv wins pod-wide — the broadcast_config
     discipline, and here it is load-bearing for liveness, not just
@@ -182,6 +199,19 @@ def _report_observability(trace_path, breakdown, cfg, result) -> None:
         # fused Pallas kernel pays HBM every rep — dividing by the
         # full-run fuse here would under-report the traced run's
         # bandwidth by up to that factor.
+        # The chosen Pallas schedule and its steady-state in-VMEM depth
+        # (reps per HBM round-trip) are display-only: the measured GB/s
+        # above stays at fuse=1 because traced launches pay HBM per rep.
+        steady_depth = None
+        if result.backend == "pallas":
+            from tpu_stencil.runtime import roofline as _rl
+
+            steady_depth = _rl.effective_fuse(
+                cfg.filter_name, cfg.height, block_h=result.block_h,
+                fuse=result.fuse, schedule=result.schedule,
+                w_img=cfg.width, channels=cfg.channels,
+                reps=cfg.repetitions, n_frames=_frames_per_device(cfg),
+            )
         table = obs.breakdown.render_breakdown(tracer, roofline_info={
             "frame_bytes": cfg.height * cfg.width * cfg.channels * cfg.frames,
             "reps": cfg.repetitions,
@@ -190,6 +220,8 @@ def _report_observability(trace_path, breakdown, cfg, result) -> None:
             "h_img": cfg.height,
             "block_h": result.block_h,
             "fuse": 1,
+            "schedule": result.schedule,
+            "in_vmem_depth": steady_depth,
         })
         print(table, end="")
         if result.mesh_shape is not None and result.overlap is not None:
@@ -240,6 +272,9 @@ def _report_introspection(breakdown, cfg, result, hlo_dump) -> None:
             cfg.height * cfg.width * cfg.channels * cfg.frames,
             result.backend, cfg.filter_name, cfg.height,
             block_h=result.block_h, fuse=result.fuse,
+            schedule=result.schedule, w_img=cfg.width,
+            channels=cfg.channels, reps=cfg.repetitions,
+            n_frames=_frames_per_device(cfg),
         )
         for rec in recs:
             # Driver-path sites lower the same per-rep program the

@@ -18,9 +18,12 @@ from typing import Optional, Tuple
 
 
 # Canonical Pallas per-rep schedule names (see docs/KERNEL.md and
-# ops/pallas_stencil.py, which imports this tuple). Lives here so CLI
+# ops/pallas_stencil.py, which imports this tuple). "deep" is the
+# temporal-blocking schedule: whole-image VMEM residency when the image
+# fits (one HBM load + one store for the entire rep loop), else a
+# trapezoid stripe at a VMEM-feasibility-chosen depth. Lives here so CLI
 # parsing/validation stays jax-free.
-PALLAS_SCHEDULES = ("pad", "shrink", "strips", "pack", "pack_strips")
+PALLAS_SCHEDULES = ("pad", "shrink", "strips", "pack", "pack_strips", "deep")
 
 # Interior/border overlap schedule for the sharded path (see
 # tpu_stencil/parallel/overlap.py, which imports this tuple): "off"
@@ -56,10 +59,21 @@ def _validate_common(cfg) -> None:
         raise ValueError(
             f"unknown boundary {cfg.boundary!r}; expected zero|periodic"
         )
-    if cfg.block_h is not None and cfg.block_h < 1:
-        raise ValueError(f"block_h must be >= 1, got {cfg.block_h}")
+    if cfg.block_h is not None and (cfg.block_h < 8 or cfg.block_h % 8):
+        # Validated here, jax-free, so a bad --block-h fails at argument
+        # parsing with an actionable message instead of surfacing later
+        # as a geometry error inside the traced kernel build.
+        nearest = max(8, -(-cfg.block_h // 8) * 8)
+        raise ValueError(
+            f"block_h must be a positive multiple of 8 (Pallas DMA row "
+            f"windows are sublane-aligned), got {cfg.block_h}; nearest "
+            f"valid value is {nearest}"
+        )
     if cfg.fuse is not None and cfg.fuse < 1:
-        raise ValueError(f"fuse must be >= 1, got {cfg.fuse}")
+        raise ValueError(
+            f"fuse must be a positive rep count (reps per HBM "
+            f"round-trip), got {cfg.fuse}"
+        )
 
 
 class ImageType(enum.Enum):
@@ -382,18 +396,22 @@ def build_parser() -> argparse.ArgumentParser:
         "--schedule", default=None, choices=list(PALLAS_SCHEDULES),
         help="force the Pallas per-rep schedule (see docs/KERNEL.md); "
              "default: the autotuned winner (or the kernel default for an "
-             "explicit --backend pallas). Applies to --frames batch mode "
-             "too when the backend resolves to pallas (the fused tall-image "
-             "kernel); ignored by the XLA backend; schedules a plan cannot "
-             "run degrade to their fallback",
+             "explicit --backend pallas). 'deep' is in-VMEM temporal "
+             "blocking: whole-image VMEM residency when the image fits "
+             "(one HBM load + store per whole rep loop), else a trapezoid "
+             "stripe at a VMEM-feasibility-chosen depth. Applies to "
+             "--frames batch mode too when the backend resolves to pallas "
+             "(the fused tall-image kernel); ignored by the XLA backend; "
+             "schedules a plan cannot run degrade to their fallback",
     )
     p.add_argument(
         "--block-h", dest="block_h", type=int, default=None, metavar="ROWS",
-        help="force the Pallas kernel's rows-per-grid-program (rounded up "
-             "to a sublane multiple of 8, clamped to the image/tile; pack "
-             "needs a multiple of 16 or it degrades). Default: the "
-             "kernel's measured default, or the autotuned per-shape "
-             "verdict on the auto path",
+        help="force the Pallas kernel's rows-per-grid-program (must be a "
+             "positive multiple of 8 — DMA row windows are sublane-"
+             "aligned; clamped to the image/tile; pack needs a multiple "
+             "of 16 or it degrades). Default: the kernel's measured "
+             "default, or the autotuned per-shape verdict on the auto "
+             "path",
     )
     p.add_argument(
         "--fuse", type=int, default=None, metavar="REPS",

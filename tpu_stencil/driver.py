@@ -201,19 +201,28 @@ class JobResult:
     overlap: Optional[str] = None
 
 
-def _ran_geometry(model, backend: str, rows: int, shape, channels: int):
+def _ran_geometry(model, backend: str, rows: int, shape, channels: int,
+                  schedule=None):
     """The (block_h, fuse) to report for a ``rows``-tall Pallas launch:
     the effective geometry when the user forced either knob OR the
     autotuner picked a non-default one for ``shape``; (None, None) for a
     default-geometry launch — never the requested values verbatim (they
-    align/clamp, and must not be attributed to runs that ignored them)."""
+    align/clamp, and must not be attributed to runs that ignored them).
+    A ``'deep'`` launch always reports what temporal blocking ran: the
+    trapezoid's effective (block, depth), or (None, None) for the
+    resident kernel (no static geometry — the depth is the traced rep
+    count)."""
     if backend != "pallas":
         return None, None
     bh, fz = model.resolved_geometry(tuple(shape), channels)
-    if bh is None and fz is None:
-        return None, None
     from tpu_stencil.ops import pallas_stencil
 
+    if schedule == "deep":
+        return pallas_stencil.deep_geometry(
+            model.plan, rows, shape[1], channels, bh, fz
+        )
+    if bh is None and fz is None:
+        return None, None
     return pallas_stencil.effective_geometry(model.plan, rows, bh, fz)
 
 
@@ -412,7 +421,8 @@ def run_job(
         )
         geo_rows = cfg.height
     ran_bh, ran_fuse = _ran_geometry(
-        model, ran_backend, geo_rows, (cfg.height, cfg.width), cfg.channels
+        model, ran_backend, geo_rows, (cfg.height, cfg.width), cfg.channels,
+        schedule=ran_schedule,
     )
     return JobResult(
         output_path=cfg.output_path,
@@ -518,7 +528,8 @@ def _run_frames_multihost(cfg, model, profile_dir, checkpoint_every,
     from tpu_stencil.ops import pallas_stencil as _ps
 
     ran_bh, ran_fuse = _ran_geometry(
-        model, backend, _ps.frames_rows(model.plan, h, n_per), (h, w), ch
+        model, backend, _ps.frames_rows(model.plan, h, n_per), (h, w), ch,
+        schedule=schedule,
     )
     return JobResult(
         output_path=cfg.output_path,

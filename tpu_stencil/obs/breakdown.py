@@ -89,6 +89,19 @@ def render_breakdown(tracer: Tracer,
             f"{r['count']:>6}  {gbps_by_name.get(r['name'], ''):>15}"
         )
     lines.append(f"{'total':<{name_w}}  {total:>10.6f}  {'100.0%':>6}")
+    if roofline_info and roofline_info.get("schedule"):
+        # The chosen Pallas schedule next to the numbers it explains.
+        # Traced runs launch one rep per dispatch (HBM paid every rep),
+        # so the steady-state depth is a model statement, not what the
+        # measured GB/s above achieved.
+        depth = roofline_info.get("in_vmem_depth")
+        depth_s = (
+            f"  steady-state in-VMEM depth: {depth} reps/HBM round-trip"
+            f" (traced runs launch per-rep)" if depth else ""
+        )
+        lines.append(
+            f"pallas schedule: {roofline_info['schedule']}{depth_s}"
+        )
     return "\n".join(lines) + "\n"
 
 

@@ -88,9 +88,116 @@ except TypeError:
 #              'shrink'.
 #   'pack_strips' — 'pack' with each rep computed lane-strip by
 #              lane-strip (the 'strips' trick on packed values).
+#   'deep'   — in-VMEM temporal blocking (the software-systolic execution
+#              model's depth axis): when the whole lane-padded image fits
+#              the VMEM budget, a single resident kernel keeps it in VMEM
+#              across the ENTIRE traced rep loop (one HBM load + one
+#              store for k reps — bytes/rep divides by k, not by fuse);
+#              larger images run the trapezoid variant — the existing
+#              double-buffered DMA ring pipelines the next stripe's load
+#              under the current stripe's rep loop, while the stripe's
+#              ghost band is sized for a VMEM-feasibility-chosen depth
+#              (deep_fuse_for) far past DEFAULT_FUSE and the carry
+#              overlap-shrinks in VMEM instead of returning to HBM
+#              between fuse groups. The per-rep body inside either form
+#              is the best applicable inner schedule ('pack' when
+#              _pack_ok, else 'shrink').
 # The default is measured, not assumed: tools/kernel_lab.py times all
 # schedules on hardware. Env override for on-hardware A/B through the CLI.
 DEFAULT_SCHEDULE = os.environ.get("TPU_STENCIL_PALLAS_SCHEDULE", "pack")
+
+# Deep-schedule depth candidates, best (deepest) first; deep_fuse_for
+# walks down until the ghost-overhead cap and the VMEM footprint model
+# both admit one. Divisor-of-40 entries keep the reference's 40-rep jobs
+# free of `reps % fuse` remainder launches.
+DEEP_FUSE_CANDIDATES = (64, 48, 40, 32, 24, 16, 12, 8)
+
+
+def _vmem_budget() -> int:
+    """Per-core VMEM budget (bytes) the feasibility model prunes against
+    (v5e cores have ~16 MiB of VMEM). Read per call, not at import, so
+    tests and hardware A/Bs can narrow it via ``TPU_STENCIL_VMEM_BYTES``
+    without re-importing the kernel module."""
+    return int(os.environ.get("TPU_STENCIL_VMEM_BYTES", str(16 * 2 ** 20)))
+
+
+def padded_lanes(plan: StencilPlan, wc: int, channels: int) -> int:
+    """Lane-padded flat width of a (rows, w*channels) launch: >= halo*C
+    discardable ghost lanes plus rounding to the 128-lane register
+    width — the same formula ``_run_rep_loop`` pads with, exposed so
+    the VMEM feasibility and HBM traffic models reason about the true
+    in-VMEM row length."""
+    return -(-(wc + plan.halo * channels) // 128) * 128
+
+
+def vmem_tile_bytes(plan: StencilPlan, block_h: int, fuse: int, wc: int,
+                    schedule: str = "shrink") -> int:
+    """Modeled VMEM footprint of one fused-kernel grid program at this
+    geometry: the double-buffered uint8 DMA ring plus ~3 live int32
+    intermediates of the rep body (packed schedules halve the working
+    rows). The autotuner and ``deep_fuse_for`` prune candidates whose
+    model exceeds :func:`_vmem_budget` — a deliberately conservative
+    estimate, so a candidate the model admits may still fail to compile
+    (the tuner survives that per candidate) but pruned ones never waste
+    a measurement."""
+    halo_al = -(-(fuse * plan.halo) // 8) * 8
+    tile_rows = block_h + 2 * halo_al
+    total = 2 * tile_rows * wc  # double-buffered uint8 scratch ring
+    rows = (
+        tile_rows // 2 + halo_al if schedule.startswith("pack")
+        else tile_rows
+    )
+    total += 3 * rows * wc * 4  # ~3 live int32 intermediates per rep
+    return total
+
+
+def resident_feasible(plan: StencilPlan, n_rows: int, wc: int) -> bool:
+    """Whether the whole lane-padded image fits the resident deep
+    kernel's VMEM working set: uint8 in + out blocks plus ~4 live int32
+    intermediates of the fixed-shape rep body (padded carry, rows acc,
+    rolled term, masked result)."""
+    if not _supported(plan):
+        return False
+    hp = -(-n_rows // 8) * 8
+    return hp * wc * (2 + 4 * 4) <= _vmem_budget()
+
+
+def _deep_inner(plan: StencilPlan, block_h: int) -> str:
+    """The per-rep body the deep trapezoid runs: the measured-best
+    schedule that applies at this plan/block ('pack' when its 16-bit
+    SWAR bounds hold, else 'shrink')."""
+    return "pack" if _pack_ok(plan, block_h) else "shrink"
+
+
+def deep_fuse_for(plan: StencilPlan, block_h: int,
+                  wc: Optional[int] = None) -> int:
+    """The trapezoid depth (reps per HBM round-trip) the 'deep' schedule
+    runs at ``block_h``: the deepest :data:`DEEP_FUSE_CANDIDATES` entry
+    whose ghost recompute stays <= 50% of the block
+    (``2*depth*halo <= block_h/2``) and whose modeled VMEM footprint
+    fits the budget (``wc`` = lane-padded flat width; None skips the
+    VMEM check — callers without a width get the overhead-capped
+    depth)."""
+    if not plan.halo:
+        return DEEP_FUSE_CANDIDATES[0]
+    cap = max(1, block_h // (4 * plan.halo))
+
+    def fits(cand: int) -> bool:
+        return wc is None or vmem_tile_bytes(
+            plan, block_h, cand, wc, _deep_inner(plan, block_h)
+        ) <= _vmem_budget()
+
+    for cand in DEEP_FUSE_CANDIDATES:
+        if cand <= cap and fits(cand):
+            return cand
+    # Every deep candidate was pruned: walk the shallow depths down —
+    # the fallback must satisfy the same feasibility model it fell out
+    # of, or the tuner would measure a config the model calls
+    # infeasible. fuse=1 has the smallest footprint the geometry allows.
+    for cand in (min(DEFAULT_FUSE, cap), 4, 2, 1):
+        if cand <= cap and fits(cand):
+            return cand
+    return 1
 
 
 def _check_schedule(schedule: Optional[str]) -> str:
@@ -118,16 +225,23 @@ def effective_block_h(n_rows: int, block_h: Optional[int] = None) -> int:
 
 def effective_geometry(plan: StencilPlan, n_rows: int,
                        block_h: Optional[int] = None,
-                       fuse: Optional[int] = None) -> Tuple[int, int]:
+                       fuse: Optional[int] = None,
+                       schedule: Optional[str] = None,
+                       wc: Optional[int] = None) -> Tuple[int, int]:
     """The (block_h, fuse) :func:`iterate` actually launches for an
     ``n_rows``-tall image: the aligned/clamped block, and fuse clamped to
     ``block_h / (2*halo)`` so the ghost bands stay a bounded fraction of
-    the block (halo-0 plans are unclamped). ``None`` = module defaults.
-    Single source of truth for the rep-loop clamp AND for reporting
-    layers — a run must never be attributed to a geometry that did not
-    launch."""
+    the block (halo-0 plans are unclamped). ``None`` = module defaults —
+    except under ``schedule='deep'``, where an unforced fuse defaults to
+    the trapezoid depth :func:`deep_fuse_for` picks (``wc`` = lane-padded
+    flat width for its VMEM feasibility check). Single source of truth
+    for the rep-loop clamp AND for reporting layers — a run must never
+    be attributed to a geometry that did not launch."""
     bh = effective_block_h(n_rows, block_h)
-    fz = DEFAULT_FUSE if fuse is None else fuse  # 0 stays a loud error
+    if fuse is None and schedule == "deep":
+        fz = deep_fuse_for(plan, bh, wc)
+    else:
+        fz = DEFAULT_FUSE if fuse is None else fuse  # 0 stays a loud error
     if plan.halo:
         fz = max(1, min(fz, bh // (2 * plan.halo)))
     return bh, fz
@@ -161,6 +275,45 @@ def effective_schedule_for(plan: StencilPlan, n_rows: int,
     )
 
 
+def deep_geometry(plan: StencilPlan, n_rows: int, w: int, channels: int,
+                  block_h: Optional[int] = None,
+                  fuse: Optional[int] = None
+                  ) -> Tuple[Optional[int], Optional[int]]:
+    """The (block_h, fuse) a single-device 'deep' launch reports:
+    (None, None) when the resident kernel runs — the whole image stays
+    in VMEM across the traced rep loop, so there is no static geometry
+    to attribute — else the trapezoid's effective (block, depth). A
+    forced block_h/fuse forces the trapezoid (mirrors
+    ``_run_rep_loop``'s dispatch)."""
+    wcp = padded_lanes(plan, w * channels, channels)
+    if (block_h is None and fuse is None
+            and resident_feasible(plan, n_rows, wcp)):
+        return None, None
+    return effective_geometry(plan, n_rows, block_h, fuse,
+                              schedule="deep", wc=wcp)
+
+
+def in_vmem_depth(plan: StencilPlan, h_img: int, w_img: int, channels: int,
+                  schedule: Optional[str] = None,
+                  block_h: Optional[int] = None, fuse: Optional[int] = None,
+                  reps: Optional[int] = None) -> int:
+    """Reps per HBM round-trip a Pallas launch achieves — the divisor of
+    the deep-blocking HBM traffic model
+    (:func:`tpu_stencil.runtime.roofline.analytic_bytes_per_rep`). For
+    the resident deep kernel this is the full rep count (one load + one
+    store for the whole loop); for the trapezoid and every fused
+    schedule it is the effective fuse depth."""
+    if not plan_supported(plan, channels):
+        return 1
+    sched = _check_schedule(schedule)
+    wcp = padded_lanes(plan, w_img * channels, channels)
+    if (sched == "deep" and block_h is None and fuse is None
+            and resident_feasible(plan, h_img, wcp)):
+        return max(1, int(reps)) if reps else 1
+    return effective_geometry(plan, h_img, block_h, fuse,
+                              schedule=sched, wc=wcp)[1]
+
+
 def _pack_ok(plan: StencilPlan, block_h: int) -> bool:
     """'pack' preconditions: separable nonneg dyadic plan whose per-rep
     intermediates all fit 16 bits (255 * 2^shift < 2^16 <=> shift <= 8,
@@ -181,6 +334,17 @@ def _effective_schedule(schedule: Optional[str], plan: StencilPlan,
     if schedule.startswith("pack") and not _pack_ok(plan, block_h):
         return "strips" if schedule == "pack_strips" else "shrink"
     return schedule
+
+
+def _kernel_schedule(schedule: Optional[str], plan: StencilPlan,
+                     block_h: int) -> str:
+    """The per-rep body a grid-of-row-blocks kernel actually compiles:
+    the effective schedule, with 'deep' mapped to its inner body — deep
+    is a driver-level schedule (residency / trapezoid depth selection);
+    inside a block program its rep loop IS the best applicable inner
+    schedule at this block height."""
+    s = _effective_schedule(schedule, plan, block_h)
+    return _deep_inner(plan, block_h) if s == "deep" else s
 
 
 _check_schedule(DEFAULT_SCHEDULE)  # env override validated at import
@@ -885,7 +1049,7 @@ def valid_fused(ext_u8: jax.Array, plan: StencilPlan, fuse: int,
         _valid_kernel, plan=plan, block_h=bh, grid=grid, halo_al=halo_al,
         fuse=fuse, ghost=g, wc=wl, rows_glob=global_shape[0],
         cols_glob_c=global_shape[1], channels=channels,
-        schedule=_effective_schedule(schedule, plan, bh),
+        schedule=_kernel_schedule(schedule, plan, bh),
     )
     out = pl.pallas_call(
         kernel,
@@ -912,6 +1076,68 @@ def valid_fused(ext_u8: jax.Array, plan: StencilPlan, fuse: int,
     return out[:th, g * channels : g * channels + twc]
 
 
+def _resident_kernel(scal_ref, in_ref, out_ref, *, plan: StencilPlan,
+                     n_rows_real: int, wc: int, wc_real: int,
+                     channels: int, frame=None):
+    """The resident deep-blocking program (grid of ONE): the whole
+    lane-padded image arrives as a single VMEM block, a
+    ``jax.lax.fori_loop`` over the *traced* rep count (SMEM scalar)
+    applies the fixed-shape rep body in VMEM, and one uint8 store ends
+    the launch — the first load and the final store are the only HBM
+    traffic for the entire rep loop (bytes/rep = 2*frame/reps).
+
+    The rep body is the 'pad' schedule's fixed-shape form (shapes must
+    be loop-invariant for ``fori_loop``): re-pad the carry by ``halo``
+    rows, run the separable/direct passes, and one hoisted-mask select
+    re-establishes the zero boundary — pad lanes and out-of-extent rows
+    (including inter-frame gap rows in batch mode) back to zero every
+    rep, exactly the semantics the grid kernels enforce."""
+    h = plan.halo
+    rows = out_ref.shape[0]
+    reps = scal_ref[0, 0]
+    rid = jax.lax.broadcasted_iota(jnp.int32, (rows, wc), 0)
+    keep = _row_keep(rid, n_rows_real, frame)
+    if wc_real != wc:
+        cid = jax.lax.broadcasted_iota(jnp.int32, (rows, wc), 1)
+        keep = jnp.logical_and(keep, cid < wc_real)
+
+    def body(_, cur):
+        padded = jnp.pad(cur, ((h, h), (0, 0)))
+        val = _rep_val(padded, plan=plan, dt=jnp.int32, wc=wc,
+                       channels=channels)
+        return jnp.where(keep, val, 0)
+
+    # Masking the initial carry is a no-op on real pixels (the caller's
+    # pad rows/lanes are already zero) but keeps the loop invariant —
+    # every iteration starts from a boundary-clean value.
+    cur0 = jnp.where(keep, in_ref[:].astype(jnp.int32), 0)
+    out = jax.lax.fori_loop(0, reps, body, cur0)
+    out_ref[:] = out.astype(jnp.uint8)
+
+
+def _build_resident_call(plan: StencilPlan, hp: int, h_real: int, wc: int,
+                         wc_real: int, channels: int, interpret: bool,
+                         frame=None, vma=None):
+    kernel = functools.partial(
+        _resident_kernel, plan=plan, n_rows_real=h_real, wc=wc,
+        wc_real=wc_real, channels=channels, frame=frame,
+    )
+    return pl.pallas_call(
+        kernel,
+        grid=(1,),
+        out_shape=jax.ShapeDtypeStruct(
+            (hp, wc), jnp.uint8,
+            **({"vma": frozenset(vma)} if vma and _VMA_SUPPORTED else {}),
+        ),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((hp, wc), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((hp, wc), lambda i: (0, 0)),
+        interpret=interpret,
+    )
+
+
 def _build_call(plan: StencilPlan, hp: int, h_real: int, wc: int,
                 wc_real: int, channels: int, block_h: int, fuse: int,
                 interpret: bool, schedule: str = None, frame=None,
@@ -921,8 +1147,8 @@ def _build_call(plan: StencilPlan, hp: int, h_real: int, wc: int,
     kernel = functools.partial(
         _sep_kernel, plan=plan, block_h=block_h, grid=grid, halo_al=halo_al,
         fuse=fuse, n_rows_real=h_real, wc=wc, wc_real=wc_real,
-        channels=channels, schedule=_effective_schedule(schedule, plan,
-                                                        block_h),
+        channels=channels, schedule=_kernel_schedule(schedule, plan,
+                                                     block_h),
         frame=frame,
     )
     return pl.pallas_call(
@@ -966,11 +1192,37 @@ def _run_rep_loop(x2, repetitions, plan: StencilPlan, rows: int,
     ``fuse`` may be None (module defaults); the clamp lives in
     :func:`effective_geometry` (fuse capped so the ghost bands stay a
     small fraction of the block and the tile fits VMEM; halo-0 filters
-    have no ghost bands, any fuse depth is free)."""
-    bh, fuse = effective_geometry(plan, rows, block_h, fuse)
-    hp = -(-rows // bh) * bh
+    have no ghost bands, any fuse depth is free).
+
+    ``schedule='deep'`` dispatches the temporal-blocking forms: the
+    resident kernel when the lane-padded image fits the VMEM budget (one
+    launch covers the whole traced rep loop — no outer fori_loop, no
+    remainder launches), else the trapezoid — the regular grid kernel
+    whose fuse depth :func:`effective_geometry` deepens to the
+    feasibility-model verdict, with the existing double-buffered DMA
+    ring pipelining the next stripe's load under the current stripe's
+    rep loop."""
     # Lane-aligned width with >= halo*C ghost lanes (pad doubles as ghosts).
-    wcp = -(-(wc + plan.halo * channels) // 128) * 128
+    wcp = padded_lanes(plan, wc, channels)
+    sched = _check_schedule(schedule)
+    # Forced geometry wins over residency: a user (or A/B) pinning
+    # --block-h/--fuse asked for THAT launch shape — the trapezoid runs
+    # it, never a silently-identical resident kernel (which has no
+    # static geometry and would make forced-depth A/Bs compare nothing).
+    if (sched == "deep" and block_h is None and fuse is None
+            and resident_feasible(plan, rows, wcp)):
+        hp = -(-rows // 8) * 8
+        if hp != rows or wcp != wc:
+            x2 = jnp.pad(x2, ((0, hp - rows), (0, wcp - wc)))
+        scal = jnp.asarray(repetitions, jnp.int32).reshape(1, 1)
+        out = _build_resident_call(
+            plan, hp, rows_real, wcp, wc, channels, interpret,
+            frame=frame, vma=vma,
+        )(scal, x2)
+        return out[:rows, :wc]
+    bh, fuse = effective_geometry(plan, rows, block_h, fuse,
+                                  schedule=sched, wc=wcp)
+    hp = -(-rows // bh) * bh
     if hp != rows or wcp != wc:
         x2 = jnp.pad(x2, ((0, hp - rows), (0, wcp - wc)))
     fused = _build_call(plan, hp, rows_real, wcp, wc, channels, bh, fuse,

@@ -434,6 +434,28 @@ class ShardedRunner:
                 # lowering.
                 self.backend = "xla"
             else:
+                if pallas_stencil.effective_schedule_for(
+                        model.plan, tile[0], self.schedule,
+                        block_h=geo_bh) == "deep":
+                    # 'deep' on the sharded path deepens the halo-exchange
+                    # chunk: one widened exchange covers the whole
+                    # trapezoid depth (fewer collectives per rep), and the
+                    # per-device kernel runs deep's inner body — the
+                    # valid-ghost kernel has no resident form, so the
+                    # reported schedule is the inner one that launches.
+                    bh_tile = pallas_stencil.effective_block_h(
+                        tile[0], geo_bh
+                    )
+                    if geo_fz is None:
+                        geo_fz = pallas_stencil.deep_fuse_for(
+                            model.plan, bh_tile,
+                            pallas_stencil.padded_lanes(
+                                model.plan, tile[1] * channels, channels
+                            ),
+                        )
+                    self.schedule = pallas_stencil._deep_inner(
+                        model.plan, bh_tile
+                    )
                 # ppermute delivers at most one neighbor tile of ghost
                 # data per hop, so the fused-chunk depth is capped by the
                 # tile; the mask path needs per-rep pad re-zeroing, which

@@ -1,15 +1,27 @@
-"""Backend autotuner: measure XLA vs Pallas once per (platform, filter,
-shape) and cache the winner on disk.
+"""Backend/schedule/geometry autotuner: a measured grid search over
+``(backend, schedule, block_h, fuse)`` once per (platform, filter,
+shape), pruned by a VMEM-footprint feasibility model and cached on disk.
 
 The reference picks its schedule at compile time by editing source
-(``mpi/mpi_convolution.c:98-101``) or by choosing which binary to run; here
-the schedule space is {XLA lowering, Pallas fused kernel} and the best
-choice genuinely depends on shape (e.g. XLA's schedule degrades above a
-size threshold on v5e while the Pallas kernel's does not). ``--backend
-autotune`` measures both ONCE, persists the verdict in a small JSON cache
+(``mpi/mpi_convolution.c:98-101``) or by choosing which binary to run;
+here the schedule space is {XLA lowering} x {Pallas per-rep schedules,
+incl. the 'deep' temporal-blocking form} x a geometry grid, and the
+best point genuinely depends on shape (e.g. XLA's schedule degrades
+above a size threshold on v5e while the Pallas kernel's does not, and
+the feasible deep depth depends on the image width). ``--backend
+autotune`` (and the default ``auto``) measures the grid ONCE, persists
+the verdict in a versioned JSON cache
 (``~/.cache/tpu_stencil/autotune.json``, override with
-``TPU_STENCIL_AUTOTUNE_CACHE``), and every later run with the same key pays
-nothing.
+``TPU_STENCIL_AUTOTUNE_CACHE``), and every later run with the same key
+pays nothing — a warm cache performs ZERO probes.
+
+Cache hygiene: the file carries a top-level ``schema_version``; entries
+are keyed with ``jax.__version__`` embedded, and keys whose embedded
+version no longer matches the running stack are evicted at load (a
+runtime upgrade can flip which point wins, and stale-version keys must
+not accumulate forever). Files written by the pre-versioned format (a
+flat key->entry object) migrate transparently: their entries are read,
+re-filtered, and the next store rewrites the versioned shape.
 
 Measurements use the same steady-state two-point differencing as bench.py
 (dispatch/fence overhead cancels), with a fresh device_put per call because
@@ -28,6 +40,11 @@ import numpy as np
 from tpu_stencil.ops.lowering import StencilPlan
 
 _CANDIDATES = ("xla", "pallas")
+
+# Cache file schema: {"schema_version": 2, "jax_version": ..., "entries":
+# {key: verdict}}. Version 1 was the bare entries object (no wrapper);
+# _load_cache migrates it in place.
+SCHEMA_VERSION = 2
 
 
 def _cache_path() -> str:
@@ -57,21 +74,54 @@ def _key(plan: StencilPlan, shape: Tuple[int, int], channels: int) -> str:
     return key
 
 
+def _entry_jax_version(key: str) -> Optional[str]:
+    """The jax version embedded in a cache key (``_key`` puts it second;
+    overlap keys prepend an extra segment). None for unparseable keys —
+    those are garbage and get evicted."""
+    parts = key.split("|")
+    idx = 2 if parts and parts[0] == "overlap" else 1
+    return parts[idx] if len(parts) > idx else None
+
+
 def _load_cache() -> dict:
+    """The cache's entries dict, migrated from either on-disk format
+    (versioned wrapper or the legacy flat object) and filtered to keys
+    whose embedded jax version matches the running stack — stale-version
+    verdicts must neither answer nor accumulate."""
     try:
         with open(_cache_path()) as f:
-            return json.load(f)
+            raw = json.load(f)
     except (OSError, ValueError):
         return {}
+    if not isinstance(raw, dict):
+        return {}
+    entries = raw.get("entries") if "schema_version" in raw else raw
+    if not isinstance(entries, dict):
+        return {}
+    import jax
+
+    cur = jax.__version__
+    return {
+        k: v for k, v in entries.items()
+        if isinstance(k, str) and _entry_jax_version(k) == cur
+    }
 
 
 def _store_cache(cache: dict) -> None:
+    """Persist the entries dict in the versioned wrapper (evicted keys —
+    dropped by ``_load_cache`` — are gone for good on the next store)."""
     path = _cache_path()
+    import jax
+
     try:
         os.makedirs(os.path.dirname(path), exist_ok=True)
         tmp = f"{path}.tmp.{os.getpid()}"
         with open(tmp, "w") as f:
-            json.dump(cache, f, indent=1)
+            json.dump({
+                "schema_version": SCHEMA_VERSION,
+                "jax_version": jax.__version__,
+                "entries": cache,
+            }, f, indent=1)
         os.replace(tmp, path)
     except OSError:
         pass  # read-only home: tuning still works, it just re-measures
@@ -151,14 +201,25 @@ def _pallas_schedules(plan: StencilPlan, shape: Tuple[int, int],
 # target the large-shape cliffs (1920x5040 / 8K rows — VERDICT r4 item
 # 2): taller blocks amortize per-program DMA ramp on tall images, and
 # per-SHAPE adoption needs the candidate in this grid (the cliff A/B in
-# tools/bh_fuse_ab.py can only flip the global default). fuse=20 rows:
+# tools/bh_fuse_ab.py can only flip the global default). fuse=20/40 rows:
 # `reps % fuse` runs as single-rep launches (repetitions is traced, so
 # the remainder depth cannot be compiled statically), which taxes
 # non-divisor fuses on the reference's 40-rep jobs — a divisor-of-40
-# fuse gets the deep traffic cut with ZERO remainder launches.
+# fuse gets the deep traffic cut with ZERO remainder launches. The
+# fuse>=32 rows are the deep-blocking depths (HBM bytes/rep divides by
+# fuse); candidates whose modeled VMEM footprint exceeds the budget are
+# pruned before measurement (pallas_stencil.vmem_tile_bytes).
 _GEOMETRY_GRID = (
     (256, 8), (256, 16), (256, 20), (512, 8), (512, 16), (512, 20),
+    (128, 32), (256, 32), (256, 40), (512, 32), (512, 64),
 )
+
+# The geometry-stage prune fires only when the footprint model exceeds
+# the budget by this factor: the model deliberately over-counts (see
+# pallas_stencil.vmem_tile_bytes), and a hard cutoff at 1x would forbid
+# the 512-row cliff candidates that were measured successfully before
+# the prune existed.
+_VMEM_PRUNE_SLACK = 2.0
 
 
 def _grid_fingerprint():
@@ -227,15 +288,22 @@ def best_full_config(
         )
         key += f"|forced={force_schedule}"
     # Key and measure at the EFFECTIVE geometry (align/clamp), so
-    # requested values that launch identically (e.g. --block-h 100 vs
-    # 104) share one cache entry and one measurement sweep. Only passed
-    # through to measure() when forced: the measure callable is
-    # monkeypatchable (12 tests) and pre-geometry signatures must keep
-    # working for default-geometry tuning.
+    # requested values that launch identically share one cache entry and
+    # one measurement sweep (the CLI now rejects non-multiple-of-8
+    # blocks, but programmatic callers bypass that validation, and fuse
+    # still clamps). Only passed through to measure() when forced: the
+    # measure callable is monkeypatchable (12 tests) and pre-geometry
+    # signatures must keep working for default-geometry tuning.
     geo_kw = {}
     if block_h is not None or fuse is not None:
+        # Schedule-aware resolution: under a forced 'deep' schedule an
+        # unforced fuse defaults to the deep_fuse_for depth — the same
+        # path the launch takes — so the verdict is measured (and keyed)
+        # at the geometry that will actually run, never DEFAULT_FUSE.
         eff_bh, eff_fz = ps.effective_geometry(
-            plan, shape[0], block_h, fuse
+            plan, shape[0], block_h, fuse,
+            schedule=force_schedule,
+            wc=ps.padded_lanes(plan, shape[1] * channels, channels),
         )
         key += f"|bh={eff_bh}|fz={eff_fz}"
         geo_kw = {"block_h": eff_bh, "fuse": eff_fz}
@@ -285,10 +353,18 @@ def best_full_config(
     # default's (or a previous candidate's) are never measured twice.
     win_bh = win_fuse = None
     geo_us = {}
-    if (winner == "pallas" and not geo_kw
+    wcp = ps.padded_lanes(plan, shape[1] * channels, channels)
+    deep_resident = (
+        win_sched == "deep" and ps.resident_feasible(plan, shape[0], wcp)
+    )
+    if (winner == "pallas" and not geo_kw and not deep_resident
             and _measure_takes_geometry(measure)):
+        # deep_resident skips the stage: the resident kernel has no
+        # static (block_h, fuse) — the whole image is one VMEM block and
+        # the depth is the traced rep count.
         geo_timings = {(None, None): timings[(winner, win_sched)]}
-        seen_eff = {ps.effective_geometry(plan, shape[0])}
+        seen_eff = {ps.effective_geometry(plan, shape[0],
+                                          schedule=win_sched, wc=wcp)}
         for gbh, gfz in _GEOMETRY_GRID:
             eff = ps.effective_geometry(plan, shape[0], gbh, gfz)
             if eff in seen_eff:
@@ -299,6 +375,19 @@ def best_full_config(
             ) != force_schedule:
                 # A user-forced --schedule must never be degraded away by
                 # a geometry verdict: skip candidates it cannot run at.
+                continue
+            if ps.vmem_tile_bytes(
+                plan, eff[0], eff[1], wcp,
+                ps._kernel_schedule(win_sched, plan, eff[0]),
+            ) > _VMEM_PRUNE_SLACK * ps._vmem_budget():
+                # Feasibility-model pruning: a clearly-impossible tile
+                # would at best fail Mosaic compilation — never spend a
+                # measurement (or a cache slot in geo_us) on it. The 2x
+                # slack accounts for the model's deliberate over-count
+                # (intermediates usually stay strip/register-resident),
+                # so the historically-measured 512-row cliff candidates
+                # stay in the grid; genuine compile failures are still
+                # caught per candidate below.
                 continue
             try:
                 geo_timings[(gbh, gfz)] = measure(

@@ -52,33 +52,58 @@ def ici_ghost_bytes_per_rep(tile_shape, channels: int, halo: int,
 
 
 def effective_fuse(filter_name: str, h_img: int,
-                   block_h=None, fuse=None) -> int:
-    """The fuse depth :func:`tpu_stencil.ops.pallas_stencil.iterate` will
-    actually use for this (filter, image height) — HBM traffic per rep is
-    divided by it. Mirrors iterate's clamp exactly (``block_h``/``fuse``:
-    a forced/tuned geometry; None = module defaults)."""
+                   block_h=None, fuse=None, schedule=None,
+                   w_img=None, channels: int = 1, reps=None,
+                   n_frames: int = 1) -> int:
+    """The in-VMEM depth (reps per HBM round-trip)
+    :func:`tpu_stencil.ops.pallas_stencil.iterate` will actually achieve
+    for this (filter, image height) — HBM traffic per rep is divided by
+    it. Mirrors iterate's clamp exactly (``block_h``/``fuse``: a
+    forced/tuned geometry; None = module defaults). Under
+    ``schedule='deep'`` this is the temporal-blocking depth: the full
+    ``reps`` count when the resident kernel applies (``w_img``/
+    ``channels`` feed its VMEM feasibility check; without a width the
+    resident form is assumed infeasible), else the trapezoid depth the
+    feasibility model picks. ``n_frames`` > 1 models the fused
+    tall-image batch launch — residency is decided at the stacked
+    clip's height (``frames_rows``), never per frame."""
     from tpu_stencil.models.blur import IteratedConv2D
     from tpu_stencil.ops import pallas_stencil as ps
 
     plan = IteratedConv2D(filter_name).plan
     if not ps._supported(plan):
         return 1
-    return ps.effective_geometry(plan, h_img, block_h, fuse)[1]
+    rows = (
+        ps.frames_rows(plan, h_img, n_frames) if n_frames > 1 else h_img
+    )
+    if schedule is not None and w_img:
+        return ps.in_vmem_depth(plan, rows, w_img, channels,
+                                schedule=schedule, block_h=block_h,
+                                fuse=fuse, reps=reps)
+    return ps.effective_geometry(plan, rows, block_h, fuse,
+                                 schedule=schedule)[1]
 
 
 def analytic_bytes_per_rep(frame_bytes: int, backend: str,
                            filter_name: str, h_img: int,
-                           block_h=None, fuse=None) -> float:
+                           block_h=None, fuse=None, schedule=None,
+                           w_img=None, channels: int = 1,
+                           reps=None, n_frames: int = 1) -> float:
     """The traffic model's HBM bytes per repetition: the XLA step reads
     + writes the frame every rep; the fused Pallas kernel pays HBM once
-    per ``fuse`` reps (ghost-band overhead excluded — it is compute,
-    not extra HBM traffic). This is the numerator of :func:`achieved`
-    and the model side of the introspection cross-check
-    (:func:`tpu_stencil.obs.introspect.cross_check`) — one formula, so
-    the roofline and the XLA-vs-model audit can never disagree about
-    what the model claims."""
+    per in-VMEM depth reps — the effective fuse, or under
+    ``schedule='deep'`` the full temporal-blocking depth (the whole
+    ``reps`` loop for the resident kernel, the feasibility-chosen
+    trapezoid depth otherwise; ghost-band overhead excluded — it is
+    compute, not extra HBM traffic). This is the numerator of
+    :func:`achieved` and the model side of the introspection
+    cross-check (:func:`tpu_stencil.obs.introspect.cross_check`) — one
+    formula, so the roofline and the XLA-vs-model audit can never
+    disagree about what the model claims."""
     eff = (
-        effective_fuse(filter_name, h_img, block_h, fuse)
+        effective_fuse(filter_name, h_img, block_h, fuse,
+                       schedule=schedule, w_img=w_img, channels=channels,
+                       reps=reps, n_frames=n_frames)
         if backend == "pallas" else 1
     )
     return 2.0 * frame_bytes / eff
@@ -86,14 +111,18 @@ def analytic_bytes_per_rep(frame_bytes: int, backend: str,
 
 def achieved(frame_bytes: int, per_rep_s: float, backend: str,
              filter_name: str, h_img: int,
-             block_h=None, fuse=None) -> Tuple[float, float]:
+             block_h=None, fuse=None, schedule=None,
+             w_img=None, channels: int = 1, reps=None
+             ) -> Tuple[float, float]:
     """(HBM GB/s, % of v5e peak) for one measured per-rep time.
 
-    ``block_h``/``fuse``: the geometry that ran, when non-default — the
+    ``block_h``/``fuse`` (and for deep runs ``schedule``/``w_img``/
+    ``channels``/``reps``): what actually ran, when non-default — the
     traffic model must follow the launch, not the module defaults.
     """
     gbps = analytic_bytes_per_rep(
-        frame_bytes, backend, filter_name, h_img, block_h, fuse
+        frame_bytes, backend, filter_name, h_img, block_h, fuse,
+        schedule=schedule, w_img=w_img, channels=channels, reps=reps,
     ) / per_rep_s / 1e9
     return gbps, 100 * gbps / V5E_HBM_GBPS
 
