@@ -94,6 +94,21 @@ def _backoffs():
     return tuple(float(x) for x in v.split(",")) if v else BACKOFFS
 
 
+def _transient_rc(rc) -> bool:
+    """Whether a failed child attempt is worth a backoff + retry — the
+    shared transient-vs-permanent classifier's subprocess spelling
+    (tpu_stencil.resilience.retry.transient_returncode), so bench,
+    serve, and stream all draw the retryable line in one place. rc=2
+    (backend unavailable at init) is the permanent contract: a
+    4-attempt backoff loop against a dead backend is how round 5 ran
+    the harness into its rc=124 timeout. The PR-4 fail-fast
+    '"partial": true' capture behavior is unchanged — the child already
+    streamed its error capture before exiting 2."""
+    from tpu_stencil.resilience import retry as _retry
+
+    return _retry.transient_returncode(rc)
+
+
 def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
@@ -950,13 +965,14 @@ def main() -> int:
             if final != lines[-1]:  # already streamed; print only new info
                 print(final, flush=True)
             return _sentry_gate(final)
-        if rc == 2:
-            # Backend unavailable at init: the child already emitted its
-            # partial error capture and there is nothing a backoff loop
-            # can fix fast enough — retrying is how a dead tunnel runs
-            # the whole harness into its timeout (round 5). Fail fast.
+        if not _transient_rc(rc):
+            # Permanent by the shared classifier (backend unavailable at
+            # init): the child already emitted its partial error capture
+            # and there is nothing a backoff loop can fix fast enough —
+            # retrying is how a dead tunnel runs the whole harness into
+            # its timeout (round 5). Fail fast.
             log("backend unavailable; not retrying")
-            return 2
+            return rc
         log(f"attempt {attempt}: rc={rc}")
         if attempt < ATTEMPTS - 1:
             backoffs = _backoffs()

@@ -1,0 +1,984 @@
+"""Chaos + resilience suite (ISSUE 7): fault injection at every stage
+boundary x engine, retry/backoff classification, deadlines/watchdog,
+and the graceful degradation ladder.
+
+The contract every chaos case asserts: with a fault injected, the run
+either **finishes bit-exact vs the golden model after recovery** (the
+production retry/fallback/restart path absorbed it) or **fails with a
+typed error** (``tpu_stencil.resilience.errors``) **within its
+deadline** — never hangs (every run is wrapped in a thread-join
+watchdog), never silently corrupts.
+
+Deterministic cases are tier-1 (``chaos`` marker); probabilistic soak
+variants are additionally ``slow``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import threading
+import time
+import warnings
+
+import numpy as np
+import pytest
+
+from tpu_stencil import filters, obs
+from tpu_stencil.config import ImageType, JobConfig, ServeConfig, StreamConfig
+from tpu_stencil.ops import stencil
+from tpu_stencil.resilience import deadline, errors, fallback, faults, retry
+
+H, W, C, REPS = 24, 16, 3, 3
+
+
+@pytest.fixture(autouse=True)
+def _clean_resilience_state():
+    faults.clear()
+    obs.reset()
+    yield
+    faults.clear()
+    obs.reset()
+
+
+def _within(seconds, fn, *args, **kwargs):
+    """Run ``fn`` with a hang watchdog: the chaos contract's 'never
+    hangs' clause, enforced at the test level. Re-raises ``fn``'s
+    exception; a still-running thread fails the test."""
+    box = {}
+
+    def run():
+        try:
+            box["value"] = fn(*args, **kwargs)
+        except BaseException as e:  # noqa: BLE001 - re-raised below
+            box["error"] = e
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    t.join(seconds)
+    assert not t.is_alive(), f"{fn} hung past {seconds}s"
+    if "error" in box:
+        raise box["error"]
+    return box.get("value")
+
+
+def _golden(img, reps, filter_name="gaussian"):
+    return stencil.reference_stencil_numpy(
+        img, filters.get_filter(filter_name), reps
+    )
+
+
+def _job(tmp_path, **kw):
+    img = np.random.default_rng(3).integers(
+        0, 256, (H, W, C), dtype=np.uint8
+    )
+    src = tmp_path / "in.raw"
+    img.tofile(src)
+    cfg = JobConfig(
+        image=str(src), width=W, height=H, repetitions=REPS,
+        image_type=ImageType.RGB, output=str(tmp_path / "out.raw"), **kw,
+    )
+    return cfg, img
+
+
+def _run_job(cfg, **kw):
+    # Pin to one device: the test harness fakes 8 CPU devices, which
+    # would route a bare run_job onto the sharded path — these cases
+    # target the single-device engine (the sharded chaos has its own).
+    import jax
+
+    from tpu_stencil import driver
+
+    kw.setdefault("devices", jax.devices()[:1])
+    return driver.run_job(cfg, **kw)
+
+
+def _run_job_sharded(cfg, **kw):
+    from tpu_stencil import driver
+
+    return driver.run_job(cfg, **kw)  # all 8 fake devices: mesh path
+
+
+# -- fault spec parsing ------------------------------------------------
+
+def test_parse_spec_issue_example():
+    plan = faults.parse_spec("compute:frame=3:raise=RuntimeError,h2d:p=0.1")
+    (rule,) = plan["compute"]
+    assert rule.index == 3 and rule.exc is RuntimeError and rule.times == 1
+    (rule,) = plan["h2d"]
+    assert rule.p == 0.1 and rule.times == 0  # probabilistic: unlimited
+
+
+def test_parse_spec_rejects_garbage():
+    with pytest.raises(ValueError):
+        faults.parse_spec("warp:at=1")           # unknown point
+    with pytest.raises(ValueError):
+        faults.parse_spec("compute:zap=1")       # unknown field
+    with pytest.raises(ValueError):
+        faults.parse_spec("compute:p=2.0")       # p outside (0, 1]
+    with pytest.raises(ValueError):
+        faults.parse_spec("compute:raise=Boom")  # unknown exception
+    with pytest.raises(ValueError):
+        faults.parse_spec("compute:frame3")      # not key=value
+
+
+def test_rule_fires_once_then_passes():
+    faults.configure("compute:at=1:times=1")
+    site = faults.site("compute")
+    site(0)                       # index mismatch: no fire
+    with pytest.raises(errors.InjectedFault) as ei:
+        site(1)
+    assert ei.value.point == "compute" and ei.value.index == 1
+    site(1)                       # budget spent: the retry path succeeds
+    assert obs.snapshot()["counters"][
+        "resilience_faults_injected_total"] == 1
+
+
+def test_bare_rule_fires_on_first_call_with_own_counter():
+    faults.configure("read")
+    site = faults.site("read")
+    with pytest.raises(errors.InjectedFault):
+        site()
+    site()  # times=1 default: second call passes
+
+
+def test_unarmed_sites_resolve_to_none():
+    # The zero-overhead contract's static half: with nothing armed,
+    # every site resolves to None at prepare time.
+    for point in faults.POINTS:
+        assert faults.site(point) is None
+    faults.configure("compute:at=0")
+    assert faults.site("compute") is not None
+    assert faults.site("read") is None  # other points still free
+
+
+def test_site_rejects_unknown_point():
+    with pytest.raises(ValueError):
+        faults.site("warp")
+
+
+# -- retry classification + policy ------------------------------------
+
+@pytest.mark.parametrize("exc,want", [
+    (RuntimeError("RESOURCE_EXHAUSTED: out of memory"), "transient"),
+    (RuntimeError("UNAVAILABLE: tunnel reset"), "transient"),
+    (ConnectionResetError("peer"), "transient"),
+    (TimeoutError("slow"), "transient"),
+    (OSError(5, "I/O error"), "transient"),             # EIO
+    (errors.DispatchTimeout("iterate", 30.0), "transient"),
+    (errors.InjectedFault("chaos"), "transient"),
+    (RuntimeError("mystery"), "transient"),             # default bias
+    (NotImplementedError("no pallas"), "permanent"),
+    (ValueError("shape (3,) != (4,)"), "permanent"),
+    (TypeError("bad arg"), "permanent"),
+    (FileNotFoundError(2, "gone"), "permanent"),        # ENOENT
+    (RuntimeError("INVALID_ARGUMENT: bad dims"), "permanent"),
+    (errors.DeadlineExceeded("expired"), "permanent"),
+])
+def test_classify(exc, want):
+    assert retry.classify(exc) == want
+
+
+def test_classify_queue_full_by_name():
+    from tpu_stencil.serve.engine import QueueFull
+
+    assert retry.classify(QueueFull("full")) == "transient"
+
+
+def test_transient_returncode_matches_bench_contract():
+    assert not retry.transient_returncode(2)   # backend unavailable
+    assert retry.transient_returncode(1)
+    assert retry.transient_returncode(None)    # killed/timed-out child
+    assert retry.transient_returncode(-9)
+
+
+def test_retry_call_recovers_and_counts():
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise RuntimeError("UNAVAILABLE: blip")
+        return "ok"
+
+    policy = retry.RetryPolicy(attempts=3, base_delay=0.0, jitter=0.0)
+    assert retry.retry_call(flaky, policy=policy) == "ok"
+    assert len(calls) == 3
+    assert obs.snapshot()["counters"]["resilience_retries_total"] == 2
+
+
+def test_retry_call_permanent_raises_immediately():
+    calls = []
+
+    def broken():
+        calls.append(1)
+        raise NotImplementedError("never")
+
+    with pytest.raises(NotImplementedError):
+        retry.retry_call(broken, policy=retry.RetryPolicy(
+            attempts=5, base_delay=0.0))
+    assert len(calls) == 1
+
+
+def test_retry_call_exhausts_budget():
+    calls = []
+
+    def always():
+        calls.append(1)
+        raise RuntimeError("UNAVAILABLE")
+
+    with pytest.raises(RuntimeError):
+        retry.retry_call(always, policy=retry.RetryPolicy(
+            attempts=3, base_delay=0.0, jitter=0.0))
+    assert len(calls) == 3
+
+
+def test_retry_on_retry_hook_can_abort():
+    def always():
+        raise RuntimeError("UNAVAILABLE")
+
+    def deadline_hook(_attempt, exc):
+        raise TimeoutError("budget gone")
+
+    with pytest.raises(TimeoutError):
+        retry.retry_call(always, policy=retry.RetryPolicy(
+            attempts=10, base_delay=0.0), on_retry=deadline_hook)
+
+
+def test_policy_delay_shape():
+    p = retry.RetryPolicy(attempts=4, base_delay=1.0, multiplier=2.0,
+                          max_delay=3.0, jitter=0.0)
+    assert [p.delay(k) for k in range(4)] == [1.0, 2.0, 3.0, 3.0]
+    pj = dataclasses.replace(p, jitter=0.5)
+    for k in range(4):
+        lo, hi = 0.5 * p.delay(k), 1.5 * p.delay(k)
+        assert lo <= pj.delay(k) <= hi
+
+
+# -- deadlines + watchdog ----------------------------------------------
+
+def test_fence_passthrough_without_timeout():
+    class Ready:
+        def block_until_ready(self):
+            return self
+
+    r = Ready()
+    assert deadline.fence(r, 0) is r
+    assert deadline.fence(r, 30.0, "x") is r
+
+
+def test_fence_converts_hang_to_typed_timeout():
+    class Hung:
+        def block_until_ready(self):
+            time.sleep(30)
+
+    t0 = time.perf_counter()
+    with pytest.raises(errors.DispatchTimeout) as ei:
+        deadline.fence(Hung(), 0.2, "unit.hang")
+    assert time.perf_counter() - t0 < 5
+    assert ei.value.label == "unit.hang" and ei.value.seconds == 0.2
+    assert obs.snapshot()["counters"][
+        "resilience_dispatch_timeouts_total"] == 1
+
+
+def test_fence_surfaces_drain_error():
+    class Boom:
+        def block_until_ready(self):
+            raise RuntimeError("UNAVAILABLE: died in flight")
+
+    with pytest.raises(RuntimeError, match="died in flight"):
+        deadline.fence(Boom(), 10.0, "unit.err")
+
+
+def test_env_default_timeout(monkeypatch):
+    monkeypatch.setenv(deadline.ENV_VAR, "7.5")
+    assert deadline.default_timeout() == 7.5
+    assert deadline.resolve(0) == 7.5       # env default applies
+    assert deadline.resolve(3.0) == 3.0     # explicit config wins
+    monkeypatch.setenv(deadline.ENV_VAR, "nonsense")
+    assert deadline.default_timeout() == 0.0
+
+
+def test_deadline_budget():
+    d = deadline.Deadline.after(60.0)
+    assert not d.expired() and d.remaining() > 50
+    assert deadline.Deadline.after(-1.0).expired()
+
+
+def test_run_job_passes_dispatch_timeout(tmp_path, monkeypatch):
+    seen = []
+    orig = deadline.fence
+
+    def spy(x, timeout_s=None, label="dispatch"):
+        seen.append((timeout_s, label))
+        return orig(x, 0, label)
+
+    monkeypatch.setattr(deadline, "fence", spy)
+    cfg, _ = _job(tmp_path, dispatch_timeout_s=12.5)
+    _within(300, _run_job, cfg)
+    assert any(t == 12.5 and lbl.startswith("driver.iterate")
+               for t, lbl in seen)
+
+
+# -- driver chaos matrix ----------------------------------------------
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("point", ["read", "h2d", "compute", "d2h", "write"])
+def test_run_job_fault_fails_typed(tmp_path, point):
+    cfg, _ = _job(tmp_path)
+    faults.configure(point)
+    with pytest.raises(errors.InjectedFault) as ei:
+        _within(300, _run_job, cfg)
+    assert ei.value.point == point
+
+
+@pytest.mark.chaos
+def test_run_job_compute_rep_index_fires_in_fused_launch(tmp_path):
+    # compute:rep=N must fire even when the whole rep loop is one fused
+    # launch (no --checkpoint-every chunking): the site is checked at
+    # every rep index the launch spans.
+    cfg, _ = _job(tmp_path)
+    faults.configure(f"compute:rep={REPS - 1}")
+    with pytest.raises(errors.InjectedFault) as ei:
+        _within(300, _run_job, cfg)
+    assert ei.value.index == REPS - 1
+
+
+@pytest.mark.chaos
+def test_run_job_checkpoint_fault_fails_typed(tmp_path):
+    cfg, _ = _job(tmp_path)
+    faults.configure("checkpoint")
+    with pytest.raises(errors.InjectedFault):
+        _within(300, _run_job, cfg, checkpoint_every=1)
+
+
+@pytest.mark.chaos
+def test_run_job_compile_fault_recovers_via_ladder(tmp_path):
+    cfg, img = _job(tmp_path)
+    faults.configure("compile")  # one firing; the demoted rung passes
+    result = _within(300, _run_job, cfg)
+    out = np.fromfile(cfg.output_path, np.uint8).reshape(H, W, C)
+    np.testing.assert_array_equal(out, _golden(img, REPS))
+    assert result.backend == "xla"
+    assert obs.snapshot()["counters"]["resilience_fallbacks_total"] == 1
+
+
+@pytest.mark.chaos
+def test_injected_vmem_oom_demotes_deep_to_fused_to_xla(tmp_path):
+    # The acceptance scenario: VMEM-OOM at compile demotes
+    # deep -> default fused schedule -> xla, each step visible in
+    # resilience_fallbacks_total + the --breakdown resilience table,
+    # final output bit-exact.
+    cfg, img = _job(tmp_path, backend="pallas", schedule="deep")
+    faults.configure("compile:raise=oom:times=2")
+    result = _within(600, _run_job, cfg)
+    out = np.fromfile(cfg.output_path, np.uint8).reshape(H, W, C)
+    np.testing.assert_array_equal(out, _golden(img, REPS))
+    assert result.backend == "xla" and result.schedule is None
+    snap = obs.snapshot()
+    assert snap["counters"]["resilience_fallbacks_total"] == 2
+    table = obs.breakdown.render_resilience(snap)
+    assert "schedule/backend demotions" in table and "2" in table
+
+
+@pytest.mark.chaos
+def test_fallback_backend_cpu_completes_degraded(tmp_path):
+    cfg, img = _job(tmp_path, backend="xla", fallback_backend="cpu")
+    faults.configure("compile:raise=oom:times=1")
+    result = _within(600, _run_job, cfg)
+    out = np.fromfile(cfg.output_path, np.uint8).reshape(H, W, C)
+    np.testing.assert_array_equal(out, _golden(img, REPS))
+    assert result.backend == "xla"
+    assert obs.snapshot()["counters"]["resilience_fallbacks_total"] == 1
+
+
+@pytest.mark.chaos
+def test_permanent_compile_error_does_not_demote(tmp_path):
+    cfg, _ = _job(tmp_path)
+    faults.configure("compile:raise=ValueError")
+    with pytest.raises(ValueError):
+        _within(300, _run_job, cfg)
+    assert obs.snapshot()["counters"].get(
+        "resilience_fallbacks_total", 0) == 0
+
+
+def test_ladder_shapes():
+    assert fallback.ladder("xla") == (fallback.Rung("xla", None),)
+    assert fallback.ladder("pallas", "deep") == (
+        fallback.Rung("pallas", "deep"),
+        fallback.Rung("pallas", None),
+        fallback.Rung("xla", None),
+    )
+    assert fallback.ladder("auto") == (
+        fallback.Rung("auto", None), fallback.Rung("xla", None),
+    )
+    assert fallback.ladder("xla", None, "cpu") == (
+        fallback.Rung("xla", None),
+        fallback.Rung("xla", None, platform="cpu"),
+    )
+
+
+def test_demotable_taxonomy():
+    assert fallback.demotable(RuntimeError("RESOURCE_EXHAUSTED: vmem"))
+    assert fallback.demotable(RuntimeError("Mosaic failed to compile"))
+    assert fallback.demotable(MemoryError())
+    assert fallback.demotable(NotImplementedError("no pallas build"))
+    assert fallback.demotable(errors.InjectedOOM())
+    assert not fallback.demotable(ValueError("bad shape"))
+    assert not fallback.demotable(RuntimeError("mystery"))
+    # Injected faults demote only at the compile boundary (or as OOM):
+    # an h2d/read blip must fail typed, not silently change backends.
+    compile_fault = errors.InjectedFault("x")
+    compile_fault.point = "compile"
+    assert fallback.demotable(compile_fault)
+    h2d_fault = errors.InjectedFault("x")
+    h2d_fault.point = "h2d"
+    assert not fallback.demotable(h2d_fault)
+    oom_any_point = errors.InjectedOOM("placement")
+    oom_any_point.point = "h2d"
+    assert fallback.demotable(oom_any_point)
+
+
+def test_fault_sites_resolved_per_job_not_per_rep(tmp_path, monkeypatch):
+    # The zero-overhead acceptance test's dynamic half: site() is
+    # consulted a fixed number of times per job, independent of the
+    # rep count — injection checks resolve at engine-prepare time.
+    calls = []
+    orig = faults.site
+
+    def counting_site(point):
+        calls.append(point)
+        return orig(point)
+
+    monkeypatch.setattr(faults, "site", counting_site)
+    cfg, _ = _job(tmp_path)
+    _within(300, _run_job, cfg)
+    per_job = len(calls)
+    calls.clear()
+    cfg8 = dataclasses.replace(cfg, repetitions=REPS + 13)
+    _within(300, _run_job, cfg8)
+    assert len(calls) == per_job  # rep count never changes site lookups
+
+
+# -- stream chaos ------------------------------------------------------
+
+def _clip(tmp_path, n=3, seed=11):
+    clip = np.random.default_rng(seed).integers(
+        0, 256, (n, H, W, C), dtype=np.uint8
+    )
+    path = tmp_path / "clip.raw"
+    clip.tofile(path)
+    return path, clip
+
+
+def _stream_cfg(clip_path, out, **kw):
+    return StreamConfig(
+        input=str(clip_path), width=W, height=H, repetitions=REPS,
+        image_type=ImageType.RGB, output=str(out), **kw,
+    )
+
+
+def _stream_golden(clip):
+    return np.concatenate([_golden(f, REPS) for f in clip])
+
+
+def _run_stream(cfg, **kw):
+    from tpu_stencil.stream.engine import run_stream
+
+    return run_stream(cfg, **kw)
+
+
+@pytest.mark.chaos
+def test_stream_read_fault_retries_bit_exact(tmp_path):
+    clip_path, clip = _clip(tmp_path)
+    out = tmp_path / "out.raw"
+    faults.configure("read:frame=1")
+    res = _within(300, _run_stream, _stream_cfg(clip_path, out, frames=3))
+    assert res.frames == 3 and res.restarts == 0
+    got = np.fromfile(out, np.uint8).reshape(3 * H, W, C)
+    np.testing.assert_array_equal(got, _stream_golden(clip))
+    assert obs.snapshot()["counters"]["resilience_retries_total"] >= 1
+
+
+@pytest.mark.chaos
+def test_stream_write_fault_retries_into_directory_sink(tmp_path):
+    clip_path, clip = _clip(tmp_path)
+    outdir = tmp_path / "frames"
+    faults.configure("write:frame=2")
+    res = _within(300, _run_stream,
+                  _stream_cfg(clip_path, str(outdir) + os.sep, frames=3))
+    assert res.frames == 3
+    got = np.concatenate([
+        np.fromfile(outdir / f"frame_{i:06d}.raw", np.uint8)
+        .reshape(H, W, C)
+        for i in range(3)
+    ])
+    np.testing.assert_array_equal(got, _stream_golden(clip))
+
+
+@pytest.mark.chaos
+def test_stream_read_fault_on_pipe_fails_typed(tmp_path):
+    # A pipe cannot rewind (mark() is None): the first read fault is
+    # final and surfaces as a typed read-stage StreamFailure.
+    from tpu_stencil.stream.engine import StreamFailure
+
+    clip_path, clip = _clip(tmp_path, n=2)
+    fifo = str(tmp_path / "in.fifo")
+    os.mkfifo(fifo)
+
+    def feed():
+        with open(fifo, "wb") as f:
+            f.write(clip.tobytes())
+
+    t = threading.Thread(target=feed, daemon=True)
+    t.start()
+    faults.configure("read:frame=1")
+    cfg = _stream_cfg(fifo, tmp_path / "out.raw", frames=2)
+    with pytest.raises(StreamFailure) as ei:
+        _within(300, _run_stream, cfg)
+    assert ei.value.stage == "read"
+    assert isinstance(ei.value.__cause__, errors.InjectedFault)
+    t.join(10)
+    assert obs.snapshot()["counters"].get(
+        "resilience_retries_total", 0) == 0
+
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("point", ["h2d", "compute", "d2h"])
+def test_stream_engine_fault_restarts_from_checkpoint(tmp_path, point):
+    clip_path, clip = _clip(tmp_path)
+    out = tmp_path / "out.raw"
+    faults.configure(f"{point}:frame=1")
+    res = _within(600, _run_stream,
+                  _stream_cfg(clip_path, out, frames=3,
+                              checkpoint_every=1))
+    assert res.restarts == 1
+    got = np.fromfile(out, np.uint8).reshape(3 * H, W, C)
+    np.testing.assert_array_equal(got, _stream_golden(clip))
+    assert obs.snapshot()["counters"][
+        "resilience_stream_restarts_total"] == 1
+
+
+@pytest.mark.chaos
+def test_stream_restart_never_adopts_stale_sidecar(tmp_path):
+    # A sidecar left by a KILLED earlier run must not leak into this
+    # run's engine restart: a fresh (non-resume) run invalidates it, so
+    # a restart before the first commit re-streams from frame 0 instead
+    # of silently skipping frames the stale record claims are done.
+    from tpu_stencil.runtime import checkpoint as ckpt
+
+    clip_path, clip = _clip(tmp_path)
+    out = tmp_path / "out.raw"
+    cfg = _stream_cfg(clip_path, out, frames=3, checkpoint_every=1)
+    ckpt.save_stream_progress(cfg, 2)  # the killed run's stale record
+    faults.configure("compute:frame=0")  # restart fires pre-commit
+    res = _within(600, _run_stream, cfg)
+    assert res.restarts == 1 and res.skipped == 0
+    got = np.fromfile(out, np.uint8).reshape(3 * H, W, C)
+    np.testing.assert_array_equal(got, _stream_golden(clip))
+
+
+@pytest.mark.chaos
+def test_stream_engine_fault_without_checkpoint_fails_typed(tmp_path):
+    from tpu_stencil.stream.engine import StreamFailure
+
+    clip_path, _ = _clip(tmp_path)
+    faults.configure("compute:frame=1")
+    with pytest.raises(StreamFailure) as ei:
+        _within(300, _run_stream,
+                _stream_cfg(clip_path, tmp_path / "out.raw", frames=3))
+    assert ei.value.stage == "compute" and ei.value.frame_index == 1
+    assert isinstance(ei.value.__cause__, errors.InjectedFault)
+
+
+@pytest.mark.chaos
+def test_stream_permanent_engine_fault_never_restarts(tmp_path):
+    from tpu_stencil.stream.engine import StreamFailure
+
+    clip_path, _ = _clip(tmp_path)
+    faults.configure("compute:frame=1:raise=ValueError")
+    with pytest.raises(StreamFailure):
+        _within(300, _run_stream,
+                _stream_cfg(clip_path, tmp_path / "out.raw", frames=3,
+                            checkpoint_every=1))
+    assert obs.snapshot()["counters"].get(
+        "resilience_stream_restarts_total", 0) == 0
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_stream_probabilistic_fault_soak(tmp_path):
+    # Seeded probabilistic chaos (TPU_STENCIL_FAULTS_SEED defaults to 0,
+    # so even this "random" soak replays identically): either the retry
+    # budget absorbs every fault and the stream is bit-exact, or the
+    # run fails typed — never hangs, never corrupts.
+    from tpu_stencil.stream.engine import StreamFailure
+
+    n = 12
+    clip_path, clip = _clip(tmp_path, n=n, seed=23)
+    out = tmp_path / "out.raw"
+    faults.configure("read:p=0.15,write:p=0.1")
+    try:
+        res = _within(600, _run_stream,
+                      _stream_cfg(clip_path, out, frames=n))
+        assert res.frames == n
+        got = np.fromfile(out, np.uint8).reshape(n * H, W, C)
+        np.testing.assert_array_equal(got, _stream_golden(clip))
+    except StreamFailure as e:
+        assert isinstance(e.__cause__, errors.InjectedFault)
+
+
+def test_source_mark_semantics(tmp_path):
+    from tpu_stencil.stream import frames as frames_io
+
+    clip_path, clip = _clip(tmp_path, n=2)
+    frame_bytes = H * W * C
+    src = frames_io.RawStreamSource(str(clip_path), frame_bytes)
+    buf = np.empty(frame_bytes, np.uint8)
+    restore = src.mark()
+    assert restore is not None
+    assert src.read_into(buf)
+    first = buf.copy()
+    restore()
+    assert src.read_into(buf)
+    np.testing.assert_array_equal(buf, first)  # re-read same frame
+    src.close()
+
+    fifo = str(tmp_path / "m.fifo")
+    os.mkfifo(fifo)
+    # Keep a nonblocking reader + a writer open so the source's own
+    # open() never parks waiting for the other end.
+    rd = os.open(fifo, os.O_RDONLY | os.O_NONBLOCK)
+    wr = os.open(fifo, os.O_WRONLY)
+    try:
+        pipe_src = frames_io.RawStreamSource(fifo, frame_bytes)
+        assert pipe_src.mark() is None  # consumed pipe bytes are gone
+        pipe_src.close()
+    finally:
+        os.close(wr)
+        os.close(rd)
+
+
+def test_sink_retryable_write_is_idempotent(tmp_path):
+    from tpu_stencil.stream import frames as frames_io
+
+    frame_bytes = H * W * C
+    a = np.arange(frame_bytes, dtype=np.uint8) % 251
+    b = (a + 1) % 251
+    path = tmp_path / "sink.raw"
+    sink = frames_io.RawStreamSink(str(path), frame_bytes)
+    assert sink.retryable_writes
+    sink.write(0, a)
+    sink.write(1, b)
+    sink.write(1, b)  # the retry shape: same index re-written
+    sink.close()
+    got = np.fromfile(path, np.uint8)
+    np.testing.assert_array_equal(got, np.concatenate([a, b]))
+
+
+# -- serve chaos -------------------------------------------------------
+
+def _serve_img(seed=0, shape=(16, 12, 3)):
+    return np.random.default_rng(seed).integers(
+        0, 256, shape, dtype=np.uint8
+    )
+
+
+@pytest.mark.chaos
+def test_serve_compute_fault_fails_batch_typed_worker_survives():
+    from tpu_stencil.serve.engine import StencilServer
+
+    img = _serve_img()
+    faults.configure("compute:at=0")
+    with StencilServer(ServeConfig(max_queue=8, max_batch=2)) as s:
+        fut = s.submit(img, 2)
+        with pytest.raises(errors.InjectedFault):
+            _within(300, fut.result, timeout=300)
+        # One failed batch must not take the worker with it.
+        got = _within(300, s.submit(img, 2).result, timeout=300)
+        np.testing.assert_array_equal(got, _golden(img, 2))
+        assert s.stats()["counters"]["failed_total"] == 1
+
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("point", ["h2d", "d2h", "compile"])
+def test_serve_stage_faults_fail_typed_then_recover(point):
+    from tpu_stencil.serve.engine import StencilServer
+
+    img = _serve_img()
+    faults.configure(f"{point}:at=0")
+    with StencilServer(ServeConfig(max_queue=8, max_batch=2)) as s:
+        with pytest.raises(errors.InjectedFault):
+            _within(300, s.submit(img, 2).result, timeout=300)
+        got = _within(300, s.submit(img, 2).result, timeout=300)
+        np.testing.assert_array_equal(got, _golden(img, 2))
+
+
+@pytest.mark.chaos
+def test_serve_worker_death_propagates_typed():
+    # Satellite regression: a worker thread dying from an unhandled
+    # exception must fail every pending/in-flight future typed and
+    # reject subsequent submits — futures must never wait forever.
+    from tpu_stencil.serve.engine import StencilServer
+
+    img = _serve_img()
+    faults.configure("compute:at=0:raise=fatal")
+    s = StencilServer(ServeConfig(max_queue=8, max_batch=2))
+    try:
+        fut = s.submit(img, 2)
+        with pytest.raises(errors.WorkerCrashed):
+            _within(300, fut.result, timeout=300)
+        with pytest.raises(errors.WorkerCrashed):
+            s.submit(img, 2)
+        assert s.stats()["counters"][
+            "resilience_worker_crashes_total"] == 1
+    finally:
+        s.close(timeout=5)
+
+
+@pytest.mark.chaos
+def test_serve_expired_request_fails_typed_not_batched():
+    from tpu_stencil.serve.engine import StencilServer
+
+    img = _serve_img()
+    s = StencilServer(ServeConfig(max_queue=8, max_batch=2), start=False)
+    try:
+        fut = s.submit(img, 1, deadline_s=0.02)
+        time.sleep(0.1)  # expire while the worker is parked
+        s.start()
+        with pytest.raises(errors.DeadlineExceeded):
+            _within(300, fut.result, timeout=300)
+        got = _within(300, s.submit(img, 1).result, timeout=300)
+        np.testing.assert_array_equal(got, _golden(img, 1))
+        c = s.stats()["counters"]
+        assert c["deadline_expired_total"] == 1
+        assert c["failed_total"] >= 1
+    finally:
+        s.close(timeout=5)
+
+
+def test_serve_default_deadline_from_config():
+    from tpu_stencil.serve.engine import StencilServer
+
+    img = _serve_img()
+    s = StencilServer(ServeConfig(max_queue=8, request_timeout_s=0.02),
+                      start=False)
+    try:
+        fut = s.submit(img, 1)
+        time.sleep(0.1)
+        s.start()
+        with pytest.raises(errors.DeadlineExceeded):
+            _within(300, fut.result, timeout=300)
+    finally:
+        s.close(timeout=5)
+
+
+def test_submit_retrying_backpressure():
+    from tpu_stencil.serve.engine import QueueFull, StencilServer
+
+    img = _serve_img()
+    parked = StencilServer(ServeConfig(max_queue=1), start=False)
+    parked.submit(img, 1)
+    # Full queue + parked worker: the retry budget runs out typed.
+    with pytest.raises((QueueFull, TimeoutError)):
+        parked.submit_retrying(
+            img, 1,
+            policy=retry.RetryPolicy(attempts=3, base_delay=0.001,
+                                     jitter=0.0),
+            give_up_after_s=5.0,
+        )
+    assert obs.snapshot()["counters"]["resilience_retries_total"] >= 1
+    # A live worker drains the queue: the same retrying submit lands.
+    parked.start()
+    got = _within(300, parked.submit_retrying(img, 1).result, timeout=300)
+    np.testing.assert_array_equal(got, _golden(img, 1))
+    parked.close(timeout=5)
+
+
+# -- sharded chaos -----------------------------------------------------
+
+@pytest.mark.chaos
+def test_sharded_collective_fault_fails_typed(tmp_path):
+    cfg, _ = _job(tmp_path, mesh_shape=(2, 2))
+    faults.configure("collective")
+    with pytest.raises(errors.InjectedFault) as ei:
+        _within(600, _run_job_sharded, cfg)
+    assert ei.value.point == "collective"
+
+
+def test_sharded_diagnose_edges_healthy():
+    import jax
+
+    from tpu_stencil.models.blur import IteratedConv2D
+    from tpu_stencil.parallel.sharded import ShardedRunner
+
+    runner = ShardedRunner(
+        IteratedConv2D("gaussian", backend="xla"), (H, W), C,
+        mesh_shape=(2, 2), devices=jax.devices()[:4],
+    )
+    verdicts = _within(600, runner.diagnose_edges, timeout_s=120.0)
+    assert verdicts == {"rows": "ok", "cols": "ok"}
+
+
+def test_collective_timeout_carries_edges():
+    e = errors.CollectiveTimeout("sharded.iterate", 30.0,
+                                 edges={"rows": "timeout", "cols": "ok"})
+    assert isinstance(e, errors.DispatchTimeout)
+    assert e.edges == {"rows": "timeout", "cols": "ok"}
+    assert "rows" in str(e)
+
+
+# -- checkpoint crash-consistency fuzz (satellite) ---------------------
+
+def test_stream_checkpoint_crash_consistency_fuzz(tmp_path):
+    # Kill the writer at EVERY byte offset of a simulated atomic save:
+    # restore must always yield either the old or the new frame index,
+    # never a parse error — the property tmp-then-rename exists for.
+    from tpu_stencil.runtime import checkpoint as ckpt
+
+    cfg = _stream_cfg(tmp_path / "clip.raw", tmp_path / "out.raw")
+    ckpt.save_stream_progress(cfg, 3)  # the committed "old" state
+    path = ckpt._stream_paths(cfg)
+    new_payload = json.dumps(
+        dict(ckpt._stream_fingerprint(cfg), frames_done=7)
+    ).encode()
+    for k in range(len(new_payload) + 1):
+        # Crash mid-tmp-write (before the rename): k bytes of the new
+        # sidecar landed in the tmp file, the published file untouched.
+        with open(path + ".tmp", "wb") as f:
+            f.write(new_payload[:k])
+        assert ckpt.restore_stream_progress(cfg) == 3
+        os.remove(path + ".tmp")
+    # Crash after the rename: the new state is fully visible.
+    with open(path + ".tmp", "wb") as f:
+        f.write(new_payload)
+    os.replace(path + ".tmp", path)
+    assert ckpt.restore_stream_progress(cfg) == 7
+    ckpt.clear_stream_progress(cfg)
+
+
+@pytest.mark.chaos
+def test_stream_checkpoint_fault_fails_typed(tmp_path):
+    from tpu_stencil.stream.engine import StreamFailure
+
+    clip_path, _ = _clip(tmp_path)
+    faults.configure("checkpoint")
+    with pytest.raises(StreamFailure) as ei:
+        _within(300, _run_stream,
+                _stream_cfg(clip_path, tmp_path / "out.raw", frames=3,
+                            checkpoint_every=1))
+    assert ei.value.stage == "write"
+    assert isinstance(ei.value.__cause__, errors.InjectedFault)
+
+
+# -- autotune cache robustness (satellite) -----------------------------
+
+@pytest.mark.parametrize("payload", [
+    b"garbage{{{",                                   # not JSON at all
+    b"",                                             # empty (crash at 0)
+    b'{"schema_version": 2, "entries": {"a": ',      # truncated mid-write
+    b"[1, 2, 3]",                                    # wrong top-level type
+    b'{"schema_version": 2, "entries": 42}',         # entries not a dict
+])
+def test_autotune_corrupt_cache_loads_cold_with_warning(
+        tmp_path, monkeypatch, payload):
+    from tpu_stencil.runtime import autotune
+
+    path = tmp_path / "autotune.json"
+    path.write_bytes(payload)
+    monkeypatch.setenv("TPU_STENCIL_AUTOTUNE_CACHE", str(path))
+    with pytest.warns(RuntimeWarning, match="unreadable"):
+        assert autotune._load_cache() == {}
+
+
+def test_autotune_missing_cache_is_silent_cold_miss(tmp_path, monkeypatch):
+    from tpu_stencil.runtime import autotune
+
+    monkeypatch.setenv("TPU_STENCIL_AUTOTUNE_CACHE",
+                       str(tmp_path / "absent.json"))
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")  # any warning fails the test
+        assert autotune._load_cache() == {}
+
+
+def test_autotune_store_is_atomic_and_recovers_corruption(
+        tmp_path, monkeypatch):
+    from tpu_stencil.ops import lowering
+    from tpu_stencil.runtime import autotune
+
+    path = tmp_path / "autotune.json"
+    path.write_bytes(b"garbage from a crashed writer")
+    monkeypatch.setenv("TPU_STENCIL_AUTOTUNE_CACHE", str(path))
+    plan = lowering.plan_filter(filters.get_filter("gaussian"))
+    key = autotune._key(plan, (H, W), C)
+    entry = {"backend": "xla", "schedule": None, "block_h": None,
+             "fuse": None}
+    autotune._store_cache({key: entry})
+    # The rewritten file parses clean (no warning) and round-trips.
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        assert autotune._load_cache() == {key: entry}
+    # tmp-then-rename left no stray tmp files behind.
+    assert [p.name for p in tmp_path.iterdir()] == [path.name]
+    raw = json.loads(path.read_text())
+    assert raw["schema_version"] == autotune.SCHEMA_VERSION
+
+
+# -- config + CLI surface ---------------------------------------------
+
+def test_config_validation():
+    with pytest.raises(ValueError, match="dispatch_timeout_s"):
+        JobConfig("i.raw", 8, 8, 1, ImageType.GREY,
+                  dispatch_timeout_s=-1.0)
+    with pytest.raises(ValueError, match="fallback backend"):
+        JobConfig("i.raw", 8, 8, 1, ImageType.GREY,
+                  fallback_backend="gpu")
+    with pytest.raises(ValueError, match="io_retries"):
+        StreamConfig("i.raw", 8, 8, 1, ImageType.GREY, io_retries=-1)
+    with pytest.raises(ValueError, match="max_engine_restarts"):
+        StreamConfig("i.raw", 8, 8, 1, ImageType.GREY,
+                     max_engine_restarts=-1)
+    with pytest.raises(ValueError, match="request_timeout_s"):
+        ServeConfig(request_timeout_s=-0.5)
+
+
+def test_run_cli_rejects_bad_fault_spec(tmp_path):
+    from tpu_stencil.config import parse_args
+
+    img = tmp_path / "i.raw"
+    img.write_bytes(bytes(64))
+    with pytest.raises(SystemExit):
+        parse_args([str(img), "8", "8", "1", "grey", "--faults",
+                    "warp:at=1"])
+
+
+def test_run_cli_parses_resilience_flags(tmp_path):
+    from tpu_stencil.config import parse_args
+
+    img = tmp_path / "i.raw"
+    img.write_bytes(bytes(64))
+    cfg, ns = parse_args([
+        str(img), "8", "8", "1", "grey",
+        "--dispatch-timeout", "30", "--fallback-backend", "cpu",
+        "--faults", "compute:rep=1",
+    ])
+    assert cfg.dispatch_timeout_s == 30.0
+    assert cfg.fallback_backend == "cpu"
+    assert ns.faults == "compute:rep=1"
+
+
+def test_render_resilience_table():
+    from tpu_stencil.obs import breakdown
+
+    assert breakdown.render_resilience({"counters": {}}) == ""
+    assert breakdown.render_resilience(
+        {"counters": {"resilience_retries_total": 0}}) == ""
+    table = breakdown.render_resilience({"counters": {
+        "resilience_retries_total": 3,
+        "resilience_fallbacks_total": 2,
+        "deadline_expired_total": 1,
+    }})
+    assert "retries (backoff taken)" in table
+    assert "schedule/backend demotions" in table
+    assert "deadline-expired requests" in table

@@ -47,13 +47,27 @@ def main(argv=None) -> int:
     # parse_args does no JAX work, so parse first: --help/usage errors must
     # exit without joining a pod rendezvous.
     cfg, ns = parse_args(argv)
+    if ns.faults is not None:
+        # Arm the fault-injection harness (the spec already validated at
+        # parse time). Per-process, like the env var: multi-host chaos
+        # sets TPU_STENCIL_FAULTS on every host instead.
+        from tpu_stencil.resilience import faults as _faults
+
+        _faults.configure(ns.faults)
     if ns.platform:
         # The config API beats a pinned JAX_PLATFORMS env var (a
         # sitecustomize can force-export one); must land before the first
         # backend initialization, i.e. before distributed bring-up.
+        # --fallback-backend cpu keeps the cpu backend registered next
+        # to the pinned platform: the degraded-completion rung needs
+        # jax.devices("cpu") to resolve exactly when the accelerator is
+        # failing — the scenario the flag exists for.
         import jax
 
-        jax.config.update("jax_platforms", ns.platform)
+        platforms = ns.platform
+        if ns.fallback_backend == "cpu" and ns.platform != "cpu":
+            platforms = f"{ns.platform},cpu"
+        jax.config.update("jax_platforms", platforms)
     # Multi-process bring-up precedes the first JAX computation (the
     # MPI_Init-leads-main discipline, mpi/mpi_convolution.c:23). Auto mode:
     # joins a Cloud TPU pod job when the environment defines one, and is a
@@ -224,6 +238,11 @@ def _report_observability(trace_path, breakdown, cfg, result) -> None:
             "in_vmem_depth": steady_depth,
         })
         print(table, end="")
+        # The resilience side table: nonzero fault/retry/demotion/
+        # timeout counters from this run (empty — and unprinted — on a
+        # clean one). Demotions recorded by the fallback ladder land
+        # here AND in resilience_fallbacks_total in --metrics-text.
+        print(obs.breakdown.render_resilience(obs.snapshot()), end="")
         if result.mesh_shape is not None and result.overlap is not None:
             # Sharded runs: the ICI ghost-bytes model next to the
             # measured exchange/interior/border probe spans. fuse=1 and

@@ -74,6 +74,11 @@ def _validate_common(cfg) -> None:
             f"fuse must be a positive rep count (reps per HBM "
             f"round-trip), got {cfg.fuse}"
         )
+    if cfg.dispatch_timeout_s < 0:
+        raise ValueError(
+            f"dispatch_timeout_s must be >= 0 (0 = off / env default), "
+            f"got {cfg.dispatch_timeout_s}"
+        )
 
 
 class ImageType(enum.Enum):
@@ -117,6 +122,17 @@ class JobConfig:
     # phase-probe ratio, cached). Bit-exact across all modes; ignored by
     # single-device runs (no exchange to overlap).
     overlap: str = "off"
+    # Dispatch watchdog window in seconds around every device fence
+    # (tpu_stencil.resilience.deadline): past it a hung dispatch raises
+    # a typed DispatchTimeout instead of hanging forever (the rc=124
+    # dead-tunnel mode). 0 = off, unless TPU_STENCIL_DISPATCH_TIMEOUT
+    # arms an env default.
+    dispatch_timeout_s: float = 0.0
+    # Graceful-degradation completion rung: "cpu" lets the driver finish
+    # a job on the CPU XLA path after every accelerator rung of the
+    # fallback ladder failed — degraded, bit-identical, not dead. None
+    # (default) stops the ladder at the accelerator XLA rung.
+    fallback_backend: Optional[str] = None
     # Accumulation dtype is a property of the backend's plan, not a flag:
     # integer plans accumulate exactly (int16/int32), --backend reference
     # forces the float32 semantics of the C code. A separate dtype knob was
@@ -134,6 +150,11 @@ class JobConfig:
             raise ValueError(
                 f"unknown overlap mode {self.overlap!r}; expected one of "
                 f"{'|'.join(OVERLAP_MODES)}"
+            )
+        if self.fallback_backend not in (None, "cpu"):
+            raise ValueError(
+                f"unknown fallback backend {self.fallback_backend!r}; "
+                f"expected cpu (or omit)"
             )
 
     @property
@@ -191,6 +212,19 @@ class StreamConfig:
     ring_buffers: Optional[int] = None  # host staging ring (None = depth+2)
     checkpoint_every: int = 0  # frame-index checkpoint period (0 = off)
     progress_every: int = 0    # stderr frame-index heartbeat (0 = off)
+    # Dispatch watchdog window (seconds) around the drain's compute
+    # fence — same contract as JobConfig.dispatch_timeout_s.
+    dispatch_timeout_s: float = 0.0
+    # Transient-I/O retries per frame read/write (resilience.retry's
+    # classifier + short-backoff IO_POLICY); only sources/sinks whose
+    # position can be rewound retry (regular files, frame directories).
+    io_retries: int = 2
+    # Mid-stream engine-fault recovery: after a transient h2d/compute/
+    # d2h failure, re-prepare the engine and resume from the frame
+    # checkpoint up to this many times (needs --checkpoint-every and a
+    # restartable source — a regular file or frame directory; a pipe's
+    # consumed frames cannot be re-read). 0 disables.
+    max_engine_restarts: int = 1
 
     def __post_init__(self) -> None:
         _validate_common(self)
@@ -216,6 +250,15 @@ class StreamConfig:
         if self.progress_every < 0:
             raise ValueError(
                 f"progress_every must be >= 0, got {self.progress_every}"
+            )
+        if self.io_retries < 0:
+            raise ValueError(
+                f"io_retries must be >= 0, got {self.io_retries}"
+            )
+        if self.max_engine_restarts < 0:
+            raise ValueError(
+                f"max_engine_restarts must be >= 0, got "
+                f"{self.max_engine_restarts}"
             )
 
     @property
@@ -293,6 +336,11 @@ class ServeConfig:
     # (device_bytes_in_use / peak / limit). 0 disables; backends
     # without allocator stats (CPU) never start the thread regardless.
     mem_sample_interval_s: float = 0.5
+    # Default per-request deadline (seconds; 0 = none): a request whose
+    # deadline expires while queued fails typed (DeadlineExceeded)
+    # instead of occupying a batch slot. submit(deadline_s=...)
+    # overrides per request.
+    request_timeout_s: float = 0.0
 
     def __post_init__(self) -> None:
         if self.backend not in BACKENDS:
@@ -320,6 +368,11 @@ class ServeConfig:
             raise ValueError(
                 f"mem_sample_interval_s must be >= 0 (0 = off), got "
                 f"{self.mem_sample_interval_s}"
+            )
+        if self.request_timeout_s < 0:
+            raise ValueError(
+                f"request_timeout_s must be >= 0 (0 = none), got "
+                f"{self.request_timeout_s}"
             )
         if self.bucket_edges is not None:
             edges = tuple(self.bucket_edges)
@@ -478,6 +531,30 @@ def build_parser() -> argparse.ArgumentParser:
              "program (see docs/OBSERVABILITY.md)",
     )
     p.add_argument(
+        "--dispatch-timeout", dest="dispatch_timeout_s", type=float,
+        default=0.0, metavar="SECONDS",
+        help="watchdog window around every device fence: a dispatch "
+             "still pending past it raises a typed DispatchTimeout "
+             "instead of hanging forever (the dead-tunnel rc=124 mode). "
+             "0 = off, unless TPU_STENCIL_DISPATCH_TIMEOUT sets an env "
+             "default (see docs/RESILIENCE.md)",
+    )
+    p.add_argument(
+        "--fallback-backend", default=None, choices=["cpu"],
+        help="opt-in degraded-completion rung: after every accelerator "
+             "rung of the fallback ladder fails (deep -> default fused "
+             "schedule -> xla), finish the job on the CPU XLA path — "
+             "bit-identical output, recorded in "
+             "resilience_fallbacks_total",
+    )
+    p.add_argument(
+        "--faults", default=None, metavar="SPEC",
+        help="arm the fault-injection harness (chaos testing / failure "
+             "reproduction), e.g. 'compute:rep=3:raise=RuntimeError,"
+             "h2d:p=0.1'; same grammar as TPU_STENCIL_FAULTS, which "
+             "this flag overrides (docs/RESILIENCE.md)",
+    )
+    p.add_argument(
         "--checkpoint-every", type=int, default=0, metavar="N",
         help="checkpoint the frame every N repetitions (0 = off)",
     )
@@ -532,7 +609,18 @@ def parse_args(argv=None) -> Tuple[JobConfig, argparse.Namespace]:
             block_h=ns.block_h,
             fuse=ns.fuse,
             overlap=ns.overlap,
+            dispatch_timeout_s=ns.dispatch_timeout_s,
+            fallback_backend=ns.fallback_backend,
         )
     except ValueError as e:
         parser.error(str(e))
+    if ns.faults is not None:
+        # Validate the spec at parse time (jax-free) so a mistyped chaos
+        # spec dies as a usage error, not mid-job; armed in cli.main.
+        from tpu_stencil.resilience import faults as _faults
+
+        try:
+            _faults.parse_spec(ns.faults)
+        except ValueError as e:
+            parser.error(str(e))
     return cfg, ns

@@ -22,6 +22,10 @@ from tpu_stencil.config import JobConfig
 from tpu_stencil.io import images as images_io
 from tpu_stencil.io import raw as raw_io
 from tpu_stencil.models.blur import IteratedConv2D
+from tpu_stencil.resilience import deadline as _deadline
+from tpu_stencil.resilience import errors as _res_errors
+from tpu_stencil.resilience import fallback as _fallback
+from tpu_stencil.resilience import faults as _faults
 from tpu_stencil.utils.timing import Timer, max_across_processes
 
 
@@ -126,7 +130,11 @@ def prepare_engine(model, imgs: np.ndarray, devices, frames: Optional[int] = Non
     engine's bucket executables mirror (serve adds pad-mask re-zeroing
     for heterogeneous shapes; see tpu_stencil/serve/engine.py).
     """
+    fault_h2d = _faults.site("h2d")
+    fault_compile = _faults.site("compile")
     with obs.phase("place"):
+        if fault_h2d is not None:
+            fault_h2d()
         if frames is not None:
             img_dev, step_fn = _place_frames(model, np.asarray(imgs), devices)
             n_true = frames
@@ -138,6 +146,8 @@ def prepare_engine(model, imgs: np.ndarray, devices, frames: Optional[int] = Non
             step_fn = model
             fetch = np.asarray
     with obs.phase("compile") as s:
+        if fault_compile is not None:
+            fault_compile()
         img_dev = s.fence(step_fn(img_dev, 0))  # warm-up; output == input
     if obs.introspect.enabled():
         # AOT-introspect the program the warm-up just compiled (cost /
@@ -277,17 +287,37 @@ def _checkpointed_iterate(
     img_dev,
     checkpoint_every: int,
     start_rep: int,
+    fault: Optional[Callable] = None,   # resolved "compute" fault site
+    timeout_s: float = 0.0,             # dispatch watchdog (0 = off/env)
 ):
     """Run the remaining reps, checkpointing every N. Returns
     (out_dev, compute_seconds). Checkpoint I/O happens *between* timed
     chunks so the reported compute window stays comparable to the
     reference's (which has no checkpointing); the final state is written as
-    the job output, not as a checkpoint."""
+    the job output, not as a checkpoint.
+
+    ``fault`` is the compute-dispatch injection checker, resolved ONCE
+    by the caller (the hot-path contract: with no faults armed this is
+    a branch on a local None). A launch covering reps [r, r+n) checks
+    the site at EVERY rep index it spans, so ``compute:rep=N`` fires
+    regardless of chunking — the rep loop itself is fused on device and
+    this per-rep host loop only exists while a fault is armed. Every
+    chunk fence runs under the dispatch watchdog: a hung device raises
+    a typed :class:`~tpu_stencil.resilience.errors.DispatchTimeout`
+    instead of parking the job forever."""
+    if fault is not None:
+        inner_run = run_fn
+
+        def run_fn(x, n, _rep=[start_rep]):
+            for r in range(_rep[0], _rep[0] + n):
+                fault(r)
+            _rep[0] += n
+            return inner_run(x, n)
     if not checkpoint_every:
         with Timer() as t:
             out = _reps_spanned(run_fn, img_dev,
                                 cfg.repetitions - start_rep, start_rep)
-            out.block_until_ready()
+            _deadline.fence(out, timeout_s, "driver.iterate")
         return out, t.elapsed
 
     total = 0.0
@@ -296,7 +326,7 @@ def _checkpointed_iterate(
         n = min(checkpoint_every, cfg.repetitions - rep)
         with Timer() as t:
             img_dev = _reps_spanned(run_fn, img_dev, n, rep)
-            img_dev.block_until_ready()
+            _deadline.fence(img_dev, timeout_s, f"driver.iterate[rep={rep}]")
         total += t.elapsed
         rep += n
         if rep < cfg.repetitions:
@@ -378,12 +408,54 @@ def run_job(
                                 checkpoint_every, resume, total_t)
 
         start_rep, frame = _maybe_restore(cfg, resume)
+        fault_read = _faults.site("read")
         with obs.phase("load"):
+            if fault_read is not None:
+                fault_read()
             img = _load_input(cfg) if frame is None else frame
-        img_dev, step_fn, fetch = prepare_engine(
-            model, img, devices,
-            frames=cfg.frames if cfg.frames > 1 else None,
-        )
+        # Graceful degradation ladder: a demotable prepare/compile
+        # failure (VMEM/HBM OOM, Mosaic refusing the tile, a missing
+        # capability) steps deep -> default fused schedule -> xla
+        # (-> opt-in cpu) instead of killing the job — every rung is
+        # bit-identical, each demotion lands in
+        # resilience_fallbacks_total and the --breakdown table.
+        rungs = _fallback.ladder(cfg.backend, cfg.schedule,
+                                 cfg.fallback_backend)
+        for i, rung in enumerate(rungs):
+            if i:
+                # Demoted rung: default geometry too — the failed
+                # compile may have been geometry-induced.
+                model = IteratedConv2D(cfg.filter_name,
+                                       backend=rung.backend,
+                                       schedule=rung.schedule,
+                                       boundary=cfg.boundary)
+            try:
+                if rung.platform is None:
+                    run_devices = devices
+                else:
+                    # Inside the try: with jax_platforms pinned to an
+                    # accelerator only, an unregistered cpu backend must
+                    # surface as a typed rung failure, not a bare
+                    # backend-lookup error masking the original fault.
+                    try:
+                        run_devices = jax.devices(rung.platform)[
+                            :max(1, len(devices))]
+                    except RuntimeError as e:
+                        raise _res_errors.ResilienceError(
+                            f"fallback platform {rung.platform!r} is not "
+                            f"available ({e}); run with --platform "
+                            f"<accel> so the CLI registers cpu alongside,"
+                            f" or set jax_platforms to include cpu"
+                        ) from e
+                img_dev, step_fn, fetch = prepare_engine(
+                    model, img, run_devices,
+                    frames=cfg.frames if cfg.frames > 1 else None,
+                )
+                break
+            except Exception as e:
+                if i + 1 >= len(rungs) or not _fallback.demotable(e):
+                    raise
+                _fallback.record_demotion(rung, rungs[i + 1], e)
         def save_fn(rep, dev):
             from tpu_stencil.runtime import checkpoint as ckpt
 
@@ -394,12 +466,20 @@ def run_job(
                 out_dev, compute = _checkpointed_iterate(
                     cfg, lambda x, n: step_fn(x, n), save_fn,
                     img_dev, checkpoint_every, start_rep,
+                    fault=_faults.site("compute"),
+                    timeout_s=_deadline.resolve(cfg.dispatch_timeout_s),
                 )
+        fault_d2h = _faults.site("d2h")
         with obs.phase("fetch"):
+            if fault_d2h is not None:
+                fault_d2h()
             out = fetch(out_dev)
         _record_device_memory()
         compute_seconds = max_across_processes(compute)
+        fault_write = _faults.site("write")
         with obs.phase("store"):
+            if fault_write is not None:
+                fault_write()
             _store_output(cfg, out)
         _clear_checkpoint(cfg, checkpoint_every, resume)
 
@@ -494,7 +574,9 @@ def _run_frames_multihost(cfg, model, profile_dir, checkpoint_every,
         with _maybe_profile(profile_dir):
             with obs.phase("iterate", reps=cfg.repetitions):
                 out_dev, compute = _checkpointed_iterate(
-                    cfg, step_fn, save_fn, dev, checkpoint_every, start_rep
+                    cfg, step_fn, save_fn, dev, checkpoint_every, start_rep,
+                    fault=_faults.site("compute"),
+                    timeout_s=_deadline.resolve(cfg.dispatch_timeout_s),
                 )
         with obs.phase("fetch"):
             out = fetch(out_dev)  # crop device-multiple padding
@@ -571,8 +653,11 @@ def _run_sharded(cfg, model, devices, profile_dir, checkpoint_every, resume,
         restored = ckpt.restore_sharded(cfg, runner.sharding)
         if restored is not None:
             start_rep, img_dev = restored
+    fault_read = _faults.site("read")
     if img_dev is None:
         with obs.phase("load"):
+            if fault_read is not None:
+                fault_read()
             if images_io.is_raw(cfg.image, sniff=True):
                 # Per-process sharded read: each host touches only the rows
                 # its devices own (the MPI-IO pattern,
@@ -593,7 +678,10 @@ def _run_sharded(cfg, model, devices, profile_dir, checkpoint_every, resume,
     # excludes startup: it opens after MPI_Barrier,
     # mpi/mpi_convolution.c:151-155). A 0-rep run's output equals its input,
     # so it doubles as the timed run's input — no second transfer.
+    fault_compile = _faults.site("compile")
     with obs.phase("compile") as s:
+        if fault_compile is not None:
+            fault_compile()
         img_dev = s.fence(runner.run(img_dev, 0))
     if obs.enabled():
         # Pack/exchange/compute attribution: one measured rep each of the
@@ -608,15 +696,49 @@ def _run_sharded(cfg, model, devices, profile_dir, checkpoint_every, resume,
 
         ckpt.save_sharded(cfg, rep, dev)
 
-    with _maybe_profile(profile_dir):
-        with obs.phase("iterate", reps=cfg.repetitions):
-            out_dev, compute = _checkpointed_iterate(
-                cfg, runner.run, save_fn, img_dev, checkpoint_every,
-                start_rep,
-            )
+    # The sharded compute loop: the "collective" fault site fires at
+    # launch granularity (the halo exchange lives inside the compiled
+    # program — a host-side injection before the launch is the
+    # deterministic stand-in for a wedged exchange), and a watchdog
+    # timeout upgrades to CollectiveTimeout with per-mesh-axis exchange
+    # probe verdicts so the operator learns WHICH edge is stuck.
+    fault_coll = _faults.site("collective")
+    run_fn = runner.run
+    if fault_coll is not None:
+        def run_fn(x, n, _inner=runner.run):
+            fault_coll()
+            return _inner(x, n)
+    timeout_s = _deadline.resolve(cfg.dispatch_timeout_s)
+    try:
+        with _maybe_profile(profile_dir):
+            with obs.phase("iterate", reps=cfg.repetitions):
+                out_dev, compute = _checkpointed_iterate(
+                    cfg, run_fn, save_fn, img_dev, checkpoint_every,
+                    start_rep, fault=_faults.site("compute"),
+                    timeout_s=timeout_s,
+                )
+    except _res_errors.DispatchTimeout as e:
+        edges = {}
+        if jax.process_count() == 1:
+            # Post-mortem per-edge diagnosis, itself watchdogged (a
+            # wedged device must not hang the hang report). Multi-host
+            # skips it: the probes are collective, and ranks that did
+            # not time out would not join them.
+            try:
+                edges = runner.diagnose_edges(timeout_s=min(
+                    10.0, timeout_s or 10.0
+                ))
+            except Exception:
+                pass
+        raise _res_errors.CollectiveTimeout(
+            e.label, e.seconds, edges=edges
+        ) from e
     _record_device_memory()
     compute_seconds = max_across_processes(compute)
+    fault_write = _faults.site("write")
     with obs.phase("store"):
+        if fault_write is not None:
+            fault_write()
         if images_io.is_raw(cfg.output_path):
             distributed.write_sharded(
                 cfg.output_path, out_dev, cfg.height, cfg.width, cfg.channels

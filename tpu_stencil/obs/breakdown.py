@@ -277,6 +277,41 @@ def render_introspection(records: List[dict]) -> str:
     return "\n".join(lines) + "\n"
 
 
+# The resilience counter schema (docs/RESILIENCE.md): registry name ->
+# human row label. Rendered in declaration order; zero/absent counters
+# are omitted — a healthy run prints no table at all.
+_RESILIENCE_COUNTERS = (
+    ("resilience_faults_injected_total", "faults injected"),
+    ("resilience_retries_total", "retries (backoff taken)"),
+    ("resilience_fallbacks_total", "schedule/backend demotions"),
+    ("resilience_dispatch_timeouts_total", "dispatch watchdog timeouts"),
+    ("resilience_stream_restarts_total", "stream engine restarts"),
+    ("resilience_worker_crashes_total", "serve worker crashes"),
+    ("deadline_expired_total", "deadline-expired requests"),
+)
+
+
+def render_resilience(snapshot: dict) -> str:
+    """The ``--breakdown`` resilience side table: every nonzero
+    resilience counter in a registry snapshot (driver or serve), one
+    row each. Returns "" when nothing fired — a clean run stays clean;
+    a run that injected, retried, demoted, timed out, or restarted
+    says so next to the timings it explains."""
+    counters = snapshot.get("counters", {})
+    rows = [
+        (label, counters[name])
+        for name, label in _RESILIENCE_COUNTERS
+        if counters.get(name)
+    ]
+    if not rows:
+        return ""
+    head = f"{'resilience':<32}  {'count':>6}"
+    lines = ["", head, "-" * len(head)]
+    for label, count in rows:
+        lines.append(f"{label:<32}  {count:>6}")
+    return "\n".join(lines) + "\n"
+
+
 def render_memory(stats: Optional[dict]) -> str:
     """One device-memory line from ``device.memory_stats()`` output;
     backends without allocator stats (CPU) say so explicitly instead of

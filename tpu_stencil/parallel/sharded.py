@@ -730,6 +730,68 @@ class ShardedRunner:
             with obs.span("sharded.border_compute", "sharded") as s:
                 s.fence(split_probes[1](img_dev))
 
+    def edge_probes(self):
+        """Per-mesh-axis exchange-only probe programs:
+        ``{"rows": fn, "cols": fn}`` (axes with one device are omitted —
+        nothing to exchange). Each runs ONLY that axis's ppermute ghost
+        traffic, ghosts cropped back off so specs match — the
+        post-mortem instrument :meth:`diagnose_edges` fences one at a
+        time to localize a wedged exchange to its mesh axis."""
+        plan = self.model.plan
+        halo = max(1, plan.halo)
+        spec = (
+            P(ROWS_AXIS, COLS_AXIS) if self.channels == 1
+            else P(ROWS_AXIS, COLS_AXIS, None)
+        )
+        boundary = self.boundary
+        probes = {}
+        for name, axis_name, n, dim in (
+            ("rows", ROWS_AXIS, self.mesh.shape[ROWS_AXIS], 0),
+            ("cols", COLS_AXIS, self.mesh.shape[COLS_AXIS], 1),
+        ):
+            if n <= 1:
+                continue
+
+            def exchange_one(tile, _axes=((axis_name, n, dim),), _dim=dim):
+                ext = halo_exchange(tile, halo, _axes, boundary)
+                crop = [slice(None)] * ext.ndim
+                crop[_dim] = slice(halo, halo + tile.shape[_dim])
+                return ext[tuple(crop)]
+
+            probes[name] = jax.jit(shard_map(
+                exchange_one, mesh=self.mesh, in_specs=(spec,),
+                out_specs=spec,
+            ))
+        return probes
+
+    def diagnose_edges(self, timeout_s: float = 10.0) -> dict:
+        """Per-edge exchange verdicts after a suspected collective hang:
+        run each mesh axis's exchange-only probe on a fresh zero canvas,
+        each under its own watchdog, and report ``"ok"`` / ``"timeout"``
+        / ``"error: <type>"`` per axis — the sharded analog of "which
+        rank is stuck". Bounded by construction: a wedged device costs
+        at most ``timeout_s`` per axis (the abandoned fence thread is a
+        daemon). A fresh canvas, never the job's arrays — those were
+        donated to the launch that hung."""
+        from tpu_stencil.resilience import deadline as _deadline
+        from tpu_stencil.resilience.errors import DispatchTimeout
+
+        shape = self.padded_shape
+        if self.channels != 1:
+            shape = shape + (self.channels,)
+        img = jax.device_put(np.zeros(shape, np.uint8), self.sharding)
+        verdicts = {}
+        for name, fn in self.edge_probes().items():
+            try:
+                _deadline.fence(fn(img), timeout_s,
+                                f"sharded.exchange[{name}]")
+                verdicts[name] = "ok"
+            except DispatchTimeout:
+                verdicts[name] = "timeout"
+            except Exception as e:
+                verdicts[name] = f"error: {type(e).__name__}"
+        return verdicts
+
     def introspect_warmup(self, img_dev: jax.Array, repetitions: int):
         """AOT-introspect the compiled sharded program the warm-up just
         built (cost/memory analysis, compile wall-time — see

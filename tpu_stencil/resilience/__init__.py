@@ -1,0 +1,53 @@
+"""Resilience: fault injection, retry, deadlines/watchdog, degradation.
+
+The reference MPI/CUDA programs abort on any failure; the production
+engines here (driver, serve, stream, sharded) need a systematic failure
+model instead. Four pillars, each its own module (docs/RESILIENCE.md):
+
+* :mod:`~tpu_stencil.resilience.faults` — the fault-injection harness:
+  named injection points at every stage boundary, armed by
+  ``TPU_STENCIL_FAULTS`` / ``--faults``, resolved at engine-prepare time
+  so the no-faults hot path pays nothing.
+* :mod:`~tpu_stencil.resilience.retry` — exponential backoff + jitter
+  with ONE transient-vs-permanent classifier shared by bench, serve,
+  and stream.
+* :mod:`~tpu_stencil.resilience.deadline` — per-request deadlines and
+  the dispatch watchdog that converts a hung ``block_until_ready`` (the
+  rc=124 dead-tunnel mode) into a typed
+  :class:`~tpu_stencil.resilience.errors.DispatchTimeout`.
+* :mod:`~tpu_stencil.resilience.fallback` — the graceful degradation
+  ladder: deep -> default fused schedule -> XLA (-> opt-in CPU),
+  bit-identical at every rung.
+
+Everything is observable: ``resilience_*`` counters in the driver
+registry, ``resilience.*`` spans under tracing, and the ``--breakdown``
+resilience table. Jax-free at import (CLI validation runs before
+backend bring-up); jax is only touched inside a watchdog fence.
+"""
+
+from tpu_stencil.resilience import deadline, fallback, faults, retry
+from tpu_stencil.resilience.errors import (
+    CollectiveTimeout,
+    DeadlineExceeded,
+    DispatchTimeout,
+    FatalInjectedFault,
+    InjectedFault,
+    InjectedOOM,
+    ResilienceError,
+    WorkerCrashed,
+)
+
+__all__ = [
+    "CollectiveTimeout",
+    "DeadlineExceeded",
+    "DispatchTimeout",
+    "FatalInjectedFault",
+    "InjectedFault",
+    "InjectedOOM",
+    "ResilienceError",
+    "WorkerCrashed",
+    "deadline",
+    "fallback",
+    "faults",
+    "retry",
+]

@@ -1,0 +1,122 @@
+"""Deadlines and the dispatch watchdog.
+
+The worst production failure the engines have actually hit is not an
+exception — it is silence: a dead TPU tunnel leaves ``block_until_ready``
+parked forever (the r03–r05 bench rounds' rc=124 harness timeouts, now
+carried in ROADMAP). Two primitives convert silence into typed errors:
+
+* :func:`fence` — the watchdog spelling of ``jax.block_until_ready``:
+  drain the dispatch on a daemon thread and wait at most ``timeout_s``;
+  past it, raise :class:`~.errors.DispatchTimeout` naming the fence
+  point. The hung dispatch itself cannot be cancelled — the daemon
+  thread is abandoned — but the caller gets control back, typed, which
+  is the difference between "the job failed at iterate" and an operator
+  killing a 2-hour-silent process. Used by the driver's chunk fences,
+  the stream drain's compute fence, and the sharded path (which
+  upgrades the timeout to :class:`~.errors.CollectiveTimeout` with
+  per-edge probe verdicts).
+* :class:`Deadline` — an absolute time budget (serve's per-request
+  deadlines): cheap ``expired()`` checks at scheduling points, so an
+  expired request fails typed instead of occupying a batch slot.
+
+``timeout_s=0`` (the default) disables the watchdog: the fence is then
+exactly ``block_until_ready``, no thread, no overhead. The env default
+``TPU_STENCIL_DISPATCH_TIMEOUT`` arms every fence that was not given an
+explicit config value — the operator's one-line guard for unattended
+runs. Timeouts increment ``resilience_dispatch_timeouts_total``.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Optional
+
+from tpu_stencil.resilience.errors import DispatchTimeout
+
+ENV_VAR = "TPU_STENCIL_DISPATCH_TIMEOUT"
+
+
+def default_timeout() -> float:
+    """The env-configured watchdog window in seconds (0 = off)."""
+    try:
+        return max(0.0, float(os.environ.get(ENV_VAR, "0") or "0"))
+    except ValueError:
+        return 0.0
+
+
+def resolve(cfg_timeout_s: Optional[float]) -> float:
+    """A config field's effective window: the explicit value when set
+    (> 0), else the env default — so ``--dispatch-timeout`` wins and an
+    unset flag still honors the operator's env guard."""
+    if cfg_timeout_s and cfg_timeout_s > 0:
+        return float(cfg_timeout_s)
+    return default_timeout()
+
+
+def _block(x):
+    """``block_until_ready`` for a single array OR a pytree. The method
+    is preferred when present (it also lets tests hand in a stub whose
+    ``block_until_ready`` hangs — the only way to exercise the watchdog
+    without a dead TPU)."""
+    blocker = getattr(x, "block_until_ready", None)
+    if blocker is not None:
+        blocker()
+        return x
+    import jax
+
+    return jax.block_until_ready(x)
+
+
+def fence(x, timeout_s: Optional[float] = None, label: str = "dispatch"):
+    """Drain ``x`` (``block_until_ready``) under a watchdog: returns
+    ``x`` when the device finishes within ``timeout_s`` seconds, raises
+    :class:`DispatchTimeout` otherwise. ``timeout_s`` None means the
+    env default; 0 disables the watchdog entirely (plain blocking
+    drain — no thread is spawned)."""
+    t = default_timeout() if timeout_s is None else timeout_s
+    if not t or t <= 0:
+        return _block(x)
+    done = threading.Event()
+    box: dict = {}
+
+    def drain() -> None:
+        try:
+            box["value"] = _block(x)
+        except BaseException as e:  # surfaced to the caller below
+            box["error"] = e
+        finally:
+            done.set()
+
+    th = threading.Thread(target=drain, name=f"tpu-stencil-fence-{label}",
+                          daemon=True)
+    th.start()
+    if not done.wait(t):
+        from tpu_stencil import obs
+
+        obs.registry().counter("resilience_dispatch_timeouts_total").inc()
+        raise DispatchTimeout(label, t)
+    if "error" in box:
+        raise box["error"]
+    return box["value"]
+
+
+class Deadline:
+    """An absolute wall-clock budget. ``Deadline.after(s)`` starts one;
+    ``remaining()``/``expired()`` are lock-free clock reads."""
+
+    __slots__ = ("t_end",)
+
+    def __init__(self, t_end: float) -> None:
+        self.t_end = t_end
+
+    @classmethod
+    def after(cls, seconds: float) -> "Deadline":
+        return cls(time.perf_counter() + seconds)
+
+    def remaining(self) -> float:
+        return self.t_end - time.perf_counter()
+
+    def expired(self) -> bool:
+        return time.perf_counter() > self.t_end

@@ -1,0 +1,108 @@
+"""The typed failure vocabulary of the resilience subsystem.
+
+The reference programs abort on any failure (SURVEY.md §5: a bad
+``MPI_File_read`` or a CUDA error is a ``perror`` + ``exit``); before
+this subsystem, so did the engines here — with one extra failure mode
+the reference never had: a dead TPU tunnel *hangs* a dispatch silently
+(the r03–r05 bench rounds' rc=124 mode). Every error an engine can now
+surface deliberately is a class in this module, so callers (and the
+chaos suite) can assert "failed typed" instead of pattern-matching
+messages — the contract ``tests/test_resilience.py`` enforces for every
+(injection point x engine) pair: finish bit-exact after recovery, or
+raise one of these before the deadline. Never hang, never corrupt.
+
+Jax-free by design (the CLI layers import it before backend bring-up).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+class ResilienceError(RuntimeError):
+    """Base class of every typed failure the resilience layer raises."""
+
+
+class InjectedFault(ResilienceError):
+    """A fault fired by the injection harness (:mod:`.faults`).
+
+    Classified *transient* by the retry classifier — chaos tests assert
+    that one injected failure plus the production retry/fallback path
+    yields a bit-exact result, which requires the injection to look like
+    the transient errors it stands in for. ``point``/``index`` name the
+    injection site and the call index that fired."""
+
+    point: Optional[str] = None
+    index: Optional[int] = None
+
+
+class InjectedOOM(InjectedFault):
+    """An injected resource-exhaustion failure. The message carries the
+    ``RESOURCE_EXHAUSTED`` token the real XLA allocator errors carry, so
+    the same classifiers (retry's transient test, fallback's demotable
+    test) handle the injected and the real failure identically."""
+
+    def __init__(self, msg: str = "") -> None:
+        super().__init__(
+            f"RESOURCE_EXHAUSTED: injected VMEM/HBM OOM{': ' if msg else ''}"
+            f"{msg}"
+        )
+
+
+class FatalInjectedFault(BaseException):
+    """An injected failure that deliberately escapes ``except Exception``
+    handlers — the stand-in for a worker thread dying outright (the
+    failure mode the serve engine's :class:`WorkerCrashed` propagation
+    exists for). A ``BaseException`` on purpose: per-batch catch-alls
+    must NOT absorb it, exactly like they cannot absorb a real
+    interpreter-level thread death."""
+
+    point: Optional[str] = None
+    index: Optional[int] = None
+
+
+class DispatchTimeout(ResilienceError):
+    """A device dispatch did not complete within the watchdog window —
+    the rc=124 hung-tunnel mode, converted from an indefinite hang into
+    a typed error (:func:`tpu_stencil.resilience.deadline.fence`).
+
+    The hung dispatch itself cannot be cancelled (the watchdog abandons
+    a daemon thread parked in ``block_until_ready``); what the timeout
+    buys is that the *caller* gets control back, typed."""
+
+    def __init__(self, label: str, seconds: float) -> None:
+        super().__init__(
+            f"device dispatch {label!r} still pending after {seconds:g}s "
+            "watchdog window (hung device / dead tunnel?)"
+        )
+        self.label = label
+        self.seconds = seconds
+
+
+class CollectiveTimeout(DispatchTimeout):
+    """A sharded-mesh dispatch timed out. ``edges`` carries the per-mesh-
+    axis exchange-probe verdicts (``{"rows": "ok"|"timeout"|..., "cols":
+    ...}``) when a post-mortem diagnosis could run — which edge's ghost
+    traffic is wedged, the sharded analog of "which rank is stuck"."""
+
+    def __init__(self, label: str, seconds: float,
+                 edges: Optional[dict] = None) -> None:
+        super().__init__(label, seconds)
+        self.edges = dict(edges or {})
+        if self.edges:
+            self.args = (
+                f"{self.args[0]} (per-edge exchange probes: {self.edges})",
+            )
+
+
+class DeadlineExceeded(ResilienceError):
+    """A request's deadline expired before it was served (serve's
+    per-request deadlines). Permanent by classification: retrying the
+    same expired request can only expire again."""
+
+
+class WorkerCrashed(ResilienceError):
+    """The serve engine's worker thread died from an unhandled
+    exception. Every queued and in-flight future fails with this (they
+    would otherwise wait forever), and subsequent submits are rejected
+    with it — a crashed server stays typed-dead until reconstructed."""

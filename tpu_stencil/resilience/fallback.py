@@ -1,0 +1,121 @@
+"""Graceful degradation ladder: demote the schedule/backend instead of
+dying.
+
+The deep temporal-blocking schedule buys its bandwidth by holding whole
+blocks resident in VMEM — which is exactly the configuration most
+likely to fail compilation on a shape the feasibility model mispriced
+(VMEM OOM, Mosaic refusing a tile). A compile failure used to kill the
+job; now the driver walks a ladder of strictly-cheaper configurations:
+
+    deep  ->  default fused Pallas per-rep schedule  ->  XLA lowering
+    (and, opt-in via ``--fallback-backend cpu``, a final CPU rung that
+    completes the job degraded rather than dead)
+
+Every rung produces bit-identical output — the ladder trades speed,
+never semantics — so a demoted run is a slower correct run, not a
+different answer. Each demotion increments
+``resilience_fallbacks_total``, records a ``resilience.demote`` span
+(from/to/error), logs one stderr line, and shows up in the
+``--breakdown`` resilience table.
+
+:func:`demotable` decides which failures step the ladder: resource
+exhaustion (VMEM/HBM OOM), Mosaic/compile errors, capability guards
+(``NotImplementedError`` — e.g. Pallas missing from the build), and
+injected faults (the chaos suite drives the ladder with ``raise=oom``).
+Data/validation errors do NOT demote: a bad shape fails identically on
+every rung, and burning three compiles to discover that helps no one.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import sys
+from typing import Optional, Tuple
+
+from tpu_stencil.resilience.errors import InjectedFault
+
+# Message tokens marking a compile/resource failure a cheaper
+# configuration may survive (XLA allocator + Mosaic vocabularies).
+_DEMOTABLE_TOKENS = (
+    "RESOURCE_EXHAUSTED", "out of memory", "OOM", "VMEM", "vmem",
+    "HBM", "Mosaic", "mosaic", "exceeds the memory",
+    "Attempting to allocate",
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Rung:
+    """One ladder step: the (backend, schedule) to try, optionally on a
+    different platform (the CPU completion rung)."""
+
+    backend: str
+    schedule: Optional[str] = None
+    platform: Optional[str] = None
+
+    @property
+    def label(self) -> str:
+        name = self.backend
+        if self.schedule:
+            name += f"[{self.schedule}]"
+        if self.platform:
+            name += f"@{self.platform}"
+        return name
+
+
+def ladder(backend: str, schedule: Optional[str] = None,
+           fallback_backend: Optional[str] = None) -> Tuple[Rung, ...]:
+    """The demotion sequence for a requested configuration, most capable
+    first. Forced schedules drop first (deep -> the default fused
+    per-rep schedule), then the backend drops to the XLA lowering —
+    always available, always bit-identical. ``fallback_backend='cpu'``
+    appends the opt-in degraded-completion rung."""
+    rungs = [Rung(backend, schedule)]
+    if backend in ("auto", "autotune", "pallas"):
+        if schedule is not None:
+            # Same backend, default schedule: the failure may be the
+            # schedule's (deep's VMEM residency), not the kernel's.
+            rungs.append(Rung(backend, None))
+        rungs.append(Rung("xla", None))
+    if fallback_backend == "cpu":
+        rungs.append(Rung("xla", None, platform="cpu"))
+    # Dedupe consecutive equal rungs (e.g. backend='xla' with a cpu rung).
+    out = [rungs[0]]
+    for r in rungs[1:]:
+        if r != out[-1]:
+            out.append(r)
+    return tuple(out)
+
+
+def demotable(exc: BaseException) -> bool:
+    """Whether a cheaper rung might survive this failure. Distinct from
+    :func:`tpu_stencil.resilience.retry.classify`: that asks "will the
+    SAME configuration succeed if retried", this asks "will a CHEAPER
+    configuration succeed" — NotImplementedError is permanent there and
+    demotable here."""
+    if isinstance(exc, InjectedFault):
+        # Injected resource exhaustion (raise=oom) demotes wherever it
+        # fires; a plain injected fault demotes only at the compile
+        # boundary — an injected h2d/read blip must surface typed, not
+        # vanish into a silent backend change.
+        return (str(exc).startswith("RESOURCE_EXHAUSTED")
+                or exc.point in (None, "compile"))
+    if isinstance(exc, (MemoryError, NotImplementedError)):
+        return True
+    msg = str(exc)
+    return any(tok in msg for tok in _DEMOTABLE_TOKENS)
+
+
+def record_demotion(frm: Rung, to: Rung, exc: BaseException) -> None:
+    """One demotion: counter + span + a stderr line an operator can
+    grep. Called once per ladder step actually taken."""
+    from tpu_stencil import obs
+
+    obs.registry().counter("resilience_fallbacks_total").inc()
+    with obs.span("resilience.demote", "resilience",
+                  frm=frm.label, to=to.label, error=type(exc).__name__):
+        pass  # zero-duration marker: the ladder stepped here
+    print(
+        f"resilience: demoting {frm.label} -> {to.label} after "
+        f"{type(exc).__name__}: {exc}",
+        file=sys.stderr, flush=True,
+    )

@@ -1,0 +1,185 @@
+"""Shared retry policy: exponential backoff + jitter, one transient-vs-
+permanent classifier for the whole tree.
+
+Before this module the only retry logic lived in
+``runtime/bench_sweep.py`` (retry everything except NotImplementedError)
+and ``bench.py``'s parent ladder (retry every child failure except the
+rc=2 backend-unavailable contract) — each with its own inline
+classification. Now bench, serve, and stream share :func:`classify`:
+
+* **transient** (worth a backoff + retry): tunnel/transport drops
+  (``UNAVAILABLE``, connection resets), allocator pressure
+  (``RESOURCE_EXHAUSTED`` — the very next attempt may land after a
+  neighbor frees HBM), hung-dispatch timeouts
+  (:class:`~.errors.DispatchTimeout` — the bench rc=124 mode, where the
+  tunnel usually recovers), queue backpressure (``QueueFull``), I/O
+  errors without a permanent errno, and injected faults (chaos tests
+  assert the production retry path recovers from them).
+* **permanent** (retrying burns the backoff budget for nothing):
+  capability guards (``NotImplementedError``), shape/validation errors
+  (``ValueError``/``TypeError``), expired deadlines, missing files, and
+  XLA's ``INVALID_ARGUMENT``/``UNIMPLEMENTED`` family.
+
+Unknown exceptions default to transient — the historical bench_sweep
+behavior, and the right bias for a harness whose dominant real failure
+is a flaky tunnel.
+
+Backoff is exponential with decorrelating jitter so N clients that
+failed together do not retry together (the thundering-herd shape the
+serve queue would otherwise see). Every retry increments
+``resilience_retries_total`` and (under tracing) records a
+``resilience.retry`` span covering the backoff sleep.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import errno as _errno
+import os
+import random
+import time
+from typing import Callable, Optional
+
+from tpu_stencil.resilience.errors import (
+    DeadlineExceeded,
+    DispatchTimeout,
+    InjectedFault,
+)
+
+TRANSIENT = "transient"
+PERMANENT = "permanent"
+
+# Message tokens that mark a failure class regardless of exception type
+# (XLA/PJRT errors all surface as RuntimeError/XlaRuntimeError with a
+# status token in the text).
+_TRANSIENT_TOKENS = (
+    "RESOURCE_EXHAUSTED", "UNAVAILABLE", "DEADLINE_EXCEEDED", "ABORTED",
+    "CANCELLED", "connection reset", "transfer", "temporarily",
+    "out of memory",
+)
+_PERMANENT_TOKENS = (
+    "INVALID_ARGUMENT", "UNIMPLEMENTED", "FAILED_PRECONDITION",
+)
+_PERMANENT_TYPES = (
+    NotImplementedError, TypeError, AssertionError, AttributeError,
+    KeyError, IndexError, ArithmeticError,
+)
+# OSError errnos that no retry can fix (the path/permission family);
+# everything else (EIO, EAGAIN, EINTR, ...) is worth another attempt.
+_PERMANENT_ERRNOS = frozenset(
+    getattr(_errno, name) for name in
+    ("ENOENT", "EACCES", "EPERM", "EISDIR", "ENOTDIR", "EEXIST", "EROFS")
+    if hasattr(_errno, name)
+)
+# Backpressure/overload signals classified by type NAME: the classes
+# live in tpu_stencil.serve.engine, which imports this package — naming
+# them here by string keeps the dependency one-way.
+_TRANSIENT_TYPE_NAMES = frozenset({"QueueFull"})
+
+
+def classify(exc: BaseException) -> str:
+    """``"transient"`` (retry may succeed) or ``"permanent"`` (it
+    cannot). See the module docstring for the taxonomy."""
+    if isinstance(exc, DeadlineExceeded):
+        return PERMANENT  # an expired request can only expire again
+    if isinstance(exc, (InjectedFault, DispatchTimeout)):
+        return TRANSIENT
+    if type(exc).__name__ in _TRANSIENT_TYPE_NAMES:
+        return TRANSIENT
+    msg = str(exc)
+    if any(tok in msg for tok in _PERMANENT_TOKENS):
+        return PERMANENT
+    if any(tok in msg for tok in _TRANSIENT_TOKENS):
+        return TRANSIENT
+    if isinstance(exc, OSError):
+        return (
+            PERMANENT if exc.errno in _PERMANENT_ERRNOS else TRANSIENT
+        )
+    if isinstance(exc, _PERMANENT_TYPES + (ValueError,)):
+        return PERMANENT
+    return TRANSIENT
+
+
+def is_transient(exc: BaseException) -> bool:
+    return classify(exc) == TRANSIENT
+
+
+def transient_returncode(rc: Optional[int]) -> bool:
+    """The subprocess spelling of :func:`classify`, for supervisors that
+    retry child *processes* (bench.py's capture ladder): rc=2 is the
+    documented backend-unavailable contract (a dead backend cannot come
+    back within a backoff window — retrying it is how round 5 ran the
+    harness into its rc=124 timeout), everything else — including a
+    killed/timed-out child (rc None or negative) — is worth the retry."""
+    return rc != 2
+
+
+# Entropy-seeded by default — N processes that failed together must NOT
+# draw identical jitter and retry in lockstep (the herd the jitter
+# exists to break). TPU_STENCIL_RETRY_SEED pins it for replayable tests.
+_seed = os.environ.get("TPU_STENCIL_RETRY_SEED")
+_jitter_rng = random.Random(int(_seed)) if _seed else random.Random()
+del _seed
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """Exponential backoff with jitter. ``attempts`` counts total tries
+    (1 = no retry). Delay before retry k (0-based) is
+    ``min(base_delay * multiplier**k, max_delay)`` scaled by a random
+    factor in ``[1 - jitter, 1 + jitter]``."""
+
+    attempts: int = 3
+    base_delay: float = 0.5
+    multiplier: float = 2.0
+    max_delay: float = 30.0
+    jitter: float = 0.1
+
+    def delay(self, attempt: int) -> float:
+        d = min(self.base_delay * self.multiplier ** attempt, self.max_delay)
+        if self.jitter > 0.0:
+            d *= 1.0 + self.jitter * (2.0 * _jitter_rng.random() - 1.0)
+        return max(0.0, d)
+
+
+DEFAULT_POLICY = RetryPolicy()
+
+# The stream engine's reader/writer I/O policy: short delays (a frame
+# pipeline must not park for 30s on one flaky read) with the same shape.
+IO_POLICY = RetryPolicy(attempts=3, base_delay=0.05, multiplier=2.0,
+                        max_delay=1.0)
+
+
+def retry_call(
+    fn: Callable,
+    policy: Optional[RetryPolicy] = None,
+    classify_fn: Callable[[BaseException], str] = classify,
+    on_retry: Optional[Callable[[int, BaseException], None]] = None,
+    label: str = "",
+):
+    """Call ``fn()`` under ``policy``: permanent failures raise
+    immediately, transient ones back off and retry until the attempt
+    budget runs out (the last error raises). ``on_retry(attempt, exc)``
+    runs before each backoff — rewind/cleanup hooks live there (a hook
+    that raises aborts the retry loop with its own error, which is how
+    callers impose an overall deadline)."""
+    policy = policy or DEFAULT_POLICY
+    last: Optional[BaseException] = None
+    for attempt in range(max(1, policy.attempts)):
+        try:
+            return fn()
+        except Exception as e:
+            last = e
+            if (attempt + 1 >= max(1, policy.attempts)
+                    or classify_fn(e) != TRANSIENT):
+                raise
+            from tpu_stencil import obs
+
+            obs.registry().counter("resilience_retries_total").inc()
+            if on_retry is not None:
+                on_retry(attempt, e)
+            with obs.span("resilience.retry", "resilience",
+                          attempt=attempt, label=label,
+                          error=type(e).__name__):
+                time.sleep(policy.delay(attempt))
+    raise last  # unreachable (the loop always returns or raises)

@@ -83,20 +83,47 @@ def _entry_jax_version(key: str) -> Optional[str]:
     return parts[idx] if len(parts) > idx else None
 
 
+def _corrupt_cache_warning(path: str, why: str) -> None:
+    """A corrupted/truncated cache file (e.g. a crash mid-write by a
+    pre-atomic writer, or a half-synced home dir) must load as a cold
+    miss — tuning re-measures and the next store rewrites a good file —
+    but never silently: the operator should learn their warm cache is
+    gone before a surprise re-tune bill, not after."""
+    import warnings
+
+    warnings.warn(
+        f"autotune cache at {path} is unreadable ({why}); treating it "
+        "as cold — verdicts re-measure and the next store rewrites it",
+        RuntimeWarning,
+        stacklevel=3,
+    )
+
+
 def _load_cache() -> dict:
     """The cache's entries dict, migrated from either on-disk format
     (versioned wrapper or the legacy flat object) and filtered to keys
     whose embedded jax version matches the running stack — stale-version
-    verdicts must neither answer nor accumulate."""
+    verdicts must neither answer nor accumulate. Garbage/empty/partial
+    files (crash mid-write) load as a cold miss with a warning, never an
+    exception — a corrupted cache must cost a re-measure, not the job."""
+    path = _cache_path()
     try:
-        with open(_cache_path()) as f:
+        with open(path) as f:
             raw = json.load(f)
-    except (OSError, ValueError):
+    except FileNotFoundError:
+        return {}  # cold cache: the normal first-run state, no warning
+    except (OSError, ValueError) as e:
+        _corrupt_cache_warning(path, f"{type(e).__name__}: {e}")
         return {}
     if not isinstance(raw, dict):
+        _corrupt_cache_warning(path, f"top-level {type(raw).__name__}, "
+                               "expected object")
         return {}
     entries = raw.get("entries") if "schema_version" in raw else raw
     if not isinstance(entries, dict):
+        _corrupt_cache_warning(
+            path, "entries is not an object"
+        )
         return {}
     import jax
 

@@ -173,22 +173,27 @@ def _pallas_label(filter_name: str, frame_h: int,
 
 
 def _with_retries(measure_fn, label: str, retries: int = 2):
-    """Run one measurement with retry/backoff: transient tunnel drops must
-    not kill a (possibly hours-long) sweep. Deterministic capability
-    errors (NotImplementedError guards) can never succeed on retry and
-    fail fast instead of burning the backoff budget."""
-    last = None
-    for attempt in range(retries + 1):
-        try:
-            return measure_fn()
-        except NotImplementedError:
-            raise
-        except Exception as e:
-            last = e
-            print(f"row {label} attempt {attempt} failed: "
-                  f"{type(e).__name__}: {e}", file=sys.stderr, flush=True)
-            time.sleep(15 * (attempt + 1))
-    raise last
+    """Run one measurement under the shared retry policy
+    (:mod:`tpu_stencil.resilience.retry`): transient tunnel drops must
+    not kill a (possibly hours-long) sweep, while deterministic
+    failures — capability guards (NotImplementedError), shape/validation
+    errors — can never succeed on retry and fail fast instead of burning
+    the backoff budget. The classifier is the same one serve and stream
+    use, so "what bench retries" can never drift from "what the engines
+    retry"."""
+    from tpu_stencil.resilience import retry as _retry
+
+    def on_retry(attempt, e):
+        print(f"row {label} attempt {attempt} failed: "
+              f"{type(e).__name__}: {e}", file=sys.stderr, flush=True)
+
+    return _retry.retry_call(
+        measure_fn,
+        policy=_retry.RetryPolicy(attempts=retries + 1, base_delay=15.0,
+                                  multiplier=2.0, max_delay=120.0),
+        on_retry=on_retry,
+        label=f"bench_sweep[{label}]",
+    )
 
 
 def _row(img, filter_name, mode, size_label, backend, budget_s, reps,

@@ -81,8 +81,22 @@ def _commit_meta(cfg: JobConfig, rep: int, versioned: str) -> None:
             os.remove(name)
 
 
+def _checkpoint_fault(index: int) -> None:
+    """The ``checkpoint`` injection point (resilience.faults): checked
+    at every checkpoint commit so chaos tests exercise the
+    crash-mid-save path the atomic tmp-then-rename discipline exists
+    for. site() resolution is per commit, not per rep — checkpoints are
+    already host-sync points, never the hot path."""
+    from tpu_stencil.resilience import faults as _faults
+
+    site = _faults.site("checkpoint")
+    if site is not None:
+        site(index)
+
+
 def save(cfg: JobConfig, rep: int, frame: np.ndarray) -> None:
     """Atomically persist the frame as the state after ``rep`` repetitions."""
+    _checkpoint_fault(rep)
     data_path, meta_path = _paths(cfg)
     tmp = data_path + ".tmp"
     arr = np.ascontiguousarray(np.asarray(frame, np.uint8))
@@ -279,6 +293,7 @@ def save_stream_progress(cfg, frames_done: int) -> None:
     the sink. No frame payload — unlike the rep checkpoints, a stream's
     completed frames already live in the output; progress is one
     integer plus the fingerprint."""
+    _checkpoint_fault(int(frames_done))
     path = _stream_paths(cfg)
     meta = dict(_stream_fingerprint(cfg), frames_done=int(frames_done))
     tmp = path + ".tmp"

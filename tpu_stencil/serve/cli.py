@@ -64,6 +64,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "single-device (no ghost exchange), so modes other "
                         "than off are accepted but inert until a "
                         "spatially-sharded serve path lands")
+    p.add_argument("--request-timeout", dest="request_timeout_s",
+                   type=float, default=0.0, metavar="SECONDS",
+                   help="per-request deadline: a request still queued "
+                        "past it fails typed (DeadlineExceeded) instead "
+                        "of occupying a batch slot (0 = none; "
+                        "docs/RESILIENCE.md)")
+    p.add_argument("--faults", default=None, metavar="SPEC",
+                   help="arm the fault-injection harness (chaos testing "
+                        "/ failure reproduction); same grammar as "
+                        "TPU_STENCIL_FAULTS, which this flag overrides")
     p.add_argument("--max-queue", type=int, default=256,
                    help="bounded queue depth; beyond it submissions are "
                         "rejected (default 256)")
@@ -193,6 +203,13 @@ def _export_trace(path: str) -> None:
 def main(argv=None) -> int:
     parser = build_parser()
     ns = parser.parse_args(argv)
+    if ns.faults is not None:
+        from tpu_stencil.resilience import faults as _faults
+
+        try:
+            _faults.configure(ns.faults)
+        except ValueError as e:
+            parser.error(str(e))
     if ns.platform:
         import jax
 
@@ -234,6 +251,7 @@ def main(argv=None) -> int:
             filter_name=ns.filter_name, backend=ns.backend,
             max_queue=ns.max_queue, max_batch=ns.max_batch,
             overlap=ns.overlap,
+            request_timeout_s=ns.request_timeout_s,
         )
     except ValueError as e:
         parser.error(str(e))

@@ -47,6 +47,9 @@ import numpy as np
 from tpu_stencil.config import ServeConfig
 from tpu_stencil.obs import introspect as _introspect
 from tpu_stencil.obs import span as _obs_span
+from tpu_stencil.resilience import faults as _faults
+from tpu_stencil.resilience import retry as _retry
+from tpu_stencil.resilience.errors import DeadlineExceeded, WorkerCrashed
 from tpu_stencil.serve import bucketing
 from tpu_stencil.serve.metrics import Registry
 
@@ -91,6 +94,10 @@ class Request:
     bucket_hw: Tuple[int, int]
     future: concurrent.futures.Future
     t_submit: float
+    # Absolute perf_counter deadline (None = none): expired requests
+    # fail typed (DeadlineExceeded) at batch formation instead of
+    # occupying a batch slot.
+    t_deadline: Optional[float] = None
 
 
 def _mask_valid(imgs, valid_h, valid_w):
@@ -283,6 +290,27 @@ class StencilServer:
         self._closing = False
         self._ids = itertools.count()
         self._worker: Optional[threading.Thread] = None
+        # Worker-death propagation: when the worker thread dies from an
+        # unhandled exception this holds it; every queued/in-flight
+        # future fails with a typed WorkerCrashed and subsequent
+        # submits are rejected with it (a crashed server stays
+        # typed-dead until reconstructed).
+        self._crashed: Optional[BaseException] = None
+        # In-flight dispatched batches, owned by the worker loop but an
+        # instance attribute so the death handler can fail their
+        # futures (a local deque would strand them forever). Same for
+        # the batch currently being dispatched/retired: it is neither
+        # pending nor in-flight while the worker holds it, and a death
+        # mid-dispatch must not strand it.
+        self._inflight_batches: "collections.deque" = collections.deque()
+        self._current_batch: List[Request] = []
+        # Fault-injection sites resolved ONCE at construction (the
+        # hot-path contract: with no faults armed every per-batch check
+        # is a branch on a captured None).
+        self._fault_h2d = _faults.site("h2d")
+        self._fault_d2h = _faults.site("d2h")
+        self._fault_compute = _faults.site("compute")
+        self._fault_compile = _faults.site("compile")
         # Compile-site introspection bookkeeping: cache keys whose
         # executable has been AOT-introspected (one capture per entry,
         # only while introspection is armed — see _dispatch_inner).
@@ -305,6 +333,8 @@ class StencilServer:
         self._m_real = m.counter("image_pixels_total")
         self._m_depth = m.gauge("queue_depth")
         self._m_inflight = m.gauge("inflight_batches")
+        self._m_deadline = m.counter("deadline_expired_total")
+        self._m_crashes = m.counter("resilience_worker_crashes_total")
         self._m_qwait = m.histogram("queue_wait_seconds")
         self._m_blat = m.histogram("batch_latency_seconds")
         self._m_rlat = m.histogram("request_latency_seconds")
@@ -369,12 +399,19 @@ class StencilServer:
     # -- submission ----------------------------------------------------
 
     def submit(self, image: np.ndarray, reps: int,
-               filter_name: Optional[str] = None
+               filter_name: Optional[str] = None,
+               deadline_s: Optional[float] = None,
                ) -> "concurrent.futures.Future":
         """Enqueue one request; returns a Future resolving to the blurred
         uint8 array (same shape as ``image``). Raises :class:`QueueFull`
-        when the queue is at capacity and :class:`ServerClosed` after
-        ``close()``."""
+        when the queue is at capacity, :class:`ServerClosed` after
+        ``close()``, and
+        :class:`~tpu_stencil.resilience.errors.WorkerCrashed` when the
+        worker thread died. ``deadline_s`` (default
+        ``cfg.request_timeout_s``; 0/None = none) bounds how long the
+        request may wait: expired requests fail typed with
+        :class:`~tpu_stencil.resilience.errors.DeadlineExceeded` at
+        batch formation instead of occupying a batch slot."""
         image = np.asarray(image)  # no copy yet: validate + gate first
         if image.dtype != np.uint8:
             raise ValueError(f"image must be uint8, got {image.dtype}")
@@ -403,11 +440,17 @@ class StencilServer:
         # the key by contract so a future f32 path can't alias entries.
         key = (fname, bucket_hw, channels, str(image.dtype),
                self.cfg.backend, int(reps))
+        if deadline_s is None:
+            deadline_s = self.cfg.request_timeout_s
+        if deadline_s is not None and deadline_s < 0:
+            raise ValueError(f"deadline_s must be >= 0, got {deadline_s}")
         fut: concurrent.futures.Future = concurrent.futures.Future()
+        now = time.perf_counter()
         req = Request(
             req_id=next(self._ids), image=image, reps=int(reps),
             filter_name=fname, key=key, bucket_hw=bucket_hw, future=fut,
-            t_submit=time.perf_counter(),
+            t_submit=now,
+            t_deadline=(now + deadline_s) if deadline_s else None,
         )
         with _obs_span("serve.enqueue", "serve", req_id=req.req_id):
             with self._cond:
@@ -418,10 +461,56 @@ class StencilServer:
                 self._cond.notify()
         return fut
 
+    def submit_retrying(
+        self, image: np.ndarray, reps: int,
+        filter_name: Optional[str] = None,
+        deadline_s: Optional[float] = None,
+        policy: Optional["_retry.RetryPolicy"] = None,
+        give_up_after_s: Optional[float] = 300.0,
+    ) -> "concurrent.futures.Future":
+        """:meth:`submit` under the shared retry policy
+        (:mod:`tpu_stencil.resilience.retry`): :class:`QueueFull` is
+        transient backpressure — back off and re-offer — while
+        :class:`ServerClosed` / ``WorkerCrashed`` / validation errors
+        raise immediately (the classifier knows the difference). The
+        closed-loop client shape loadgen uses. ``give_up_after_s``
+        bounds the total retry window regardless of the policy's
+        attempt budget."""
+        from tpu_stencil.resilience import deadline as _deadline_mod
+
+        budget = (
+            _deadline_mod.Deadline.after(give_up_after_s)
+            if give_up_after_s else None
+        )
+
+        def on_retry(_attempt: int, exc: BaseException) -> None:
+            if budget is not None and budget.expired():
+                raise TimeoutError(
+                    f"gave up re-offering after {give_up_after_s}s of "
+                    f"backpressure"
+                ) from exc
+
+        return _retry.retry_call(
+            lambda: self.submit(image, reps, filter_name,
+                                deadline_s=deadline_s),
+            policy=policy or _retry.RetryPolicy(
+                attempts=1_000_000, base_delay=0.001, multiplier=1.0,
+                max_delay=0.05, jitter=0.5,
+            ),
+            on_retry=on_retry,
+            label="serve.submit",
+        )
+
     def _gate_locked(self) -> None:
         """Admission gate (caller holds the lock): raises
-        :class:`ServerClosed` / :class:`QueueFull` (counted) when the
-        request must not enter."""
+        :class:`WorkerCrashed` / :class:`ServerClosed` /
+        :class:`QueueFull` (counted) when the request must not enter."""
+        if self._crashed is not None:
+            raise WorkerCrashed(
+                f"serve worker thread died "
+                f"({type(self._crashed).__name__}: {self._crashed}); "
+                "construct a new server"
+            )
         if self._closing:
             raise ServerClosed("server is closed")
         if len(self._pending) >= self.cfg.max_queue:
@@ -451,25 +540,38 @@ class StencilServer:
 
     # -- scheduler / worker --------------------------------------------
 
-    def _take_batch_locked(self) -> List[Request]:
+    def _take_batch_locked(self) -> Tuple[List[Request], List[Request]]:
         """Pop the next micro-batch: the oldest request's executable key
         (FIFO fairness), joined by up to ``max_batch - 1`` same-key
-        followers. O(pending) scan — pending is bounded by max_queue."""
+        followers. O(pending) scan — pending is bounded by max_queue.
+
+        Returns ``(batch, expired)``: requests whose deadline passed are
+        swept out of the queue here (never occupying a batch slot) and
+        handed back for the caller to fail typed OUTSIDE the lock —
+        resolving a future runs client ``add_done_callback`` hooks,
+        which must not run under the server lock."""
         if not self._pending:
-            return []
+            return [], []
+        expired: List[Request] = []
         with _obs_span("serve.batch_form", "serve"):
-            key = self._pending[0].key
+            now = time.perf_counter()
+            key = None
             batch: List[Request] = []
             kept: "collections.deque[Request]" = collections.deque()
             while self._pending:
                 r = self._pending.popleft()
+                if r.t_deadline is not None and now > r.t_deadline:
+                    expired.append(r)
+                    continue
+                if key is None:
+                    key = r.key
                 if r.key == key and len(batch) < self.cfg.max_batch:
                     batch.append(r)
                 else:
                     kept.append(r)
             self._pending = kept
             self._m_depth.set(len(self._pending))
-        return batch
+        return batch, expired
 
     def _model_for(self, filter_name: str):
         from tpu_stencil.models.blur import IteratedConv2D
@@ -520,14 +622,21 @@ class StencilServer:
                 backend = "xla"
         interpret = jax.default_backend() == "cpu"
         reps = batch[0].reps
-        exe_key = batch[0].key + (nb,)
-        exe = self._cache.get(
-            exe_key,
-            lambda: _build_bucket_executable(
+
+        def builder():
+            if self._fault_compile is not None:
+                self._fault_compile()
+            return _build_bucket_executable(
                 model.plan, backend, self.cfg.boundary, interpret, reps
-            ),
-        )
+            )
+
+        exe_key = batch[0].key + (nb,)
+        exe = self._cache.get(exe_key, builder)
         t0 = time.perf_counter()
+        if self._fault_h2d is not None:
+            self._fault_h2d()
+        if self._fault_compute is not None:
+            self._fault_compute()
         # Explicit transfer, then launch: under async dispatch both return
         # immediately, so the NEXT batch's host-side assembly (and its
         # transfer) overlaps this batch's device compute.
@@ -563,6 +672,8 @@ class StencilServer:
 
     def _retire_inner(self, batch, out_dev, meta, t0) -> None:
         bh, bw, channels, nb, backend = meta
+        if self._fault_d2h is not None:
+            self._fault_d2h()
         out = np.asarray(out_dev)  # blocks until the device is done
         t1 = time.perf_counter()
         self._m_batches.inc()
@@ -593,21 +704,66 @@ class StencilServer:
 
     def _worker_loop(self) -> None:
         try:
+            self._worker_loop_inner()
+        except BaseException as e:
+            # An unhandled escape from the loop — including
+            # BaseException-level failures the per-batch catches
+            # deliberately do not absorb — is a worker death. Without
+            # propagation every pending/in-flight future would wait
+            # forever: fail them all typed and reject future submits.
+            self._on_worker_death(e)
+
+    def _on_worker_death(self, exc: BaseException) -> None:
+        with self._cond:
+            self._crashed = exc
+            victims = list(self._current_batch)
+            self._current_batch = []
+            victims.extend(self._pending)
+            self._pending.clear()
+            while self._inflight_batches:
+                victims.extend(self._inflight_batches.popleft()[0])
+            self._m_depth.set(0)
+            self._m_inflight.set(0)
+            self._cond.notify_all()
+        self._m_crashes.inc()
+        err = WorkerCrashed(
+            f"serve worker thread died ({type(exc).__name__}: {exc})"
+        )
+        err.__cause__ = exc
+        for r in victims:
+            if not r.future.done() and _resolve(r.future, exc=err):
+                self._m_failed.inc()
+
+    def _worker_loop_inner(self) -> None:
+        try:
             # On the worker thread, not in __init__: the availability
             # probe touches jax.local_devices(), and constructing a
             # server must never force backend init on the caller.
             self._memsampler.start()
         except Exception:
             pass  # telemetry must never take down the serving loop
-        inflight: "collections.deque" = collections.deque()
+        inflight = self._inflight_batches
         while True:
             with self._cond:
                 while (not self._pending and not self._closing
                        and not inflight):
                     self._cond.wait()
-                batch = self._take_batch_locked()
+                batch, expired = self._take_batch_locked()
                 closing = self._closing
+            for r in expired:
+                # Typed, outside the lock: an expired request fails
+                # instead of occupying a batch slot.
+                self._m_deadline.inc()
+                if not r.future.done() and _resolve(
+                    r.future,
+                    exc=DeadlineExceeded(
+                        f"request {r.req_id} expired after waiting "
+                        f"{time.perf_counter() - r.t_submit:.3f}s"
+                    ),
+                ):
+                    self._m_failed.inc()
             if batch:
+                self._current_batch = batch  # death-handler visibility
                 try:
                     inflight.append(self._dispatch(batch))
                     self._m_inflight.set(len(inflight))
@@ -615,18 +771,21 @@ class StencilServer:
                     for r in batch:
                         if not r.future.done() and _resolve(r.future, exc=e):
                             self._m_failed.inc()
+                self._current_batch = []
             # Retire when the pipeline is full (keeps depth bounded) or
             # when there is nothing new to overlap with.
             while inflight and (
                 len(inflight) >= self.cfg.pipeline_depth or not batch
             ):
                 done_batch, out_dev, meta, t0 = inflight.popleft()
+                self._current_batch = done_batch  # death-handler visibility
                 try:
                     self._retire(done_batch, out_dev, meta, t0)
                 except Exception as e:
                     for r in done_batch:
                         if not r.future.done() and _resolve(r.future, exc=e):
                             self._m_failed.inc()
+                self._current_batch = []
                 self._m_inflight.set(len(inflight))
                 if batch:
                     break  # go assemble the next batch for overlap

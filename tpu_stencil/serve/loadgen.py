@@ -107,23 +107,18 @@ def run(
                         return
                     next_i[0] = i + 1
                 try:
-                    while True:
-                        try:
-                            fut = server.submit(images[i], reps)
-                            break
-                        except QueueFull:
-                            # Closed loops retry (the client is
-                            # synchronous); the rejection is already
-                            # counted by the server — but never past the
-                            # run deadline, or a wedged server would spin
-                            # these workers forever and run() would
-                            # return a plausible-looking partial report.
-                            if time.perf_counter() > t_start + timeout:
-                                raise TimeoutError(
-                                    f"loadgen deadline ({timeout}s) hit "
-                                    "retrying a full queue"
-                                )
-                            time.sleep(0.001)
+                    # Closed loops retry backpressure (the client is
+                    # synchronous): the shared resilience.retry policy
+                    # classifies QueueFull transient and backs off with
+                    # jitter, but never past the run deadline — a wedged
+                    # server must not spin these workers forever while
+                    # run() returns a plausible-looking partial report.
+                    fut = server.submit_retrying(
+                        images[i], reps,
+                        give_up_after_s=max(
+                            0.001, t_start + timeout - time.perf_counter()
+                        ),
+                    )
                     fut.result(timeout=timeout)
                 except BaseException as e:  # propagate via run(), never die silently
                     with completed_lock:

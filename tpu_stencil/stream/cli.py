@@ -116,6 +116,34 @@ def build_parser() -> argparse.ArgumentParser:
         help="print the frame index to stderr every N written frames",
     )
     p.add_argument(
+        "--dispatch-timeout", dest="dispatch_timeout_s", type=float,
+        default=0.0, metavar="SECONDS",
+        help="watchdog window around the drain's compute fence: a hung "
+             "dispatch fails typed (DispatchTimeout) instead of parking "
+             "the pipeline forever (0 = off, unless "
+             "TPU_STENCIL_DISPATCH_TIMEOUT arms an env default)",
+    )
+    p.add_argument(
+        "--io-retries", dest="io_retries", type=int, default=2,
+        metavar="N",
+        help="transient-I/O retries per frame read/write (rewindable "
+             "sources and idempotent sinks only; default 2)",
+    )
+    p.add_argument(
+        "--engine-restarts", dest="max_engine_restarts", type=int,
+        default=1, metavar="N",
+        help="mid-stream engine restarts after a transient h2d/compute/"
+             "d2h fault: re-prepare the engine and resume from the "
+             "frame checkpoint (needs --checkpoint-every and a file/"
+             "directory input; default 1, 0 = off)",
+    )
+    p.add_argument(
+        "--faults", default=None, metavar="SPEC",
+        help="arm the fault-injection harness (chaos testing / failure "
+             "reproduction); same grammar as TPU_STENCIL_FAULTS, which "
+             "this flag overrides (docs/RESILIENCE.md)",
+    )
+    p.add_argument(
         "--platform", default=None, choices=["cpu", "tpu", "gpu"],
         help="force the JAX platform via the config API before "
              "backend init",
@@ -168,10 +196,20 @@ def main(argv=None) -> int:
             ring_buffers=ns.ring_buffers,
             checkpoint_every=ns.checkpoint_every,
             progress_every=ns.progress_every,
+            dispatch_timeout_s=ns.dispatch_timeout_s,
+            io_retries=ns.io_retries,
+            max_engine_restarts=ns.max_engine_restarts,
         )
         out_spec = cfg.output_path  # stdin + no --output dies here, pre-jax
     except ValueError as e:
         parser.error(str(e))
+    if ns.faults is not None:
+        from tpu_stencil.resilience import faults as _faults
+
+        try:
+            _faults.configure(ns.faults)
+        except ValueError as e:
+            parser.error(str(e))
     # A stdout sink owns stdout: the binary frame stream must never be
     # interleaved with report text (a consumer piping '--output -' would
     # read corrupted frames), so the human summary moves to stderr and
@@ -226,6 +264,8 @@ def main(argv=None) -> int:
     print(
         f"streamed {result.frames} frame(s)"
         + (f" (+{result.skipped} resumed)" if result.skipped else "")
+        + (f" (engine restarted {result.restarts}x)"
+           if result.restarts else "")
         + f" in {result.wall_seconds:.3f}s "
         f"({result.frames_per_second:.2f} frames/s, "
         f"depth={result.pipeline_depth}, backend={result.backend}"
@@ -248,6 +288,7 @@ def main(argv=None) -> int:
             "backend": result.backend,
             "schedule": result.schedule,
             "pipeline_depth": result.pipeline_depth,
+            "restarts": result.restarts,
             "output": out_spec,
         }
         text = json.dumps(payload, indent=2, sort_keys=True)
@@ -282,6 +323,8 @@ def _report_observability(ns, cfg: StreamConfig, result, out) -> None:
             "frames": result.frames,
             "wall_seconds": result.wall_seconds,
         }), end="", file=out)
+        print(obs.breakdown.render_resilience(obs.snapshot()),
+              end="", file=out)
 
 
 if __name__ == "__main__":

@@ -64,6 +64,9 @@ import numpy as np
 
 from tpu_stencil import obs
 from tpu_stencil.config import StreamConfig
+from tpu_stencil.resilience import deadline as _deadline
+from tpu_stencil.resilience import faults as _faults
+from tpu_stencil.resilience import retry as _retry
 from tpu_stencil.stream import frames as frames_io
 
 _EOF = object()          # clean end-of-stream sentinel
@@ -98,6 +101,7 @@ class StreamResult:
     schedule: Optional[str]
     pipeline_depth: int
     output: str
+    restarts: int = 0        # mid-stream engine restarts that recovered
 
 
 class _Abort(Exception):
@@ -223,17 +227,44 @@ class _StageSpan:
         ).observe(dt)
 
 
+def _io_policy(cfg: StreamConfig) -> _retry.RetryPolicy:
+    """The reader/writer transient-I/O policy: ``cfg.io_retries`` extra
+    attempts on the shared short-backoff shape."""
+    return dataclasses.replace(_retry.IO_POLICY, attempts=1 + cfg.io_retries)
+
+
 def _reader(pl: _Pipeline, source, start_frame: int) -> None:
     """Prefetch frames into the staging ring, honoring the dispatch
-    window (a frame occupies a window slot from read start)."""
+    window (a frame occupies a window slot from read start). Transient
+    read failures retry under the shared policy — but only when the
+    source can rewind (``source.mark()``): a pipe's consumed bytes are
+    gone, so pipe errors propagate on the first failure."""
     cfg = pl.cfg
     idx = start_frame
+    fault = _faults.site("read")  # resolved once, NOT per frame
+    policy = _io_policy(cfg)
+
+    def read_frame(i: int, buf) -> bool:
+        def attempt() -> bool:
+            if fault is not None:
+                fault(i)
+            return source.read_into(buf)
+
+        restore = source.mark()
+        if restore is None:
+            return attempt()
+        return _retry.retry_call(
+            attempt, policy=policy,
+            on_retry=lambda _a, _e: restore(),
+            label=f"stream.read[{i}]",
+        )
+
     try:
         while cfg.frames is None or idx < cfg.frames:
             pl.acquire_window()
             buf_i = pl.get(pl.free_q)
             with pl.stage("read", idx):
-                ok = source.read_into(pl.ring[buf_i])
+                ok = read_frame(idx, pl.ring[buf_i])
             if not ok:
                 if cfg.frames is not None:
                     raise IOError(
@@ -256,8 +287,13 @@ def _drain(pl: _Pipeline, eng: dict) -> None:
     """Fence compute in dispatch order, copy D2H, free the window slot,
     hand off to the writer. ``eng['fetch']`` is installed by the
     dispatcher's bootstrap before the first in-flight item is enqueued
-    (the queue's lock orders the publication)."""
+    (the queue's lock orders the publication). The compute fence runs
+    under the dispatch watchdog: a hung device raises a typed
+    ``DispatchTimeout`` (surfaced as a ``compute``-stage StreamFailure)
+    instead of parking the drain thread forever."""
     idx, stage = -1, "compute"
+    fault_d2h = _faults.site("d2h")  # resolved once, NOT per frame
+    timeout_s = _deadline.resolve(pl.cfg.dispatch_timeout_s)
     try:
         while True:
             item = pl.get(pl.inflight_q)
@@ -266,10 +302,13 @@ def _drain(pl: _Pipeline, eng: dict) -> None:
                 return
             idx, out_dev, t_disp = item
             stage = "compute"
-            with pl.stage("compute", idx, t0=t_disp) as s:
-                s.fence(out_dev)
+            with pl.stage("compute", idx, t0=t_disp):
+                _deadline.fence(out_dev, timeout_s,
+                                f"stream.compute[frame={idx}]")
             stage = "d2h"
             with pl.stage("d2h", idx):
+                if fault_d2h is not None:
+                    fault_d2h(idx)
                 arr = eng["fetch"](out_dev)
             pl.release_window()
             pl.put(pl.write_q, (idx, arr))
@@ -284,6 +323,26 @@ def _writer(pl: _Pipeline, sink, done: list) -> None:
     progress heartbeat. ``done[0]`` tracks frames fully written."""
     cfg = pl.cfg
     idx = -1
+    fault = _faults.site("write")  # resolved once, NOT per frame
+    policy = _io_policy(cfg)
+    retryable = bool(getattr(sink, "retryable_writes", False))
+
+    def write_frame(i: int, frame) -> None:
+        def attempt() -> None:
+            if fault is not None:
+                fault(i)
+            sink.write(i, frame)
+
+        if retryable:
+            # Idempotent sinks (positioned files, per-frame directory
+            # files, null) retry transient failures; append-only sinks
+            # fail on the first error — a retried partial write would
+            # duplicate bytes.
+            _retry.retry_call(attempt, policy=policy,
+                              label=f"stream.write[{i}]")
+        else:
+            attempt()
+
     try:
         while True:
             item = pl.get(pl.write_q)
@@ -291,7 +350,7 @@ def _writer(pl: _Pipeline, sink, done: list) -> None:
                 return
             idx, arr = item
             with pl.stage("write", idx):
-                sink.write(idx, arr)
+                write_frame(idx, arr)
             done[0] = idx + 1
             obs.registry().counter("stream_frames_total").inc()
             if cfg.checkpoint_every and done[0] % cfg.checkpoint_every == 0:
@@ -345,6 +404,11 @@ def _dispatch(pl: _Pipeline, model, devices, eng: dict) -> None:
 
     cfg = pl.cfg
     idx, stage = -1, "compute"  # bootstrap failures are compile/compute
+    # Injection sites resolved once per run, before the frame loop —
+    # the hot path branches on captured Nones (the zero-overhead
+    # contract tests assert).
+    fault_h2d = _faults.site("h2d")
+    fault_compute = _faults.site("compute")
     try:
         first = pl.get(pl.filled_q)
         if first is _EOF:
@@ -354,7 +418,8 @@ def _dispatch(pl: _Pipeline, model, devices, eng: dict) -> None:
         # First frame bootstraps the engine: prepare_engine places it
         # and runs the 0-rep warm-up compile whose output equals its
         # input — the warm device array IS frame 0's input, no second
-        # transfer (the run_job discipline).
+        # transfer (the run_job discipline). prepare_engine checks the
+        # h2d/compile injection sites itself.
         frame0 = pl.ring[b0].reshape(cfg.frame_shape)
         img_dev, _step_fn, fetch = driver.prepare_engine(
             model, frame0, devices
@@ -367,6 +432,9 @@ def _dispatch(pl: _Pipeline, model, devices, eng: dict) -> None:
         # is already transferred: recycle its ring slot now and mark the
         # in-flight record bufferless.
         pl.free_q.put(b0)
+        stage = "compute"
+        if fault_compute is not None:
+            fault_compute(idx)
         t_disp = time.perf_counter()
         out0 = launch(img_dev)
         pl.put(pl.inflight_q, (idx, out0, t_disp))
@@ -376,6 +444,8 @@ def _dispatch(pl: _Pipeline, model, devices, eng: dict) -> None:
                 break
             idx, bi = item
             stage = "h2d"
+            if fault_h2d is not None:
+                fault_h2d(idx)
             with pl.stage("h2d", idx) as s:
                 # Fenced: device_put returns before the PCIe copy
                 # lands, and an unfenced span would misattribute the
@@ -389,6 +459,8 @@ def _dispatch(pl: _Pipeline, model, devices, eng: dict) -> None:
                 ))
             pl.free_q.put(bi)  # fenced H2D consumed the staging buffer
             stage = "compute"
+            if fault_compute is not None:
+                fault_compute(idx)
             t_disp = time.perf_counter()
             out = launch(dev)  # async dispatch; donates dev
             pl.put(pl.inflight_q, (idx, out, t_disp))
@@ -408,7 +480,61 @@ def run_stream(
 ) -> StreamResult:
     """Run one streaming job end to end; returns :class:`StreamResult`
     or raises :class:`StreamFailure`. ``source``/``sink`` override the
-    config's specs (tests and benchmarks inject synthetic stages)."""
+    config's specs (tests and benchmarks inject synthetic stages).
+
+    Mid-stream engine-fault recovery: when a *transient* failure hits an
+    engine stage (h2d/compute/d2h) and the job checkpoints its progress
+    (``checkpoint_every`` + a restartable path source — a regular file
+    or frame directory, whose consumed frames can be re-served), the
+    pipeline is torn down, the engine re-prepared ONCE per restart
+    budget (``cfg.max_engine_restarts``), and the run resumes from the
+    frame checkpoint — already-written frames stay written, the restart
+    count lands in ``StreamResult.restarts`` and
+    ``resilience_stream_restarts_total``. I/O-stage failures are
+    handled *inside* the pipeline by the reader/writer retry policy and
+    never restart the engine; injected source/sink objects skip
+    restarts entirely (the caller owns their positioning)."""
+    restarts = 0
+    while True:
+        try:
+            result = _run_stream_once(cfg, devices, resume, source, sink)
+            result.restarts = restarts
+            return result
+        except StreamFailure as e:
+            restartable = (
+                restarts < cfg.max_engine_restarts
+                and source is None and sink is None
+                and cfg.checkpoint_every > 0
+                and e.stage in ("h2d", "compute", "d2h")
+                and e.__cause__ is not None
+                and _retry.is_transient(e.__cause__)
+                and frames_io.is_restartable_source(cfg.input)
+            )
+            if not restartable:
+                raise
+            restarts += 1
+            obs.registry().counter(
+                "resilience_stream_restarts_total"
+            ).inc()
+            print(
+                f"stream: engine fault at {e.stage}[frame "
+                f"{e.frame_index}] ({type(e.__cause__).__name__}); "
+                f"re-preparing engine and resuming from checkpoint "
+                f"(restart {restarts}/{cfg.max_engine_restarts})",
+                file=sys.stderr, flush=True,
+            )
+            resume = True  # honor whatever progress the checkpoint holds
+
+
+def _run_stream_once(
+    cfg: StreamConfig,
+    devices: Optional[list] = None,
+    resume: bool = False,
+    source: Optional[frames_io.FrameSource] = None,
+    sink: Optional[frames_io.FrameSink] = None,
+) -> StreamResult:
+    """One pipeline lifetime (see :func:`run_stream`, which owns the
+    engine-restart loop around this)."""
     import jax
 
     from tpu_stencil.models.blur import IteratedConv2D
@@ -429,6 +555,15 @@ def run_stream(
         restored = ckpt.restore_stream_progress(cfg)
         if restored is not None:
             start_frame = restored
+    elif cfg.checkpoint_every:
+        # A non-resume run starts over: a stale sidecar from a killed
+        # earlier run must be invalidated NOW, or a mid-stream engine
+        # restart (run_stream's resume=True retry) before this run's
+        # first commit would adopt the old progress and silently skip
+        # frames this run never produced.
+        from tpu_stencil.runtime import checkpoint as ckpt
+
+        ckpt.clear_stream_progress(cfg)
     if cfg.frames is not None and start_frame > cfg.frames:
         raise ValueError(
             f"checkpoint records {start_frame} frames done but --frames "

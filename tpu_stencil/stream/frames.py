@@ -46,6 +46,14 @@ class FrameSource:
         seek; pipes read and discard."""
         raise NotImplementedError
 
+    def mark(self):
+        """A rewind point for transient-read retries: a zero-arg
+        callable restoring the source to its current position, or None
+        when the position cannot be restored (a pipe's consumed bytes
+        are gone) — the engine only retries reads when a mark exists
+        (:mod:`tpu_stencil.resilience.retry`)."""
+        return None
+
     def close(self) -> None:
         pass
 
@@ -59,7 +67,16 @@ class FrameSource:
 class FrameSink:
     """In-order frame consumer. Context-managed; single producer. The
     engine guarantees ``write`` is called with strictly increasing
-    frame indices starting at the resume point."""
+    frame indices starting at the resume point.
+
+    ``retryable_writes``: True when ``write(index, frame)`` is
+    idempotent (re-writing an index lands the same bytes in the same
+    place — positioned file writes, per-frame directory files), so the
+    engine may retry a transient write failure; append-only streams
+    (stdout, pipes) are False — a retried partial write would duplicate
+    bytes."""
+
+    retryable_writes = False
 
     def write(self, index: int, frame: np.ndarray) -> None:
         raise NotImplementedError
@@ -122,6 +139,18 @@ class RawStreamSource(FrameSource):
             )
         self._frames_read += n
 
+    def mark(self):
+        if not self._f.seekable():
+            return None  # a pipe's consumed bytes cannot be re-read
+        pos = self._f.tell()
+        frames = self._frames_read
+
+        def restore() -> None:
+            self._f.seek(pos)
+            self._frames_read = frames
+
+        return restore
+
     def close(self) -> None:
         if self._owns:
             self._f.close()
@@ -164,6 +193,14 @@ class RawDirectorySource(FrameSource):
     def skip(self, n: int) -> None:
         self._i += max(0, n)
 
+    def mark(self):
+        i = self._i
+
+        def restore() -> None:
+            self._i = i
+
+        return restore
+
 
 class RawStreamSink(FrameSink):
     """Concatenated headerless frames to one byte stream: a regular
@@ -195,8 +232,17 @@ class RawStreamSink(FrameSink):
                     )
                 self._f.seek(start_frame * frame_bytes)
                 self._f.truncate()
+        # Positioned writes on seekable files make write(index, ...)
+        # idempotent — frame i's home is exactly i*frame_bytes — so a
+        # transient failure can be retried without duplicating bytes.
+        # Pipes stay append-only and non-retryable, and stdout is
+        # excluded unconditionally (a capture harness can make it
+        # claim seekability it must not be trusted with).
+        self.retryable_writes = self._owns and self._f.seekable()
 
     def write(self, index: int, frame: np.ndarray) -> None:
+        if self.retryable_writes:
+            self._f.seek(index * self.frame_bytes)
         # Buffer-protocol write: ascontiguousarray is a no-op view for
         # the already-contiguous uint8 arrays the engine drains, so a
         # frame is NOT copied again on its way out (tobytes() would
@@ -221,6 +267,8 @@ class RawDirectorySink(FrameSink):
     can never leave a torn frame under a complete-looking name. Resume
     is natural — frame files are keyed by index, rewrites idempotent."""
 
+    retryable_writes = True  # per-index atomic files: rewrites idempotent
+
     def __init__(self, path: str, frame_bytes: int, start_frame: int = 0):
         self.path = path
         self.frame_bytes = frame_bytes
@@ -237,6 +285,8 @@ class RawDirectorySink(FrameSink):
 
 class NullSink(FrameSink):
     """Discard frames — benchmark the pipeline without a write stage."""
+
+    retryable_writes = True  # discarding is trivially idempotent
 
     def __init__(self, *a, **k):
         self.frames_written = 0
@@ -268,6 +318,19 @@ def open_sink(spec: str, frame_bytes: int, start_frame: int = 0) -> FrameSink:
     if spec != "-" and _is_dir_spec(spec):
         return RawDirectorySink(spec.rstrip(os.sep), frame_bytes, start_frame)
     return RawStreamSink(spec, frame_bytes, start_frame)
+
+
+def is_restartable_source(spec: str) -> bool:
+    """True when a fresh ``open_source`` of ``spec`` can re-serve frames
+    an earlier open already consumed (a regular file seeks, a frame
+    directory re-lists) — the gate on the engine's mid-stream restart:
+    a pipe/FIFO/stdin's consumed frames are gone, so restarting one
+    would silently drop them."""
+    if spec == "-":
+        return False
+    if _is_dir_spec(spec):
+        return True
+    return os.path.exists(spec) and _stat.S_ISREG(os.stat(spec).st_mode)
 
 
 def is_resumable_sink(spec: str) -> bool:
