@@ -37,10 +37,16 @@ number, and ``tools/bench_capture.py`` correctly refuses to promote it.
 
 Multichip mode: ``TPU_STENCIL_BENCH_MESH=RxC`` measures the *sharded*
 path (ShardedRunner over an RxC device mesh; ``TPU_STENCIL_BENCH_OVERLAP``
-selects the interior/border overlap schedule, default off) and emits a
-versioned headline capture whose metric is suffixed with the mesh and
-the RESOLVED overlap mode — a distinct perf-sentry series per
-(mesh, overlap), so sharded runs gate regressions like single-chip ones.
+selects the interior/border overlap schedule — ``off`` default,
+``split``/``fused-split``/``edge``/``auto``) and emits a versioned
+headline capture whose metric is suffixed with the mesh and the
+RESOLVED overlap mode (e.g. ``..._mesh2x4_overlap-edge_...``) — a
+distinct perf-sentry series per (mesh, overlap), so sharded runs gate
+regressions like single-chip ones. The capture additionally carries
+per-edge exchange-span riders (``edge_exchange_us`` /
+``edge_ici_gbps``: each edge's independent ppermute probe against the
+per-edge ICI ghost-bytes model), so 8-device weak scaling is gated per
+edge rather than eyeballed.
 
 Per-schedule mode: ``TPU_STENCIL_BENCH_SCHEDULE=s1,s2,...`` emits one
 versioned headline capture PER named Pallas schedule (metric suffixed
@@ -437,7 +443,36 @@ def _measure_multichip(mesh_shape, overlap: str, platform: str) -> dict:
     )
     line["hbm_gbps"] = round(gbps, 1)
     line["pct_hbm_peak"] = round(pct, 1)
+    # Per-edge exchange riders: each edge's independent ppermute probe,
+    # best-of-3, with the implied per-edge ICI GB/s against the per-edge
+    # ghost-bytes model — so 8-device weak scaling is GATED per edge
+    # (the sentry keeps them as capture extras), not eyeballed from an
+    # aggregate number that hides one slow link.
+    per_edge_model = _roofline.ici_ghost_bytes_per_edge(
+        runner.tile, C, max(1, model.halo), mesh_shape, mode="edge"
+    )
+    probe_img = runner.put(img)  # probes never donate: one canvas serves
+    edge_us, edge_gbps = {}, {}
+    for name, fn in runner.edge_probes().items():
+        jax.block_until_ready(fn(probe_img))  # compile fence
+        best = min(
+            _timed(lambda f=fn: jax.block_until_ready(f(probe_img)))
+            for _ in range(3)
+        )
+        edge_us[name] = round(best * 1e6, 2)
+        b = per_edge_model.get(name, 0.0)
+        if best > 0 and b > 0:
+            edge_gbps[name] = round(b / best / 1e9, 3)
+    if edge_us:
+        line["edge_exchange_us"] = edge_us
+        line["edge_ici_gbps"] = edge_gbps
     return line
+
+
+def _timed(fn) -> float:
+    t0 = time.perf_counter()
+    fn()
+    return time.perf_counter() - t0
 
 
 def _measure_stream(platform: str) -> dict:

@@ -520,12 +520,56 @@ def test_overlap_flag_parses_and_validates():
     cfg, _ = parse_args(["i.raw", "8", "8", "1", "grey",
                          "--overlap", "split"])
     assert cfg.overlap == "split"
+    cfg, _ = parse_args(["i.raw", "8", "8", "1", "grey",
+                         "--overlap", "edge"])
+    assert cfg.overlap == "edge"
     cfg, _ = parse_args(["i.raw", "8", "8", "1", "grey"])
     assert cfg.overlap == "off"
     with pytest.raises(SystemExit):
         parse_args(["i.raw", "8", "8", "1", "grey", "--overlap", "corner"])
     with pytest.raises(ValueError, match="overlap"):
         JobConfig("x", 5, 5, 1, ImageType.GREY, overlap="diagonal")
+
+
+def test_overlap_edge_cli_end_to_end(tmp_path, rng, capsys):
+    # --overlap edge on a mesh: bit-exact output, the resolved per-edge
+    # pipeline named in the --time report line.
+    import jax
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    img = rng.integers(0, 256, size=(32, 40), dtype=np.uint8)
+    src = str(tmp_path / "ove.raw")
+    raw_io.write_raw(src, img[..., None])
+    out = str(tmp_path / "ove_out.raw")
+    assert cli.main([src, "40", "32", "3", "grey", "--mesh", "2x4",
+                     "--backend", "xla", "--overlap", "edge", "--time",
+                     "--output", out]) == 0
+    assert "overlap=edge" in capsys.readouterr().out
+    got = raw_io.read_raw(out, 40, 32, 1)[..., 0]
+    want = stencil.reference_stencil_numpy(
+        img, filters.get_filter("gaussian"), 3
+    )
+    np.testing.assert_array_equal(got, want)
+
+
+def test_overlap_edge_breakdown_per_edge_table(tmp_path, rng, capsys):
+    # --breakdown on an edge-overlap mesh run must print the per-edge
+    # exchange table (one row per edge, no single join).
+    import jax
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    img = rng.integers(0, 256, size=(32, 40), dtype=np.uint8)
+    src = str(tmp_path / "oveb.raw")
+    raw_io.write_raw(src, img[..., None])
+    out = str(tmp_path / "oveb_out.raw")
+    assert cli.main([src, "40", "32", "2", "grey", "--mesh", "2x4",
+                     "--backend", "xla", "--overlap", "edge",
+                     "--breakdown", "--output", out]) == 0
+    cap = capsys.readouterr().out
+    assert "overlap schedule: edge" in cap
+    assert "per-edge exchange" in cap
+    for x in ("n", "s", "w", "e"):
+        assert f"sharded.exchange_edge[{x}]" in cap or f"\n{x}  " in cap
 
 
 def test_overlap_split_cli_end_to_end(tmp_path, rng, capsys):

@@ -1,18 +1,23 @@
-"""Explicit interior/border overlap schedule (`tpu_stencil.parallel.overlap`).
+"""Explicit interior/border overlap schedules (`tpu_stencil.parallel.overlap`).
 
-The acceptance bar is bit-exactness: `--overlap split` and
-`--overlap fused-split` must produce byte-identical output to
-`--overlap off` (and to the independent NumPy golden model) on every
-plan/boundary/channels/fuse combination — including tiles narrower than
-2*halo, where the ghost-free interior band is empty and the split
-degrades to the monolithic step inside the same program. Plus: `auto`
-resolution (cached probe ratio, no re-probe on a warm cache), the
-`overlap_mode` gauge, the new probe spans, and the ICI ghost-bytes
-roofline model.
+The acceptance bar is bit-exactness: `--overlap split`,
+`--overlap fused-split`, and the partitioned per-edge pipeline
+`--overlap edge` must produce byte-identical output to `--overlap off`
+(and to the independent NumPy golden model) on every
+plan/boundary/channels/fuse/schedule combination — including tiles
+narrower than 2*halo, where the ghost-free interior band is empty, the
+split degrades to the monolithic step inside the same program, and the
+runner resolves (and reports) the mode as `off`. Plus: `auto`
+resolution (the three-way off/split/edge verdict from the probe bundle,
+cached — no re-probe on a warm cache), the `overlap_mode` gauge, the
+per-edge probe spans (four distinct fences, no single join), the
+persistent ghost-slab rep loop (slab threaded through the fori_loop
+carry), and the per-edge ICI ghost-bytes roofline model.
 """
 
 import numpy as np
 import jax
+import jax.numpy as jnp
 import pytest
 
 from tpu_stencil import filters
@@ -28,9 +33,9 @@ requires_8 = pytest.mark.skipif(
 
 
 def _run(img, filter_name, reps, mesh_shape, backend="xla", overlap="off",
-         boundary="zero", fuse=None):
+         boundary="zero", fuse=None, schedule=None):
     model = IteratedConv2D(filter_name, backend=backend, boundary=boundary,
-                           fuse=fuse)
+                           fuse=fuse, schedule=schedule)
     channels = 1 if img.ndim == 2 else img.shape[2]
     runner = sharded.ShardedRunner(
         model, img.shape[:2], channels, mesh_shape=mesh_shape,
@@ -44,7 +49,7 @@ def _run(img, filter_name, reps, mesh_shape, backend="xla", overlap="off",
 
 
 @requires_8
-@pytest.mark.parametrize("overlap", ["split", "fused-split"])
+@pytest.mark.parametrize("overlap", ["split", "fused-split", "edge"])
 @pytest.mark.parametrize("shape,mesh", [
     ((32, 40, 3), (2, 4)),   # RGB, wide interior
     ((32, 40), (2, 4)),      # grey
@@ -156,6 +161,281 @@ def test_bad_mode_rejected(rng):
                               devices=jax.devices()[:1], overlap="diagonal")
 
 
+# --- partitioned per-edge pipeline (--overlap edge) ----------------------
+
+
+SIZE1_AXES = (("r", 1, 0), ("c", 1, 1))
+
+
+@pytest.mark.parametrize("name", ["gaussian", "gaussian5", "edge", "box"])
+@pytest.mark.parametrize("boundary", ["zero", "periodic"])
+def test_edge_step_unit_matches_padded_step(rng, name, boundary):
+    # Size-1 axes: no collectives, so the nine-piece per-edge assembly
+    # is testable as a pure function against the monolithic padded
+    # step — every plan kind, both boundaries, grey + RGB + odd shapes.
+    plan = lowering.plan_filter(filters.get_filter(name))
+    for shape in [(16, 20), (16, 20, 3), (9, 13, 3)]:
+        img = jnp.asarray(rng.integers(0, 256, size=shape, dtype=np.uint8))
+        want = np.asarray(lowering.padded_step(img, plan, boundary))
+        got = np.asarray(
+            overlap_mod.edge_step(img, plan, SIZE1_AXES, None, boundary)
+        )
+        np.testing.assert_array_equal(got, want)
+
+
+@requires_8
+def test_edge_periodic_boundary(rng):
+    img = rng.integers(0, 256, size=(16, 24, 3), dtype=np.uint8)
+    got, _ = _run(img, "gaussian", 4, (2, 2), "xla", "edge",
+                  boundary="periodic")
+    want = stencil.reference_stencil_numpy(
+        img, filters.get_filter("gaussian"), 4, boundary="periodic"
+    )
+    np.testing.assert_array_equal(got, want)
+
+
+@requires_8
+def test_edge_direct_plan(rng):
+    # direct_int plans (the non-separable edge /28) with negative taps:
+    # corner patches included.
+    img = rng.integers(0, 256, size=(24, 16, 3), dtype=np.uint8)
+    got, _ = _run(img, "edge", 4, (2, 2), "xla", "edge")
+    off, _ = _run(img, "edge", 4, (2, 2), "xla", "off")
+    np.testing.assert_array_equal(got, off)
+
+
+@requires_8
+@pytest.mark.parametrize("fuse", [1, 2, 4])
+def test_edge_pallas_chunks(rng, fuse):
+    # The chunked per-edge pipeline under the valid-ghost Pallas kernel:
+    # one fuse*halo-deep per-edge slab covers the whole chunk, reps span
+    # chunks plus a remainder at halo depth.
+    img = rng.integers(0, 256, size=(32, 40, 3), dtype=np.uint8)
+    got, runner = _run(img, "gaussian", 5, (2, 2), "pallas", "edge",
+                       fuse=fuse)
+    assert runner.backend == "pallas" and runner.overlap == "edge"
+    assert runner.fuse == fuse
+    want = np.asarray(IteratedConv2D("gaussian", backend="xla")(img, 5))
+    np.testing.assert_array_equal(got, want)
+
+
+@requires_8
+def test_edge_wide_halo_pallas_fuse_clamped(rng):
+    # gaussian5 halo=2 on a 24-row tile: the edge pipeline clamps the
+    # chunk depth so every chunk keeps a ghost-free interior
+    # (fuse <= (min(tile)-1)//(2*halo)), where fused-split would
+    # degrade in-program instead.
+    img = rng.integers(0, 256, size=(48, 40), dtype=np.uint8)
+    got, runner = _run(img, "gaussian5", 4, (2, 2), "pallas", "edge")
+    assert runner.overlap == "edge"
+    h = IteratedConv2D("gaussian5").halo
+    assert runner.fuse * 2 * h < min(runner.tile)
+    want = np.asarray(IteratedConv2D("gaussian5", backend="xla")(img, 4))
+    np.testing.assert_array_equal(got, want)
+
+
+@requires_8
+def test_edge_degenerate_tile_resolves_off(rng):
+    # Satellite bugfix: a tile with no ghost-free interior runs the
+    # monolithic step in-program, so the RESOLVED mode — gauge and
+    # runner.overlap (what JobResult/--time report) — must be "off",
+    # never the requested schedule that degraded away.
+    from tpu_stencil import obs
+
+    obs.reset()
+    try:
+        img = rng.integers(0, 256, size=(16, 24, 3), dtype=np.uint8)
+        got, runner = _run(img, "gaussian", 5, (8, 1), "xla", "edge")
+        assert runner.overlap == "off"
+        assert runner.overlap_requested == "edge"
+        assert obs.snapshot()["gauges"]["overlap_mode"]["value"] == (
+            overlap_mod.MODE_CODES["off"]
+        )
+        want = stencil.reference_stencil_numpy(
+            img, filters.get_filter("gaussian"), 5
+        )
+        np.testing.assert_array_equal(got, want)
+    finally:
+        obs.reset()
+
+
+@requires_8
+@pytest.mark.parametrize("overlap", ["split", "fused-split", "edge"])
+@pytest.mark.parametrize("schedule", [None, "deep"])
+def test_overlap_schedule_composition(rng, overlap, schedule):
+    # The overlap x deep-schedule composition matrix (tier-1 slice):
+    # every overlap schedule must stitch bit-exactly under the default
+    # AND the deep temporal-blocking schedule at fuse 1/2/4 — one
+    # widened per-edge exchange covers a fuse*halo chunk.
+    img = rng.integers(0, 256, size=(32, 40, 3), dtype=np.uint8)
+    want = np.asarray(IteratedConv2D("gaussian", backend="xla")(img, 5))
+    for fuse in (1, 2, 4):
+        model = IteratedConv2D("gaussian", backend="pallas",
+                               schedule=schedule, fuse=fuse)
+        runner = sharded.ShardedRunner(
+            model, (32, 40), 3, mesh_shape=(2, 2),
+            devices=jax.devices()[:4], overlap=overlap,
+        )
+        got = runner.fetch(runner.run(runner.put(img), 5))
+        np.testing.assert_array_equal(got, want)
+
+
+@requires_8
+@pytest.mark.slow
+@pytest.mark.parametrize("overlap", ["split", "fused-split", "edge"])
+@pytest.mark.parametrize("schedule", [None, "deep"])
+@pytest.mark.parametrize("fuse", [1, 2, 4])
+@pytest.mark.parametrize("shape", [(32, 40), (32, 40, 3)])
+@pytest.mark.parametrize("boundary", ["zero", "periodic"])
+def test_overlap_schedule_composition_full(rng, overlap, schedule, fuse,
+                                           shape, boundary):
+    # The full fuzz grid the ISSUE names: overlap x schedule x fuse x
+    # grey/RGB x zero/periodic vs the monolithic golden (periodic
+    # demotes pallas->xla and deep is then ignored; the degraded combo
+    # must STILL be bit-exact). Slow-marked; the tier-1 slice above
+    # covers every axis.
+    img = rng.integers(0, 256, size=shape, dtype=np.uint8)
+    got, _ = _run(img, "gaussian", 5, (2, 2), "pallas", overlap,
+                  boundary=boundary, fuse=fuse, schedule=schedule)
+    want = stencil.reference_stencil_numpy(
+        img, filters.get_filter("gaussian"), 5, boundary=boundary
+    )
+    np.testing.assert_array_equal(got, want)
+
+
+@requires_8
+@pytest.mark.slow
+@pytest.mark.parametrize("overlap", ["split", "fused-split", "edge"])
+@pytest.mark.parametrize("name,mesh", [
+    ("gaussian5", (4, 2)),   # tile rows == 2h: EMPTY interior band
+    ("gaussian7", (4, 2)),   # tile rows < 2h: negative interior
+])
+def test_overlap_degenerate_tiles_full(rng, overlap, name, mesh):
+    img = rng.integers(0, 256, size=(16, 40), dtype=np.uint8)
+    got, runner = _run(img, name, 3, mesh, "pallas", overlap)
+    assert runner.overlap == "off"  # resolved, reported monolithic
+    want = stencil.reference_stencil_numpy(img, filters.get_filter(name), 3)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_edge_iterate_slab_is_loop_carried(rng):
+    # The persistent-exchange contract: the per-edge ghost slab is
+    # threaded through the fori_loop carry (allocated once by the
+    # prologue exchange, ping/ponged by the while loop's aliased
+    # buffers), so the traced steady state performs zero per-rep
+    # slab setup. Asserted structurally: the while carry holds the
+    # 8 slab leaves next to the tile.
+    plan = lowering.plan_filter(filters.get_filter("gaussian"))
+    h = plan.halo
+
+    def f(x, n):
+        return overlap_mod.edge_iterate(
+            x, n, h, SIZE1_AXES,
+            lambda t, sl: overlap_mod.edge_step_from(t, sl, plan),
+        )
+
+    jaxpr = jax.make_jaxpr(f)(
+        jax.ShapeDtypeStruct((16, 20), jnp.uint8), jnp.int32(3)
+    )
+
+    def find_whiles(jx):
+        out = []
+        for eqn in jx.eqns:
+            if eqn.primitive.name == "while":
+                out.append(eqn)
+            for v in eqn.params.values():
+                inner = getattr(v, "jaxpr", None)
+                if inner is not None:
+                    out += find_whiles(getattr(inner, "jaxpr", inner))
+        return out
+
+    whiles = find_whiles(jaxpr.jaxpr)
+    assert whiles, "edge_iterate must lower to a while loop"
+    shapes = [
+        tuple(v.aval.shape) for w in whiles for v in w.invars
+        if hasattr(v.aval, "shape")
+    ]
+    # tile + 4 edge strips + 4 corner patches in the carry.
+    assert (16, 20) in shapes
+    assert shapes.count((h, 20)) >= 2          # n + s strips
+    assert shapes.count((16, h)) >= 2          # w + e strips
+    assert shapes.count((h, h)) >= 4           # four corners
+
+
+@requires_8
+def test_per_edge_probe_spans(rng):
+    # Four DISTINCT per-edge exchange spans per traced mesh run — the
+    # instrument that demonstrates border strips fencing independently
+    # (no single join).
+    from tpu_stencil import obs
+
+    obs.reset()
+    obs.enable()
+    try:
+        model = IteratedConv2D("gaussian", backend="xla")
+        runner = sharded.ShardedRunner(
+            model, (32, 40), 3, mesh_shape=(2, 4),
+            devices=jax.devices()[:8], overlap="edge",
+        )
+        img = rng.integers(0, 256, size=(32, 40, 3), dtype=np.uint8)
+        dev = runner.run(runner.put(img), 0)
+        runner.trace_phase_probes(dev)
+        names = {rec.name for rec in obs.get_tracer().spans()}
+        assert {f"sharded.exchange_edge[{x}]"
+                for x in ("n", "s", "w", "e")} <= names
+    finally:
+        obs.disable()
+        obs.reset()
+
+
+@requires_8
+def test_edge_probes_omit_trivial_axis(rng):
+    model = IteratedConv2D("gaussian", backend="xla")
+    runner = sharded.ShardedRunner(
+        model, (32, 24), 1, mesh_shape=(1, 4), devices=jax.devices()[:4],
+    )
+    assert set(runner.edge_probes()) == {"w", "e"}
+
+
+@requires_8
+def test_render_overlap_per_edge_table(rng):
+    from tpu_stencil import obs
+
+    obs.reset()
+    obs.enable()
+    try:
+        model = IteratedConv2D("gaussian", backend="xla")
+        runner = sharded.ShardedRunner(
+            model, (32, 40), 3, mesh_shape=(2, 4),
+            devices=jax.devices()[:8], overlap="edge",
+        )
+        img = rng.integers(0, 256, size=(32, 40, 3), dtype=np.uint8)
+        dev = runner.run(runner.put(img), 0)
+        runner.trace_phase_probes(dev)
+        table = obs.breakdown.render_overlap(obs.get_tracer(), {
+            "overlap": runner.overlap, "tile": runner.tile, "channels": 3,
+            "halo": model.halo, "mesh_shape": runner.mesh_shape,
+            "fuse": 1, "elem_bytes": 1,
+        })
+        assert "overlap schedule: edge" in table
+        assert "per-edge exchange" in table
+        for x in ("n", "s", "w", "e"):
+            assert f"\n{x}     " in table  # one row per edge
+    finally:
+        obs.disable()
+        obs.reset()
+
+
+def test_mode_codes_cover_resolved_modes():
+    # Every resolved mode has a distinct gauge code, and the
+    # requested-but-unresolved AUTO_CODE collides with none of them —
+    # the gauge can never report the literal "auto" as a resolved mode.
+    codes = overlap_mod.MODE_CODES
+    assert set(codes) == {"off", "split", "fused-split", "edge"}
+    assert len(set(codes.values())) == len(codes)
+    assert overlap_mod.AUTO_CODE not in codes.values()
+
+
 # --- strip-valid pass ----------------------------------------------------
 
 
@@ -191,7 +471,7 @@ def test_auto_resolves_and_caches(rng, tmp_path, monkeypatch):
     model = IteratedConv2D("gaussian", backend="xla")
     r1 = sharded.ShardedRunner(model, (32, 40), 3, mesh_shape=(2, 4),
                                devices=jax.devices()[:8], overlap="auto")
-    assert r1.overlap in ("off", "split")
+    assert r1.overlap in ("off", "split", "edge")
     assert len(calls) == 1
     # Warm cache: the second runner must resolve WITHOUT re-probing.
     r2 = sharded.ShardedRunner(model, (32, 40), 3, mesh_shape=(2, 4),
@@ -212,6 +492,57 @@ def test_overlap_from_ratio_decision():
     assert autotune.overlap_from_ratio(0.5, "xla") == "split"
     assert autotune.overlap_from_ratio(0.5, "pallas") == "fused-split"
     assert autotune.overlap_from_ratio(50.0, "xla") == "split"
+
+
+def test_overlap_verdict_three_way():
+    # The three-way measured verdict: the ratio floor still gates "off";
+    # above it the split-vs-edge candidate A/B decides, and "edge" needs
+    # a strictly faster measurement — a tie keeps the split family.
+    low = {"exchange_s": 1e-7, "interior_s": 2e-4,
+           "candidates": {"split": 1e-4, "edge": 5e-5}}
+    assert autotune.overlap_verdict(low, "xla") == "off"
+    b = {"exchange_s": 1e-4, "interior_s": 2e-4,
+         "candidates": {"split": 1e-4, "edge": 5e-5}}
+    assert autotune.overlap_verdict(b, "xla") == "edge"
+    b["candidates"] = {"split": 1e-4, "edge": 1e-4}
+    assert autotune.overlap_verdict(b, "xla") == "split"
+    assert autotune.overlap_verdict(b, "pallas") == "fused-split"
+    # Legacy bundles (no candidates) fall back to the two-way verdict.
+    assert autotune.overlap_verdict(
+        {"exchange_s": 1e-4, "interior_s": 2e-4}, "xla"
+    ) == "split"
+
+
+def test_best_overlap_bundle_caches_edge_verdict(tmp_path, monkeypatch):
+    monkeypatch.setenv(
+        "TPU_STENCIL_AUTOTUNE_CACHE", str(tmp_path / "autotune.json")
+    )
+    plan = lowering.plan_filter(filters.get_filter("gaussian"))
+    calls = []
+
+    def measure():
+        calls.append(1)
+        return {
+            "exchange_s": 1e-4, "interior_s": 2e-4,
+            "edges": {"n": 3e-5, "s": 3e-5, "w": 2e-5, "e": 2e-5},
+            "candidates": {"split": 1e-4, "edge": 6e-5},
+        }
+
+    mode = autotune.best_overlap(plan, (32, 40), 3, (2, 4), "xla", measure)
+    assert mode == "edge" and len(calls) == 1
+    # Warm cache: the edge verdict round-trips without re-probing, and
+    # the stored entry carries the audit trail.
+    assert autotune.best_overlap(
+        plan, (32, 40), 3, (2, 4), "xla", measure
+    ) == "edge"
+    assert len(calls) == 1
+    assert autotune.cached_overlap(plan, (32, 40), 3, (2, 4), "xla") == "edge"
+    import json
+
+    entries = json.load(open(tmp_path / "autotune.json"))["entries"]
+    [entry] = [v for k, v in entries.items() if k.startswith("overlap")]
+    assert entry["candidate_us"] == {"split": 100.0, "edge": 60.0}
+    assert set(entry["edge_us"]) == {"n", "s", "w", "e"}
 
 
 def test_best_overlap_measures_once_and_caches(tmp_path, monkeypatch):
@@ -331,6 +662,37 @@ def test_ici_ghost_bytes_model():
     ) == 4 * b
 
 
+def test_ici_ghost_bytes_per_edge_model():
+    # Phased mode: per-edge breakdown sums to the aggregate model, W/E
+    # strips ride the row-extended array.
+    per = roofline.ici_ghost_bytes_per_edge((32, 12), 3, 1, (2, 4))
+    assert per == {"n": 12 * 3, "s": 12 * 3, "w": 34 * 3, "e": 34 * 3}
+    assert sum(per.values()) == roofline.ici_ghost_bytes_per_rep(
+        (32, 12), 3, 1, (2, 4)
+    )
+    # Edge mode: all four strips cover the BARE tile, the corner hop is
+    # broken out (4 g x g patches), and the sum matches the aggregate.
+    per_e = roofline.ici_ghost_bytes_per_edge(
+        (32, 12), 3, 1, (2, 4), mode="edge"
+    )
+    assert per_e == {"n": 12 * 3, "s": 12 * 3, "w": 32 * 3, "e": 32 * 3,
+                     "corners": 4 * 3}
+    assert sum(per_e.values()) == roofline.ici_ghost_bytes_per_rep(
+        (32, 12), 3, 1, (2, 4), mode="edge"
+    )
+    # Trivial axes drop their edges in both modes; a rows-only mesh has
+    # no corner hop at all.
+    assert roofline.ici_ghost_bytes_per_edge(
+        (32, 12), 3, 1, (8, 1), mode="edge"
+    ) == {"n": 12 * 3, "s": 12 * 3}
+    # A fused chunk divides per-rep traffic by fuse (strips g=fuse*halo
+    # deep, one exchange per fuse reps).
+    fused = roofline.ici_ghost_bytes_per_edge(
+        (32, 12), 3, 1, (8, 1), fuse=4, mode="edge"
+    )
+    assert fused == {"n": 12 * 3, "s": 12 * 3}
+
+
 # --- timing probe A/B (deselect with -m 'not timing') -------------------
 
 
@@ -348,14 +710,50 @@ def test_probe_ab_split_vs_off(rng):
         model, (64, 64), 1, mesh_shape=(2, 4),
         devices=jax.devices()[:8], overlap="off",
     )
-    ex, it = runner._measure_overlap_probes()
+    bundle = runner._measure_overlap_probes()
+    ex, it = bundle["exchange_s"], bundle["interior_s"]
     assert ex > 0 and it > 0
-    mode = autotune.overlap_from_ratio(ex / it, runner.backend)
-    assert mode in ("off", "split")
+    assert all(v > 0 for v in bundle["edges"].values())
+    assert bundle["candidates"]["split"] > 0
+    assert bundle["candidates"]["edge"] > 0
+    mode = autotune.overlap_verdict(bundle, runner.backend)
+    assert mode in ("off", "split", "edge")
     img = rng.integers(0, 256, size=(64, 64), dtype=np.uint8)
     a, _ = _run(img, "gaussian", 4, (2, 4), "xla", "off")
     b, _ = _run(img, "gaussian", 4, (2, 4), "xla", "split")
     np.testing.assert_array_equal(a, b)
+
+
+@requires_8
+@pytest.mark.timing
+def test_edge_never_auto_selected_when_slower(rng, tmp_path, monkeypatch):
+    """The three-way A/B's guardrail: `edge` may only win `auto` when
+    its one-rep candidate probe MEASURED faster than the split's — a
+    measured-slower edge must never be gated on. Asserted on the real
+    probe bundle (wall clock) AND on the verdict the measured bundle
+    produces through best_overlap's cache path."""
+    monkeypatch.setenv(
+        "TPU_STENCIL_AUTOTUNE_CACHE", str(tmp_path / "autotune.json")
+    )
+    model = IteratedConv2D("gaussian", backend="xla")
+    runner = sharded.ShardedRunner(
+        model, (64, 64), 1, mesh_shape=(2, 4),
+        devices=jax.devices()[:8], overlap="off",
+    )
+    bundle = runner._measure_overlap_probes()
+    mode = autotune.best_overlap(
+        model.plan, runner.tile, 1, runner.mesh_shape, runner.backend,
+        measure=lambda: bundle,
+    )
+    cand = bundle["candidates"]
+    if cand["edge"] >= cand["split"]:
+        assert mode != "edge", (mode, cand)
+    # And with the measurement forced slower, the verdict can never be
+    # edge regardless of what the wall clock did above.
+    forced = dict(bundle)
+    forced["candidates"] = {"split": cand["split"],
+                            "edge": cand["split"] * 2}
+    assert autotune.overlap_verdict(forced, runner.backend) != "edge"
 
 
 @requires_8

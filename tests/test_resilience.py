@@ -818,15 +818,25 @@ def test_sharded_diagnose_edges_healthy():
         mesh_shape=(2, 2), devices=jax.devices()[:4],
     )
     verdicts = _within(600, runner.diagnose_edges, timeout_s=120.0)
-    assert verdicts == {"rows": "ok", "cols": "ok"}
+    # Per-EDGE verdicts (the PR-8 follow-up): each specific edge named,
+    # healthy edges carry their measured probe latency.
+    assert set(verdicts) == {"n", "s", "w", "e"}
+    assert all(v.startswith("ok (") and v.endswith("ms)")
+               for v in verdicts.values()), verdicts
 
 
 def test_collective_timeout_carries_edges():
-    e = errors.CollectiveTimeout("sharded.iterate", 30.0,
-                                 edges={"rows": "timeout", "cols": "ok"})
+    e = errors.CollectiveTimeout(
+        "sharded.iterate", 30.0,
+        edges={"n": "timeout", "s": "ok (1.20ms)", "w": "ok (0.80ms)",
+               "e": "ok (0.90ms)"},
+    )
     assert isinstance(e, errors.DispatchTimeout)
-    assert e.edges == {"rows": "timeout", "cols": "ok"}
-    assert "rows" in str(e)
+    assert e.edges["n"] == "timeout"
+    # The message names the specific stuck edge next to the healthy
+    # edges' measured latencies.
+    assert "'n': 'timeout'" in str(e)
+    assert "1.20ms" in str(e)
 
 
 # -- checkpoint crash-consistency fuzz (satellite) ---------------------

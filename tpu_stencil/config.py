@@ -28,11 +28,15 @@ PALLAS_SCHEDULES = ("pad", "shrink", "strips", "pack", "pack_strips", "deep")
 # Interior/border overlap schedule for the sharded path (see
 # tpu_stencil/parallel/overlap.py, which imports this tuple): "off"
 # delegates compute/comm overlap to XLA's latency-hiding scheduler,
-# "split"/"fused-split" run the explicit interior/border split, "auto"
-# resolves from the measured exchange/interior phase-probe ratio
-# (cached, runtime/autotune.py). Lives here so CLI parsing stays
+# "split"/"fused-split" run the explicit interior/border split with one
+# joined exchange, "edge" runs the partitioned per-edge pipeline (four
+# independent per-edge ppermutes, each border strip released as soon as
+# its own edge's ghosts arrive, persistent exchange slabs carried
+# across the rep loop), "auto" resolves from the measured
+# exchange/interior phase-probe ratio plus a split-vs-edge candidate
+# A/B (cached, runtime/autotune.py). Lives here so CLI parsing stays
 # jax-free.
-OVERLAP_MODES = ("auto", "split", "fused-split", "off")
+OVERLAP_MODES = ("auto", "split", "fused-split", "edge", "off")
 
 
 BACKENDS = ("auto", "xla", "pallas", "reference", "autotune")
@@ -484,9 +488,14 @@ def build_parser() -> argparse.ArgumentParser:
              "from the arrived ghosts (the reference's hand-scheduled "
              "inner-then-border ordering, made explicit); fused-split "
              "widens the exchange and the border bands by fuse*halo so "
-             "one exchange covers a whole Pallas chunk; auto resolves "
-             "from the measured exchange/interior phase-probe ratio "
-             "(cached alongside the autotune verdicts). All modes are "
+             "one exchange covers a whole Pallas chunk; edge splits the "
+             "exchange itself into four independent per-edge ppermutes "
+             "so each border strip fences only on its own edge's "
+             "arrival, with persistent ghost slabs carried across the "
+             "rep loop (the partitioned/persistent MPI pattern); auto "
+             "resolves from the measured exchange/interior phase-probe "
+             "ratio plus a split-vs-edge candidate A/B (cached "
+             "alongside the autotune verdicts). All modes are "
              "bit-exact; single-device runs ignore this",
     )
     p.add_argument(

@@ -206,8 +206,11 @@ class JobResult:
     block_h: Optional[int] = None
     fuse: Optional[int] = None
     # Resolved interior/border overlap schedule of a sharded run
-    # ("off" | "split" | "fused-split" — "auto" resolves before compile);
-    # None on single-device/frames paths (no exchange to overlap).
+    # ("off" | "split" | "fused-split" | "edge" — "auto" resolves before
+    # compile, and a degenerate tile resolves every split flavor to
+    # "off": report-what-ran, never the literal "auto" or a schedule
+    # that degraded away in-program); None on single-device/frames
+    # paths (no exchange to overlap).
     overlap: Optional[str] = None
 
 

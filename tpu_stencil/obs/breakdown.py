@@ -110,22 +110,40 @@ def render_overlap(tracer: Tracer, info: dict) -> str:
     the ICI ghost-bytes traffic model
     (:func:`tpu_stencil.runtime.roofline.ici_ghost_bytes_per_rep`) next
     to the measured exchange/interior/border probe spans, the exchange
-    span's implied ICI GB/s vs the v5e ceiling, and the
-    exchange/interior probe ratio ``--overlap auto`` decides on.
+    span's implied ICI GB/s vs the v5e ceiling, a PER-EDGE table (one
+    row per ``sharded.exchange_edge[*]`` span: the edge's own measured
+    latency, per-edge model bytes, and implied per-edge ICI GB/s — four
+    independent fences, no single join), and the exchange/interior
+    probe ratio ``--overlap auto`` decides on.
 
     ``info``: ``{overlap, tile, channels, halo, mesh_shape, fuse,
     elem_bytes}``. Renders nothing when no sharded probe spans were
     recorded (single-device runs)."""
+    from tpu_stencil.parallel.overlap import EDGE_NAMES
+
     by = {r["name"]: r for r in aggregate(tracer)}
     names = [n for n in (
         "sharded.halo_exchange", "sharded.interior_compute",
         "sharded.interior_overlap", "sharded.border_compute",
     ) if n in by]
-    if not names:
+    edge_rows = [
+        (x, f"sharded.exchange_edge[{x}]") for x in EDGE_NAMES
+        if f"sharded.exchange_edge[{x}]" in by
+    ]
+    if not names and not edge_rows:
         return ""
     from tpu_stencil.runtime import roofline
 
+    model_mode = "edge" if info.get("overlap") == "edge" else "phased"
     bytes_rep = roofline.ici_ghost_bytes_per_rep(
+        info["tile"], info["channels"], info["halo"], info["mesh_shape"],
+        fuse=info.get("fuse") or 1, elem_bytes=info.get("elem_bytes", 1),
+        mode=model_mode,
+    )
+    # The halo_exchange probe always runs the PHASED (corner-routed)
+    # exchange, so its implied GB/s divides by the phased bytes even
+    # when the production schedule (the header's model) is per-edge.
+    bytes_phased = roofline.ici_ghost_bytes_per_rep(
         info["tile"], info["channels"], info["halo"], info["mesh_shape"],
         fuse=info.get("fuse") or 1, elem_bytes=info.get("elem_bytes", 1),
     )
@@ -139,10 +157,35 @@ def render_overlap(tracer: Tracer, info: dict) -> str:
     for n in names:
         sec = by[n]["seconds"] / by[n]["count"]
         ann = ""
-        if n == "sharded.halo_exchange" and sec > 0 and bytes_rep > 0:
-            gbps = bytes_rep / sec / 1e9
+        if n == "sharded.halo_exchange" and sec > 0 and bytes_phased > 0:
+            gbps = bytes_phased / sec / 1e9
             ann = f"{gbps:8.2f} {100 * gbps / roofline.V5E_ICI_GBPS:5.1f}%"
         lines.append(f"{n:<26}  {sec:>10.6f}  {ann:>15}")
+    if edge_rows:
+        # The per-edge probes exchange one bare-tile strip each (the
+        # edge pipeline's shape), so their model is always mode="edge":
+        # each measured span divided by ITS OWN edge's bytes.
+        per_edge = roofline.ici_ghost_bytes_per_edge(
+            info["tile"], info["channels"], info["halo"],
+            info["mesh_shape"], elem_bytes=info.get("elem_bytes", 1),
+            mode="edge",
+        )
+        lines.append("per-edge exchange (independent ppermutes; border "
+                     "strips fence per edge):")
+        ehead = (f"{'edge':<6}  {'seconds':>10}  {'model KB':>8}  "
+                 f"{'ICI GB/s':>8} {'peak':>6}")
+        lines += [ehead, "-" * len(ehead)]
+        for x, span_name in edge_rows:
+            sec = by[span_name]["seconds"] / by[span_name]["count"]
+            b = per_edge.get(x, 0.0)
+            ann = ""
+            if sec > 0 and b > 0:
+                gbps = b / sec / 1e9
+                ann = (f"{gbps:8.2f} "
+                       f"{100 * gbps / roofline.V5E_ICI_GBPS:5.1f}%")
+            lines.append(
+                f"{x:<6}  {sec:>10.6f}  {b / 1e3:>8.3f}  {ann:>15}"
+            )
     ex, it = by.get("sharded.halo_exchange"), by.get("sharded.interior_compute")
     if ex and it and it["seconds"] > 0:
         from tpu_stencil.runtime.autotune import OVERLAP_MIN_RATIO
@@ -150,7 +193,8 @@ def render_overlap(tracer: Tracer, info: dict) -> str:
         ratio = (ex["seconds"] / ex["count"]) / (it["seconds"] / it["count"])
         lines.append(
             f"probe ratio exchange/interior: {ratio:.3f} "
-            f"(--overlap auto splits above {OVERLAP_MIN_RATIO:g})"
+            f"(--overlap auto splits above {OVERLAP_MIN_RATIO:g}; "
+            f"split-vs-edge decided by the measured candidate A/B)"
         )
     return "\n".join(lines) + "\n"
 

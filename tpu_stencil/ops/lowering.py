@@ -229,6 +229,18 @@ def valid_step(ext_u8: jax.Array, plan: StencilPlan) -> jax.Array:
 
     The unit shared by the single-device driver (ghosts from zero padding)
     and the sharded driver (ghosts from ppermute halo exchange).
+
+    Window-independence contract (what the overlap schedules rest on):
+    every plan computes each output pixel as a per-pixel shifted-add
+    chain in static tap order over its ``(k, k)`` input window —
+    ``_sep_pass``/the direct loops/``conv2d_valid`` are all elementwise
+    over window slices — so the result is a pure function of the input
+    window's VALUES, never of how the surrounding array was windowed or
+    materialized. Slicing one joined extended array
+    (:func:`valid_window`, the split schedule) and concatenating the
+    same values from per-edge ghost strips (the partitioned per-edge
+    pipeline, :mod:`tpu_stencil.parallel.overlap`) are therefore
+    bit-identical by construction.
     """
     if plan.kind == "sep_int":
         xi = ext_u8.astype(jnp.int32)

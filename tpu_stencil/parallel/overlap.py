@@ -22,6 +22,28 @@ measure, or force it. This module makes the schedule explicit:
   (its outer ``fuse*halo`` rows/cols play the ghost role — local,
   trusted data instead of exchanged data; the kernel cannot tell the
   difference).
+* :func:`edge_step` / :func:`fused_edge_chunk` — the partitioned
+  per-edge pipeline (``--overlap edge``, after partitioned/persistent
+  MPI stencil communication, PAPERS.md arxiv 2508.13370). The split
+  schedules above still run ONE corner-routed exchange and a single
+  join before any border strip computes; here the exchange itself is
+  partitioned into four independent per-edge ``ppermute``\\ s (N/S on
+  the rows axis, W/E on the cols axis, each over the *bare* tile) plus
+  one tiny packed second hop for the four corner patches, and every
+  border strip's compute data-depends ONLY on its own edge's arrival:
+  the top strip fences on the N ppermute alone, the left strip on the
+  W ppermute alone, and so on. XLA is therefore free to release the
+  interior band AND early border strips while slower edges are still
+  in flight — per-edge dependence instead of a single join.
+* :func:`edge_iterate` — the persistent-exchange rep loop for the edge
+  pipeline: the per-edge ghost slab is threaded through the
+  ``lax.fori_loop`` carry (allocated ONCE by the prologue exchange,
+  then ping/ponged between the while loop's aliased in/out buffers
+  every iteration — XLA's while-loop buffer assignment is fixed, so
+  the traced steady state performs zero per-rep allocation or setup),
+  and each iteration posts the NEXT exchange as soon as its tile is
+  produced — the ``MPI_Start``-at-end-of-iteration shape of persistent
+  communication, expressed as data dependence.
 
 Bit-exactness (the acceptance bar: identical output to the
 exchange-then-compute program on every plan/boundary/channels/fuse
@@ -32,34 +54,56 @@ combination):
   note), and the interior's input window is the local tile — the same
   values the monolithic ghost-extended array holds at those
   coordinates;
+* the per-edge pieces assemble each strip's input window by
+  concatenation (edge ghost + tile slab, corner ghost + edge slabs +
+  tile corner) instead of slicing one joined extended array — the
+  window VALUES are identical either way, and every plan computes each
+  output pixel as a per-pixel shifted-add chain in static tap order
+  over its own window (``valid_step``'s window-independence contract),
+  so how the window was materialized cannot change a single bit;
 * the fused interior relies on exactly the overlap-halo argument the
   valid-ghost kernel already rests on: any radius-``fuse*halo`` input
   window determines the ``fuse``-rep output, and the kernel's global
   re-zero runs on *global* coordinates, which each band call passes
   unchanged.
 
+Corner routing without diagonal sends: the NW corner ghost is the west
+neighbor's *own* N ghost's east columns (that neighbor already received
+its N ghost from my NW diagonal), so one packed W/E ``ppermute`` of the
+N+S ghosts' edge columns delivers all four ``g x g`` corner patches —
+two tiny messages, the per-edge analog of the phased scheme's
+corner-through-edge routing. Zero-boundary corners fall out: a missing
+diagonal means either the hop has no source (edge rank) or the relayed
+strip is itself zeros, both yielding the calloc'd-ghost zeros the
+monolithic program holds there.
+
 Degenerate tiles: a tile with no ghost-free interior (min dimension
 ``<= 2 * fuse * halo``) degrades to the monolithic exchange-then-compute
 step inside the same program — the split is a schedule, never a
-correctness precondition.
+correctness precondition. The runner resolves the *reported* mode to
+``off`` when even the single-rep split is degenerate, so the gauge and
+``JobResult`` name what actually runs.
 
 Mode vocabulary (``--overlap``): ``off`` (delegate to XLA, the
 pre-existing program), ``split`` (per-rep split), ``fused-split``
 (chunked split; degrades to ``split`` when the backend is not Pallas),
-``auto`` (resolved by :func:`tpu_stencil.runtime.autotune.best_overlap`
-from the measured exchange/interior phase-probe ratio, cached on disk
-alongside the backend/schedule/geometry verdicts).
+``edge`` (the partitioned per-edge pipeline, per-rep on XLA and chunked
+on Pallas), ``auto`` (resolved by
+:func:`tpu_stencil.runtime.autotune.best_overlap` from the measured
+exchange/interior phase-probe ratio plus the split-vs-edge candidate
+A/B, cached on disk alongside the backend/schedule/geometry verdicts).
 """
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import Dict, Optional
 
 import jax.numpy as jnp
 from jax import lax
 
 from tpu_stencil.config import OVERLAP_MODES
 from tpu_stencil.ops import lowering as _lowering
+from tpu_stencil.parallel import halo as _halo
 from tpu_stencil.parallel.halo import halo_exchange
 
 # Numeric codes the ``overlap_mode`` obs gauge reports (resolved modes
@@ -67,8 +111,15 @@ from tpu_stencil.parallel.halo import halo_exchange
 # AUTO_CODE is for contexts with no mesh to resolve against (the serve
 # engine records its *configured* mode): a requested-but-unresolved
 # "auto".
-MODE_CODES = {"off": 0, "split": 1, "fused-split": 2}
-AUTO_CODE = 3
+MODE_CODES = {"off": 0, "split": 1, "fused-split": 2, "edge": 3}
+AUTO_CODE = 4
+
+# The per-edge ghost-slab vocabulary: four edge strips plus the four
+# corner patches the packed second hop delivers. Order is load-bearing
+# for multi-host determinism (every rank must issue the same collective
+# sequence) and for the probe/breakdown tables.
+EDGE_NAMES = ("n", "s", "w", "e")
+CORNER_NAMES = ("nw", "ne", "sw", "se")
 
 
 def check_mode(mode: str) -> str:
@@ -184,3 +235,270 @@ def fused_split_chunk(tile_u8, plan, axes, fuse, global_shape, interpret,
     mid = jnp.concatenate([left, interior, right], axis=1)
     out2 = jnp.concatenate([top, mid, bottom], axis=0)
     return out2.reshape(tile_u8.shape)
+
+
+# --- partitioned per-edge pipeline ("--overlap edge") -------------------
+
+
+def exchange_edge(tile, g: int, axis_name: str, axis_size: int, dim: int,
+                  lo: bool, boundary: str = "zero"):
+    """ONE edge's ghost strip as one independent ``ppermute``.
+
+    ``lo=True`` is the low side of ``dim`` (N for dim 0, W for dim 1):
+    the ghost arrives from the previous rank's high strip via the
+    forward permutation — exactly one collective, no dependence on any
+    other edge's traffic. Size-1 axes degrade locally (zeros for the
+    calloc'd zero boundary, the opposite strip for periodic wrap), so
+    the same program text serves meshes with a trivial axis."""
+    if boundary not in ("zero", "periodic"):
+        raise ValueError(f"unknown boundary {boundary!r}")
+    src = _halo._edge(tile, dim, lo=not lo, halo=g)  # strip the ghost mirrors
+    if axis_size == 1:
+        if boundary == "periodic":
+            return src
+        return jnp.zeros_like(src)
+    if boundary == "periodic":
+        perm = (
+            [(i, (i + 1) % axis_size) for i in range(axis_size)] if lo
+            else [(i, (i - 1) % axis_size) for i in range(axis_size)]
+        )
+    else:
+        # Ranks with no source receive zeros = the global zero boundary.
+        perm = (
+            [(i, i + 1) for i in range(axis_size - 1)] if lo
+            else [(i, i - 1) for i in range(1, axis_size)]
+        )
+    return lax.ppermute(src, axis_name, perm)
+
+
+def exchange_corners(n_ghost, s_ghost, g: int, axis_name: str,
+                     axis_size: int, dim: int, boundary: str = "zero"):
+    """The four ``g x g`` corner ghosts, via ONE packed W/E ``ppermute``
+    per direction (two tiny messages total).
+
+    My NW corner ghost is my NW diagonal's bottom-right ``g x g`` block
+    — which my west neighbor already holds as the east columns of *its*
+    N ghost. So each rank relays the edge columns of its own N+S ghosts
+    (packed into one ``2g x g`` payload per direction) and receives its
+    west- and east-side corner pairs. Data-dependence: corners wait on
+    the N/S ppermutes plus this hop — two edges, never the full join.
+    """
+    east = jnp.concatenate([
+        _halo._edge(n_ghost, dim, lo=False, halo=g),
+        _halo._edge(s_ghost, dim, lo=False, halo=g),
+    ], axis=0)
+    west = jnp.concatenate([
+        _halo._edge(n_ghost, dim, lo=True, halo=g),
+        _halo._edge(s_ghost, dim, lo=True, halo=g),
+    ], axis=0)
+    if axis_size == 1:
+        if boundary == "periodic":
+            lo_pack, hi_pack = east, west  # my own wrap is my neighbor
+        else:
+            lo_pack, hi_pack = jnp.zeros_like(east), jnp.zeros_like(west)
+    else:
+        if boundary == "periodic":
+            fwd = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+            bwd = [(i, (i - 1) % axis_size) for i in range(axis_size)]
+        else:
+            fwd = [(i, i + 1) for i in range(axis_size - 1)]
+            bwd = [(i, i - 1) for i in range(1, axis_size)]
+        lo_pack = lax.ppermute(east, axis_name, fwd)  # from my west neighbor
+        hi_pack = lax.ppermute(west, axis_name, bwd)  # from my east neighbor
+    nw, sw = lo_pack[:g], lo_pack[g:]
+    ne, se = hi_pack[:g], hi_pack[g:]
+    return nw, ne, sw, se
+
+
+def exchange_edge_slab(tile, g: int, axes, boundary: str = "zero"
+                       ) -> Dict[str, jnp.ndarray]:
+    """The full per-edge ghost slab for one exchange: ``{"n", "s", "w",
+    "e"}`` edge strips (four INDEPENDENT ppermutes over the bare tile)
+    plus ``{"nw", "ne", "sw", "se"}`` corner patches (the packed second
+    hop). This is the unit :func:`edge_iterate` threads through the rep
+    loop carry — the persistent exchange buffer."""
+    (row_axis, r, dim0), (col_axis, c, dim1) = axes
+    n = exchange_edge(tile, g, row_axis, r, dim0, lo=True,
+                      boundary=boundary)
+    s = exchange_edge(tile, g, row_axis, r, dim0, lo=False,
+                      boundary=boundary)
+    w = exchange_edge(tile, g, col_axis, c, dim1, lo=True,
+                      boundary=boundary)
+    e = exchange_edge(tile, g, col_axis, c, dim1, lo=False,
+                      boundary=boundary)
+    corners = exchange_corners(n, s, g, col_axis, c, dim1, boundary)
+    return {**dict(zip(EDGE_NAMES, (n, s, w, e))),
+            **dict(zip(CORNER_NAMES, corners))}
+
+
+def edge_step_from(tile_u8, slab, plan, mask_tile=None):
+    """One repetition from an already-exchanged per-edge ghost slab.
+
+    Nine pieces, each a ``valid_step`` over its own assembled input
+    window, stitched 3x3. The data-dependence structure IS the
+    schedule: interior <- local tile only; top/bottom strips (interior
+    width) <- their N/S edge ghost only; left/right strips (interior
+    height) <- their W/E edge ghost only; the four ``h x h`` corner
+    patches <- two adjacent edges + the corner hop. Requires a
+    non-degenerate tile (``min(th, tw) > 2*halo``) — callers degrade to
+    the monolithic step below that (:func:`edge_step` does)."""
+    h = plan.halo
+    th, tw = int(tile_u8.shape[0]), int(tile_u8.shape[1])
+    cat = jnp.concatenate
+
+    def vs(win):
+        return _lowering.valid_step(win, plan)
+
+    n, s, w, e = slab["n"], slab["s"], slab["w"], slab["e"]
+    interior = vs(tile_u8)
+    top = vs(cat([n, tile_u8[: 2 * h]], axis=0))
+    bottom = vs(cat([tile_u8[th - 2 * h:], s], axis=0))
+    left = vs(cat([w, tile_u8[:, : 2 * h]], axis=1))
+    right = vs(cat([tile_u8[:, tw - 2 * h:], e], axis=1))
+    nw_o = vs(cat([
+        cat([slab["nw"], n[:, : 2 * h]], axis=1),
+        cat([w[: 2 * h], tile_u8[: 2 * h, : 2 * h]], axis=1),
+    ], axis=0))
+    ne_o = vs(cat([
+        cat([n[:, tw - 2 * h:], slab["ne"]], axis=1),
+        cat([tile_u8[: 2 * h, tw - 2 * h:], e[: 2 * h]], axis=1),
+    ], axis=0))
+    sw_o = vs(cat([
+        cat([w[th - 2 * h:], tile_u8[th - 2 * h:, : 2 * h]], axis=1),
+        cat([slab["sw"], s[:, : 2 * h]], axis=1),
+    ], axis=0))
+    se_o = vs(cat([
+        cat([tile_u8[th - 2 * h:, tw - 2 * h:], e[th - 2 * h:]], axis=1),
+        cat([s[:, tw - 2 * h:], slab["se"]], axis=1),
+    ], axis=0))
+    out = cat([
+        cat([nw_o, top, ne_o], axis=1),
+        cat([left, interior, right], axis=1),
+        cat([sw_o, bottom, se_o], axis=1),
+    ], axis=0)
+    if mask_tile is not None:
+        out = out * mask_tile
+    return out
+
+
+def edge_step(tile_u8, plan, axes, mask_tile=None, boundary="zero"):
+    """One repetition of the per-edge pipeline, exchange included (the
+    probe/one-shot spelling; the production rep loop is
+    :func:`edge_iterate`, which owns the exchange so the slab persists
+    across reps). Degenerate tiles — no ghost-free interior — run the
+    monolithic exchange-then-compute program, bit-exact like
+    :func:`split_step`'s degrade."""
+    h = plan.halo
+    th, tw = int(tile_u8.shape[0]), int(tile_u8.shape[1])
+    if h == 0:
+        out = _lowering.valid_step(tile_u8, plan)
+    elif th <= 2 * h or tw <= 2 * h:
+        ext = halo_exchange(tile_u8, h, axes, boundary)
+        out = _lowering.valid_step(ext, plan)
+    else:
+        slab = exchange_edge_slab(tile_u8, h, axes, boundary)
+        return edge_step_from(tile_u8, slab, plan, mask_tile)
+    if mask_tile is not None:
+        out = out * mask_tile
+    return out
+
+
+def fused_edge_chunk(tile_u8, plan, axes, fuse, global_shape, interpret,
+                     schedule=None, block_h: Optional[int] = None,
+                     slab=None):
+    """``fuse`` repetitions of the per-edge pipeline (Pallas valid-ghost
+    path): one ``g = fuse*halo``-deep per-edge slab covers the whole
+    chunk, and the nine pieces each run the valid-ghost kernel over
+    their own assembled window with the SAME global (row, flat-col)
+    origins the monolithic program would pass — so the kernel's
+    global-extent re-zero, and therefore every bit, is identical.
+
+    ``slab``: an already-exchanged depth-``g`` slab (from
+    :func:`edge_iterate`'s carry); None exchanges here. Degenerate
+    chunks run the monolithic valid-ghost chunk."""
+    from tpu_stencil.ops import pallas_stencil
+
+    (row_axis, r, dim0), (col_axis, c, dim1) = axes
+    g = fuse * plan.halo
+    th, tw = int(tile_u8.shape[0]), int(tile_u8.shape[1])
+    channels = tile_u8.shape[2] if tile_u8.ndim == 3 else 1
+    row0 = lax.axis_index(row_axis) * th
+    col0 = lax.axis_index(col_axis) * (tw * channels)
+    kw = dict(interpret=interpret, vma=(row_axis, col_axis),
+              schedule=schedule,
+              **({"block_h": block_h} if block_h is not None else {}))
+    if g == 0 or th <= 2 * g or tw <= 2 * g:
+        ext = halo_exchange(tile_u8, g, axes)
+        ext2 = ext.reshape(th + 2 * g, (tw + 2 * g) * channels)
+        out2 = pallas_stencil.valid_fused(
+            ext2, plan, fuse, channels, row0, col0, global_shape, **kw
+        )
+        return out2.reshape(tile_u8.shape)
+    if slab is None:
+        slab = exchange_edge_slab(tile_u8, g, axes)
+    gc = g * channels
+    twc = tw * channels
+    cat = jnp.concatenate
+
+    def vf(win, r_off, c_off):
+        win2 = win.reshape(win.shape[0], win.shape[1] * channels)
+        return pallas_stencil.valid_fused(
+            win2, plan, fuse, channels, row0 + r_off, col0 + c_off,
+            global_shape, **kw
+        )
+
+    n, s, w, e = slab["n"], slab["s"], slab["w"], slab["e"]
+    interior = vf(tile_u8, g, gc)
+    top = vf(cat([n, tile_u8[: 2 * g]], axis=0), 0, gc)
+    bottom = vf(cat([tile_u8[th - 2 * g:], s], axis=0), th - g, gc)
+    left = vf(cat([w, tile_u8[:, : 2 * g]], axis=1), g, 0)
+    right = vf(cat([tile_u8[:, tw - 2 * g:], e], axis=1), g, twc - gc)
+    nw_o = vf(cat([
+        cat([slab["nw"], n[:, : 2 * g]], axis=1),
+        cat([w[: 2 * g], tile_u8[: 2 * g, : 2 * g]], axis=1),
+    ], axis=0), 0, 0)
+    ne_o = vf(cat([
+        cat([n[:, tw - 2 * g:], slab["ne"]], axis=1),
+        cat([tile_u8[: 2 * g, tw - 2 * g:], e[: 2 * g]], axis=1),
+    ], axis=0), 0, twc - gc)
+    sw_o = vf(cat([
+        cat([w[th - 2 * g:], tile_u8[th - 2 * g:, : 2 * g]], axis=1),
+        cat([slab["sw"], s[:, : 2 * g]], axis=1),
+    ], axis=0), th - g, 0)
+    se_o = vf(cat([
+        cat([tile_u8[th - 2 * g:, tw - 2 * g:], e[th - 2 * g:]], axis=1),
+        cat([s[:, tw - 2 * g:], slab["se"]], axis=1),
+    ], axis=0), th - g, twc - gc)
+    out2 = cat([
+        cat([nw_o, top, ne_o], axis=1),
+        cat([left, interior, right], axis=1),
+        cat([sw_o, bottom, se_o], axis=1),
+    ], axis=0)
+    return out2.reshape(tile_u8.shape)
+
+
+def edge_iterate(tile, reps, g: int, axes, compute_fn, boundary="zero"):
+    """The persistent-exchange rep loop of the edge pipeline.
+
+    The prologue exchange allocates the per-edge ghost slab ONCE; the
+    ``lax.fori_loop`` then carries ``(tile, slab)``, each iteration
+    consuming the slab that matches its tile and posting the NEXT
+    exchange as soon as its output exists — persistent communication
+    (MPI_Start at the end of the iteration, MPI_Wait at the top of the
+    next) expressed as data dependence. Because the slab is loop state,
+    XLA's while-loop buffer assignment ping/pongs it between the two
+    aliased carry buffers: zero per-rep allocation or setup in the
+    traced steady state. The posted-but-unconsumed final slab is the
+    one wasted exchange persistent MPI also pays on its last round.
+
+    ``compute_fn(tile, slab) -> tile`` runs one rep (or one fused
+    chunk) from the slab; ``reps`` is the (traced) loop count."""
+    slab0 = exchange_edge_slab(tile, g, axes, boundary)
+
+    def body(_, carry):
+        x, slab = carry
+        out = compute_fn(x, slab)
+        return out, exchange_edge_slab(out, g, axes, boundary)
+
+    out, _ = lax.fori_loop(0, reps, body, (tile, slab0))
+    return out

@@ -7,9 +7,13 @@ local stencil on the ghost-extended tile, double-buffered via the
 ``lax.fori_loop`` carry, entirely on device. Compute/communication overlap
 is either delegated to XLA's latency-hiding scheduler (``--overlap off``,
 the default) or made explicit via the interior/border split of
-:mod:`tpu_stencil.parallel.overlap` (``--overlap split|fused-split|auto``)
-— the reference's hand-written inner-then-border schedule (``:194-224``),
-expressed as data dependence instead of request ordering.
+:mod:`tpu_stencil.parallel.overlap`
+(``--overlap split|fused-split|edge|auto``) — the reference's
+hand-written inner-then-border schedule (``:194-224``), expressed as
+data dependence instead of request ordering; ``edge`` further
+partitions the exchange into four independent per-edge ``ppermute``\\ s
+with persistent ghost slabs carried across the rep loop (the
+partitioned/persistent MPI pattern).
 
 Non-divisible image shapes — which the reference aborts on
 (``mpi/mpi_convolution.c:54-58``) — are padded up to the tile grid and the
@@ -134,11 +138,15 @@ def build_sharded_iterate(
     ``overlap``: a *resolved* interior/border schedule — ``off`` keeps the
     monolithic exchange-then-compute step (XLA's latency-hiding scheduler
     owns the overlap), ``split``/``fused-split`` run the explicit split of
-    :mod:`tpu_stencil.parallel.overlap` (bit-exact with ``off`` by
-    construction). ``auto`` must be resolved by the caller
-    (:class:`ShardedRunner` does) before reaching here.
+    :mod:`tpu_stencil.parallel.overlap`, ``edge`` the partitioned
+    per-edge pipeline with the persistent ghost slab threaded through
+    the rep-loop carry (all bit-exact with ``off`` by construction).
+    ``auto`` must be resolved by the caller (:class:`ShardedRunner`
+    does) before reaching here; ``edge`` additionally requires a tile
+    with a ghost-free interior at every chunk depth (the runner clamps
+    ``fuse`` and resolves degenerate tiles to ``off``).
     """
-    if overlap not in ("off", "split", "fused-split"):
+    if overlap not in ("off", "split", "fused-split", "edge"):
         raise ValueError(
             f"build_sharded_iterate needs a resolved overlap mode, "
             f"got {overlap!r}"
@@ -162,7 +170,19 @@ def build_sharded_iterate(
                 "pallas sharded execution with a pad mask requires fuse=1"
             )
 
-        if overlap in ("split", "fused-split"):
+        if overlap == "edge":
+            # Partitioned per-edge pipeline at chunk granularity: the
+            # slab comes from edge_iterate's persistent carry, each
+            # border band's launch fences only on its own edge.
+            def edge_chunk(x, slab, n_fused, mask_tile):
+                out = overlap_mod.fused_edge_chunk(
+                    x, plan, axes, n_fused, global_shape, interpret,
+                    schedule=schedule, block_h=block_h, slab=slab,
+                )
+                if mask_tile is not None:
+                    out = out * mask_tile
+                return out
+        elif overlap in ("split", "fused-split"):
             # Explicit split at chunk granularity: the interior launch
             # reads only the local tile, the border launches read the
             # exchanged ghosts ("split" differs from "fused-split" only
@@ -184,6 +204,10 @@ def build_sharded_iterate(
                 if mask_tile is not None:
                     out = out * mask_tile
                 return out
+    elif overlap == "edge":
+        def edge_chunk(x, slab, n_fused, mask_tile):
+            assert n_fused == 1
+            return overlap_mod.edge_step_from(x, slab, plan, mask_tile)
     elif overlap in ("split", "fused-split"):
         # fused-split needs the valid-ghost Pallas kernel; on the XLA
         # path both modes mean the per-rep split (the runner reports the
@@ -196,20 +220,49 @@ def build_sharded_iterate(
             assert n_fused == 1
             return _local_step(x, plan, axes, mask_tile, boundary)
 
-    def iter_tile(tile, reps, mask_tile):
-        # ``fuse`` reps per exchange, then the remainder one at a time.
-        # With a mask (indivisible global shape) fuse is forced to 1 by the
-        # runner: the pad region must be re-zeroed *every* rep, which a
-        # fused kernel does not do.
-        if fuse > 1:
-            tile = lax.fori_loop(
-                0, reps // fuse,
-                lambda _, x: step_chunk(x, fuse, mask_tile), tile,
+    if overlap == "edge":
+        def iter_tile(tile, reps, mask_tile):
+            # Persistent-slab loop for the steady-state reps: the
+            # per-edge ghost slab lives in the fori_loop carry,
+            # allocated once by the prologue exchange — no per-rep
+            # setup.
+            if fuse > 1:
+                tile = overlap_mod.edge_iterate(
+                    tile, reps // fuse, fuse * plan.halo, axes,
+                    lambda x, sl: edge_chunk(x, sl, fuse, mask_tile),
+                    boundary,
+                )
+
+                # Remainder (< fuse reps, possibly ZERO — reps is
+                # traced): the slab exchanges inside the body, because a
+                # persistent prologue ahead of a zero-trip loop would
+                # execute six collectives nobody consumes.
+                def rem_body(_, x):
+                    sl = overlap_mod.exchange_edge_slab(
+                        x, plan.halo, axes, boundary
+                    )
+                    return edge_chunk(x, sl, 1, mask_tile)
+
+                return lax.fori_loop(0, reps % fuse, rem_body, tile)
+            return overlap_mod.edge_iterate(
+                tile, reps, plan.halo, axes,
+                lambda x, sl: edge_chunk(x, sl, 1, mask_tile), boundary,
             )
-            reps = reps % fuse
-        return lax.fori_loop(
-            0, reps, lambda _, x: step_chunk(x, 1, mask_tile), tile
-        )
+    else:
+        def iter_tile(tile, reps, mask_tile):
+            # ``fuse`` reps per exchange, then the remainder one at a
+            # time. With a mask (indivisible global shape) fuse is forced
+            # to 1 by the runner: the pad region must be re-zeroed
+            # *every* rep, which a fused kernel does not do.
+            if fuse > 1:
+                tile = lax.fori_loop(
+                    0, reps // fuse,
+                    lambda _, x: step_chunk(x, fuse, mask_tile), tile,
+                )
+                reps = reps % fuse
+            return lax.fori_loop(
+                0, reps, lambda _, x: step_chunk(x, 1, mask_tile), tile
+            )
 
     if needs_mask:
         local_iter = iter_tile
@@ -493,6 +546,20 @@ class ShardedRunner:
         self.overlap = self._resolve_overlap(overlap)
         if self.overlap == "split":
             self.fuse = 1
+        elif self.overlap == "edge":
+            if self.backend != "pallas":
+                self.fuse = 1  # per-rep pipeline on the XLA path
+            elif model.halo:
+                # Keep every chunk split-able: the per-edge pipeline
+                # needs a nonempty ghost-free interior at the chunk
+                # depth g = fuse*halo (min(tile) > 2g), where
+                # fused-split would degrade in-program instead.
+                self.fuse = max(
+                    1, min(self.fuse, (min(tile) - 1) // (2 * model.halo))
+                )
+        # The resolved mode is always a MODE_CODES member — never the
+        # literal "auto", and never a schedule the tile degraded away.
+        assert self.overlap in overlap_mod.MODE_CODES, self.overlap
         from tpu_stencil import obs as _obs
 
         _obs.registry().gauge("overlap_mode").set(
@@ -523,11 +590,20 @@ class ShardedRunner:
     def _resolve_overlap(self, requested: str) -> str:
         """Resolve the requested ``--overlap`` mode to what this runner
         actually compiles: ``auto`` asks the autotuner (measured
-        exchange/interior phase-probe ratio, cached on disk alongside the
+        exchange/interior phase-probe ratio plus the split-vs-edge
+        candidate A/B, cached on disk alongside the
         backend/schedule/geometry verdicts — a warm cache never
         re-probes); ``fused-split`` degrades to ``split`` when the
-        interior cannot run the valid-ghost Pallas kernel."""
+        interior cannot run the valid-ghost Pallas kernel; a degenerate
+        tile (no ghost-free interior even at single-rep depth) resolves
+        every split flavor to ``off`` — the program would run the
+        monolithic step in-program anyway, and the gauge/``JobResult``
+        must report what actually runs, never a schedule that degraded
+        away."""
         if requested == "off":
+            return "off"
+        h = self.model.plan.halo
+        if h < 1 or min(self.tile) <= 2 * h:
             return "off"
         if requested != "auto":
             if requested == "fused-split" and self.backend != "pallas":
@@ -558,7 +634,7 @@ class ShardedRunner:
 
         from tpu_stencil.runtime import autotune
 
-        modes = ("off", "split", "fused-split")
+        modes = ("off", "split", "fused-split", "edge")
         vote = np.full(1, -1, np.int32)
         if jax.process_index() == 0:
             hit = autotune.cached_overlap(
@@ -581,19 +657,31 @@ class ShardedRunner:
         vote = multihost_utils.broadcast_one_to_all(vote)
         return modes[int(vote[0])]
 
-    def _measure_overlap_probes(self) -> Tuple[float, float]:
-        """(exchange_seconds, interior_seconds): one best-of-3 execution
-        each of the exchange-only and interior-only probe programs on a
-        zero canvas of this runner's padded shape, compiles fenced out —
-        the ratio ``--overlap auto`` decides on. Collective on a
-        multi-host mesh (every process must call it together)."""
+    def _measure_overlap_probes(self) -> dict:
+        """The probe-measurement bundle ``--overlap auto`` decides on:
+        ``{"exchange_s", "interior_s", "edges": {edge: s}, "candidates":
+        {"split": s, "edge": s}}`` — best-of-3 executions each of the
+        exchange-only / interior-only phase probes, the per-edge
+        exchange probes (one independent ppermute each), and the two
+        one-rep candidate step programs (the split-vs-edge A/B), on a
+        zero canvas of this runner's padded shape with compiles fenced
+        out. Collective on a multi-host mesh (every process must call it
+        together, and the dict insertion order fixes the collective
+        sequence)."""
         exchange_fn, interior_fn = self._phase_probes()
+        split_fn, edge_fn = self._candidate_probes()
+        edge_fns = self.edge_probes()
         shape = self.padded_shape
         if self.channels != 1:
             shape = shape + (self.channels,)
         img = jax.device_put(np.zeros(shape, np.uint8), self.sharding)
-        jax.block_until_ready(exchange_fn(img))  # compile fences
-        jax.block_until_ready(interior_fn(img))
+        ordered = (
+            [("exchange_s", exchange_fn), ("interior_s", interior_fn)]
+            + [(f"edge:{k}", fn) for k, fn in edge_fns.items()]
+            + [("cand:split", split_fn), ("cand:edge", edge_fn)]
+        )
+        for _, fn in ordered:  # compile fences
+            jax.block_until_ready(fn(img))
 
         def best_of(fn, n=3):
             import time
@@ -605,7 +693,45 @@ class ShardedRunner:
                 best = min(best, time.perf_counter() - t0)
             return best
 
-        return best_of(exchange_fn), best_of(interior_fn)
+        timings = {name: best_of(fn) for name, fn in ordered}
+        return {
+            "exchange_s": timings["exchange_s"],
+            "interior_s": timings["interior_s"],
+            "edges": {k: timings[f"edge:{k}"] for k in edge_fns},
+            "candidates": {"split": timings["cand:split"],
+                           "edge": timings["cand:edge"]},
+        }
+
+    def _candidate_probes(self):
+        """One-rep ``split_step`` and ``edge_step`` programs over this
+        runner's mesh — the schedule A/B the three-way auto verdict
+        times. Both run the XLA lowering regardless of the production
+        backend: the Pallas chunked variants share the same dependence
+        structure (one joined exchange vs four per-edge fences), so the
+        XLA pair is the portable proxy for which structure hides the
+        wires better on this mesh. Neither donates."""
+        plan = self.model.plan
+        r = self.mesh.shape[ROWS_AXIS]
+        c = self.mesh.shape[COLS_AXIS]
+        axes = ((ROWS_AXIS, r, 0), (COLS_AXIS, c, 1))
+        spec = (
+            P(ROWS_AXIS, COLS_AXIS) if self.channels == 1
+            else P(ROWS_AXIS, COLS_AXIS, None)
+        )
+        boundary = self.boundary
+
+        def split_probe(tile):
+            return overlap_mod.split_step(tile, plan, axes, None, boundary)
+
+        def edge_probe(tile):
+            return overlap_mod.edge_step(tile, plan, axes, None, boundary)
+
+        def build(f):
+            return jax.jit(shard_map(
+                f, mesh=self.mesh, in_specs=(spec,), out_specs=spec,
+            ))
+
+        return build(split_probe), build(edge_probe)
 
     def _phase_probes(self):
         """Two compile-once probe programs over this runner's mesh:
@@ -711,16 +837,27 @@ class ShardedRunner:
         split_probes = (
             self._overlap_probes() if self.overlap != "off" else None
         )
+        edge_fns = self.edge_probes()
         with obs.span("sharded.probe_compile", "sharded") as s:
             s.fence(exchange_fn(img_dev))
             s.fence(interior_fn(img_dev))
             if split_probes is not None:
                 s.fence(split_probes[0](img_dev))
                 s.fence(split_probes[1](img_dev))
+            for fn in edge_fns.values():
+                s.fence(fn(img_dev))
         with obs.span("sharded.halo_exchange", "sharded") as s:
             s.fence(exchange_fn(img_dev))
         with obs.span("sharded.interior_compute", "sharded") as s:
             s.fence(interior_fn(img_dev))
+        # Per-edge exchange spans: one independent ppermute each — four
+        # DISTINCT fences per exchange on a 2-D mesh, the instrument
+        # that shows border strips can release per edge (no single
+        # join), and the per-edge latencies the --breakdown table and
+        # the multichip capture report.
+        for name, fn in edge_fns.items():
+            with obs.span(f"sharded.exchange_edge[{name}]", "sharded") as s:
+                s.fence(fn(img_dev))
         if split_probes is not None:
             # The explicit split's halves, measured separately: the
             # interior band XLA may overlap with the exchange, and the
@@ -731,32 +868,53 @@ class ShardedRunner:
                 s.fence(split_probes[1](img_dev))
 
     def edge_probes(self):
-        """Per-mesh-axis exchange-only probe programs:
-        ``{"rows": fn, "cols": fn}`` (axes with one device are omitted —
-        nothing to exchange). Each runs ONLY that axis's ppermute ghost
-        traffic, ghosts cropped back off so specs match — the
-        post-mortem instrument :meth:`diagnose_edges` fences one at a
-        time to localize a wedged exchange to its mesh axis."""
+        """Per-EDGE exchange-only probe programs: a subset of ``{"n",
+        "s", "w", "e"}`` (axes with one device are omitted — nothing to
+        exchange). Each runs ONLY that edge's single independent
+        ``ppermute`` (:func:`tpu_stencil.parallel.overlap.
+        exchange_edge` — the same primitive the edge pipeline computes
+        its border strips from), with the arrived ghost folded into the
+        output so the collective cannot be simplified away. Used by the
+        trace-time per-edge spans, the auto-verdict measurement bundle,
+        the multichip bench capture's per-edge ICI riders, and the
+        post-mortem instrument :meth:`diagnose_edges`, which fences one
+        at a time to localize a wedged exchange to its specific edge."""
         plan = self.model.plan
-        halo = max(1, plan.halo)
+        g = max(1, plan.halo)
         spec = (
             P(ROWS_AXIS, COLS_AXIS) if self.channels == 1
             else P(ROWS_AXIS, COLS_AXIS, None)
         )
         boundary = self.boundary
+        r = self.mesh.shape[ROWS_AXIS]
+        c = self.mesh.shape[COLS_AXIS]
+        # One (axis, side) geometry per canonical edge name, emitted in
+        # EDGE_NAMES order — the one ordering every consumer shares.
+        geometry = {
+            "n": (ROWS_AXIS, r, 0, True), "s": (ROWS_AXIS, r, 0, False),
+            "w": (COLS_AXIS, c, 1, True), "e": (COLS_AXIS, c, 1, False),
+        }
+        sides = [
+            (name,) + geometry[name] for name in overlap_mod.EDGE_NAMES
+            if geometry[name][1] > 1
+        ]
         probes = {}
-        for name, axis_name, n, dim in (
-            ("rows", ROWS_AXIS, self.mesh.shape[ROWS_AXIS], 0),
-            ("cols", COLS_AXIS, self.mesh.shape[COLS_AXIS], 1),
-        ):
-            if n <= 1:
-                continue
+        for name, axis_name, n_ax, dim, lo in sides:
 
-            def exchange_one(tile, _axes=((axis_name, n, dim),), _dim=dim):
-                ext = halo_exchange(tile, halo, _axes, boundary)
-                crop = [slice(None)] * ext.ndim
-                crop[_dim] = slice(halo, halo + tile.shape[_dim])
-                return ext[tuple(crop)]
+            def exchange_one(tile, _a=axis_name, _n=n_ax, _d=dim, _lo=lo):
+                ghost = overlap_mod.exchange_edge(
+                    tile, g, _a, _n, _d, lo=_lo, boundary=boundary
+                )
+                # Fold the ghost in (shape-preserving shift) instead of
+                # cropping it off: the probe's output must data-depend
+                # on the arrived strip.
+                keep = [slice(None)] * tile.ndim
+                keep[_d] = (
+                    slice(0, tile.shape[_d] - g) if _lo else slice(g, None)
+                )
+                rest = tile[tuple(keep)]
+                parts = [ghost, rest] if _lo else [rest, ghost]
+                return jnp.concatenate(parts, axis=_d)
 
             probes[name] = jax.jit(shard_map(
                 exchange_one, mesh=self.mesh, in_specs=(spec,),
@@ -766,13 +924,19 @@ class ShardedRunner:
 
     def diagnose_edges(self, timeout_s: float = 10.0) -> dict:
         """Per-edge exchange verdicts after a suspected collective hang:
-        run each mesh axis's exchange-only probe on a fresh zero canvas,
-        each under its own watchdog, and report ``"ok"`` / ``"timeout"``
-        / ``"error: <type>"`` per axis — the sharded analog of "which
-        rank is stuck". Bounded by construction: a wedged device costs
-        at most ``timeout_s`` per axis (the abandoned fence thread is a
-        daemon). A fresh canvas, never the job's arrays — those were
+        run each edge's independent exchange probe on a fresh zero
+        canvas, each under its own watchdog, and report ``"ok (<measured
+        latency>)"`` / ``"timeout"`` / ``"error: <type>"`` per edge
+        (``n``/``s``/``w``/``e``) — which SPECIFIC edge's ghost traffic
+        is wedged, with the healthy edges' measured latencies for
+        contrast, instead of a whole-axis verdict. Bounded by
+        construction: a wedged device costs at most two watchdog
+        windows per edge — one for the compile-fencing first execution,
+        one for the timed run (the abandoned fence threads are
+        daemons). A fresh canvas, never the job's arrays — those were
         donated to the launch that hung."""
+        import time
+
         from tpu_stencil.resilience import deadline as _deadline
         from tpu_stencil.resilience.errors import DispatchTimeout
 
@@ -783,9 +947,18 @@ class ShardedRunner:
         verdicts = {}
         for name, fn in self.edge_probes().items():
             try:
+                # First execution fences the (fresh-jit) compile AND the
+                # first run under the watchdog — a wedged edge is caught
+                # here; then a second execution is timed, so a healthy
+                # edge reports its ICI latency, not its compile time.
                 _deadline.fence(fn(img), timeout_s,
-                                f"sharded.exchange[{name}]")
-                verdicts[name] = "ok"
+                                f"sharded.exchange_edge[{name}]/compile")
+                t0 = time.perf_counter()
+                _deadline.fence(fn(img), timeout_s,
+                                f"sharded.exchange_edge[{name}]")
+                verdicts[name] = (
+                    f"ok ({(time.perf_counter() - t0) * 1e3:.2f}ms)"
+                )
             except DispatchTimeout:
                 verdicts[name] = "timeout"
             except Exception as e:

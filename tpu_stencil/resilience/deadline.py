@@ -14,7 +14,9 @@ carried in ROADMAP). Two primitives convert silence into typed errors:
   killing a 2-hour-silent process. Used by the driver's chunk fences,
   the stream drain's compute fence, and the sharded path (which
   upgrades the timeout to :class:`~.errors.CollectiveTimeout` with
-  per-edge probe verdicts).
+  per-edge probe verdicts — one independent N/S/W/E ``ppermute`` probe
+  each, ``ShardedRunner.diagnose_edges``, so the report names the
+  specific stuck edge with the healthy edges' measured latencies).
 * :class:`Deadline` — an absolute time budget (serve's per-request
   deadlines): cheap ``expired()`` checks at scheduling points, so an
   expired request fails typed instead of occupying a batch slot.

@@ -80,10 +80,14 @@ class DispatchTimeout(ResilienceError):
 
 
 class CollectiveTimeout(DispatchTimeout):
-    """A sharded-mesh dispatch timed out. ``edges`` carries the per-mesh-
-    axis exchange-probe verdicts (``{"rows": "ok"|"timeout"|..., "cols":
-    ...}``) when a post-mortem diagnosis could run — which edge's ghost
-    traffic is wedged, the sharded analog of "which rank is stuck"."""
+    """A sharded-mesh dispatch timed out. ``edges`` carries the PER-EDGE
+    exchange-probe verdicts (``{"n": "ok (1.2ms)"|"timeout"|"error:
+    ...", "s": ..., "w": ..., "e": ...}`` — one independent ppermute
+    probe per edge, reusing the per-edge pipeline's exchange
+    primitives) when a post-mortem diagnosis could run: WHICH specific
+    edge's ghost traffic is wedged, with the healthy edges' measured
+    latencies for contrast — the sharded analog of "which rank is
+    stuck", at single-link resolution."""
 
     def __init__(self, label: str, seconds: float,
                  edges: Optional[dict] = None) -> None:

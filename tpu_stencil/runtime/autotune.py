@@ -465,19 +465,60 @@ def best_full_config(
 # the GPU tuning study both find the explicit schedule wins when comm and
 # compute are COMPARABLE; when the exchange is a negligible sliver of the
 # interior time there is nothing to hide and the stitch overhead is pure
-# loss. The decision input is the measured exchange/interior phase-probe
-# ratio (ShardedRunner._measure_overlap_probes).
+# loss. The decision inputs are the measured exchange/interior
+# phase-probe ratio plus, for the three-way off/split/edge verdict, the
+# measured one-rep split-vs-edge candidate A/B and the per-edge probe
+# spans (ShardedRunner._measure_overlap_probes).
 OVERLAP_MIN_RATIO = 0.05  # exchange below 5% of interior: overlap is moot
+
+_OVERLAP_MODES = ("off", "split", "fused-split", "edge")
 
 
 def overlap_from_ratio(ratio: float, backend: str) -> str:
     """Map a measured exchange/interior time ratio to an overlap mode:
     ``off`` below :data:`OVERLAP_MIN_RATIO`, else the chunked
     ``fused-split`` on the Pallas backend (one widened exchange per
-    fused chunk) and the per-rep ``split`` elsewhere."""
+    fused chunk) and the per-rep ``split`` elsewhere. The two-way
+    (legacy) half of :func:`overlap_verdict` — it never picks ``edge``
+    because it has no candidate A/B to justify it with."""
     if not ratio > OVERLAP_MIN_RATIO:
         return "off"
     return "fused-split" if backend == "pallas" else "split"
+
+
+def _probe_bundle(measured) -> dict:
+    """Normalize a ``measure()`` result: either the legacy
+    ``(exchange_s, interior_s)`` pair or the full bundle dict
+    (``exchange_s``/``interior_s``/``edges``/``candidates``) the runner
+    now produces — monkeypatched legacy measures keep deciding the
+    two-way verdict instead of crashing."""
+    if isinstance(measured, dict):
+        return measured
+    exchange_s, interior_s = measured
+    return {"exchange_s": exchange_s, "interior_s": interior_s}
+
+
+def overlap_verdict(bundle: dict, backend: str) -> str:
+    """The three-way measured verdict ``--overlap auto`` resolves to.
+
+    ``off`` when the exchange/interior ratio is below
+    :data:`OVERLAP_MIN_RATIO` (nothing worth hiding — every split
+    flavor's stitch overhead would be pure loss). Otherwise the
+    measured split-vs-edge candidate A/B decides: ``edge`` ONLY when
+    the per-edge pipeline's one-rep probe measured strictly faster than
+    the joined split's — never on modeling grounds — else the split
+    family (``fused-split`` on Pallas). Bundles without candidates
+    (legacy measures) fall back to :func:`overlap_from_ratio`."""
+    exchange_s = bundle["exchange_s"]
+    interior_s = bundle["interior_s"]
+    ratio = exchange_s / interior_s if interior_s > 0 else float("inf")
+    if not ratio > OVERLAP_MIN_RATIO:
+        return "off"
+    split_mode = "fused-split" if backend == "pallas" else "split"
+    cand = bundle.get("candidates") or {}
+    if "split" in cand and "edge" in cand:
+        return "edge" if cand["edge"] < cand["split"] else split_mode
+    return split_mode
 
 
 def _overlap_key(plan: StencilPlan, tile: Tuple[int, int], channels: int,
@@ -500,8 +541,7 @@ def cached_overlap(plan: StencilPlan, tile: Tuple[int, int], channels: int,
     hit = _load_cache().get(
         _overlap_key(plan, tile, channels, mesh_shape, backend)
     )
-    if isinstance(hit, dict) and hit.get("overlap") in (
-            "off", "split", "fused-split"):
+    if isinstance(hit, dict) and hit.get("overlap") in _OVERLAP_MODES:
         return hit["overlap"]
     return None
 
@@ -511,27 +551,44 @@ def best_overlap(plan: StencilPlan, tile: Tuple[int, int], channels: int,
                  measure, cache: bool = True) -> str:
     """The overlap mode for this (platform, filter, tile, mesh, backend):
     from the disk cache when available (a warm cache never re-probes),
-    measured once and cached otherwise. ``measure()`` returns
-    ``(exchange_seconds, interior_seconds)`` — the runner passes its
-    phase-probe closure, so the autotuner owns only the decision and the
-    persistence, never a mesh."""
+    measured once and cached otherwise. ``measure()`` returns the probe
+    bundle dict (``exchange_s``/``interior_s``/``edges``/``candidates``
+    — :meth:`ShardedRunner._measure_overlap_probes`) or the legacy
+    ``(exchange_seconds, interior_seconds)`` pair; the runner passes its
+    probe closure, so the autotuner owns only the decision and the
+    persistence, never a mesh. The cache entry carries the per-edge
+    probe spans and the candidate A/B next to the verdict, so a stored
+    ``edge`` decision is auditable."""
     if cache:
         hit = cached_overlap(plan, tile, channels, mesh_shape, backend)
         if hit is not None:
             return hit
-    exchange_s, interior_s = measure()
-    ratio = (
-        exchange_s / interior_s if interior_s > 0 else float("inf")
-    )
-    mode = overlap_from_ratio(ratio, backend)
+    bundle = _probe_bundle(measure())
+    mode = overlap_verdict(bundle, backend)
     if cache:
-        store = _load_cache()
-        store[_overlap_key(plan, tile, channels, mesh_shape, backend)] = {
+        exchange_s, interior_s = bundle["exchange_s"], bundle["interior_s"]
+        ratio = (
+            exchange_s / interior_s if interior_s > 0 else float("inf")
+        )
+        entry = {
             "overlap": mode,
             "ratio": round(ratio, 4),
             "exchange_us": round(exchange_s * 1e6, 2),
             "interior_us": round(interior_s * 1e6, 2),
         }
+        if bundle.get("edges"):
+            entry["edge_us"] = {
+                k: round(v * 1e6, 2) for k, v in bundle["edges"].items()
+            }
+        if bundle.get("candidates"):
+            entry["candidate_us"] = {
+                k: round(v * 1e6, 2)
+                for k, v in bundle["candidates"].items()
+            }
+        store = _load_cache()
+        store[_overlap_key(plan, tile, channels, mesh_shape, backend)] = (
+            entry
+        )
         _store_cache(store)
     return mode
 

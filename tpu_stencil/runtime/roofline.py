@@ -22,33 +22,60 @@ V5E_ICI_GBPS = 200.0
 V5E_PCIE_GBPS = 32.0
 
 
-def ici_ghost_bytes_per_rep(tile_shape, channels: int, halo: int,
-                            mesh_shape, fuse: int = 1,
-                            elem_bytes: int = 1) -> float:
-    """Modeled ICI ghost bytes *received per device per repetition* on
-    the sharded mesh — the comm side of the interior/border overlap
-    split (:mod:`tpu_stencil.parallel.overlap`), shown by ``--breakdown``
-    next to the measured exchange/interior/border probe spans.
+def ici_ghost_bytes_per_edge(tile_shape, channels: int, halo: int,
+                             mesh_shape, fuse: int = 1,
+                             elem_bytes: int = 1,
+                             mode: str = "phased") -> dict:
+    """Per-edge breakdown of the modeled ICI ghost bytes *received per
+    device per repetition*: ``{"n", "s", "w", "e"[, "corners"]}`` (keys
+    only for edges that exchange — axes of size 1 exchange nothing).
 
-    Model (an interior device — the bottleneck rank): the row phase
-    delivers two ``g = fuse*halo``-deep strips of the tile width; the
-    column phase runs on the row-extended array, so its two strips are
-    ``tile_h + 2*g`` tall. Axes of size 1 exchange nothing. A fused
-    chunk pays one exchange per ``fuse`` reps, so per-rep traffic
-    divides by ``fuse``. ``elem_bytes``: 1 for the uint8 exchanges (the
-    split schedules, the Pallas chunk, direct plans), 4 for the
-    monolithic XLA sep_int step's int32 phased exchange.
+    ``mode="phased"`` models the corner-routed two-phase exchange every
+    joined schedule runs (off/split/fused-split, and the per-axis
+    ppermutes of the monolithic step): the column strips ride the
+    row-extended array, so W/E are ``tile_h + 2*g`` tall and corners
+    travel inside them. ``mode="edge"`` models the partitioned per-edge
+    pipeline: all four strips cover the BARE tile (W/E are ``tile_h``
+    tall) and the four ``g x g`` corner patches arrive via the packed
+    second hop, broken out as ``"corners"`` — per-edge bytes the
+    ``--breakdown`` per-edge table and the multichip capture's ICI
+    riders divide each measured edge span by. A fused chunk pays one
+    exchange per ``fuse`` reps, so per-rep traffic divides by ``fuse``;
+    ``g = fuse*halo`` is the strip depth.
     """
     th, tw = tile_shape
     r, c = mesh_shape
     g = fuse * halo
-    total = 0
+    scale = elem_bytes / max(1, fuse)
+    per_edge = {}
     if r > 1:
-        total += 2 * g * tw * channels * elem_bytes
+        per_edge["n"] = per_edge["s"] = g * tw * channels * scale
     if c > 1:
-        rows = th + (2 * g if r > 1 else 0)
-        total += 2 * g * rows * channels * elem_bytes
-    return total / max(1, fuse)
+        rows = th + (2 * g if (r > 1 and mode != "edge") else 0)
+        per_edge["w"] = per_edge["e"] = g * rows * channels * scale
+        if mode == "edge":
+            per_edge["corners"] = 4 * g * g * channels * scale
+    return per_edge
+
+
+def ici_ghost_bytes_per_rep(tile_shape, channels: int, halo: int,
+                            mesh_shape, fuse: int = 1,
+                            elem_bytes: int = 1,
+                            mode: str = "phased") -> float:
+    """Total modeled ICI ghost bytes *received per device per
+    repetition* on the sharded mesh — the comm side of the
+    interior/border overlap schedules
+    (:mod:`tpu_stencil.parallel.overlap`), shown by ``--breakdown``
+    next to the measured exchange/interior/border probe spans. The sum
+    of :func:`ici_ghost_bytes_per_edge` (see there for the per-mode
+    strip geometry); ``elem_bytes``: 1 for the uint8 exchanges (the
+    split/edge schedules, the Pallas chunk, direct plans), 4 for the
+    monolithic XLA sep_int step's int32 phased exchange.
+    """
+    return float(sum(ici_ghost_bytes_per_edge(
+        tile_shape, channels, halo, mesh_shape, fuse=fuse,
+        elem_bytes=elem_bytes, mode=mode,
+    ).values()))
 
 
 def effective_fuse(filter_name: str, h_img: int,

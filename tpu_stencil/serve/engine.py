@@ -341,11 +341,13 @@ class StencilServer:
         self._m_bsize = m.histogram("batch_size")
         self._m_gbps = m.histogram("batch_hbm_gbps")
         # Configured overlap schedule, same gauge name/coding as the
-        # sharded runner's (parallel/overlap.py MODE_CODES), plus
-        # AUTO_CODE for a requested "auto" — serve has no mesh to
-        # resolve it against. Bucket executables are single-device
-        # today, so the mode is inert — recorded so dashboards see the
-        # knob the deployment set.
+        # sharded runner's (parallel/overlap.py MODE_CODES: off=0,
+        # split=1, fused-split=2, edge=3), plus AUTO_CODE (4) for a
+        # requested "auto" — serve has no mesh to resolve it against,
+        # and only serve may report it (the sharded runner always
+        # resolves before the gauge is set). Bucket executables are
+        # single-device today, so the mode is inert — recorded so
+        # dashboards see the knob the deployment set.
         from tpu_stencil.parallel import overlap as _overlap_mod
 
         m.gauge("overlap_mode").set(
