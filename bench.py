@@ -60,6 +60,18 @@ excluded) and emits a versioned headline capture in seconds/frame with
 the pipeline depth folded into the metric name — its own perf-sentry
 series, gateable like the mesh captures
 (``TPU_STENCIL_BENCH_STREAM_FRAMES`` / ``_DEPTH`` tune the run).
+``TPU_STENCIL_BENCH_STREAM_MESH=N`` additionally fans the stream over N
+devices (``tpu_stencil.parallel.fanout``) and folds ``_meshfan<N>``
+into the metric name — the whole-mesh frames/s series, its own sentry
+key, with per-device frame counts and frames/s riders.
+
+Serve mesh-fan mode: ``TPU_STENCIL_BENCH_SERVE_MESHFAN=1`` measures the
+serving engine's sharded request route (``ServeConfig.overlap=split``
+with the threshold at 1 pixel, so every north-star request routes
+through the shard_map path over all local devices) and emits a
+versioned ``..._serve_meshfan<N>_wall_per_request`` headline — the
+serve-side mesh series the sentry gates
+(``TPU_STENCIL_BENCH_SERVE_REQUESTS`` tunes the run).
 
 Exit codes: 0 = capture landed (even partial-only); 1 = nothing
 parseable; 2 = the requested backend is unavailable (init failed — the
@@ -443,6 +455,14 @@ def _measure_multichip(mesh_shape, overlap: str, platform: str) -> dict:
     )
     line["hbm_gbps"] = round(gbps, 1)
     line["pct_hbm_peak"] = round(pct, 1)
+    # Frames/s riders: the spatial mesh cooperates on ONE frame per
+    # REPS reps (every device in lockstep — per-device rate equals the
+    # mesh rate), so mesh captures and the mesh-fan stream/serve
+    # captures all report throughput in one unit the sentry can keep
+    # side by side.
+    fps = 1.0 / (per_rep * REPS) if per_rep > 0 else 0.0
+    line["frames_per_second"] = round(fps, 3)
+    line["per_device_frames_per_second"] = round(fps, 3)
     # Per-edge exchange riders: each edge's independent ppermute probe,
     # best-of-3, with the implied per-edge ICI GB/s against the per-edge
     # ghost-bytes model — so 8-device weak scaling is GATED per edge
@@ -486,7 +506,11 @@ def _measure_stream(platform: str) -> dict:
     headline measures the steady state, not the compile.
 
     Knobs: ``TPU_STENCIL_BENCH_STREAM_FRAMES`` (default 16),
-    ``TPU_STENCIL_BENCH_STREAM_DEPTH`` (default 2)."""
+    ``TPU_STENCIL_BENCH_STREAM_DEPTH`` (default 2),
+    ``TPU_STENCIL_BENCH_STREAM_MESH`` (fan width N; default 1 = the
+    single-device engine — N > 1 folds ``_meshfan<N>`` into the metric
+    name, a distinct sentry series, and carries per-device frame-count
+    and frames/s riders)."""
     import tempfile
 
     from tpu_stencil.config import ImageType, StreamConfig
@@ -494,13 +518,31 @@ def _measure_stream(platform: str) -> dict:
 
     n_frames = int(os.environ.get("TPU_STENCIL_BENCH_STREAM_FRAMES", "16"))
     depth = int(os.environ.get("TPU_STENCIL_BENCH_STREAM_DEPTH", "2"))
+    mesh_n = int(os.environ.get("TPU_STENCIL_BENCH_STREAM_MESH", "1"))
     backend = os.environ.get("TPU_STENCIL_BENCH_BACKENDS", "auto").split(",")[0]
     rng = np.random.default_rng(0)
+    if mesh_n == 0:
+        # Resolve the auto width ONCE up front (the measured A/B probe
+        # is expensive) and run warm-up + headline at the explicit
+        # width — otherwise each run_stream would re-pay the probe,
+        # and a warm-up shorter than the resolved fan would leave
+        # un-warmed lanes compiling inside the timed headline.
+        import jax
+
+        from tpu_stencil.parallel import fanout as _fanout
+
+        probe_cfg = StreamConfig(
+            input="probe", width=W, height=H, repetitions=REPS,
+            image_type=ImageType.RGB, backend=backend, output="null",
+            frames=2, pipeline_depth=depth, mesh_frames=0,
+        )
+        mesh_n = _fanout.resolve_mesh_frames(probe_cfg, jax.devices())
+        log(f"stream auto mesh: resolved to {mesh_n} device(s)")
     with tempfile.TemporaryDirectory(prefix="bench_stream_") as d:
         clip = os.path.join(d, "clip.raw")
         frame = rng.integers(0, 256, size=(H, W, C), dtype=np.uint8)
         with open(clip, "wb") as f:
-            for _ in range(max(2, n_frames)):
+            for _ in range(max(2, max(mesh_n, n_frames))):
                 f.write(frame.tobytes())
 
         def cfg(frames, k):
@@ -508,17 +550,23 @@ def _measure_stream(platform: str) -> dict:
                 input=clip, width=W, height=H, repetitions=REPS,
                 image_type=ImageType.RGB, backend=backend,
                 output="null", frames=frames, pipeline_depth=k,
+                mesh_frames=mesh_n,
             )
 
-        run_stream(cfg(2, depth))  # warm-up: compile lands in jit cache
+        # Warm-up: every device's executable lands in the jit cache
+        # (one frame per fan lane), so the headline measures steady
+        # state on the whole mesh, not the first lane's compile.
+        run_stream(cfg(max(2, mesh_n), depth))
         res = run_stream(cfg(n_frames, depth))
     per_frame = res.wall_seconds / max(1, res.frames)
-    log(f"stream depth={depth} [{res.backend}]: "
+    meshfan = f"_meshfan{res.n_devices}" if res.n_devices > 1 else ""
+    log(f"stream{meshfan.replace('_', ' ')} depth={depth} [{res.backend}]: "
         f"{res.frames_per_second:.2f} frames/s "
         f"({per_frame * 1e3:.1f} ms/frame, {res.frames} frames)")
     line = {
         "metric": (
-            f"{W}x{H}_rgb_{REPS}reps_stream_depth{depth}_wall_per_frame"
+            f"{W}x{H}_rgb_{REPS}reps_stream{meshfan}_depth{depth}"
+            f"_wall_per_frame"
         ),
         "value": round(per_frame, 6),
         "unit": "s",
@@ -540,7 +588,81 @@ def _measure_stream(platform: str) -> dict:
         "schema_version": 1,
         "ts": round(time.monotonic(), 6),
     }
+    if res.n_devices > 1:
+        # Per-device riders: whole-mesh weak scaling is gated on the
+        # headline; these show WHICH lane fell behind when it regresses.
+        line["n_devices"] = res.n_devices
+        line["per_device_frames"] = res.per_device_frames
+        line["per_device_frames_per_second"] = round(
+            res.frames_per_second / res.n_devices, 3
+        )
     return line
+
+
+def _measure_serve_meshfan(platform: str) -> dict:
+    """Serve mesh-fan capture (``TPU_STENCIL_BENCH_SERVE_MESHFAN=1``):
+    drive north-star-sized requests through the serving engine's
+    SHARDED route (overlap=split, threshold 1 px — every request runs
+    the shard_map path over all local devices) and emit a versioned
+    headline in wall seconds per request, the device count folded into
+    the metric name (``..._serve_meshfan<N>_wall_per_request`` — its
+    own sentry series). A warm-up request pays the mesh compile so the
+    headline measures steady state.
+
+    Knob: ``TPU_STENCIL_BENCH_SERVE_REQUESTS`` (default 4)."""
+    import jax
+
+    from tpu_stencil.config import ServeConfig
+    from tpu_stencil.serve.engine import StencilServer
+
+    n_dev = len(jax.devices())
+    n_req = int(os.environ.get("TPU_STENCIL_BENCH_SERVE_REQUESTS", "4"))
+    rng = np.random.default_rng(0)
+    img = rng.integers(0, 256, size=(H, W, C), dtype=np.uint8)
+    cfg = ServeConfig(overlap="split", shard_min_pixels=1,
+                      max_queue=max(16, n_req))
+    with StencilServer(cfg) as server:
+        server.submit(img, REPS).result(timeout=CHILD_TIMEOUT)  # warm
+        t0 = time.perf_counter()
+        futs = [server.submit(img, REPS) for _ in range(n_req)]
+        for f in futs:
+            f.result(timeout=CHILD_TIMEOUT)
+        wall = time.perf_counter() - t0
+        stats = server.stats()
+    per_req = wall / max(1, n_req)
+    log(f"serve meshfan{n_dev}: {per_req * 1e3:.1f} ms/request "
+        f"({n_req} sharded requests, overlap=split)")
+    return {
+        "metric": (
+            f"{W}x{H}_rgb_{REPS}reps_serve_meshfan{n_dev}"
+            f"_wall_per_request"
+        ),
+        "value": round(per_req, 6),
+        "unit": "s",
+        "vs_baseline": round(BASELINE_S / per_req, 2),
+        "backend": "sharded",
+        "platform": platform,
+        "n_devices": n_dev,
+        "requests": n_req,
+        "requests_per_second": round(n_req / wall, 3) if wall > 0 else 0.0,
+        # Sharded requests are spatial lockstep work (every device
+        # cooperates on each request), so the per-device rate equals the
+        # mesh rate — the same convention _measure_multichip uses, so
+        # the rider compares across series without a device-count skew.
+        "per_device_frames_per_second": round(
+            n_req / wall, 3
+        ) if wall > 0 else 0.0,
+        "sharded_requests_total": (
+            stats["counters"]["sharded_requests_total"]
+        ),
+        "overlap": "split",
+        "shape": f"{W}x{H}",
+        "reps": REPS,
+        "filter": "gaussian",
+        "dtype": "uint8",
+        "schema_version": 1,
+        "ts": round(time.monotonic(), 6),
+    }
 
 
 def _measure_schedule_headlines(schedules, platform: str) -> list:
@@ -639,6 +761,15 @@ def child_main() -> int:
             result = _measure_stream(platform)
         except Exception as e:
             log(f"stream: FAILED {type(e).__name__}: {e}")
+            return 1
+        print(json.dumps(result), flush=True)
+        return 0
+
+    if os.environ.get("TPU_STENCIL_BENCH_SERVE_MESHFAN") == "1":
+        try:
+            result = _measure_serve_meshfan(platform)
+        except Exception as e:
+            log(f"serve meshfan: FAILED {type(e).__name__}: {e}")
             return 1
         print(json.dumps(result), flush=True)
         return 0

@@ -1,0 +1,613 @@
+"""Mesh fan-out (tpu_stencil.parallel.fanout) + sharded serve routing:
+mesh-fan streams vs N sequential run_job calls bit-exact, the
+device-count-mismatch resume contract, the auto A/B's
+never-enable-a-measured-loss discipline, the whole-mesh roofline model,
+and the serve fuzz asserting sharded-routed requests return bytes
+identical to the single-device bucket path."""
+
+import dataclasses
+import json
+
+import numpy as np
+import pytest
+
+import jax
+
+from tpu_stencil import driver, filters, obs
+from tpu_stencil.config import ImageType, JobConfig, ServeConfig, StreamConfig
+from tpu_stencil.ops import stencil
+from tpu_stencil.parallel import fanout
+from tpu_stencil.runtime import checkpoint as ckpt
+from tpu_stencil.runtime import roofline
+from tpu_stencil.stream import cli as stream_cli
+from tpu_stencil.stream import frames as frames_io
+from tpu_stencil.stream.engine import run_stream
+
+
+def _make_clip(path, n, h, w, ch, seed=0):
+    rng = np.random.default_rng(seed)
+    shape = (n, h, w) if ch == 1 else (n, h, w, ch)
+    clip = rng.integers(0, 256, size=shape, dtype=np.uint8)
+    clip.tofile(path)
+    return clip
+
+
+def _golden_frames(tmp_path, clip, reps, image_type, **job_kw):
+    """Each frame through an independent run_job; returns raw bytes."""
+    h, w = clip.shape[1:3]
+    out = []
+    for i in range(clip.shape[0]):
+        src = str(tmp_path / f"golden_in_{i}.raw")
+        dst = str(tmp_path / f"golden_out_{i}.raw")
+        clip[i].tofile(src)
+        driver.run_job(JobConfig(
+            image=src, width=w, height=h, repetitions=reps,
+            image_type=image_type, output=dst, **job_kw,
+        ))
+        out.append(open(dst, "rb").read())
+    return out
+
+
+def _cfg(tmp_path, clip_path, h, w, image_type, reps, **kw):
+    kw.setdefault("output", str(tmp_path / "mesh_out.raw"))
+    return StreamConfig(
+        input=str(clip_path), width=w, height=h, repetitions=reps,
+        image_type=image_type, **kw,
+    )
+
+
+# -- mesh-fan stream vs N sequential run_job calls (bit-exact fuzz) ---
+
+@pytest.mark.parametrize("image_type,boundary,depth,n_dev", [
+    (ImageType.RGB, "zero", 2, 2),
+    (ImageType.GREY, "zero", 1, 4),
+    (ImageType.RGB, "periodic", 2, 4),
+    (ImageType.GREY, "periodic", 3, 2),
+    (ImageType.RGB, "zero", 2, 1),
+])
+def test_mesh_fan_matches_run_job(tmp_path, image_type, boundary, depth,
+                                  n_dev):
+    h, w, ch, reps, n = 20, 16, image_type.channels, 3, 6
+    clip_path = tmp_path / "clip.raw"
+    clip = _make_clip(clip_path, n, h, w, ch, seed=n_dev * 10 + depth)
+    golden = _golden_frames(tmp_path, clip, reps, image_type,
+                            boundary=boundary)
+    out = str(tmp_path / "out.raw")
+    res = run_stream(_cfg(
+        tmp_path, clip_path, h, w, image_type, reps, output=out,
+        frames=n, pipeline_depth=depth, boundary=boundary,
+        mesh_frames=n_dev,
+    ))
+    assert res.frames == n
+    assert res.n_devices == n_dev
+    if n_dev > 1:
+        assert sum(res.per_device_frames) == n
+        assert res.per_device_frames[0] == -(-n // n_dev)
+    blob = open(out, "rb").read()
+    fb = h * w * ch
+    for i in range(n):
+        assert blob[i * fb:(i + 1) * fb] == golden[i], f"frame {i} differs"
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("image_type", [ImageType.GREY, ImageType.RGB])
+@pytest.mark.parametrize("boundary", ["zero", "periodic"])
+@pytest.mark.parametrize("depth", [1, 2, 4])
+@pytest.mark.parametrize("n_dev", [1, 2, 4])
+def test_mesh_fan_full_matrix(tmp_path, image_type, boundary, depth, n_dev):
+    h, w, ch, reps, n = 16, 12, image_type.channels, 2, 5
+    clip_path = tmp_path / "clip.raw"
+    clip = _make_clip(clip_path, n, h, w, ch, seed=7)
+    f = filters.get_filter("gaussian")
+    golden = b"".join(
+        stencil.reference_stencil_numpy(
+            clip[i], f, reps, boundary=boundary
+        ).tobytes()
+        for i in range(n)
+    )
+    out = str(tmp_path / "out.raw")
+    run_stream(_cfg(
+        tmp_path, clip_path, h, w, image_type, reps, output=out,
+        frames=n, pipeline_depth=depth, mesh_frames=n_dev,
+        boundary=boundary,
+    ))
+    assert open(out, "rb").read() == golden
+
+
+def test_mesh_fan_until_eof_and_dir_sink(tmp_path):
+    # EOF-driven length + per-frame directory sink through the fan.
+    h, w, ch, reps, n = 12, 10, 3, 2, 5
+    clip_path = tmp_path / "clip.raw"
+    clip = _make_clip(clip_path, n, h, w, ch, seed=3)
+    golden = _golden_frames(tmp_path, clip, reps, ImageType.RGB)
+    sink_dir = str(tmp_path / "out_frames")
+    res = run_stream(_cfg(
+        tmp_path, clip_path, h, w, ImageType.RGB, reps,
+        output=sink_dir + "/", frames=None, mesh_frames=2,
+    ))
+    assert res.frames == n and res.n_devices == 2
+    for i in range(n):
+        got = open(
+            f"{sink_dir}/{frames_io.FRAME_PATTERN.format(i)}", "rb"
+        ).read()
+        assert got == golden[i], f"frame {i} differs"
+
+
+def test_mesh_fan_too_few_devices(tmp_path):
+    clip_path = tmp_path / "clip.raw"
+    _make_clip(clip_path, 2, 8, 8, 1)
+    cfg = _cfg(tmp_path, clip_path, 8, 8, ImageType.GREY, 1,
+               frames=2, mesh_frames=64)
+    with pytest.raises(ValueError, match="64 devices.*have"):
+        run_stream(cfg)
+
+
+# -- checkpoint/resume: per-device cursors + device-count contract ----
+
+def test_device_cursors_round_robin():
+    # Progress 5, start 0, 4 lanes: frame 5 -> lane 1, 6 -> 2, 7 -> 3,
+    # 8 -> 0.
+    assert fanout.device_cursors(5, 0, 4) == [8, 5, 6, 7]
+    assert fanout.device_cursors(0, 0, 2) == [0, 1]
+    # Resumed run: deal restarts at the resume point.
+    assert fanout.device_cursors(3, 3, 3) == [3, 4, 5]
+
+
+def test_mesh_checkpoint_records_count_and_cursors(tmp_path):
+    clip_path = tmp_path / "clip.raw"
+    _make_clip(clip_path, 4, 10, 8, 1, seed=2)
+    out = str(tmp_path / "out.raw")
+    cfg = _cfg(tmp_path, clip_path, 10, 8, ImageType.GREY, 1,
+               output=out, frames=4, mesh_frames=2, checkpoint_every=2)
+    # Freeze the sidecar mid-job by saving manually (the run clears it
+    # on success): assert the writer's save shape via the API.
+    ckpt.save_stream_progress(cfg, 2, mesh_devices=2,
+                              cursors=fanout.device_cursors(2, 0, 2))
+    meta = json.load(open(str(tmp_path / "out.raw.stream.ckpt.json")))
+    assert meta["mesh_devices"] == 2
+    assert meta["device_cursors"] == [2, 3]
+    # Same-count restore round-trips; different count fails typed,
+    # naming both counts.
+    assert ckpt.restore_stream_progress(cfg, mesh_devices=2) == 2
+    with pytest.raises(ckpt.MeshCursorMismatch) as ei:
+        ckpt.restore_stream_progress(cfg, mesh_devices=4)
+    assert "2-device" in str(ei.value) and "4 device" in str(ei.value)
+    assert ei.value.recorded == 2 and ei.value.requested == 4
+
+
+def test_mesh_resume_different_count_fails_typed(tmp_path):
+    h, w, reps, n = 10, 8, 1, 4
+    clip_path = tmp_path / "clip.raw"
+    _make_clip(clip_path, n, h, w, 1, seed=5)
+    out = str(tmp_path / "out.raw")
+    cfg4 = _cfg(tmp_path, clip_path, h, w, ImageType.GREY, reps,
+                output=out, frames=n, mesh_frames=4, checkpoint_every=1)
+    # A 2-device run's sidecar is on disk (as if the run was killed).
+    ckpt.save_stream_progress(cfg4, 2, mesh_devices=2,
+                              cursors=[2, 3])
+    open(out, "wb").write(b"\0" * (2 * h * w))
+    with pytest.raises(ckpt.MeshCursorMismatch):
+        run_stream(cfg4, resume=True)
+    # Plain single-device resume of the same mesh sidecar fails too.
+    cfg1 = dataclasses.replace(cfg4, mesh_frames=1)
+    with pytest.raises(ckpt.MeshCursorMismatch):
+        run_stream(cfg1, resume=True)
+
+
+def test_mesh_resume_same_count_completes(tmp_path):
+    h, w, ch, reps, n = 12, 10, 3, 2, 5
+    clip_path = tmp_path / "clip.raw"
+    clip = _make_clip(clip_path, n, h, w, ch, seed=6)
+    golden = _golden_frames(tmp_path, clip, reps, ImageType.RGB)
+    out = str(tmp_path / "out.raw")
+    cfg = _cfg(tmp_path, clip_path, h, w, ImageType.RGB, reps,
+               output=out, frames=n, mesh_frames=2, checkpoint_every=1)
+    # Simulate a killed 2-device run: 2 frames durably written + a
+    # matching sidecar with per-device cursors.
+    fb = h * w * ch
+    with open(out, "wb") as fh:
+        fh.write(golden[0] + golden[1])
+    ckpt.save_stream_progress(cfg, 2, mesh_devices=2,
+                              cursors=fanout.device_cursors(2, 0, 2))
+    res = run_stream(cfg, resume=True)
+    assert res.skipped == 2 and res.frames == n - 2
+    blob = open(out, "rb").read()
+    for i in range(n):
+        assert blob[i * fb:(i + 1) * fb] == golden[i], f"frame {i} differs"
+
+
+def test_single_device_sidecar_still_resumes(tmp_path):
+    # Backward compat: a plain (pre-mesh) sidecar has no mesh_devices
+    # key and must keep resuming single-device runs.
+    clip_path = tmp_path / "clip.raw"
+    _make_clip(clip_path, 3, 8, 8, 1, seed=1)
+    cfg = _cfg(tmp_path, clip_path, 8, 8, ImageType.GREY, 1,
+               output=str(tmp_path / "o.raw"), frames=3)
+    ckpt.save_stream_progress(cfg, 1)
+    meta = json.load(open(str(tmp_path / "o.raw.stream.ckpt.json")))
+    assert "mesh_devices" not in meta and "device_cursors" not in meta
+    assert ckpt.restore_stream_progress(cfg) == 1
+    with pytest.raises(ckpt.MeshCursorMismatch):
+        ckpt.restore_stream_progress(cfg, mesh_devices=2)
+
+
+# -- auto (--mesh-frames 0): measured A/B, never enable a loss --------
+
+def test_auto_decides_from_measurement(tmp_path):
+    clip_path = tmp_path / "clip.raw"
+    _make_clip(clip_path, 2, 8, 8, 1)
+    cfg = _cfg(tmp_path, clip_path, 8, 8, ImageType.GREY, 1,
+               frames=2, mesh_frames=0)
+    devs = jax.devices()
+    assert fanout.resolve_mesh_frames(
+        cfg, devs, measure=lambda *a: (1.0, 0.5)
+    ) == len(devs)
+    assert fanout.resolve_mesh_frames(
+        cfg, devs, measure=lambda *a: (0.5, 1.0)
+    ) == 1
+    # A tie is NOT a win: fan-out must measure strictly faster.
+    assert fanout.resolve_mesh_frames(
+        cfg, devs, measure=lambda *a: (1.0, 1.0)
+    ) == 1
+    # One device: nothing to fan, no probe paid.
+    assert fanout.resolve_mesh_frames(
+        cfg, devs[:1], measure=lambda *a: pytest.fail("probed")
+    ) == 1
+
+
+@pytest.mark.timing
+def test_auto_never_enables_measured_loss(tmp_path):
+    """The measured A/B and the verdict must agree: whatever the probe
+    measures on THIS machine, auto picks the mesh width only when the
+    mesh arm was strictly faster — fan-out is never auto-enabled on a
+    measured loss (the deep-schedule / edge-overlap discipline)."""
+    clip_path = tmp_path / "clip.raw"
+    _make_clip(clip_path, 3, 16, 12, 1, seed=4)
+    cfg = _cfg(tmp_path, clip_path, 16, 12, ImageType.GREY, 2,
+               frames=3, mesh_frames=0, output="null")
+    devs = jax.devices()[:2]
+    t_single, t_mesh = fanout.measure_fanout_ab(cfg, devs)
+    pick = fanout.resolve_mesh_frames(
+        cfg, devs, measure=lambda *a: (t_single, t_mesh)
+    )
+    assert pick == (len(devs) if t_mesh < t_single else 1)
+
+
+@pytest.mark.timing
+@pytest.mark.slow
+def test_mesh_fan_scales_near_linear_at_4_devices(tmp_path):
+    """The acceptance A/B: 4-device fan-out throughput >= 0.8x linear.
+    Virtual CPU devices share host cores, so this can only be expressed
+    where >= 4 cores back the 4 lanes (on a 1-core CI host the
+    measured ceiling is pipeline overlap, not compute scaling — the
+    never-auto-enable-a-loss test above covers those machines)."""
+    import os as _os
+
+    if jax.default_backend() == "cpu" and (_os.cpu_count() or 1) < 4:
+        pytest.skip(
+            f"{_os.cpu_count()} host core(s) behind 4 virtual devices "
+            "cannot express compute scaling"
+        )
+    clip_path = tmp_path / "clip.raw"
+    _make_clip(clip_path, 8, 128, 128, 1, seed=14)
+    cfg = _cfg(tmp_path, clip_path, 128, 128, ImageType.GREY, 40,
+               frames=8, mesh_frames=0, output="null")
+    t_single, t_mesh = fanout.measure_fanout_ab(
+        cfg, jax.devices()[:4], frames=8
+    )
+    assert t_mesh <= t_single / (0.8 * 4), (
+        f"4-device fan-out {t_single / t_mesh:.2f}x vs >=3.2x required"
+    )
+
+
+# -- whole-mesh roofline model ---------------------------------------
+
+def test_mesh_roofline_scales_and_caps():
+    fb, reps = 64 * 48 * 3, 10
+    one = roofline.stream_frames_per_second(fb, reps, "xla", "gaussian", 64)
+    four = roofline.mesh_stream_frames_per_second(
+        fb, reps, "xla", "gaussian", 64, n_devices=4
+    )
+    cap = roofline.pcie_contention_frames_per_second(fb)
+    assert four == pytest.approx(min(4 * one, cap))
+    assert roofline.mesh_stream_frames_per_second(
+        fb, reps, "xla", "gaussian", 64, n_devices=1
+    ) == pytest.approx(min(one, cap))
+    # A frame big enough that PCIe (not compute) is the binding term:
+    # the mesh bound must stop scaling with devices.
+    big = 4 * 3840 * 2160 * 3
+    cap_big = roofline.pcie_contention_frames_per_second(big)
+    assert roofline.mesh_stream_frames_per_second(
+        big, 1, "xla", "gaussian", 4 * 2160, n_devices=64
+    ) <= cap_big
+
+
+# -- CLI surface ------------------------------------------------------
+
+def test_stream_cli_mesh_frames_round_trip(tmp_path, capsys):
+    h, w, ch, reps, n = 12, 10, 3, 2, 4
+    clip_path = str(tmp_path / "clip.raw")
+    clip = _make_clip(clip_path, n, h, w, ch, seed=8)
+    golden = _golden_frames(tmp_path, clip, reps, ImageType.RGB)
+    out = str(tmp_path / "out.raw")
+    stats = str(tmp_path / "stats.json")
+    rc = stream_cli.main([
+        clip_path, str(w), str(h), str(reps), "rgb", "--frames", str(n),
+        "--mesh-frames", "2", "--output", out, "--stats-json", stats,
+    ])
+    assert rc == 0
+    text = capsys.readouterr().out
+    assert "mesh-frames=2dev" in text
+    assert "per-device frames: dev0=2 dev1=2" in text
+    payload = json.load(open(stats))
+    assert payload["n_devices"] == 2
+    assert payload["per_device_frames"] == [2, 2]
+    blob = open(out, "rb").read()
+    fb = h * w * ch
+    assert all(
+        blob[i * fb:(i + 1) * fb] == golden[i] for i in range(n)
+    )
+
+
+def test_stream_cli_rejects_negative_mesh_frames(tmp_path):
+    clip_path = str(tmp_path / "clip.raw")
+    _make_clip(clip_path, 1, 8, 8, 1)
+    with pytest.raises(SystemExit):
+        stream_cli.main([
+            clip_path, "8", "8", "1", "grey", "--frames", "1",
+            "--mesh-frames", "-1",
+        ])
+
+
+def test_mesh_breakdown_renders_whole_mesh_bound(tmp_path, capsys):
+    clip_path = str(tmp_path / "clip.raw")
+    _make_clip(clip_path, 4, 16, 12, 3, seed=11)
+    rc = stream_cli.main([
+        clip_path, "12", "16", "2", "rgb", "--frames", "4",
+        "--mesh-frames", "2", "--output", "null", "--breakdown",
+    ])
+    assert rc == 0
+    text = capsys.readouterr().out
+    assert "mesh fan-out: 2 devices -> modeled whole-mesh bound" in text
+    assert "PCIe contention cap" in text
+    # The CLI report owns the per-device line — exactly once, even
+    # with the breakdown tables on.
+    assert text.count("per-device frames: dev0=2 dev1=2") == 1
+
+
+# -- serve: sharded routing ------------------------------------------
+
+def _serve_case(h, w, ch, seed):
+    rng = np.random.default_rng(seed)
+    shape = (h, w) if ch == 1 else (h, w, ch)
+    return rng.integers(0, 256, size=shape, dtype=np.uint8)
+
+
+@pytest.mark.parametrize("overlap", ["split", "edge"])
+def test_serve_sharded_route_matches_bucket_path(overlap):
+    """The satellite fuzz: an oversized request routed through the
+    shard_map path must return bytes identical to the single-device
+    bucket path (and the golden model)."""
+    from tpu_stencil.serve.engine import StencilServer
+
+    f = filters.get_filter("gaussian")
+    cases = [
+        (_serve_case(40, 36, 3, 1), 3),
+        (_serve_case(33, 47, 1, 2), 2),   # grey, indivisible shape
+        (_serve_case(36, 40, 3, 3), 0),   # identity
+    ]
+    got_sharded = []
+    with StencilServer(ServeConfig(
+        overlap=overlap, shard_min_pixels=900, max_batch=4,
+    )) as server:
+        futs = [server.submit(img, reps) for img, reps in cases]
+        got_sharded = [fu.result(timeout=300) for fu in futs]
+        stats = server.stats()
+    assert stats["counters"]["sharded_requests_total"] == len(cases)
+    assert stats["sharded_runners_cached"] >= 1
+    with StencilServer(ServeConfig(overlap="off")) as server:
+        got_bucket = [
+            server.submit(img, reps).result(timeout=300)
+            for img, reps in cases
+        ]
+    for (img, reps), a, b in zip(cases, got_sharded, got_bucket):
+        want = stencil.reference_stencil_numpy(img, f, reps)
+        assert np.array_equal(a, want), (img.shape, reps, "vs golden")
+        assert np.array_equal(a, b), (img.shape, reps, "vs bucket")
+        assert a.shape == img.shape and a.dtype == np.uint8
+
+
+def test_serve_small_requests_stay_on_bucket_path():
+    from tpu_stencil.serve.engine import StencilServer
+
+    small = _serve_case(10, 12, 3, 4)
+    with StencilServer(ServeConfig(
+        overlap="split", shard_min_pixels=10_000,
+    )) as server:
+        got = server.submit(small, 2).result(timeout=300)
+        stats = server.stats()
+    assert stats["counters"]["sharded_requests_total"] == 0
+    assert stats["counters"]["batches_total"] == 1
+    # Bucket dispatches charge device 0 only.
+    assert stats["counters"]["device_requests_total_dev0"] == 1
+    assert "device_requests_total_dev1" not in stats["counters"]
+    f = filters.get_filter("gaussian")
+    assert np.array_equal(got, stencil.reference_stencil_numpy(small, f, 2))
+
+
+def test_serve_sharded_and_small_never_share_a_batch():
+    """The bucketing contract: a sharded request and a small request
+    submitted back-to-back form two dispatches (separate keys), so the
+    small one never waits inside a sharded batch."""
+    from tpu_stencil.serve.engine import StencilServer
+
+    big = _serve_case(40, 40, 1, 5)
+    small = _serve_case(40, 40, 1, 6)  # same shape — only routing differs
+    with StencilServer(ServeConfig(
+        overlap="split", shard_min_pixels=1600, max_batch=8,
+    ), start=False) as server:
+        f1 = server.submit(big, 2)
+        # Drop the threshold contract by shrinking the image instead:
+        f2 = server.submit(small[:10, :10], 2)
+        server.start()
+        a, b = f1.result(timeout=300), f2.result(timeout=300)
+        stats = server.stats()
+    assert stats["counters"]["sharded_requests_total"] == 1
+    assert stats["counters"]["batches_total"] == 2
+    g = filters.get_filter("gaussian")
+    assert np.array_equal(a, stencil.reference_stencil_numpy(big, g, 2))
+    assert np.array_equal(
+        b, stencil.reference_stencil_numpy(small[:10, :10], g, 2)
+    )
+
+
+def test_serve_sharded_runner_cache_reuse_and_device_accounting():
+    from tpu_stencil.serve.engine import StencilServer
+
+    img = _serve_case(40, 36, 3, 7)
+    n_dev = len(jax.devices())
+    with StencilServer(ServeConfig(
+        overlap="split", shard_min_pixels=1, max_batch=1,
+    )) as server:
+        a = server.submit(img, 2).result(timeout=300)
+        b = server.submit(img, 5).result(timeout=300)  # reps differ
+        stats = server.stats()
+    c = stats["counters"]
+    # One runner serves both reps (the rep count is traced).
+    assert c["sharded_runner_misses_total"] == 1
+    assert c["sharded_runner_hits_total"] == 1
+    assert stats["sharded_runners_cached"] == 1
+    # Every mesh device was charged for both requests.
+    for i in range(n_dev):
+        assert c[f"device_requests_total_dev{i}"] == 2
+        assert c[f"device_bytes_dispatched_total_dev{i}"] > 0
+    f = filters.get_filter("gaussian")
+    assert np.array_equal(a, stencil.reference_stencil_numpy(img, f, 2))
+    assert np.array_equal(b, stencil.reference_stencil_numpy(img, f, 5))
+
+
+def test_serve_unservable_geometry_falls_back_to_bucket_path():
+    """A request above the threshold whose geometry the mesh CANNOT
+    serve (per-device tile smaller than the filter halo) must fall back
+    to the bucket path — served correctly, never failed — with the
+    refusal cached so retries never re-pay the failed build."""
+    from tpu_stencil.serve.engine import StencilServer
+
+    # 2 x 300 with gaussian7 (halo 3): every mesh factorization of the
+    # 8-device conftest platform tiles the 2-row axis below the halo.
+    img = _serve_case(2, 300, 1, 8)
+    f = filters.get_filter("gaussian7")
+    with StencilServer(ServeConfig(
+        filter_name="gaussian7", overlap="split", shard_min_pixels=500,
+    )) as server:
+        a = server.submit(img, 2).result(timeout=300)
+        b = server.submit(img, 2).result(timeout=300)  # cached refusal
+        stats = server.stats()
+    c = stats["counters"]
+    assert c["sharded_fallbacks_total"] == 1
+    assert c["sharded_runner_misses_total"] == 1  # failed build paid once
+    assert c["sharded_runner_hits_total"] == 1
+    want = stencil.reference_stencil_numpy(img, f, 2)
+    assert np.array_equal(a, want) and np.array_equal(b, want)
+
+
+def test_serve_config_validates_shard_min_pixels():
+    with pytest.raises(ValueError, match="shard_min_pixels"):
+        ServeConfig(shard_min_pixels=0)
+
+
+def test_stream_config_validates_mesh_frames():
+    with pytest.raises(ValueError, match="mesh_frames"):
+        StreamConfig(input="x", width=8, height=8, repetitions=1,
+                     image_type=ImageType.GREY, frames=1, mesh_frames=-2)
+    # 0 (auto) and large explicit widths are config-valid (the resolver
+    # checks device availability at run time).
+    StreamConfig(input="x", width=8, height=8, repetitions=1,
+                 image_type=ImageType.GREY, frames=1, mesh_frames=0)
+
+
+# -- chaos: the restart ladder re-fans at the same width --------------
+
+@pytest.mark.chaos
+def test_mesh_fan_engine_restart_from_checkpoint(tmp_path):
+    """A transient mid-stream compute fault on a mesh-fan run restarts
+    the whole fan at the SAME width and resumes from the cursor
+    checkpoint — already-written frames stay written, output stays
+    bit-exact."""
+    from tpu_stencil.resilience import faults
+
+    h, w, ch, reps, n = 16, 12, 3, 2, 4
+    clip_path = tmp_path / "clip.raw"
+    clip = _make_clip(clip_path, n, h, w, ch, seed=13)
+    golden = _golden_frames(tmp_path, clip, reps, ImageType.RGB)
+    out = str(tmp_path / "out.raw")
+    faults.configure("compute:frame=1")
+    try:
+        res = run_stream(_cfg(
+            tmp_path, clip_path, h, w, ImageType.RGB, reps, output=out,
+            frames=n, mesh_frames=2, checkpoint_every=1,
+        ))
+    finally:
+        faults.clear()
+    assert res.restarts == 1
+    assert res.n_devices == 2
+    blob = open(out, "rb").read()
+    fb = h * w * ch
+    for i in range(n):
+        assert blob[i * fb:(i + 1) * fb] == golden[i], f"frame {i} differs"
+
+
+@pytest.mark.chaos
+def test_serve_sharded_build_covered_by_compile_fault():
+    """The 'compile' injection point must cover the sharded route's
+    mesh-program build (the largest compile in serve): one injected
+    failure fails that request typed, the next one succeeds and is
+    bit-exact."""
+    from tpu_stencil.resilience import faults
+    from tpu_stencil.resilience.errors import InjectedFault
+    from tpu_stencil.serve.engine import StencilServer
+
+    img = _serve_case(40, 36, 3, 9)
+    faults.configure("compile:times=1")
+    try:
+        with StencilServer(ServeConfig(
+            overlap="split", shard_min_pixels=1,
+        )) as server:
+            with pytest.raises(InjectedFault):
+                server.submit(img, 2).result(timeout=300)
+            got = server.submit(img, 2).result(timeout=300)
+    finally:
+        faults.clear()
+    f = filters.get_filter("gaussian")
+    assert np.array_equal(got, stencil.reference_stencil_numpy(img, f, 2))
+
+
+# -- obs: fan-out keeps the stream span/metric vocabulary -------------
+
+def test_mesh_fan_emits_stream_spans(tmp_path):
+    h, w, reps, n = 12, 10, 2, 4
+    clip_path = tmp_path / "clip.raw"
+    _make_clip(clip_path, n, h, w, 1, seed=12)
+    obs.reset()  # fresh gauges: value AND peak must be THIS test's
+    obs.enable()
+    try:
+        run_stream(_cfg(tmp_path, clip_path, h, w, ImageType.GREY, reps,
+                        output="null", frames=n, mesh_frames=2))
+        names = {s.name for s in obs.get_tracer().spans()}
+    finally:
+        obs.disable()
+    assert {"stream.read", "stream.h2d", "stream.compute",
+            "stream.d2h", "stream.write"} <= names
+    gauges = obs.snapshot()["gauges"]
+    assert gauges["stream_mesh_devices"]["value"] == 2
+    # The dispatch-ahead window gauge stays live on mesh runs: frames
+    # were in flight (peak), and a clean drain returns it to 0.
+    assert gauges["stream_inflight_depth"]["peak"] >= 1
+    assert gauges["stream_inflight_depth"]["value"] == 0
+    # Report-what-ran: a later single-device run must not keep exposing
+    # the stale fan width.
+    run_stream(_cfg(tmp_path, clip_path, h, w, ImageType.GREY, reps,
+                    output="null", frames=n))
+    gauges = obs.snapshot()["gauges"]
+    assert gauges["stream_mesh_devices"]["value"] == 1
+    assert gauges["stream_mesh_devices"]["peak"] == 2

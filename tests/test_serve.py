@@ -477,9 +477,10 @@ def test_cli_serve_self_test_subprocess(tmp_path):
 
 def test_serve_overlap_config_plumbed():
     # ServeConfig validates the overlap vocabulary and the server records
-    # the configured mode in the overlap_mode gauge (inert today —
-    # bucket executables are single-device — but plumbed so deployment
-    # configs survive a future sharded serve path).
+    # the configured mode in the overlap_mode gauge. A non-off mode also
+    # activates sharded routing for requests >= shard_min_pixels
+    # (tests/test_fanout.py covers the route itself; this pins the
+    # config/gauge surface).
     from tpu_stencil.config import ServeConfig
     from tpu_stencil.serve.engine import StencilServer
 

@@ -214,6 +214,16 @@ class StreamConfig:
     fuse: Optional[int] = None
     pipeline_depth: int = 2  # dispatch-ahead window (1 = serial stages)
     ring_buffers: Optional[int] = None  # host staging ring (None = depth+2)
+    # Mesh fan-out (tpu_stencil.parallel.fanout): fan frames across N
+    # devices round-robin, one pipeline lane (staging ring + dispatch
+    # window) per device, with an in-order drain across devices. 1 =
+    # single-device (the PR-5 engine); N > 1 = explicit fan width
+    # (fails loudly when fewer devices exist); 0 = auto — a measured
+    # single-vs-mesh A/B probe enables fan-out only when it is
+    # strictly faster. Bit-exact in every mode (fan-out changes only
+    # where a frame computes). Host memory is O(N * ring), device
+    # memory O(N * pipeline_depth) frames.
+    mesh_frames: int = 1
     checkpoint_every: int = 0  # frame-index checkpoint period (0 = off)
     progress_every: int = 0    # stderr frame-index heartbeat (0 = off)
     # Dispatch watchdog window (seconds) around the drain's compute
@@ -239,6 +249,11 @@ class StreamConfig:
         if self.pipeline_depth < 1:
             raise ValueError(
                 f"pipeline_depth must be >= 1, got {self.pipeline_depth}"
+            )
+        if self.mesh_frames < 0:
+            raise ValueError(
+                f"mesh_frames must be >= 0 (0 = auto, 1 = single-device, "
+                f"N = fan width), got {self.mesh_frames}"
             )
         if self.ring_buffers is not None and (
             self.ring_buffers < self.pipeline_depth + 1
@@ -329,12 +344,21 @@ class ServeConfig:
     # above the top edge pad to the next top-edge multiple.
     bucket_edges: Optional[Tuple[int, ...]] = None
     # Interior/border overlap schedule, same vocabulary as
-    # JobConfig.overlap. Recorded (overlap_mode gauge, stats) and
-    # validated; today's bucket executables are single-device (no ghost
-    # exchange), so any mode other than "off" is accepted but inert
-    # until a spatially-sharded serve path lands — the knob is plumbed
-    # so deployment configs stay stable across that change.
+    # JobConfig.overlap. "off" keeps every request on the single-device
+    # bucket executables. Any other mode ACTIVATES sharded routing:
+    # requests of at least ``shard_min_pixels`` true pixels run through
+    # the spatially-sharded shard_map path (ShardedRunner over all
+    # local devices, this overlap schedule applied — split/edge/auto
+    # exactly as on the run CLI), keyed into their own request bucket
+    # so small requests never share a batch with a sharded dispatch.
+    # Bit-exact against the single-device bucket path.
     overlap: str = "off"
+    # Sharded-routing size threshold (true pixels, H*W): with a
+    # non-"off" overlap, requests at or above it route through the
+    # shard_map path; below it they stay on the bucket executables.
+    # Default 1 Mpx (~1024x1024) — below that the per-device tiles are
+    # too small for the exchange to pay for itself.
+    shard_min_pixels: int = 1 << 20
     # Device-memory sampler period (seconds): a background thread
     # gauges device.memory_stats() into the server registry
     # (device_bytes_in_use / peak / limit). 0 disables; backends
@@ -367,6 +391,11 @@ class ServeConfig:
             raise ValueError(
                 f"unknown overlap mode {self.overlap!r}; expected one of "
                 f"{'|'.join(OVERLAP_MODES)}"
+            )
+        if self.shard_min_pixels < 1:
+            raise ValueError(
+                f"shard_min_pixels must be >= 1, got "
+                f"{self.shard_min_pixels}"
             )
         if self.mem_sample_interval_s < 0:
             raise ValueError(

@@ -37,6 +37,7 @@ from tpu_stencil.obs.tracing import (
     get_tracer,
     phase,
     registry,
+    scratch_registry,
     snapshot,
     span,
 )
@@ -72,6 +73,7 @@ __all__ = [
     "phase",
     "registry",
     "reset",
+    "scratch_registry",
     "sentry",
     "snapshot",
     "span",

@@ -226,6 +226,7 @@ def render_stream(tracer: Tracer, info: dict) -> str:
         block_h=info.get("block_h"), fuse=info.get("fuse"),
     )
     depth = info.get("pipeline_depth", 2)
+    n_dev = info.get("n_devices", 1) or 1
     lines = [
         "",
         f"stream pipeline: depth={depth}  "
@@ -238,9 +239,20 @@ def render_stream(tracer: Tracer, info: dict) -> str:
     total = 0.0
     for n in stages:
         per = by[n]["seconds"] / by[n]["count"]
-        total += per
-        if per > slowest[1]:
-            slowest = (n, per)
+        # On a mesh fan the per-device stages (h2d/compute/d2h) run in
+        # n_dev concurrent lanes, so a frame's share of the mesh's
+        # THROUGHPUT is per/n_dev — the bottleneck comparison must use
+        # that, or a 4-lane compute stage would out-rank the
+        # single-threaded writer it is actually 4x faster than. The
+        # serial read/write stages handle every frame on one thread.
+        eff = (
+            per / n_dev
+            if n in ("stream.h2d", "stream.compute", "stream.d2h")
+            else per
+        )
+        total += eff
+        if eff > slowest[1]:
+            slowest = (n, eff)
         model = model_stages.get(n[len("stream."):])
         model_s = "" if model is None else f"{model:13.6f}"
         lines.append(
@@ -249,14 +261,16 @@ def render_stream(tracer: Tracer, info: dict) -> str:
     # The measured bound follows the depth's law, like the header says:
     # overlapped stages are limited by the slowest one; depth 1 pays
     # the serial sum.
+    mesh_note = f" ({n_dev} lanes)" if n_dev > 1 else ""
     if depth > 1 and slowest[1] > 0:
         lines.append(
-            f"pipeline bound: {slowest[0]} -> "
+            f"pipeline bound{mesh_note}: {slowest[0]} -> "
             f"{1.0 / slowest[1]:.2f} frames/s"
         )
     elif total > 0:
         lines.append(
-            f"pipeline bound: sum(stages) -> {1.0 / total:.2f} frames/s"
+            f"pipeline bound{mesh_note}: sum(stages) -> "
+            f"{1.0 / total:.2f} frames/s"
         )
     fps_model = roofline.stream_frames_per_second(
         info["frame_bytes"], info["reps"], info["backend"],
@@ -270,10 +284,32 @@ def render_stream(tracer: Tracer, info: dict) -> str:
             f"measured {info['frames'] / info['wall_seconds']:.2f} "
             f"frames/s vs "
         )
+    per_dev_label = "per-device " if n_dev > 1 else "device-side "
     lines.append(
-        f"{measured}modeled device-side bound {fps_model:.2f} frames/s "
+        f"{measured}modeled {per_dev_label}bound {fps_model:.2f} frames/s "
         "(host read/write measured, not modeled)"
     )
+    if n_dev > 1:
+        # Mesh fan-out: the whole-mesh bound is n_devices x the
+        # per-device max-stage bound, capped by the shared-host PCIe
+        # contention term (every frame crosses the host pipe twice no
+        # matter how many chips compute).
+        mesh_fps = roofline.mesh_stream_frames_per_second(
+            info["frame_bytes"], info["reps"], info["backend"],
+            info["filter_name"], info["h_img"],
+            block_h=info.get("block_h"), fuse=info.get("fuse"),
+            pipeline_depth=depth, n_devices=n_dev,
+        )
+        pcie_cap = roofline.pcie_contention_frames_per_second(
+            info["frame_bytes"],
+        )
+        lines.append(
+            f"mesh fan-out: {n_dev} devices -> modeled whole-mesh bound "
+            f"{mesh_fps:.2f} frames/s (PCIe contention cap "
+            f"{pcie_cap:.2f} frames/s)"
+        )
+        # Per-device frame counts are the CLI report's line (one owner
+        # — a --breakdown run would otherwise print it twice).
     return "\n".join(lines) + "\n"
 
 

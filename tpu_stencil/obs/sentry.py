@@ -129,12 +129,18 @@ def record_from_capture(obj: dict, source: str = "bench") -> dict:
         block_h = obj.get("pallas_block_h")
         fuse = obj.get("pallas_fuse")
     # Multichip headline captures (bench.py TPU_STENCIL_BENCH_MESH) carry
-    # mesh/n_devices/overlap; the mesh and resolved overlap mode are
-    # already folded into the metric name (a key field — each combination
-    # is its own series), so here they ride along as provenance only.
+    # mesh/n_devices/overlap; mesh-fan stream/serve captures
+    # (TPU_STENCIL_BENCH_STREAM_MESH / _SERVE_MESHFAN) carry the
+    # throughput and per-device riders. The mesh/fan width and resolved
+    # overlap mode are already folded into the metric name (a key field
+    # — each combination is its own series), so here they ride along as
+    # provenance only.
     extra = {
         k: obj[k]
-        for k in ("hbm_gbps", "mesh", "n_devices", "overlap") if k in obj
+        for k in ("hbm_gbps", "mesh", "n_devices", "overlap",
+                  "frames_per_second", "per_device_frames_per_second",
+                  "per_device_frames", "pipeline_depth",
+                  "requests_per_second") if k in obj
     }
     return make_record(
         metric=metric, value=value,

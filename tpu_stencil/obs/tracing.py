@@ -34,6 +34,7 @@ when tracing is off — a few clock reads per *job*, not per rep.
 
 from __future__ import annotations
 
+import contextlib as _contextlib
 import dataclasses
 import threading
 import time
@@ -195,6 +196,30 @@ def reset() -> None:
     global _tracer, _registry
     _tracer = None
     _registry = None
+
+
+@_contextlib.contextmanager
+def scratch_registry():
+    """Divert the process-wide registry to a throwaway — and silence
+    the tracer — for the duration: measurement probes run frames
+    through the real engines (a ``--mesh-frames 0`` auto A/B streams
+    ~a dozen), and without the diversion their counters/gauges would
+    land in the run's own exposition and their spans would interleave
+    with the real run's ``--trace``/``--breakdown`` at the same frame
+    indices — report-what-ran, for both telemetry surfaces. The
+    previous registry (with all its accumulated state) and tracer are
+    restored on exit."""
+    global _registry, _tracer
+    from tpu_stencil.serve.metrics import Registry
+
+    prev_registry, prev_tracer = _registry, _tracer
+    _registry = Registry()
+    _tracer = None
+    try:
+        yield _registry
+    finally:
+        _registry = prev_registry
+        _tracer = prev_tracer
 
 
 def span(name: str, cat: str = "", **args):

@@ -6,6 +6,11 @@ factorization (``RowsDivision``), neighbor topology, derived-datatype halo
 350-364``) become a ``jax.sharding.Mesh``, a perimeter-minimizing grid
 factorization, neighbor ``lax.ppermute`` shifts inside ``shard_map``, and
 XLA's latency-hiding scheduler respectively.
+
+:mod:`tpu_stencil.parallel.fanout` (imported lazily — it pulls the
+streaming engine) is the data-parallel complement: whole frames fanned
+round-robin across the mesh, one pipeline lane per device, for the
+embarrassingly-parallel streaming case.
 """
 
 from tpu_stencil.parallel.partition import grid_shape, pad_amounts, tile_shape

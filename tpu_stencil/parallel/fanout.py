@@ -1,0 +1,463 @@
+"""Mesh fan-out: data-parallel multi-chip frame streaming.
+
+The streaming engine (:mod:`tpu_stencil.stream.engine`, PR 5) pipelines
+read → H2D → compute → D2H → write on ONE device; every
+``MULTICHIP_r0*.json`` shows 8 devices consistently available. Frames
+are independent — the embarrassingly-parallel case the Cerebras
+wafer-scale stencil study (arXiv:2605.07954) calls "communication costs
+nothing" — so the mesh-level program is pure data parallelism: frame
+``i`` goes to device ``(i - start) % n`` and the only cross-device
+coupling is the writer's in-order drain.
+
+Shape of the machine (docs/STREAMING.md "Mesh fan-out"):
+
+* **one reader thread** — the source contract is single-consumer
+  (pipes/stdin are strictly sequential), so one thread reads frames in
+  order and round-robins them onto per-device lanes. Each lane owns its
+  own host staging ring (``cfg.ring_size`` buffers) and its own
+  dispatch-ahead window (``cfg.pipeline_depth``), so backpressure is
+  per device: a stalled device parks the reader only when its lane's
+  ring drains (head-of-line at the slowest device — acceptable on the
+  homogeneous meshes this targets).
+* **per-device dispatch thread** — H2D onto its device (fenced, like
+  the single-device engine) and the donated compute launch: the SAME
+  compiled step ``run_job`` / ``run_stream`` use
+  (:func:`tpu_stencil.stream.engine._build_launch` →
+  ``blur.iterate``), traced once — the shared jit cache entry — with
+  one per-device executable; each device's first frame pays its
+  executable compile inside its own lane, overlapped across devices.
+* **per-device drain thread** — fences compute in that device's
+  dispatch order (under the dispatch watchdog), copies D2H, recycles
+  the lane's staging slot.
+* **one writer thread** — drains the lanes in global frame order
+  (frame ``i`` always comes from lane ``(i - start) % n``; each lane's
+  results arrive in its dispatch order, so global order is a
+  round-robin merge with no reordering buffer), writes to the single
+  sink, and commits the frame-index checkpoint with the device count
+  and per-device cursors (:func:`tpu_stencil.runtime.checkpoint
+  .save_stream_progress`).
+
+Because the writer commits strictly in order, ``frames_done`` alone
+pins global progress — a resume re-deals the remaining frames
+round-robin from the checkpoint (frames are independent, so the
+re-deal is free; the recorded cursors are the diagnostic record of
+where the interrupted fan stood, not state a resume re-adopts). The
+recorded device count IS contractual: a ``--resume`` under a
+different count fails typed
+(:class:`tpu_stencil.runtime.checkpoint.MeshCursorMismatch`) instead
+of reinterpreting another fan width's cursor record.
+
+Failure semantics, fault-injection sites (read/h2d/compute/d2h/write),
+stage spans/clocks (``stream.*``), and the engine-restart ladder are
+the single-device engine's — :func:`tpu_stencil.stream.engine
+.run_stream` owns the restart loop around this module too, so a
+transient mid-stream device fault restarts the whole fan and resumes
+from the checkpoint.
+
+Every path is bit-exact against the golden model: fan-out changes only
+WHERE a frame computes, never what (``tests/test_fanout.py`` fuzzes
+mesh-fan streams against per-frame golden results across grey/RGB,
+boundaries, depths and 1/2/4-device CPU meshes).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue
+import sys
+import threading
+import time
+from typing import Callable, List, Optional, Tuple
+
+import numpy as np
+
+from tpu_stencil import obs
+from tpu_stencil.config import StreamConfig
+from tpu_stencil.resilience import deadline as _deadline
+from tpu_stencil.resilience import faults as _faults
+from tpu_stencil.stream import frames as frames_io
+# Module-level by design: stream.engine never imports this module at
+# import time (only lazily inside run_stream), so there is no cycle, and
+# the two engines share one _Abort/_StageSpan/StreamFailure vocabulary.
+from tpu_stencil.stream import engine as _sengine
+
+_EOF = object()
+_STAGES = ("read", "h2d", "compute", "d2h", "write")
+
+# Frames per arm of the auto (--mesh-frames 0) measured A/B probe.
+PROBE_FRAMES = 3
+
+
+# The run control surface (stop flag, first-failure slot, abort-aware
+# queue ops, stage spans/clocks) is the engines' SHARED class — one
+# teardown/attribution protocol, never two drifting copies.
+_Control = _sengine._StageControl
+
+
+class _InflightMeter:
+    """The ``stream_inflight_depth`` gauge for mesh runs (value =
+    frames currently between read-complete and D2H-complete across ALL
+    lanes; peak = the total window depth actually reached — up to
+    ``n * pipeline_depth`` on a saturated fan). Same always-on gauge
+    contract as the single-device window's
+    (:meth:`~tpu_stencil.stream.engine._Pipeline.acquire_window`)."""
+
+    def __init__(self) -> None:
+        self._n = 0
+        self._lock = threading.Lock()
+        self._gauge = obs.registry().gauge("stream_inflight_depth")
+
+    def inc(self) -> None:
+        with self._lock:
+            self._n += 1
+            self._gauge.set(self._n)
+
+    def dec(self) -> None:
+        with self._lock:
+            self._n -= 1
+            self._gauge.set(self._n)
+
+    def zero(self) -> None:
+        """Teardown: aborted in-flight frames never pass :meth:`dec`,
+        and the process-wide gauge must not keep reporting them forever
+        (peak survives, as for every gauge)."""
+        with self._lock:
+            self._n = 0
+            self._gauge.set(0)
+
+
+class _Lane:
+    """One device's queues + staging ring. The ring bounds host memory
+    per device (``cfg.ring_size`` frames), the in-flight queue bounds
+    device memory per device (``cfg.pipeline_depth`` frames) — the
+    single-device engine's backpressure contract, one instance per
+    device."""
+
+    def __init__(self, cfg: StreamConfig) -> None:
+        self.ring = [
+            np.empty(cfg.frame_bytes, np.uint8) for _ in range(cfg.ring_size)
+        ]
+        self.free_q: queue.Queue = queue.Queue()
+        for i in range(len(self.ring)):
+            self.free_q.put(i)
+        self.filled_q: queue.Queue = queue.Queue(maxsize=len(self.ring))
+        self.inflight_q: queue.Queue = queue.Queue(
+            maxsize=cfg.pipeline_depth
+        )
+        self.done_q: queue.Queue = queue.Queue(
+            maxsize=cfg.pipeline_depth + 1
+        )
+        self.frames = 0  # frames this lane fully wrote (writer-owned)
+
+
+def device_cursors(frames_done: int, start_frame: int, n: int) -> List[int]:
+    """The per-device frame cursors at global progress ``frames_done``:
+    ``cursors[d]`` is the next frame index lane ``d`` would receive
+    under the CURRENT run's round-robin deal ``frame i -> lane
+    (i - start_frame) % n``. Pure function of (progress, start, count).
+    The checkpoint records them as the diagnostic picture of where the
+    interrupted fan stood; a resume re-anchors the deal at the restored
+    ``frames_done`` (frames are independent, so the re-deal is free) —
+    it never re-adopts recorded cursors, which is also why a
+    different-count resume refuses instead of reinterpreting them."""
+    base = max(frames_done, start_frame)
+    off = (base - start_frame) % n
+    return [base + ((d - off) % n) for d in range(n)]
+
+
+def _reader(ctrl: _Control, cfg: StreamConfig, source, lanes: List[_Lane],
+            start_frame: int, meter: _InflightMeter) -> None:
+    """Round-robin prefetch: frame ``i`` fills a staging slot of lane
+    ``(i - start) % n``. Retry semantics: the engines' shared
+    :func:`~tpu_stencil.stream.engine._make_read_frame`."""
+    n = len(lanes)
+    idx = start_frame
+    read_frame = _sengine._make_read_frame(cfg, source)
+    try:
+        while cfg.frames is None or idx < cfg.frames:
+            lane = lanes[(idx - start_frame) % n]
+            buf_i = ctrl.get(lane.free_q)
+            with ctrl.stage("read", idx):
+                ok = read_frame(idx, lane.ring[buf_i])
+            if not ok:
+                if cfg.frames is not None:
+                    raise IOError(
+                        f"stream ended after {idx} frame(s); "
+                        f"--frames promised {cfg.frames}"
+                    )
+                lane.free_q.put(buf_i)
+                break
+            meter.inc()  # in flight from read-complete to D2H-complete
+            ctrl.put(lane.filled_q, (idx, buf_i))
+            idx += 1
+        for lane in lanes:
+            ctrl.put(lane.filled_q, _EOF)
+    except _sengine._Abort:
+        pass
+    except BaseException as e:
+        ctrl.fail("read", idx, e)
+
+
+def _dispatcher(ctrl: _Control, cfg: StreamConfig, lane: _Lane, device,
+                launch: Callable, dev_index: int) -> None:
+    """One device's H2D + donated-launch loop. The fenced H2D holds only
+    this frame's pre-compute path (the single-device engine's
+    attribution discipline); the launch is async dispatch, bounded by
+    the lane's in-flight queue."""
+    import jax
+
+    idx, stage = -1, "h2d"
+    fault_h2d = _faults.site("h2d")
+    fault_compute = _faults.site("compute")
+    try:
+        while True:
+            item = ctrl.get(lane.filled_q)
+            if item is _EOF:
+                ctrl.put(lane.inflight_q, _EOF)
+                return
+            idx, bi = item
+            stage = "h2d"
+            if fault_h2d is not None:
+                fault_h2d(idx)
+            with ctrl.stage("h2d", idx, dev=dev_index) as s:
+                dev_arr = s.fence(jax.device_put(
+                    lane.ring[bi].reshape(cfg.frame_shape), device
+                ))
+            lane.free_q.put(bi)  # fenced H2D consumed the staging buffer
+            stage = "compute"
+            if fault_compute is not None:
+                fault_compute(idx)
+            t_disp = time.perf_counter()
+            out = launch(dev_arr)  # async dispatch; donates dev_arr
+            ctrl.put(lane.inflight_q, (idx, out, t_disp))
+    except _sengine._Abort:
+        pass
+    except BaseException as e:
+        ctrl.fail(stage, max(idx, 0), e)
+
+
+def _drainer(ctrl: _Control, cfg: StreamConfig, lane: _Lane,
+             dev_index: int, meter: _InflightMeter) -> None:
+    """Fence one device's compute in its dispatch order (watchdogged),
+    copy D2H, hand off to the writer's merge."""
+    idx, stage = -1, "compute"
+    fault_d2h = _faults.site("d2h")
+    timeout_s = _deadline.resolve(cfg.dispatch_timeout_s)
+    try:
+        while True:
+            item = ctrl.get(lane.inflight_q)
+            if item is _EOF:
+                ctrl.put(lane.done_q, _EOF)
+                return
+            idx, out_dev, t_disp = item
+            stage = "compute"
+            with ctrl.stage("compute", idx, t0=t_disp, dev=dev_index):
+                _deadline.fence(
+                    out_dev, timeout_s,
+                    f"stream.compute[frame={idx},dev={dev_index}]",
+                )
+            stage = "d2h"
+            with ctrl.stage("d2h", idx, dev=dev_index):
+                if fault_d2h is not None:
+                    fault_d2h(idx)
+                arr = np.asarray(out_dev)
+            meter.dec()
+            ctrl.put(lane.done_q, (idx, arr))
+    except _sengine._Abort:
+        pass
+    except BaseException as e:
+        ctrl.fail(stage, max(idx, 0), e)
+
+
+def _writer(ctrl: _Control, cfg: StreamConfig, sink, lanes: List[_Lane],
+            start_frame: int, done: list) -> None:
+    """In-order drain across devices: frame ``i`` is popped from lane
+    ``(i - start) % n`` — a round-robin merge, no reordering buffer —
+    then written, counted, and checkpointed with the per-device
+    cursors. ``done[0]`` tracks frames fully written (global index).
+    Retry semantics: the engines' shared
+    :func:`~tpu_stencil.stream.engine._make_write_frame`."""
+    n = len(lanes)
+    idx = start_frame
+    write_frame = _sengine._make_write_frame(cfg, sink)
+    try:
+        while True:
+            lane = lanes[(idx - start_frame) % n]
+            item = ctrl.get(lane.done_q)
+            if item is _EOF:
+                return
+            got, arr = item
+            assert got == idx, (got, idx)  # per-lane FIFO + round-robin
+            with ctrl.stage("write", idx):
+                write_frame(idx, arr)
+            lane.frames += 1
+            done[0] = idx + 1
+            obs.registry().counter("stream_frames_total").inc()
+            if cfg.checkpoint_every and done[0] % cfg.checkpoint_every == 0:
+                from tpu_stencil.runtime import checkpoint as ckpt
+
+                sink.flush()
+                ckpt.save_stream_progress(
+                    cfg, done[0], mesh_devices=n,
+                    cursors=device_cursors(done[0], start_frame, n),
+                )
+            if cfg.progress_every and done[0] % cfg.progress_every == 0:
+                print(f"stream: frame {done[0]}", file=sys.stderr,
+                      flush=True)
+            idx += 1
+    except _sengine._Abort:
+        pass
+    except BaseException as e:
+        ctrl.fail("write", max(idx, start_frame), e)
+
+
+def run_mesh_frames(cfg: StreamConfig, devices, n: int, model,
+                    source, sink, start_frame: int) -> dict:
+    """One mesh-fan pipeline lifetime over ``n`` devices (the fan-out
+    analog of the single-device engine's thread choreography). The
+    caller (:func:`tpu_stencil.stream.engine._run_stream_once`) owns
+    source/sink lifecycle, resume resolution, and result assembly;
+    this returns ``{"frames", "stage_seconds", "per_device_frames",
+    "backend", "schedule"}`` or raises
+    :class:`~tpu_stencil.stream.engine.StreamFailure`."""
+    devices = list(devices)[:n]
+    if len(devices) < n:
+        raise ValueError(
+            f"--mesh-frames asks for {n} devices, have {len(devices)}"
+        )
+    # One trace, resolved once on this thread (autotune cache consults
+    # are not re-raced per device); per-device executables come out of
+    # the shared jit cache as each lane's first frame launches.
+    launch, backend, schedule = _sengine._build_launch(model, cfg)
+    ctrl = _Control()
+    lanes = [_Lane(cfg) for _ in range(n)]
+    done = [start_frame]
+    meter = _InflightMeter()
+    threads = [
+        threading.Thread(
+            target=_reader,
+            args=(ctrl, cfg, source, lanes, start_frame, meter),
+            name="fanout-reader", daemon=True,
+        ),
+        threading.Thread(
+            target=_writer,
+            args=(ctrl, cfg, sink, lanes, start_frame, done),
+            name="fanout-writer", daemon=True,
+        ),
+    ]
+    for d, (lane, dev) in enumerate(zip(lanes, devices)):
+        threads.append(threading.Thread(
+            target=_dispatcher, args=(ctrl, cfg, lane, dev, launch, d),
+            name=f"fanout-dispatch-{d}", daemon=True,
+        ))
+        threads.append(threading.Thread(
+            target=_drainer, args=(ctrl, cfg, lane, d, meter),
+            name=f"fanout-drain-{d}", daemon=True,
+        ))
+    try:
+        for t in threads:
+            t.start()
+        # Clean runs end via the sentinel cascade; failed runs via the
+        # stop flag. Like the single-device engine, never wait
+        # indefinitely on a reader parked in a blocking pipe read.
+        for t in threads:
+            while t.is_alive() and not ctrl.stop.is_set():
+                t.join(timeout=0.1)
+    finally:
+        ctrl.stop.set()
+        for t in threads:
+            t.join(timeout=1.0)
+        meter.zero()  # aborted in-flight frames never pass dec()
+    if ctrl.failure is not None:
+        stage, frame_index, cause = ctrl.failure
+        raise _sengine.StreamFailure(stage, frame_index, cause) from cause
+    return {
+        "frames": done[0] - start_frame,
+        "stage_seconds": dict(ctrl.stage_seconds),
+        "per_device_frames": [lane.frames for lane in lanes],
+        "backend": backend,
+        "schedule": schedule,
+    }
+
+
+def measure_fanout_ab(cfg: StreamConfig, devices,
+                      frames: int = PROBE_FRAMES) -> Tuple[float, float]:
+    """The measured single-vs-mesh A/B behind ``--mesh-frames 0``
+    (auto): run a tiny synthetic stream (random frames, null sink —
+    no disk in the loop) once warm + once timed at depth ``cfg
+    .pipeline_depth`` on 1 device and on ``len(devices)`` devices.
+    Returns ``(single_seconds, mesh_seconds)``, both arms over the same
+    frame count — at least one frame per device, or the mesh arm would
+    decide a fan width whose outer lanes (and their contention) never
+    actually ran. The probe pays ~2 compiles + ``4 * frames * reps``
+    of compute — the documented cost of asking for a measured
+    verdict."""
+    frames = max(frames, len(devices))
+    rng = np.random.default_rng(0)
+    frame = rng.integers(0, 256, cfg.frame_bytes, dtype=np.uint8)
+
+    class _Synth(frames_io.FrameSource):
+        def __init__(self, k: int) -> None:
+            self._left = k
+
+        def read_into(self, buf) -> bool:
+            if self._left <= 0:
+                return False
+            np.copyto(buf, frame)
+            self._left -= 1
+            return True
+
+    def one(n_dev: int) -> float:
+        pcfg = dataclasses.replace(
+            cfg, frames=frames, mesh_frames=max(1, n_dev), output="null",
+            checkpoint_every=0, progress_every=0,
+        )
+        _sengine.run_stream(pcfg, devices=list(devices),
+                            source=_Synth(frames),
+                            sink=frames_io.NullSink())  # warm: compiles land
+        t0 = time.perf_counter()
+        _sengine.run_stream(pcfg, devices=list(devices),
+                            source=_Synth(frames),
+                            sink=frames_io.NullSink())
+        return time.perf_counter() - t0
+
+    # The probe streams real frames through the real engines; its
+    # counters/spans must not inflate the caller's own run (and its mesh
+    # arm must not leave the stream_mesh_devices gauge behind when the
+    # verdict is single-device) — report-what-ran.
+    with obs.scratch_registry():
+        return one(1), one(len(devices))
+
+
+def resolve_mesh_frames(cfg: StreamConfig, devices,
+                        measure: Optional[Callable] = None) -> int:
+    """Resolve ``cfg.mesh_frames`` to the device count that actually
+    runs: an explicit ``N > 1`` is honored (failing loudly when fewer
+    devices exist, naming both counts); ``0`` (auto) runs the measured
+    A/B (:func:`measure_fanout_ab`, or the injected ``measure``) and
+    enables fan-out ONLY when the mesh arm measured strictly faster —
+    the same never-auto-enable-a-measured-loss discipline as the deep
+    schedule and the edge overlap verdicts. Returns 1 or the fan
+    width."""
+    n_avail = len(devices)
+    if cfg.mesh_frames == 1:
+        return 1
+    if cfg.mesh_frames > 1:
+        if n_avail < cfg.mesh_frames:
+            raise ValueError(
+                f"--mesh-frames asks for {cfg.mesh_frames} devices, "
+                f"have {n_avail}"
+            )
+        return cfg.mesh_frames
+    # auto (0): nothing to fan on one device; else measure.
+    if n_avail < 2:
+        return 1
+    t_single, t_mesh = (measure or measure_fanout_ab)(cfg, devices)
+    pick = n_avail if t_mesh < t_single else 1
+    print(
+        f"stream: --mesh-frames auto measured single={t_single:.3f}s "
+        f"mesh[{n_avail}]={t_mesh:.3f}s -> "
+        f"{'fan-out ' + str(n_avail) if pick > 1 else 'single-device'}",
+        file=sys.stderr, flush=True,
+    )
+    return pick

@@ -260,6 +260,25 @@ def restore_sharded(cfg: JobConfig, sharding) -> Optional[Tuple[int, "object"]]:
     return rep, arr
 
 
+class MeshCursorMismatch(ValueError):
+    """A ``--resume`` of a mesh-fan stream run under a different device
+    count than the one that wrote the checkpoint. The recorded
+    per-device frame cursors are round-robin-aligned to the writing
+    run's device count, so silently adopting them under another count
+    would misattribute frames to devices; the resume must fail typed,
+    naming both counts (the recorded one and the requested one)."""
+
+    def __init__(self, recorded: int, requested: int, path: str) -> None:
+        super().__init__(
+            f"stream checkpoint at {path} was written by a "
+            f"{recorded}-device mesh-fan run but --resume is running on "
+            f"{requested} device(s); re-run with --mesh-frames "
+            f"{recorded} (or delete the checkpoint to start over)"
+        )
+        self.recorded = recorded
+        self.requested = requested
+
+
 def _stream_paths(cfg) -> str:
     """The stream progress sidecar lives beside the sink (the artifact
     it describes), like the frame checkpoints beside the job output.
@@ -288,24 +307,44 @@ def _stream_fingerprint(cfg) -> dict:
     }
 
 
-def save_stream_progress(cfg, frames_done: int) -> None:
+def save_stream_progress(cfg, frames_done: int,
+                         mesh_devices: int = 1,
+                         cursors: Optional[list] = None) -> None:
     """Atomically record that frames [0, frames_done) are durably in
     the sink. No frame payload — unlike the rep checkpoints, a stream's
     completed frames already live in the output; progress is one
-    integer plus the fingerprint."""
+    integer plus the fingerprint.
+
+    Mesh-fan runs (``mesh_devices > 1``) additionally record the device
+    count and the per-device frame cursors (the next frame index each
+    of the WRITING run's round-robin lanes would have received) —
+    ``cursors[d]``, one per device. The in-order drain means
+    ``frames_done`` alone pins global progress, and a resume re-deals
+    the remaining frames from there (it does not re-adopt the recorded
+    cursors — they are the diagnostic record of where the interrupted
+    fan stood); what the resume contract enforces is the device count,
+    which a different-count resume must refuse
+    (:class:`MeshCursorMismatch`)."""
     _checkpoint_fault(int(frames_done))
     path = _stream_paths(cfg)
     meta = dict(_stream_fingerprint(cfg), frames_done=int(frames_done))
+    if mesh_devices > 1:
+        meta["mesh_devices"] = int(mesh_devices)
+        if cursors is not None:
+            meta["device_cursors"] = [int(c) for c in cursors]
     tmp = path + ".tmp"
     with open(tmp, "w") as f:
         json.dump(meta, f)
     os.replace(tmp, path)
 
 
-def restore_stream_progress(cfg) -> Optional[int]:
+def restore_stream_progress(cfg, mesh_devices: int = 1) -> Optional[int]:
     """Frames already completed by a matching prior run, or None. A
     fingerprint mismatch raises (resuming a different job's sink would
-    silently mix outputs)."""
+    silently mix outputs); a device-count mismatch against a mesh-fan
+    checkpoint raises typed (:class:`MeshCursorMismatch` — the recorded
+    per-device cursors are aligned to the writing run's round-robin, so
+    a different count must never silently adopt them)."""
     path = _stream_paths(cfg)
     if not os.path.exists(path):
         return None
@@ -317,6 +356,9 @@ def restore_stream_progress(cfg) -> Optional[int]:
             f"stream checkpoint at {path} was written for a different "
             f"job ({meta} != {want}); delete it or change --output"
         )
+    recorded = int(meta.get("mesh_devices", 1))
+    if recorded != int(mesh_devices):
+        raise MeshCursorMismatch(recorded, int(mesh_devices), path)
     return int(meta["frames_done"])
 
 

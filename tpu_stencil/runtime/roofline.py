@@ -195,6 +195,40 @@ def stream_frames_per_second(frame_bytes: int, reps: int, backend: str,
     return 1.0 / bound if bound > 0 else float("inf")
 
 
+def pcie_contention_frames_per_second(frame_bytes: int) -> float:
+    """The host-side PCIe ceiling on whole-mesh streaming frames/s:
+    every frame crosses the host's PCIe complex twice (H2D in, D2H
+    out), and the fan-out's lanes share ONE host — so no matter how
+    many chips compute, the host cannot move more than
+    ``V5E_PCIE_GBPS / (2 * frame_bytes)`` frames per second through a
+    single Gen4 x16 pipe. Deliberately independent of the device
+    count: the model is the conservative shared-pipe shape (hosts with
+    one PCIe root per chip would scale it, and then it simply never
+    binds)."""
+    return V5E_PCIE_GBPS * 1e9 / (2.0 * frame_bytes)
+
+
+def mesh_stream_frames_per_second(frame_bytes: int, reps: int,
+                                  backend: str, filter_name: str,
+                                  h_img: int, block_h=None, fuse=None,
+                                  pipeline_depth: int = 2,
+                                  n_devices: int = 1) -> float:
+    """The modeled whole-mesh steady-state frames/s bound of the mesh
+    fan-out (:mod:`tpu_stencil.parallel.fanout`): frames are
+    embarrassingly parallel, so the device-side bound is the
+    single-device pipeline bound (max-stage, or serial sum at depth 1 —
+    :func:`stream_frames_per_second`) times ``n_devices``, capped by
+    the shared-host PCIe contention term
+    (:func:`pcie_contention_frames_per_second`). Rendered next to the
+    per-device bound by the stream CLI's ``--breakdown``."""
+    per_device = stream_frames_per_second(
+        frame_bytes, reps, backend, filter_name, h_img, block_h, fuse,
+        pipeline_depth=pipeline_depth,
+    )
+    return min(per_device * max(1, n_devices),
+               pcie_contention_frames_per_second(frame_bytes))
+
+
 def achieved_frames(frame_bytes: int, n_frames: int, per_rep_s: float,
                     backend: str, filter_name: str, h_img: int,
                     block_h=None, fuse=None) -> Tuple[float, float]:

@@ -60,10 +60,21 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--overlap", default="off", choices=list(OVERLAP_MODES),
                    help="interior/border overlap schedule (same vocabulary "
                         "as the run CLI); recorded in the overlap_mode "
-                        "gauge. Today's bucket executables are "
-                        "single-device (no ghost exchange), so modes other "
-                        "than off are accepted but inert until a "
-                        "spatially-sharded serve path lands")
+                        "gauge. off (default) keeps every request on the "
+                        "single-device bucket executables; any other mode "
+                        "ACTIVATES sharded routing — requests of at least "
+                        "--shard-min-pixels run the shard_map path over "
+                        "all local devices under this schedule, bucketed "
+                        "separately so small requests never wait inside a "
+                        "sharded dispatch. Bit-exact either way "
+                        "(docs/SERVING.md)")
+    p.add_argument("--shard-min-pixels", dest="shard_min_pixels",
+                   type=int, default=1 << 20, metavar="PX",
+                   help="sharded-routing size threshold in true pixels "
+                        "(H*W): with a non-off --overlap, requests at or "
+                        "above it route through the spatially-sharded "
+                        "path; below it they stay on the bucket "
+                        "executables (default 1048576 = ~1024x1024)")
     p.add_argument("--request-timeout", dest="request_timeout_s",
                    type=float, default=0.0, metavar="SECONDS",
                    help="per-request deadline: a request still queued "
@@ -251,6 +262,7 @@ def main(argv=None) -> int:
             filter_name=ns.filter_name, backend=ns.backend,
             max_queue=ns.max_queue, max_batch=ns.max_batch,
             overlap=ns.overlap,
+            shard_min_pixels=ns.shard_min_pixels,
             request_timeout_s=ns.request_timeout_s,
         )
     except ValueError as e:

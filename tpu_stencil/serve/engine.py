@@ -98,6 +98,12 @@ class Request:
     # fail typed (DeadlineExceeded) at batch formation instead of
     # occupying a batch slot.
     t_deadline: Optional[float] = None
+    # Routed through the spatially-sharded shard_map path (ServeConfig
+    # overlap != "off" and the request is at least shard_min_pixels):
+    # the key carries a "sharded" marker, so these requests bucket
+    # separately and small requests never share a batch with (or wait
+    # inside) a sharded dispatch.
+    sharded: bool = False
 
 
 def _mask_valid(imgs, valid_h, valid_w):
@@ -252,6 +258,13 @@ class _MemorySampler:
 # is 64 by default; 8x that covers realistic churn).
 _INTROSPECT_KEY_CAP = 512
 
+# LRU cap on cached ShardedRunners (the sharded-routing analog of the
+# bucket-executable cache): each runner holds a compiled mesh program
+# for one true (filter, H, W, channels) — oversized shapes are rare and
+# huge, so the population is small, but the key space is still
+# client-controlled and must not grow unboundedly.
+_SHARDED_RUNNER_CAP = 8
+
 _server_serials = itertools.count()
 
 _last_server_ref = None  # weakref to the most recently constructed server
@@ -335,6 +348,14 @@ class StencilServer:
         self._m_inflight = m.gauge("inflight_batches")
         self._m_deadline = m.counter("deadline_expired_total")
         self._m_crashes = m.counter("resilience_worker_crashes_total")
+        # Sharded routing (overlap != "off"): oversized requests run the
+        # shard_map path; the runner cache is the sharded analog of the
+        # bucket-executable cache.
+        self._sharded_runners: "collections.OrderedDict" = (
+            collections.OrderedDict()
+        )
+        self._m_sharded = m.counter("sharded_requests_total")
+        self._m_sharded_batches = m.counter("sharded_batches_total")
         self._m_qwait = m.histogram("queue_wait_seconds")
         self._m_blat = m.histogram("batch_latency_seconds")
         self._m_rlat = m.histogram("request_latency_seconds")
@@ -343,11 +364,12 @@ class StencilServer:
         # Configured overlap schedule, same gauge name/coding as the
         # sharded runner's (parallel/overlap.py MODE_CODES: off=0,
         # split=1, fused-split=2, edge=3), plus AUTO_CODE (4) for a
-        # requested "auto" — serve has no mesh to resolve it against,
-        # and only serve may report it (the sharded runner always
-        # resolves before the gauge is set). Bucket executables are
-        # single-device today, so the mode is inert — recorded so
-        # dashboards see the knob the deployment set.
+        # requested "auto" — recorded before the first sharded dispatch
+        # resolves it against a real mesh (each ShardedRunner re-sets
+        # the driver-registry gauge with its resolved mode). A non-off
+        # mode activates sharded routing: requests of at least
+        # cfg.shard_min_pixels run the shard_map path under this
+        # schedule; "off" keeps everything on the bucket executables.
         from tpu_stencil.parallel import overlap as _overlap_mod
 
         m.gauge("overlap_mode").set(
@@ -437,11 +459,28 @@ class StencilServer:
         fname = filter_name or self.cfg.filter_name
         h, w = image.shape[:2]
         channels = image.shape[2] if image.ndim == 3 else 1
-        bucket_hw = bucketing.bucket_shape(h, w, self._edges)
-        # dtype is uint8 today across the whole pipeline; it is part of
-        # the key by contract so a future f32 path can't alias entries.
-        key = (fname, bucket_hw, channels, str(image.dtype),
-               self.cfg.backend, int(reps))
+        # Sharded routing: with a non-"off" overlap schedule, requests
+        # at/above the size threshold run the spatially-sharded
+        # shard_map path at their TRUE shape (the sharded runner's own
+        # pad/mask discipline replaces bucket padding — a bucket canvas
+        # would feed pad pixels to the mesh as image interior). The
+        # "sharded" key marker buckets them separately, so small
+        # requests never wait inside a sharded dispatch's batch.
+        sharded = (
+            self.cfg.overlap != "off"
+            and h * w >= self.cfg.shard_min_pixels
+        )
+        if sharded:
+            bucket_hw = (h, w)
+            key = (fname, (h, w), channels, str(image.dtype),
+                   self.cfg.backend, int(reps), "sharded")
+        else:
+            bucket_hw = bucketing.bucket_shape(h, w, self._edges)
+            # dtype is uint8 today across the whole pipeline; it is
+            # part of the key by contract so a future f32 path can't
+            # alias entries.
+            key = (fname, bucket_hw, channels, str(image.dtype),
+                   self.cfg.backend, int(reps))
         if deadline_s is None:
             deadline_s = self.cfg.request_timeout_s
         if deadline_s is not None and deadline_s < 0:
@@ -453,6 +492,7 @@ class StencilServer:
             filter_name=fname, key=key, bucket_hw=bucket_hw, future=fut,
             t_submit=now,
             t_deadline=(now + deadline_s) if deadline_s else None,
+            sharded=sharded,
         )
         with _obs_span("serve.enqueue", "serve", req_id=req.req_id):
             with self._cond:
@@ -528,6 +568,7 @@ class StencilServer:
         snap = self.registry.snapshot()
         snap["executables_cached"] = len(self._cache)
         snap["introspected_executables"] = len(self._introspected)
+        snap["sharded_runners_cached"] = len(self._sharded_runners)
         return snap
 
     def introspection(self) -> List[dict]:
@@ -586,13 +627,145 @@ class StencilServer:
             )
         return model
 
+    # Cache sentinel: this shape's mesh build failed on a DETERMINISTIC
+    # geometry constraint — serve it on the bucket path, and never
+    # re-pay the failed build on the next same-shape request.
+    _SHARDED_UNSERVABLE = object()
+
+    def _sharded_runner_for(self, filter_name: str, hw: Tuple[int, int],
+                            channels: int):
+        """The cached :class:`~tpu_stencil.parallel.sharded
+        .ShardedRunner` for one true (filter, H, W, channels) — keyed
+        WITHOUT reps (the runner's rep count is a traced argument, so
+        one compiled mesh program serves any reps), LRU-bounded like
+        the bucket-executable cache. Built over all local devices with
+        the server's overlap schedule (a 1-device process degrades to
+        the 1x1 mesh — still bit-exact, so routing never depends on
+        device count).
+
+        Returns None when the mesh CANNOT serve this geometry (e.g. an
+        extreme aspect ratio whose per-device tile is smaller than the
+        filter halo — a typed ValueError/NotImplementedError from the
+        runner): the caller falls back to the single-device bucket
+        path, which serves every shape the pre-routing engine did. The
+        verdict is cached so retries of the same shape never re-pay the
+        failed build."""
+        key = (filter_name, hw, channels)
+        runner = self._sharded_runners.get(key)
+        if runner is not None:
+            self.registry.counter("sharded_runner_hits_total").inc()
+            self._sharded_runners.move_to_end(key)
+            return (
+                None if runner is self._SHARDED_UNSERVABLE else runner
+            )
+        self.registry.counter("sharded_runner_misses_total").inc()
+        import jax
+
+        from tpu_stencil.parallel import sharded as _sharded
+
+        with _obs_span("serve.sharded_runner_build", "serve",
+                       shape=hw, channels=channels):
+            # The largest compile in serve: the "compile" injection
+            # point must cover it like the bucket builders, or the
+            # chaos suite cannot exercise a failed mesh build.
+            if self._fault_compile is not None:
+                self._fault_compile()
+            try:
+                runner = _sharded.ShardedRunner(
+                    self._model_for(filter_name), hw, channels,
+                    devices=jax.devices(), overlap=self.cfg.overlap,
+                )
+            except (ValueError, NotImplementedError):
+                # Deterministic geometry refusal (transient/compile
+                # failures raise other types and propagate like any
+                # dispatch error — they are NOT cached).
+                runner = self._SHARDED_UNSERVABLE
+                self.registry.counter("sharded_fallbacks_total").inc()
+        self._sharded_runners[key] = runner
+        while len(self._sharded_runners) > _SHARDED_RUNNER_CAP:
+            self._sharded_runners.popitem(last=False)
+            self.registry.counter("sharded_runner_evictions_total").inc()
+        return None if runner is self._SHARDED_UNSERVABLE else runner
+
+    def _account_devices(self, n_devices: int, total_bytes: int,
+                         n_requests: int) -> None:
+        """Per-device admission accounting: every dispatch charges each
+        device it lands on — ``device_requests_total_dev<i>`` (a
+        sharded request occupies every mesh device; a bucket batch
+        occupies device 0) and ``device_bytes_dispatched_total_dev<i>``
+        (its share of the dispatched bytes) — so a dashboard sees how
+        admission spreads load across the mesh, not just an aggregate
+        that hides an idle fan."""
+        per = total_bytes // max(1, n_devices)
+        for i in range(n_devices):
+            self.registry.counter(
+                f"device_requests_total_dev{i}"
+            ).inc(n_requests)
+            self.registry.counter(
+                f"device_bytes_dispatched_total_dev{i}"
+            ).inc(per)
+
     def _dispatch(self, batch: List[Request]):
         """Assemble the padded canvas and launch the bucket executable
-        (async under JAX dispatch). Returns the retire closure's state:
-        (batch, out_dev, true_shapes, t_start)."""
+        (async under JAX dispatch) — or, for a sharded-routed batch,
+        launch each request's mesh program. Returns the retire
+        closure's state: (batch, out_dev, meta, t_start)."""
         with _obs_span("serve.execute", "serve", batch=len(batch),
-                       reps=batch[0].reps):
+                       reps=batch[0].reps,
+                       sharded=batch[0].sharded):
+            if batch[0].sharded:
+                return self._dispatch_sharded(batch)
             return self._dispatch_inner(batch)
+
+    def _dispatch_sharded(self, batch: List[Request]):
+        """The oversized-request path: each request runs the shard_map
+        + overlap program at its TRUE shape over all local devices
+        (``ShardedRunner.put`` pads to the tile grid and the mask
+        re-zeroes the pad every rep — bit-exact vs the bucket path).
+        All launches are async dispatch; the retire fences them in
+        order, so batch-mates pipeline on the mesh."""
+        h, w = batch[0].image.shape[:2]
+        channels = (
+            batch[0].image.shape[2] if batch[0].image.ndim == 3 else 1
+        )
+        runner = self._sharded_runner_for(
+            batch[0].filter_name, (h, w), channels
+        )
+        if runner is None:
+            # The mesh cannot serve this geometry: fall back to the
+            # bucket path, which serves every shape the pre-routing
+            # engine did. Re-bucket the requests in place — the key
+            # keeps its "sharded" marker (still a unique, consistent
+            # cache key for this shape+reps), only the dispatch route
+            # changes.
+            for r in batch:
+                r.sharded = False
+                r.bucket_hw = bucketing.bucket_shape(h, w, self._edges)
+            return self._dispatch_inner(batch)
+        n_dev = int(runner.mesh.devices.size)
+        t0 = time.perf_counter()
+        if self._fault_h2d is not None:
+            self._fault_h2d()
+        if self._fault_compute is not None:
+            self._fault_compute()
+        outs = []
+        for r in batch:
+            dev = runner.put(r.image)
+            outs.append(runner.run(dev, r.reps))
+        self._m_sharded.inc(len(batch))
+        self._m_sharded_batches.inc()
+        self._m_real.inc(len(batch) * h * w)
+        ph, pw = runner.padded_shape
+        self._m_padded.inc(len(batch) * (ph * pw - h * w))
+        self._account_devices(
+            n_dev, len(batch) * ph * pw * channels, len(batch)
+        )
+        for r in batch:
+            self._m_qwait.observe(t0 - r.t_submit)
+        self._m_bsize.observe(len(batch))
+        meta = {"sharded": True, "runner": runner,
+                "backend": runner.backend, "n_devices": n_dev}
+        return batch, outs, meta, t0
 
     def _dispatch_inner(self, batch: List[Request]):
         import jax
@@ -614,6 +787,10 @@ class StencilServer:
         true_shapes = [r.image.shape[:2] for r in batch]
         self._m_padded.inc(bucketing.waste_pixels(true_shapes, (bh, bw), nb))
         self._m_real.inc(sum(h * w for h, w in true_shapes))
+        # Bucket batches run single-device: the whole canvas lands on
+        # device 0 (same per-device accounting the sharded path spreads
+        # across its mesh).
+        self._account_devices(1, int(canvas.nbytes), len(batch))
 
         model = self._model_for(batch[0].filter_name)
         backend, _sched = model.resolved_config((bh, bw), channels)
@@ -670,7 +847,30 @@ class StencilServer:
         """Block on one in-flight batch, crop per-request outputs, resolve
         futures, record latency + achieved-bandwidth metrics."""
         with _obs_span("serve.drain", "serve", batch=len(batch)):
-            self._retire_inner(batch, out_dev, meta, t0)
+            if isinstance(meta, dict) and meta.get("sharded"):
+                self._retire_sharded(batch, out_dev, meta, t0)
+            else:
+                self._retire_inner(batch, out_dev, meta, t0)
+
+    def _retire_sharded(self, batch, outs, meta, t0) -> None:
+        """Fence each sharded launch in dispatch order, crop the mesh
+        pad off (``ShardedRunner.fetch``) and resolve futures — the
+        sharded analog of the bucket retire. No HBM-roofline sample:
+        the batch_hbm_gbps model is per-chip, and a spatially-sharded
+        launch splits the frame across chips (the run CLI's
+        ``--breakdown`` owns that roofline)."""
+        runner = meta["runner"]
+        if self._fault_d2h is not None:
+            self._fault_d2h()
+        results = [runner.fetch(o) for o in outs]  # blocks per launch
+        t1 = time.perf_counter()
+        self._m_batches.inc()
+        self._m_blat.observe(t1 - t0)
+        for r, out in zip(batch, results):
+            if not r.future.done() and _resolve(
+                    r.future, np.ascontiguousarray(out)):
+                self._m_completed.inc()
+                self._m_rlat.observe(t1 - r.t_submit)
 
     def _retire_inner(self, batch, out_dev, meta, t0) -> None:
         bh, bw, channels, nb, backend = meta

@@ -103,6 +103,19 @@ def build_parser() -> argparse.ArgumentParser:
              "(default pipeline_depth + 2; must be > pipeline_depth)",
     )
     p.add_argument(
+        "--mesh-frames", dest="mesh_frames", type=int, default=1,
+        metavar="N",
+        help="mesh fan-out: round-robin frames across N devices, one "
+             "pipeline lane (staging ring + dispatch-ahead window) per "
+             "device, in-order drain across devices (docs/STREAMING.md "
+             "'Mesh fan-out'). 1 = single-device (default); N > 1 fails "
+             "loudly when fewer devices exist; 0 = auto — a measured "
+             "single-vs-mesh A/B enables fan-out only when it is "
+             "strictly faster. Bit-exact in every mode; checkpoints "
+             "record the device count and per-device cursors, so "
+             "--resume under a different count fails typed",
+    )
+    p.add_argument(
         "--checkpoint-every", type=int, default=0, metavar="N",
         help="commit a frame-index checkpoint every N written frames "
              "(0 = off); needs a resumable sink (file or directory)",
@@ -194,6 +207,7 @@ def main(argv=None) -> int:
             fuse=ns.fuse,
             pipeline_depth=ns.pipeline_depth,
             ring_buffers=ns.ring_buffers,
+            mesh_frames=ns.mesh_frames,
             checkpoint_every=ns.checkpoint_every,
             progress_every=ns.progress_every,
             dispatch_timeout_s=ns.dispatch_timeout_s,
@@ -270,8 +284,17 @@ def main(argv=None) -> int:
         f"({result.frames_per_second:.2f} frames/s, "
         f"depth={result.pipeline_depth}, backend={result.backend}"
         + (f" schedule={result.schedule}" if result.schedule else "")
+        + (f" mesh-frames={result.n_devices}dev"
+           if result.n_devices > 1 else "")
         + ")", file=report_out,
     )
+    if result.n_devices > 1 and result.per_device_frames:
+        print(
+            "per-device frames: "
+            + " ".join(f"dev{d}={c}"
+                       for d, c in enumerate(result.per_device_frames)),
+            file=report_out,
+        )
     if stages:
         print(f"stage seconds: {stages}", file=report_out)
     print(f"wrote {out_spec}" if out_spec != "null" else "sink: null",
@@ -289,6 +312,8 @@ def main(argv=None) -> int:
             "schedule": result.schedule,
             "pipeline_depth": result.pipeline_depth,
             "restarts": result.restarts,
+            "n_devices": result.n_devices,
+            "per_device_frames": result.per_device_frames,
             "output": out_spec,
         }
         text = json.dumps(payload, indent=2, sort_keys=True)
@@ -322,6 +347,7 @@ def _report_observability(ns, cfg: StreamConfig, result, out) -> None:
             "pipeline_depth": result.pipeline_depth,
             "frames": result.frames,
             "wall_seconds": result.wall_seconds,
+            "n_devices": result.n_devices,
         }), end="", file=out)
         print(obs.breakdown.render_resilience(obs.snapshot()),
               end="", file=out)

@@ -96,45 +96,41 @@ class StreamResult:
     skipped: int             # frames skipped by --resume
     wall_seconds: float      # whole run incl. warm-up compile
     frames_per_second: float # frames / wall_seconds
-    stage_seconds: Dict[str, float]  # total busy seconds per stage
+    # Total busy seconds per stage. On a mesh-fan run the per-device
+    # stages (h2d/compute/d2h) SUM across all lanes (n busy lanes can
+    # exceed wall x1); the --breakdown bottleneck comparison divides
+    # them by n_devices so the serial read/write stages compare fairly.
+    stage_seconds: Dict[str, float]
     backend: str             # report-what-ran, like JobResult
     schedule: Optional[str]
     pipeline_depth: int
     output: str
     restarts: int = 0        # mid-stream engine restarts that recovered
+    # Mesh fan-out (tpu_stencil.parallel.fanout): the device count that
+    # actually ran (report-what-ran — --mesh-frames 0 resolves by a
+    # measured A/B before this is set) and, when n_devices > 1, the
+    # frames each device's lane completed this run.
+    n_devices: int = 1
+    per_device_frames: Optional[list] = None
 
 
 class _Abort(Exception):
     """Internal: a sibling stage failed; unwind quietly."""
 
 
-class _Pipeline:
-    """Shared state of one run: queues, window, failure slot, clocks."""
+class _StageControl:
+    """Stop flag, first-failure slot, abort-aware polling queue ops and
+    the per-stage span/clock machinery — the control surface both
+    engines share (:class:`_Pipeline` extends it; the mesh fan-out's
+    lanes use it directly, :mod:`tpu_stencil.parallel.fanout`), so the
+    teardown/attribution protocol can never drift between them."""
 
-    def __init__(self, cfg: StreamConfig):
-        self.cfg = cfg
-        n_ring = cfg.ring_size
-        self.ring = [
-            np.empty(cfg.frame_bytes, np.uint8) for _ in range(n_ring)
-        ]
-        self.free_q: queue.Queue = queue.Queue()
-        for i in range(n_ring):
-            self.free_q.put(i)
-        self.filled_q: queue.Queue = queue.Queue(maxsize=n_ring)
-        self.inflight_q: queue.Queue = queue.Queue(maxsize=cfg.pipeline_depth)
-        self.write_q: queue.Queue = queue.Queue(maxsize=cfg.pipeline_depth + 1)
-        # The dispatch-ahead window: a frame holds a slot from read start
-        # until its D2H completes, so at most pipeline_depth frames are
-        # anywhere between the source and the writer queue.
-        self.window = threading.Semaphore(cfg.pipeline_depth)
+    def __init__(self) -> None:
         self.stop = threading.Event()
         self._fail_lock = threading.Lock()
         self.failure: Optional[Tuple[str, int, BaseException]] = None
         self._stage_lock = threading.Lock()
         self.stage_seconds: Dict[str, float] = {s: 0.0 for s in _STAGES}
-        self._inflight = 0
-        self._inflight_lock = threading.Lock()
-        self._gauge = obs.registry().gauge("stream_inflight_depth")
 
     def fail(self, stage: str, frame_index: int, exc: BaseException) -> None:
         with self._fail_lock:
@@ -165,6 +161,44 @@ class _Pipeline:
             except queue.Empty:
                 pass
 
+    def stage(self, name: str, frame_index: int, t0: float = None,
+              **attrs):
+        """Span + per-stage clock for one frame in one stage. ``t0``
+        backdates the span's open (and the clock) to when the stage's
+        work really began — the compute stage runs on-device from its
+        *dispatch*, not from when the drain thread gets around to
+        fencing it, and an open-at-fence span would under-measure
+        compute by however long it overlapped the previous frame's
+        drain (misnaming the bottleneck stage in ``--breakdown``).
+        ``attrs`` land on the span record (the mesh fan-out tags its
+        per-device stages with ``dev=``)."""
+        return _StageSpan(self, name, frame_index, t0, **attrs)
+
+
+class _Pipeline(_StageControl):
+    """Shared state of one run: queues, window, failure slot, clocks."""
+
+    def __init__(self, cfg: StreamConfig):
+        super().__init__()
+        self.cfg = cfg
+        n_ring = cfg.ring_size
+        self.ring = [
+            np.empty(cfg.frame_bytes, np.uint8) for _ in range(n_ring)
+        ]
+        self.free_q: queue.Queue = queue.Queue()
+        for i in range(n_ring):
+            self.free_q.put(i)
+        self.filled_q: queue.Queue = queue.Queue(maxsize=n_ring)
+        self.inflight_q: queue.Queue = queue.Queue(maxsize=cfg.pipeline_depth)
+        self.write_q: queue.Queue = queue.Queue(maxsize=cfg.pipeline_depth + 1)
+        # The dispatch-ahead window: a frame holds a slot from read start
+        # until its D2H completes, so at most pipeline_depth frames are
+        # anywhere between the source and the writer queue.
+        self.window = threading.Semaphore(cfg.pipeline_depth)
+        self._inflight = 0
+        self._inflight_lock = threading.Lock()
+        self._gauge = obs.registry().gauge("stream_inflight_depth")
+
     def acquire_window(self) -> None:
         while not self.window.acquire(timeout=0.05):
             self._check()
@@ -186,28 +220,20 @@ class _Pipeline:
             self._inflight = 0
             self._gauge.set(0)
 
-    def stage(self, name: str, frame_index: int, t0: float = None):
-        """Span + per-stage clock for one frame in one stage. ``t0``
-        backdates the span's open (and the clock) to when the stage's
-        work really began — the compute stage runs on-device from its
-        *dispatch*, not from when the drain thread gets around to
-        fencing it, and an open-at-fence span would under-measure
-        compute by however long it overlapped the previous frame's
-        drain (misnaming the bottleneck stage in ``--breakdown``)."""
-        return _StageSpan(self, name, frame_index, t0)
-
 
 class _StageSpan:
-    __slots__ = ("_pl", "name", "frame_index", "_span", "_t0")
+    __slots__ = ("_pl", "name", "frame_index", "_span", "_t0", "_attrs")
 
-    def __init__(self, pl: _Pipeline, name: str, frame_index: int,
-                 t0: float = None):
+    def __init__(self, pl: "_StageControl", name: str, frame_index: int,
+                 t0: float = None, **attrs):
         self._pl, self.name, self.frame_index = pl, name, frame_index
         self._t0 = t0
+        self._attrs = attrs
 
     def __enter__(self):
         self._span = obs.span(
-            f"stream.{self.name}", "stream", frame=self.frame_index
+            f"stream.{self.name}", "stream", frame=self.frame_index,
+            **self._attrs
         )
         self._span.__enter__()
         if self._t0 is None:
@@ -233,14 +259,12 @@ def _io_policy(cfg: StreamConfig) -> _retry.RetryPolicy:
     return dataclasses.replace(_retry.IO_POLICY, attempts=1 + cfg.io_retries)
 
 
-def _reader(pl: _Pipeline, source, start_frame: int) -> None:
-    """Prefetch frames into the staging ring, honoring the dispatch
-    window (a frame occupies a window slot from read start). Transient
-    read failures retry under the shared policy — but only when the
-    source can rewind (``source.mark()``): a pipe's consumed bytes are
-    gone, so pipe errors propagate on the first failure."""
-    cfg = pl.cfg
-    idx = start_frame
+def _make_read_frame(cfg: StreamConfig, source):
+    """The per-frame read both engines (single-device and mesh fan-out)
+    share: the ``read`` fault site resolved once, and transient
+    failures retried under the shared policy — but only when the source
+    can rewind (``source.mark()``): a pipe's consumed bytes are gone,
+    so pipe errors propagate on the first failure."""
     fault = _faults.site("read")  # resolved once, NOT per frame
     policy = _io_policy(cfg)
 
@@ -259,6 +283,41 @@ def _reader(pl: _Pipeline, source, start_frame: int) -> None:
             label=f"stream.read[{i}]",
         )
 
+    return read_frame
+
+
+def _make_write_frame(cfg: StreamConfig, sink):
+    """The per-frame write both engines share: the ``write`` fault site
+    resolved once; idempotent sinks (positioned files, per-frame
+    directory files, null) retry transient failures, append-only sinks
+    fail on the first error — a retried partial write would duplicate
+    bytes."""
+    fault = _faults.site("write")  # resolved once, NOT per frame
+    policy = _io_policy(cfg)
+    retryable = bool(getattr(sink, "retryable_writes", False))
+
+    def write_frame(i: int, frame) -> None:
+        def attempt() -> None:
+            if fault is not None:
+                fault(i)
+            sink.write(i, frame)
+
+        if retryable:
+            _retry.retry_call(attempt, policy=policy,
+                              label=f"stream.write[{i}]")
+        else:
+            attempt()
+
+    return write_frame
+
+
+def _reader(pl: _Pipeline, source, start_frame: int) -> None:
+    """Prefetch frames into the staging ring, honoring the dispatch
+    window (a frame occupies a window slot from read start). Retry
+    semantics: :func:`_make_read_frame`."""
+    cfg = pl.cfg
+    idx = start_frame
+    read_frame = _make_read_frame(cfg, source)
     try:
         while cfg.frames is None or idx < cfg.frames:
             pl.acquire_window()
@@ -320,29 +379,11 @@ def _drain(pl: _Pipeline, eng: dict) -> None:
 
 def _writer(pl: _Pipeline, sink, done: list) -> None:
     """Write results in order; commit the frame-index checkpoint and the
-    progress heartbeat. ``done[0]`` tracks frames fully written."""
+    progress heartbeat. ``done[0]`` tracks frames fully written. Retry
+    semantics: :func:`_make_write_frame`."""
     cfg = pl.cfg
     idx = -1
-    fault = _faults.site("write")  # resolved once, NOT per frame
-    policy = _io_policy(cfg)
-    retryable = bool(getattr(sink, "retryable_writes", False))
-
-    def write_frame(i: int, frame) -> None:
-        def attempt() -> None:
-            if fault is not None:
-                fault(i)
-            sink.write(i, frame)
-
-        if retryable:
-            # Idempotent sinks (positioned files, per-frame directory
-            # files, null) retry transient failures; append-only sinks
-            # fail on the first error — a retried partial write would
-            # duplicate bytes.
-            _retry.retry_call(attempt, policy=policy,
-                              label=f"stream.write[{i}]")
-        else:
-            attempt()
-
+    write_frame = _make_write_frame(cfg, sink)
     try:
         while True:
             item = pl.get(pl.write_q)
@@ -493,11 +534,21 @@ def run_stream(
     ``resilience_stream_restarts_total``. I/O-stage failures are
     handled *inside* the pipeline by the reader/writer retry policy and
     never restart the engine; injected source/sink objects skip
-    restarts entirely (the caller owns their positioning)."""
+    restarts entirely (the caller owns their positioning).
+
+    Mesh fan-out (``cfg.mesh_frames != 1``): the device count is
+    resolved ONCE per call — explicit N, or the measured auto A/B
+    (:func:`tpu_stencil.parallel.fanout.resolve_mesh_frames`) — and
+    every restart of this run re-fans at the same width, so the
+    checkpoint's per-device cursors stay aligned."""
     restarts = 0
+    n_mesh = None
     while True:
         try:
-            result = _run_stream_once(cfg, devices, resume, source, sink)
+            if n_mesh is None:
+                n_mesh = _resolve_mesh_frames(cfg, devices)
+            result = _run_stream_once(cfg, devices, resume, source, sink,
+                                      n_mesh=n_mesh)
             result.restarts = restarts
             return result
         except StreamFailure as e:
@@ -526,15 +577,65 @@ def run_stream(
             resume = True  # honor whatever progress the checkpoint holds
 
 
+def _finish_result(cfg: StreamConfig, resume: bool, t_start: float,
+                   start_frame: int, frames: int, stage_seconds: Dict,
+                   backend: str, schedule, out_spec: str,
+                   n_devices: int = 1,
+                   per_device_frames: Optional[list] = None
+                   ) -> StreamResult:
+    """The shared run epilogue both engines (single-device and mesh
+    fan-out) end in: sweep the progress sidecar of a completed run,
+    then assemble the report-what-ran :class:`StreamResult` — one
+    place, so the two paths can never drift on the completion
+    contract."""
+    if cfg.checkpoint_every or resume:
+        from tpu_stencil.runtime import checkpoint as ckpt
+
+        ckpt.clear_stream_progress(cfg)
+    wall = time.perf_counter() - t_start
+    return StreamResult(
+        frames=frames,
+        skipped=start_frame,
+        wall_seconds=wall,
+        frames_per_second=frames / wall if wall > 0 else 0.0,
+        stage_seconds=stage_seconds,
+        backend=backend,
+        schedule=schedule if backend == "pallas" else None,
+        pipeline_depth=cfg.pipeline_depth,
+        output=out_spec,
+        n_devices=n_devices,
+        per_device_frames=per_device_frames,
+    )
+
+
+def _resolve_mesh_frames(cfg: StreamConfig, devices) -> int:
+    """The device count this run fans over: 1 without ``--mesh-frames``
+    (no jax import at all on that path), else the fanout resolver's
+    verdict (explicit width, or the measured auto A/B)."""
+    if cfg.mesh_frames == 1:
+        return 1
+    import jax
+
+    from tpu_stencil.parallel import fanout
+
+    devs = devices if devices is not None else jax.devices()
+    return fanout.resolve_mesh_frames(cfg, devs)
+
+
 def _run_stream_once(
     cfg: StreamConfig,
     devices: Optional[list] = None,
     resume: bool = False,
     source: Optional[frames_io.FrameSource] = None,
     sink: Optional[frames_io.FrameSink] = None,
+    n_mesh: int = 1,
 ) -> StreamResult:
     """One pipeline lifetime (see :func:`run_stream`, which owns the
-    engine-restart loop around this)."""
+    engine-restart loop around this). ``n_mesh`` > 1 routes the frame
+    loop through the mesh fan-out engine
+    (:mod:`tpu_stencil.parallel.fanout`) — resume/IO resolution, the
+    restart ladder, and result assembly stay shared here, so the two
+    engines can never drift on those contracts."""
     import jax
 
     from tpu_stencil.models.blur import IteratedConv2D
@@ -546,13 +647,16 @@ def _run_stream_once(
                            block_h=cfg.block_h, fuse=cfg.fuse)
     if devices is None:
         devices = jax.devices()
-    devices = devices[:1]  # frame-serial streaming is single-device today
+    devices = devices[:n_mesh]
+    # Report-what-ran for THIS run, on both paths — a single-device run
+    # after a mesh one must not keep exposing the stale fan width.
+    obs.registry().gauge("stream_mesh_devices").set(n_mesh)
 
     start_frame = 0
     if resume:
         from tpu_stencil.runtime import checkpoint as ckpt
 
-        restored = ckpt.restore_stream_progress(cfg)
+        restored = ckpt.restore_stream_progress(cfg, mesh_devices=n_mesh)
         if restored is not None:
             start_frame = restored
     elif cfg.checkpoint_every:
@@ -593,6 +697,38 @@ def _run_stream_once(
         if own_source:
             source.close()
         raise
+
+    if n_mesh > 1:
+        from tpu_stencil.parallel import fanout
+
+        failed = False
+        try:
+            mesh = fanout.run_mesh_frames(
+                cfg, devices, n_mesh, model, source, sink, start_frame
+            )
+        except BaseException:
+            failed = True
+            raise
+        finally:
+            # Same close discipline as the single-device path below: a
+            # close-time error must never mask a recorded failure.
+            if own_source:
+                try:
+                    source.close()
+                except OSError:
+                    pass
+            if own_sink and sink is not None:
+                try:
+                    sink.close()
+                except OSError:
+                    if not failed:
+                        raise
+        return _finish_result(
+            cfg, resume, t_start, start_frame, mesh["frames"],
+            mesh["stage_seconds"], mesh["backend"], mesh["schedule"],
+            out_spec, n_devices=n_mesh,
+            per_device_frames=mesh["per_device_frames"],
+        )
 
     pl = _Pipeline(cfg)
     done = [start_frame]
@@ -641,23 +777,10 @@ def _run_stream_once(
         stage, frame_index, cause = pl.failure
         raise StreamFailure(stage, frame_index, cause) from cause
 
-    n = done[0] - start_frame
-    if cfg.checkpoint_every or resume:
-        from tpu_stencil.runtime import checkpoint as ckpt
-
-        ckpt.clear_stream_progress(cfg)
-    wall = time.perf_counter() - t_start
     from tpu_stencil.models.blur import resolve_backend
 
     backend = eng.get("backend", resolve_backend(cfg.backend))
-    return StreamResult(
-        frames=n,
-        skipped=start_frame,
-        wall_seconds=wall,
-        frames_per_second=n / wall if wall > 0 else 0.0,
-        stage_seconds=dict(pl.stage_seconds),
-        backend=backend,
-        schedule=eng.get("schedule") if backend == "pallas" else None,
-        pipeline_depth=cfg.pipeline_depth,
-        output=out_spec,
+    return _finish_result(
+        cfg, resume, t_start, start_frame, done[0] - start_frame,
+        dict(pl.stage_seconds), backend, eng.get("schedule"), out_spec,
     )
