@@ -73,6 +73,15 @@ versioned ``..._serve_meshfan<N>_wall_per_request`` headline — the
 serve-side mesh series the sentry gates
 (``TPU_STENCIL_BENCH_SERVE_REQUESTS`` tunes the run).
 
+Network-tier mode: ``TPU_STENCIL_BENCH_NET=1`` starts the HTTP frontend
++ per-device replica fleet IN PROCESS on an ephemeral port
+(``tpu_stencil.net``), drives north-star frames over real HTTP
+(urllib), and emits a versioned ``..._net_wall_per_request`` headline —
+its own sentry series, measuring the whole edge (parse + route +
+engine + response), with replica count, achieved req/s and response
+class counts as riders (``TPU_STENCIL_BENCH_NET_REQUESTS`` /
+``_NET_REPLICAS`` / ``_NET_CONCURRENCY`` tune the run).
+
 Exit codes: 0 = capture landed (even partial-only); 1 = nothing
 parseable; 2 = the requested backend is unavailable (init failed — the
 parent does NOT retry: a 4-attempt backoff loop against a dead backend
@@ -665,6 +674,92 @@ def _measure_serve_meshfan(platform: str) -> dict:
     }
 
 
+def _measure_net(platform: str) -> dict:
+    """Network-tier capture (``TPU_STENCIL_BENCH_NET=1``): the whole
+    HTTP edge measured end to end — frontend + router + replica fleet
+    started in process on an ephemeral port, north-star frames POSTed
+    over real HTTP. One warm request per replica first (and the fleet's
+    shared warming overlaps the sibling compiles), so the headline is
+    steady state; then ``n_req`` requests through a small client pool
+    (concurrency 4 by default — enough to exercise least-outstanding
+    placement without turning the number into a queueing benchmark).
+
+    Knobs: ``TPU_STENCIL_BENCH_NET_REQUESTS`` (default 8),
+    ``TPU_STENCIL_BENCH_NET_REPLICAS`` (default min(2, devices)),
+    ``TPU_STENCIL_BENCH_NET_CONCURRENCY`` (default 4)."""
+    import concurrent.futures
+    import urllib.request
+
+    import jax
+
+    from tpu_stencil.config import NetConfig
+    from tpu_stencil.net.http import NetFrontend
+
+    n_dev = len(jax.devices())
+    n_rep = int(os.environ.get("TPU_STENCIL_BENCH_NET_REPLICAS", "0")) \
+        or min(2, n_dev)
+    n_req = int(os.environ.get("TPU_STENCIL_BENCH_NET_REQUESTS", "8"))
+    conc = int(os.environ.get("TPU_STENCIL_BENCH_NET_CONCURRENCY", "4"))
+    rng = np.random.default_rng(0)
+    img = rng.integers(0, 256, size=(H, W, C), dtype=np.uint8)
+    body = img.tobytes()
+    cfg = NetConfig(port=0, replicas=n_rep,
+                    max_queue=max(16, n_req))
+    fe = NetFrontend(cfg).start()
+    try:
+        def post():
+            req = urllib.request.Request(
+                fe.url + f"/v1/blur?w={W}&h={H}&reps={REPS}&channels={C}",
+                data=body, method="POST",
+            )
+            with urllib.request.urlopen(req, timeout=CHILD_TIMEOUT) as r:
+                r.read()
+
+        # Warm every replica DETERMINISTICALLY before the timed window:
+        # one routed request seeds the fleet's warm-key dedup (so the
+        # first TIMED request cannot re-fire sibling warms inside the
+        # measured wall), then a direct submit per engine guarantees
+        # each compile has actually landed — sequential HTTP posts
+        # alone would all hit replica 0 (least outstanding ties break
+        # low) and leave the siblings to the asynchronous warm race.
+        post()
+        for rep in fe.fleet.replicas:
+            rep.submit(img, REPS).result(timeout=CHILD_TIMEOUT)
+        t0 = time.perf_counter()
+        with concurrent.futures.ThreadPoolExecutor(conc) as pool:
+            for f in [pool.submit(post) for _ in range(n_req)]:
+                f.result(timeout=CHILD_TIMEOUT)
+        wall = time.perf_counter() - t0
+        snap = fe.metrics_snapshot()
+    finally:
+        fe.close()
+    per_req = wall / max(1, n_req)
+    log(f"net x{n_rep} replicas: {per_req * 1e3:.1f} ms/request "
+        f"({n_req} requests over HTTP, concurrency {conc})")
+    return {
+        "metric": f"{W}x{H}_rgb_{REPS}reps_net_wall_per_request",
+        "value": round(per_req, 6),
+        "unit": "s",
+        "vs_baseline": round(BASELINE_S / per_req, 2),
+        "backend": "net",
+        "platform": platform,
+        "replicas": n_rep,
+        "requests": n_req,
+        "concurrency": conc,
+        "requests_per_second": round(n_req / wall, 3) if wall > 0 else 0.0,
+        "responses_2xx_total": snap["counters"].get(
+            "responses_2xx_total", 0
+        ),
+        "warm_submits_total": snap["counters"].get("warm_submits_total", 0),
+        "shape": f"{W}x{H}",
+        "reps": REPS,
+        "filter": "gaussian",
+        "dtype": "uint8",
+        "schema_version": 1,
+        "ts": round(time.monotonic(), 6),
+    }
+
+
 def _measure_schedule_headlines(schedules, platform: str) -> list:
     """Per-schedule headline mode (``TPU_STENCIL_BENCH_SCHEDULE=s1,s2``):
     one versioned capture line PER named Pallas schedule, the schedule
@@ -770,6 +865,15 @@ def child_main() -> int:
             result = _measure_serve_meshfan(platform)
         except Exception as e:
             log(f"serve meshfan: FAILED {type(e).__name__}: {e}")
+            return 1
+        print(json.dumps(result), flush=True)
+        return 0
+
+    if os.environ.get("TPU_STENCIL_BENCH_NET") == "1":
+        try:
+            result = _measure_net(platform)
+        except Exception as e:
+            log(f"net: FAILED {type(e).__name__}: {e}")
             return 1
         print(json.dumps(result), flush=True)
         return 0

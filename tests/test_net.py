@@ -1,0 +1,944 @@
+"""Network serving tier: round-trip exactness, admission, drain.
+
+The contract under test is docs/SERVING.md "Network tier":
+
+* a localhost HTTP round-trip is byte-identical to ``driver.run_job``
+  (and the NumPy golden model) for grey and RGB frames;
+* admission NEVER hangs a client: every replica queue full -> 429 +
+  Retry-After, inflight-bytes watermark -> 503 shed, draining -> 503,
+  expired deadline -> 504 — each typed, each counted;
+* a SIGTERM drain flips ``/healthz``, stops admission, and completes
+  (or fails typed) every accepted request — no silent drops;
+* rolling single-replica restart keeps the rest of the fleet serving;
+* ``/metrics`` survives the exposition's exact parse round-trip.
+"""
+
+import http.client
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from tpu_stencil import filters
+from tpu_stencil.config import NetConfig, ServeConfig
+from tpu_stencil.ops import stencil
+
+pytestmark = pytest.mark.filterwarnings("ignore::ResourceWarning")
+
+EDGES = (8, 16, 32, 64)
+
+
+def _golden(img, reps, name="gaussian"):
+    return stencil.reference_stencil_numpy(img, filters.get_filter(name), reps)
+
+
+def _post(url, img, reps, *, filter_name=None, timeout_s=None,
+          boundary=None, via_headers=True, http_timeout=300.0):
+    """POST one frame; returns (status, body_bytes, headers_dict)."""
+    h, w = img.shape[:2]
+    channels = img.shape[2] if img.ndim == 3 else 1
+    if via_headers:
+        headers = {"X-Width": str(w), "X-Height": str(h),
+                   "X-Reps": str(reps), "X-Channels": str(channels)}
+        if filter_name:
+            headers["X-Filter"] = filter_name
+        if timeout_s is not None:
+            headers["X-Request-Timeout"] = repr(timeout_s)
+        if boundary:
+            headers["X-Boundary"] = boundary
+        target = url + "/v1/blur"
+    else:
+        headers = {}
+        target = (url + f"/v1/blur?w={w}&h={h}&reps={reps}"
+                        f"&channels={channels}")
+        if filter_name:
+            target += f"&filter={filter_name}"
+    req = urllib.request.Request(target, data=img.tobytes(),
+                                 headers=headers, method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=http_timeout) as r:
+            return r.status, r.read(), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, e.read(), dict(e.headers)
+
+
+def _get(url, path, http_timeout=60.0):
+    try:
+        with urllib.request.urlopen(url + path, timeout=http_timeout) as r:
+            return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def _make_frontend(start_workers=True, **overrides):
+    from tpu_stencil.net import NetFrontend
+
+    kw = dict(port=0, replicas=2, bucket_edges=EDGES, max_queue=16)
+    kw.update(overrides)
+    return NetFrontend(NetConfig(**kw), start_workers=start_workers).start()
+
+
+@pytest.fixture(scope="module")
+def fe():
+    # One module-scoped tier: executables compiled by earlier tests are
+    # warm for later ones (the same economy test_serve.py uses).
+    frontend = _make_frontend()
+    yield frontend
+    frontend.close()
+
+
+# -- config / CLI validation (jax-free) -------------------------------
+
+
+def test_netconfig_validation():
+    with pytest.raises(ValueError, match="port"):
+        NetConfig(port=70000)
+    with pytest.raises(ValueError, match="replicas"):
+        NetConfig(replicas=-1)
+    with pytest.raises(ValueError, match="max_queue"):
+        NetConfig(max_queue=0)
+    with pytest.raises(ValueError, match="max_batch"):
+        NetConfig(max_batch=0)
+    with pytest.raises(ValueError, match="max_inflight_mb"):
+        NetConfig(max_inflight_mb=-1.0)
+    with pytest.raises(ValueError, match="request_timeout_s"):
+        NetConfig(request_timeout_s=-0.1)
+    with pytest.raises(ValueError, match="drain_timeout_s"):
+        NetConfig(drain_timeout_s=0.0)
+    with pytest.raises(ValueError, match="bucket_edges"):
+        NetConfig(bucket_edges=(16, 8))
+    with pytest.raises(ValueError, match="backend"):
+        NetConfig(backend="mps")
+    with pytest.raises(ValueError, match="host"):
+        NetConfig(host="")
+    with pytest.raises(ValueError, match="unknown filter"):
+        NetConfig(filter_name="bogus")  # jax-free, dies pre-bring-up
+    assert NetConfig(filter_name="gaussian5").filter_name == "gaussian5"
+    assert NetConfig(max_inflight_mb=1.5).max_inflight_bytes == 3 << 19
+
+
+def test_netconfig_derives_pinned_serve_configs():
+    cfg = NetConfig(bucket_edges=EDGES, max_queue=7, max_batch=3,
+                    request_timeout_s=1.5, filter_name="box")
+    scfg = cfg.serve_config(3)
+    assert scfg.device_index == 3
+    assert scfg.bucket_edges == EDGES
+    assert scfg.max_queue == 7 and scfg.max_batch == 3
+    assert scfg.request_timeout_s == 1.5
+    assert scfg.filter_name == "box"
+    # No per-replica memory-sampler thread: the fleet exposition is the
+    # scrape surface.
+    assert scfg.mem_sample_interval_s == 0.0
+
+
+def test_serve_config_device_index_validation():
+    with pytest.raises(ValueError, match="device_index"):
+        ServeConfig(device_index=-1)
+    assert ServeConfig(device_index=2).device_index == 2
+
+
+def test_net_cli_rejects_bad_flags():
+    from tpu_stencil.net import cli as net_cli
+
+    for argv in (["--port", "70000"],
+                 ["--replicas", "-2"],
+                 ["--drain-timeout", "0"],
+                 ["--max-inflight-mb", "-1"],
+                 ["--backend", "cuda"],
+                 ["--filter", "typo"]):
+        with pytest.raises(SystemExit) as exc:
+            net_cli.main(argv)
+        assert exc.value.code == 2, argv
+
+
+# -- round-trip exactness ---------------------------------------------
+
+
+def test_http_round_trip_rgb_bit_exact(fe, rng):
+    img = rng.integers(0, 256, (24, 18, 3), dtype=np.uint8)
+    status, body, headers = _post(fe.url, img, 3)
+    assert status == 200
+    assert headers["X-Width"] == "18" and headers["X-Height"] == "24"
+    got = np.frombuffer(body, np.uint8).reshape(img.shape)
+    np.testing.assert_array_equal(got, _golden(img, 3))
+
+
+def test_http_round_trip_grey_bit_exact(fe, rng):
+    img = rng.integers(0, 256, (17, 23), dtype=np.uint8)
+    status, body, _ = _post(fe.url, img, 2, via_headers=False)
+    assert status == 200
+    got = np.frombuffer(body, np.uint8).reshape(img.shape)
+    np.testing.assert_array_equal(got, _golden(img, 2))
+
+
+def test_http_zero_reps_identity(fe, rng):
+    img = rng.integers(0, 256, (9, 13, 3), dtype=np.uint8)
+    status, body, _ = _post(fe.url, img, 0)
+    assert status == 200
+    np.testing.assert_array_equal(
+        np.frombuffer(body, np.uint8).reshape(img.shape), img
+    )
+
+
+def test_http_per_request_filter(fe, rng):
+    img = rng.integers(0, 256, (16, 16, 3), dtype=np.uint8)
+    status, body, _ = _post(fe.url, img, 2, filter_name="box")
+    assert status == 200
+    np.testing.assert_array_equal(
+        np.frombuffer(body, np.uint8).reshape(img.shape),
+        _golden(img, 2, "box"),
+    )
+
+
+def test_http_round_trip_matches_run_job(fe, rng, tmp_path):
+    # The acceptance criterion verbatim: the network tier and the
+    # reference-shaped batch CLI produce byte-identical output for the
+    # same (image, filter, reps).
+    from tpu_stencil import driver
+    from tpu_stencil.config import ImageType, JobConfig
+
+    img = rng.integers(0, 256, (20, 28, 3), dtype=np.uint8)
+    src = tmp_path / "frame.raw"
+    out = tmp_path / "blur.raw"
+    img.tofile(src)
+    driver.run_job(JobConfig(
+        image=str(src), width=28, height=20, repetitions=4,
+        image_type=ImageType.RGB, output=str(out),
+    ))
+    want = np.fromfile(out, np.uint8).reshape(img.shape)
+    status, body, _ = _post(fe.url, img, 4)
+    assert status == 200
+    np.testing.assert_array_equal(
+        np.frombuffer(body, np.uint8).reshape(img.shape), want
+    )
+
+
+def test_http_chunked_upload_bit_exact(fe, rng):
+    # Large frames stream up in chunks; the frontend must de-chunk
+    # (stdlib handlers do not) and still be bit-exact.
+    img = rng.integers(0, 256, (33, 21, 3), dtype=np.uint8)
+    payload = img.tobytes()
+
+    def chunks():
+        for i in range(0, len(payload), 997):  # deliberately odd stride
+            yield payload[i:i + 997]
+
+    conn = http.client.HTTPConnection("127.0.0.1", fe.port, timeout=300)
+    try:
+        conn.request(
+            "POST", "/v1/blur?w=21&h=33&reps=2&channels=3",
+            body=chunks(), encode_chunked=True,
+            headers={"Transfer-Encoding": "chunked"},
+        )
+        resp = conn.getresponse()
+        body = resp.read()
+        assert resp.status == 200
+    finally:
+        conn.close()
+    np.testing.assert_array_equal(
+        np.frombuffer(body, np.uint8).reshape(img.shape), _golden(img, 2)
+    )
+
+
+# -- HTTP status mapping ----------------------------------------------
+
+
+def test_http_bad_params_400(fe, rng):
+    img = rng.integers(0, 256, (8, 8), dtype=np.uint8)
+    # Missing geometry entirely.
+    req = urllib.request.Request(fe.url + "/v1/blur", data=img.tobytes(),
+                                 method="POST")
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        urllib.request.urlopen(req, timeout=60)
+    assert exc.value.code == 400
+    # Bad channel count.
+    status, body, _ = _post(fe.url, img.reshape(8, 4, 2), 1)
+    assert status == 400 and b"channels" in body
+    # Unknown per-request filter: 400 at the edge, never a worker-side
+    # KeyError surfacing as 500 (and never a warm-cache entry).
+    status, body, _ = _post(fe.url, img, 1, filter_name="bogus")
+    assert status == 400 and b"unknown filter" in body
+    # Body length mismatch: declared 8x8 grey, sent half the bytes.
+    req = urllib.request.Request(
+        fe.url + "/v1/blur?w=8&h=8&reps=1&channels=1",
+        data=img.tobytes()[:32], method="POST",
+    )
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        urllib.request.urlopen(req, timeout=60)
+    assert exc.value.code == 400
+    assert b"needs exactly 64" in exc.value.read()
+
+
+def test_http_oversized_body_413(fe):
+    big = b"\0" * (8 * 8 + 100)
+    req = urllib.request.Request(
+        fe.url + "/v1/blur?w=8&h=8&reps=1&channels=1",
+        data=big, method="POST",
+    )
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        urllib.request.urlopen(req, timeout=60)
+    assert exc.value.code == 413
+
+
+def test_http_malformed_content_length_400(fe):
+    # A garbage framing header is a client bug (400), NOT an oversized
+    # body (413) — a client must not react by shrinking the frame.
+    conn = http.client.HTTPConnection(fe.cfg.host, fe.port, timeout=60)
+    try:
+        conn.putrequest("POST", "/v1/blur?w=8&h=8&reps=1&channels=1")
+        conn.putheader("Content-Length", "abc")
+        conn.endheaders()
+        resp = conn.getresponse()
+        assert resp.status == 400
+        assert b"Content-Length" in resp.read()
+    finally:
+        conn.close()
+
+
+def test_http_periodic_boundary_400(fe, rng):
+    # The serve engines preserve zero semantics only (pad re-zeroing,
+    # docs/SERVING.md); a periodic request must fail typed, never
+    # return silently wrong pixels.
+    img = rng.integers(0, 256, (8, 8), dtype=np.uint8)
+    status, body, _ = _post(fe.url, img, 1, boundary="periodic")
+    assert status == 400 and b"zero only" in body
+
+
+def test_http_unknown_endpoint_404(fe):
+    assert _get(fe.url, "/v2/blur")[0] == 404
+    status, _, _ = _post(fe.url + "/nope",
+                         np.zeros((4, 4), np.uint8), 1)
+    assert status == 404
+
+
+def test_backpressure_429_then_drains_without_drops(rng):
+    # Parked workers pin every queue: with 2 replicas x max_queue=1 the
+    # third request finds ALL queues full -> 429 + Retry-After (never a
+    # hang), counted in rejected_total. Un-parking then completes every
+    # ACCEPTED request bit-exact — backpressure sheds, it never drops.
+    # (warm_fleet off: a discarded warm frame would occupy one of these
+    # synthetic 1-deep queues.)
+    frontend = _make_frontend(start_workers=False, max_queue=1,
+                              warm_fleet=False)
+    try:
+        imgs = [rng.integers(0, 256, (10, 12), dtype=np.uint8)
+                for _ in range(2)]
+        results = {}
+
+        def client(i):
+            results[i] = _post(frontend.url, imgs[i], 2)
+
+        threads = [threading.Thread(target=client, args=(i,), daemon=True)
+                   for i in range(2)]
+        for t in threads:
+            t.start()
+        # Wait until both requests are queued (one per replica).
+        deadline = time.perf_counter() + 30
+        while (sum(frontend.router.outstanding().values()) < 2
+               and time.perf_counter() < deadline):
+            time.sleep(0.01)
+        assert sum(frontend.router.outstanding().values()) == 2
+        status, body, headers = _post(
+            frontend.url, rng.integers(0, 256, (10, 12), np.uint8), 2
+        )
+        assert status == 429
+        assert headers.get("Retry-After")
+        assert b"capacity" in body or b"full" in body
+        snap = frontend.registry.snapshot()
+        assert snap["counters"]["rejected_total"] == 1
+        frontend.fleet.start_workers()
+        for t in threads:
+            t.join(timeout=300)
+        for i, img in enumerate(imgs):
+            status, body, _ = results[i]
+            assert status == 200, f"accepted request {i} was dropped"
+            np.testing.assert_array_equal(
+                np.frombuffer(body, np.uint8).reshape(img.shape),
+                _golden(img, 2),
+            )
+    finally:
+        frontend.close()
+
+
+def test_load_shed_503_past_inflight_watermark(rng):
+    # 10 KB watermark < one 64x64x3 frame's 2x in-flight footprint:
+    # the request sheds BEFORE touching any replica queue.
+    frontend = _make_frontend(max_inflight_mb=0.01)
+    try:
+        img = rng.integers(0, 256, (64, 64, 3), dtype=np.uint8)
+        status, body, headers = _post(frontend.url, img, 1)
+        assert status == 503
+        assert b"shed" in body
+        assert headers.get("Retry-After")
+        snap = frontend.registry.snapshot()
+        assert snap["counters"]["shed_total"] == 1
+        assert snap["counters"]["requests_total"] == 0  # never admitted
+        # Small frames still fit under the watermark and serve fine.
+        small = rng.integers(0, 256, (8, 8), dtype=np.uint8)
+        status, body, _ = _post(frontend.url, small, 1)
+        assert status == 200
+        np.testing.assert_array_equal(
+            np.frombuffer(body, np.uint8).reshape(small.shape),
+            _golden(small, 1),
+        )
+    finally:
+        frontend.close()
+
+
+def test_deadline_maps_to_504(rng):
+    # A request whose deadline expires while queued (parked workers)
+    # fails typed at batch formation -> HTTP 504, the PR-7
+    # DeadlineExceeded made visible at the edge.
+    frontend = _make_frontend(start_workers=False)
+    try:
+        img = rng.integers(0, 256, (10, 10), dtype=np.uint8)
+        result = {}
+
+        def client():
+            result["r"] = _post(frontend.url, img, 2, timeout_s=0.05)
+
+        t = threading.Thread(target=client, daemon=True)
+        t.start()
+        deadline = time.perf_counter() + 30
+        while (sum(frontend.router.outstanding().values()) < 1
+               and time.perf_counter() < deadline):
+            time.sleep(0.01)
+        time.sleep(0.15)  # let the deadline expire while queued
+        frontend.fleet.start_workers()
+        t.join(timeout=300)
+        status, body, _ = result["r"]
+        assert status == 504
+        assert b"expired" in body
+        merged = frontend.fleet.merged_counters()
+        assert merged["deadline_expired_total"] == 1
+    finally:
+        frontend.close()
+
+
+# -- drain / restart ---------------------------------------------------
+
+
+def test_drain_under_load_completes_every_accepted_request(rng):
+    # The SIGTERM semantics minus the process: requests in flight when
+    # the drain begins all complete bit-exact, new admissions get 503,
+    # /healthz flips, and the report says every replica drained.
+    frontend = _make_frontend()
+    try:
+        imgs = [rng.integers(0, 256, (12, 10, 3), dtype=np.uint8)
+                for _ in range(4)]
+        # Warm the executable so the in-drain requests are pure compute.
+        assert _post(frontend.url, imgs[0], 5)[0] == 200
+        results = {}
+
+        def client(i):
+            results[i] = _post(frontend.url, imgs[i], 5)
+
+        threads = [threading.Thread(target=client, args=(i,), daemon=True)
+                   for i in range(len(imgs))]
+        for t in threads:
+            t.start()
+        report = frontend.drain(30.0)  # races the in-flight requests
+        assert report == {0: True, 1: True}
+        assert _get(frontend.url, "/healthz")[0] == 503
+        status, body, _ = _post(frontend.url, imgs[0], 5)
+        assert status == 503 and b"draining" in body
+        for t in threads:
+            t.join(timeout=300)
+        for i, img in enumerate(imgs):
+            status, body, _ = results[i]
+            # Every ACCEPTED request completed; one that raced the
+            # admission gate was refused typed (503), never dropped.
+            assert status in (200, 503), f"request {i}: {status}"
+            if status == 200:
+                np.testing.assert_array_equal(
+                    np.frombuffer(body, np.uint8).reshape(img.shape),
+                    _golden(img, 5),
+                )
+        snap = frontend.registry.snapshot()
+        assert snap["gauges"]["draining"]["value"] == 1
+        assert snap["counters"]["drain_abandoned_replicas_total"] == 0
+    finally:
+        frontend.close()
+
+
+def test_fleet_drain_reports_hung_replica(rng, monkeypatch):
+    # The satellite bugfix end to end: a replica whose worker cannot
+    # join inside the budget is reported abandoned (False) by index —
+    # and counted — instead of close() silently returning.
+    frontend = _make_frontend()
+    try:
+        img = rng.integers(0, 256, (8, 8), dtype=np.uint8)
+        assert _post(frontend.url, img, 1)[0] == 200
+        rep0 = frontend.fleet.replicas[0]
+        orig = rep0._dispatch
+
+        def stuck(batch):
+            time.sleep(5.0)
+            return orig(batch)
+
+        monkeypatch.setattr(rep0, "_dispatch", stuck)
+        rep0.submit(img, 1)  # the worker parks inside stuck()
+        time.sleep(0.2)
+        report = frontend.drain(0.5)
+        assert report[0] is False and report[1] is True
+        snap = frontend.registry.snapshot()
+        assert snap["counters"]["drain_abandoned_replicas_total"] == 1
+        assert (rep0.stats()["counters"]["serve_close_abandoned_total"]
+                == 1)
+    finally:
+        frontend.close()
+
+
+def test_close_returns_drained_vs_abandoned(rng, monkeypatch):
+    # StencilServer.close(timeout=) itself: True on a clean drain,
+    # False + serve_close_abandoned_total when the join times out.
+    from tpu_stencil.serve.engine import StencilServer
+
+    img = rng.integers(0, 256, (8, 8), dtype=np.uint8)
+    s = StencilServer(ServeConfig(max_queue=4, bucket_edges=EDGES))
+    s.submit(img, 1).result(timeout=300)
+    assert s.close(timeout=30) is True
+    assert s.stats()["counters"].get("serve_close_abandoned_total", 0) == 0
+
+    s2 = StencilServer(ServeConfig(max_queue=4, bucket_edges=EDGES),
+                       start=False)
+    monkeypatch.setattr(
+        s2, "_dispatch", lambda batch: time.sleep(5.0) or (batch,) * 4
+    )
+    s2.submit(img, 1)
+    s2.start()
+    time.sleep(0.2)  # the worker is now parked inside _dispatch
+    assert s2.close(timeout=0.3) is False
+    assert s2.stats()["counters"]["serve_close_abandoned_total"] == 1
+
+
+def _post_admin(url, path):
+    req = urllib.request.Request(url + path, data=b"", method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=120) as r:
+            return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def test_rolling_replica_restart(fe, rng):
+    img = rng.integers(0, 256, (14, 14, 3), dtype=np.uint8)
+    assert _post(fe.url, img, 2)[0] == 200
+    before = fe.registry.snapshot()["counters"].get(
+        "replica_restarts_total", 0
+    )
+    old = fe.fleet.replicas[0]
+    status, body = _post_admin(fe.url, "/admin/restart?replica=0")
+    assert status == 200
+    payload = json.loads(body)
+    assert payload["restarted"] and payload["old_drained"] is True
+    assert fe.fleet.replicas[0] is not old
+    snap = fe.registry.snapshot()
+    assert snap["counters"]["replica_restarts_total"] == before + 1
+    # The fresh replica serves bit-exact; the fleet never went down.
+    status, body, _ = _post(fe.url, img, 2)
+    assert status == 200
+    np.testing.assert_array_equal(
+        np.frombuffer(body, np.uint8).reshape(img.shape), _golden(img, 2)
+    )
+    # Bad index -> 400, not a crash.
+    assert _post_admin(fe.url, "/admin/restart?replica=9")[0] == 400
+
+
+def test_worker_crash_restarts_replica_and_serves(rng, monkeypatch):
+    # The resilience-ladder rung at fleet scope: a replica answering
+    # WorkerCrashed is rebuilt in place and THIS request retries on the
+    # fresh engine — one crash costs one rebuild, not an outage.
+    from tpu_stencil.resilience.errors import WorkerCrashed
+
+    frontend = _make_frontend(replicas=1)
+    try:
+        rep = frontend.fleet.replicas[0]
+
+        def crashed(*a, **k):
+            raise WorkerCrashed("injected: worker thread died")
+
+        monkeypatch.setattr(rep, "submit", crashed)
+        img = rng.integers(0, 256, (10, 10), dtype=np.uint8)
+        status, body, _ = _post(frontend.url, img, 2)
+        assert status == 200
+        np.testing.assert_array_equal(
+            np.frombuffer(body, np.uint8).reshape(img.shape),
+            _golden(img, 2),
+        )
+        assert frontend.fleet.replicas[0] is not rep
+        snap = frontend.registry.snapshot()
+        assert snap["counters"]["worker_crash_reroutes_total"] == 1
+        assert snap["counters"]["replica_restarts_total"] == 1
+    finally:
+        frontend.close()
+
+
+def test_router_skips_mid_restart_replica(rng):
+    # A replica whose engine is draining (fleet.restart closes the old
+    # engine before swapping the new one in) answers ServerClosed; the
+    # router must try a sibling, never leak the exception to the edge.
+    frontend = _make_frontend(warm_fleet=False)
+    try:
+        frontend.fleet.replicas[0].close(timeout=60)
+        img = rng.integers(0, 256, (10, 10), dtype=np.uint8)
+        status, body, headers = _post(frontend.url, img, 2)
+        assert status == 200 and int(headers["X-Replica"]) == 1
+        np.testing.assert_array_equal(
+            np.frombuffer(body, np.uint8).reshape(img.shape),
+            _golden(img, 2),
+        )
+        # EVERY replica closed: still typed (429), never a 500 or hang.
+        frontend.fleet.replicas[1].close(timeout=60)
+        assert _post(frontend.url, img, 2)[0] == 429
+    finally:
+        frontend.close()
+
+
+# -- placement / warming ----------------------------------------------
+
+
+def test_least_outstanding_placement_spreads_load(rng):
+    frontend = _make_frontend(start_workers=False, warm_fleet=False)
+    try:
+        img = rng.integers(0, 256, (10, 10), dtype=np.uint8)
+        for _ in range(4):
+            frontend.router.submit(img, 1)
+        # 4 requests over 2 idle replicas: least-outstanding placement
+        # alternates, never stacks.
+        assert frontend.router.outstanding() == {0: 2, 1: 2}
+        snap = frontend.registry.snapshot()
+        assert snap["gauges"]["replica_depth_dev0"]["value"] == 2
+        assert snap["gauges"]["replica_depth_dev1"]["value"] == 2
+        frontend.fleet.start_workers()
+    finally:
+        frontend.close()
+
+
+def test_warm_fleet_prewarms_sibling_caches(rng):
+    # The shared-cache-warming contract: the first request of a new
+    # shape fires one discarded zero-frame warm at the OTHER replica,
+    # so a later same-bucket request there is a cache HIT, not a cold
+    # compile.
+    frontend = _make_frontend()
+    try:
+        img = rng.integers(0, 256, (11, 9, 3), dtype=np.uint8)
+        status, _, headers = _post(frontend.url, img, 3)
+        assert status == 200
+        chosen = int(headers["X-Replica"])
+        sibling = frontend.fleet.replicas[1 - chosen]
+        # The warm request is async on the sibling: wait for it.
+        deadline = time.perf_counter() + 60
+        while (sibling.stats()["counters"]["completed_total"] < 1
+               and time.perf_counter() < deadline):
+            time.sleep(0.02)
+        sstats = sibling.stats()["counters"]
+        assert sstats["completed_total"] == 1  # the discarded warm frame
+        assert sstats["cache_misses_total"] == 1
+        assert (frontend.registry.snapshot()["counters"]
+                ["warm_submits_total"] == 1)
+        # Same bucket on the sibling now: a HIT, the compile was prepaid.
+        img2 = rng.integers(0, 256, (12, 10, 3), dtype=np.uint8)
+        sibling.submit(img2, 3).result(timeout=300)
+        assert sibling.stats()["counters"]["cache_hits_total"] == 1
+        # Dedup: re-routing the same key fires no second warm.
+        assert frontend.fleet.prewarm_others(chosen, img, 3) == 0
+    finally:
+        frontend.close()
+
+
+# -- scrape surfaces ---------------------------------------------------
+
+
+def test_metrics_exposition_parse_round_trip(fe, rng):
+    from tpu_stencil.obs import exposition
+
+    img = rng.integers(0, 256, (10, 10), dtype=np.uint8)
+    assert _post(fe.url, img, 1)[0] == 200
+    status, body = _get(fe.url, "/metrics")
+    assert status == 200
+    text = body.decode()
+    snap = exposition.parse_text(text, prefix="tpu_stencil_net")
+    assert snap["counters"]["requests_total"] >= 1
+    assert "fleet_completed_total" in snap["counters"]
+    assert "fleet_batches_total" in snap["counters"]
+    assert "replica_depth_dev0" in snap["gauges"]
+    assert "request_bytes" in snap["histograms"]
+    assert "request_latency_seconds" in snap["histograms"]
+    assert snap["replicas"] == 2  # scalar rider
+    # The exact inverse property the whole exposition stack guarantees.
+    assert exposition.render_text(snap, prefix="tpu_stencil_net") == text
+
+
+def test_statusz_schema(fe):
+    status, body = _get(fe.url, "/statusz")
+    assert status == 200
+    payload = json.loads(body)
+    assert payload["schema_version"] == 1
+    assert payload["replicas"] == 2
+    assert payload["draining"] is False
+    assert len(payload["per_replica"]) == 2
+    assert set(payload["outstanding"]) == {"0", "1"}
+    assert "net" in payload and "counters" in payload["net"]
+    assert payload["config"]["max_queue"] == 16
+
+
+def test_healthz_ok_when_serving(fe):
+    status, body = _get(fe.url, "/healthz")
+    assert status == 200 and body == b"ok\n"
+
+
+def test_net_spans_recorded(rng):
+    from tpu_stencil import obs
+
+    obs.enable()
+    try:
+        frontend = _make_frontend()
+        try:
+            img = rng.integers(0, 256, (8, 8), dtype=np.uint8)
+            assert _post(frontend.url, img, 1)[0] == 200
+            frontend.drain(10.0)
+        finally:
+            frontend.close()
+        names = {s.name for s in obs.get_tracer().spans()}
+        assert {"net.request", "net.route", "net.drain"} <= names, names
+    finally:
+        obs.disable()
+        obs.reset()
+
+
+# -- loadgen --http ----------------------------------------------------
+
+
+def test_loadgen_http_closed_loop(fe):
+    from tpu_stencil.serve import loadgen
+
+    target = loadgen.HttpTarget(fe.url)
+    try:
+        report = loadgen.run(
+            target, mode="closed", requests=6, concurrency=2, reps=1,
+            shapes=((10, 12),), channels=(3,), seed=1,
+        )
+    finally:
+        target.close()
+    assert report["completed"] == 6
+    assert report["p99_s"] >= report["p50_s"] > 0
+    # The stats ARE the tier's own registry, scraped over /statusz.
+    assert report["stats"]["counters"]["requests_total"] >= 6
+    assert "fleet_completed_total" in report["stats"]["counters"]
+
+
+def test_loadgen_http_rate_fps_report(fe):
+    from tpu_stencil.serve import loadgen
+
+    target = loadgen.HttpTarget(fe.url)
+    try:
+        report = loadgen.run(
+            target, requests=4, reps=1, rate_fps=200.0,
+            shapes=((10, 12),), channels=(1,), seed=2,
+        )
+    finally:
+        target.close()
+    assert report["mode"] == "open"
+    assert report["requested_fps"] == 200.0
+    assert report["completed"] == 4
+
+
+def test_loadgen_http_all_shed_reports_zero_completed(rng):
+    # Every request shed (draining tier): the open-loop report must
+    # say completed=0 with zeroed latency keys, not crash — the
+    # overload scenario IS what the open loop exists to measure.
+    from tpu_stencil.serve import loadgen
+
+    frontend = _make_frontend(warm_fleet=False)
+    try:
+        frontend.begin_drain()
+        target = loadgen.HttpTarget(frontend.url)
+        try:
+            report = loadgen.run(
+                target, requests=3, reps=1, rate_fps=100.0,
+                shapes=((8, 8),), channels=(1,), seed=3,
+            )
+        finally:
+            target.close()
+        assert report["completed"] == 0
+        assert report["p50_s"] == report["p99_s"] == 0.0
+        # A draining 503 is PERMANENT for this process (the gate never
+        # reopens): the retrying closed-loop client fails fast typed,
+        # it does not re-offer for the give-up budget.
+        from tpu_stencil.serve.engine import ServerClosed
+
+        target = loadgen.HttpTarget(frontend.url)
+        try:
+            t0 = time.perf_counter()
+            fut = target.submit_retrying(
+                np.zeros((8, 8), np.uint8), 1, give_up_after_s=300.0
+            )
+            with pytest.raises(ServerClosed, match="draining"):
+                fut.result(timeout=60)
+            assert time.perf_counter() - t0 < 30
+        finally:
+            target.close()
+    finally:
+        frontend.close()
+
+
+def test_serve_cli_http_mode(fe, capsys):
+    from tpu_stencil.serve import cli as serve_cli
+
+    rc = serve_cli.main([
+        "--http", fe.url, "--requests", "4", "--concurrency", "2",
+        "--reps", "1", "--shapes", "10x12", "--channels", "3",
+    ])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "served 4/4" in out and "http" in out
+
+
+def test_http_target_maps_429_to_queue_full(rng):
+    from tpu_stencil.serve import loadgen
+    from tpu_stencil.serve.engine import QueueFull
+
+    frontend = _make_frontend(start_workers=False, max_queue=1,
+                              warm_fleet=False)
+    try:
+        target = loadgen.HttpTarget(frontend.url)
+        try:
+            img = rng.integers(0, 256, (8, 8), dtype=np.uint8)
+            f1 = target.submit(img, 1)
+            f2 = target.submit(img, 1)
+            deadline = time.perf_counter() + 30
+            while (sum(frontend.router.outstanding().values()) < 2
+                   and time.perf_counter() < deadline):
+                time.sleep(0.01)
+            f3 = target.submit(img, 1)
+            with pytest.raises(QueueFull):
+                f3.result(timeout=60)
+            frontend.fleet.start_workers()
+            for f in (f1, f2):
+                np.testing.assert_array_equal(
+                    f.result(timeout=300), _golden(img, 1)
+                )
+        finally:
+            target.close()
+    finally:
+        frontend.close()
+
+
+def test_http_target_permanent_error_fails_fast(fe, rng):
+    # A deterministic HTTP failure (404 here: wrong base path) must
+    # surface as a PERMANENT error immediately — the retrying closed
+    # loop may not hammer the server for the whole give-up budget.
+    from tpu_stencil.serve import loadgen
+
+    target = loadgen.HttpTarget(fe.url + "/wrong-base")
+    try:
+        img = rng.integers(0, 256, (8, 8), dtype=np.uint8)
+        t0 = time.perf_counter()
+        fut = target.submit_retrying(img, 1, give_up_after_s=300.0)
+        with pytest.raises(ValueError, match="HTTP 404"):
+            fut.result(timeout=60)
+        assert time.perf_counter() - t0 < 30  # failed fast, no re-offer
+    finally:
+        target.close()
+
+
+# -- the SIGTERM drain, end to end ------------------------------------
+
+
+def test_cli_sigterm_graceful_drain_subprocess(rng):
+    # The acceptance criterion as a black box: a real `python -m
+    # tpu_stencil net` process accepts a slow request, SIGTERM flips
+    # /healthz to draining and stops admission, the accepted request
+    # still completes bit-exact, and the process exits 0 reporting a
+    # clean drain.
+    repo = os.path.join(os.path.dirname(__file__), os.pardir)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "tpu_stencil", "net", "--port", "0",
+         "--replicas", "2", "--platform", "cpu",
+         "--drain-timeout", "60"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        cwd=repo, env=dict(os.environ, JAX_PLATFORMS="cpu"),
+    )
+    try:
+        line = proc.stdout.readline()
+        assert "net: serving on http://" in line, line
+        url = line.split()[3]
+        img = rng.integers(0, 256, (64, 64), dtype=np.uint8)
+        # Warm both the executable and the fleet.
+        status, _, _ = _post(url, img, 1, http_timeout=300)
+        assert status == 200
+        # A deliberately slow request (~seconds of CPU rep loop) so the
+        # drain window is observable.
+        slow = rng.integers(0, 256, (256, 256), dtype=np.uint8)
+        result = {}
+
+        def client():
+            result["r"] = _post(url, slow, 20000, http_timeout=300)
+
+        t = threading.Thread(target=client, daemon=True)
+        t.start()
+        time.sleep(1.0)  # admitted and computing (incl. its compile)
+        proc.send_signal(signal.SIGTERM)
+        # /healthz must flip to draining while the request drains.
+        saw_draining = False
+        deadline = time.perf_counter() + 30
+        while time.perf_counter() < deadline:
+            try:
+                status, body = _get(url, "/healthz", http_timeout=5)
+            except (ConnectionError, OSError):
+                break  # listener already down: drain finished
+            if status == 503 and b"draining" in body:
+                saw_draining = True
+                break
+            time.sleep(0.05)
+        assert saw_draining, "healthz never flipped to draining"
+        t.join(timeout=300)
+        status, body, _ = result["r"]
+        assert status == 200, f"accepted request died in drain: {status}"
+        # Full payload delivered (bit-exactness vs run_job/golden is
+        # pinned by the round-trip tests; a 20000-rep NumPy golden
+        # would dominate the suite's runtime here).
+        assert len(body) == slow.size
+        rc = proc.wait(timeout=120)
+        out = proc.stdout.read()
+        assert rc == 0, (out, proc.stderr.read()[-2000:])
+        assert "drained 2 replica(s) cleanly" in out
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+        proc.stdout.close()
+        proc.stderr.close()
+
+
+# -- bench rider -------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_bench_net_capture_subprocess():
+    repo = os.path.join(os.path.dirname(__file__), os.pardir)
+    proc = subprocess.run(
+        [sys.executable, "bench.py"],
+        capture_output=True, text=True, timeout=580, cwd=repo,
+        env=dict(os.environ, JAX_PLATFORMS="cpu",
+                 TPU_STENCIL_BENCH_PLATFORM="cpu",
+                 TPU_STENCIL_BENCH_SHAPE="48x32",
+                 TPU_STENCIL_BENCH_NET="1",
+                 TPU_STENCIL_BENCH_NET_REQUESTS="4",
+                 TPU_STENCIL_BENCH_SENTRY="off"),
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [l for l in proc.stdout.splitlines() if l.strip()]
+    cap = json.loads(lines[-1])
+    assert cap["metric"].endswith("_net_wall_per_request")
+    assert cap["value"] > 0
+    assert cap["replicas"] >= 1
+    assert cap["responses_2xx_total"] >= cap["requests"]

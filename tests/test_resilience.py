@@ -186,6 +186,15 @@ def test_classify_queue_full_by_name():
     assert retry.classify(QueueFull("full")) == "transient"
 
 
+def test_classify_server_closed_by_name():
+    # A closed/draining server never reopens for this process: the
+    # submit_retrying contract ("ServerClosed raises immediately")
+    # depends on this being permanent.
+    from tpu_stencil.serve.engine import ServerClosed
+
+    assert retry.classify(ServerClosed("server is closed")) == "permanent"
+
+
 def test_transient_returncode_matches_bench_contract():
     assert not retry.transient_returncode(2)   # backend unavailable
     assert retry.transient_returncode(1)

@@ -9,8 +9,10 @@ writes ``blur_<input>``. Extra flags expose what the reference hard-codes:
 ``--filter``, ``--backend``, ``--mesh``, ``--output``.
 
 Subcommands: ``python -m tpu_stencil serve ...`` (the micro-batching
-inference service), ``python -m tpu_stencil stream ...`` (the pipelined
-multi-frame streaming engine, docs/STREAMING.md) and
+inference service), ``python -m tpu_stencil net ...`` (the network
+serving tier: HTTP frontend + per-device replica fleet,
+docs/SERVING.md "Network tier"), ``python -m tpu_stencil stream ...``
+(the pipelined multi-frame streaming engine, docs/STREAMING.md) and
 ``python -m tpu_stencil perf {log,check,report}`` (the perf-regression
 sentry, docs/OBSERVABILITY.md).
 """
@@ -38,6 +40,13 @@ def main(argv=None) -> int:
         from tpu_stencil.stream import cli as stream_cli
 
         return stream_cli.main(argv[1:])
+    if argv and argv[0] == "net":
+        # The network serving tier: HTTP frontend + per-device replica
+        # fleet + graceful SIGTERM drain (docs/SERVING.md "Network
+        # tier"); owns its own flags, jax-free validation.
+        from tpu_stencil.net import cli as net_cli
+
+        return net_cli.main(argv[1:])
     if argv and argv[0] == "perf":
         # The perf-regression sentry (log/check/report) is jax-free by
         # design: a history query must exit without backend bring-up.

@@ -369,10 +369,22 @@ class ServeConfig:
     # instead of occupying a batch slot. submit(deadline_s=...)
     # overrides per request.
     request_timeout_s: float = 0.0
+    # Pin every bucket dispatch to one local device (index into
+    # jax.local_devices()); None = the process default device. The
+    # replica-fleet knob (tpu_stencil.net): one StencilServer per
+    # device, each committed to its own chip, so N replicas serve N
+    # devices in parallel instead of all stacking on device 0. Sharded
+    # routing (overlap != off) still spans the whole mesh regardless.
+    device_index: Optional[int] = None
 
     def __post_init__(self) -> None:
         if self.backend not in BACKENDS:
             raise ValueError(f"unknown backend {self.backend!r}")
+        if self.device_index is not None and self.device_index < 0:
+            raise ValueError(
+                f"device_index must be >= 0 (None = default device), got "
+                f"{self.device_index}"
+            )
         if self.boundary not in ("zero", "periodic"):
             raise ValueError(f"unknown boundary {self.boundary!r}")
         if self.max_queue < 1:
@@ -408,13 +420,140 @@ class ServeConfig:
                 f"{self.request_timeout_s}"
             )
         if self.bucket_edges is not None:
-            edges = tuple(self.bucket_edges)
-            if not edges or any(e < 1 for e in edges) or list(edges) != sorted(set(edges)):
-                raise ValueError(
-                    "bucket_edges must be strictly ascending positive ints, "
-                    f"got {self.bucket_edges!r}"
-                )
-            object.__setattr__(self, "bucket_edges", edges)
+            object.__setattr__(
+                self, "bucket_edges", _normalize_bucket_edges(self.bucket_edges)
+            )
+
+
+def _normalize_bucket_edges(edges) -> Tuple[int, ...]:
+    """Shared ServeConfig/NetConfig bucket-ladder validation: strictly
+    ascending positive ints (one rule, so a fleet's replicas can never
+    disagree with a standalone server on what a valid ladder is)."""
+    out = tuple(edges)
+    if not out or any(e < 1 for e in out) or list(out) != sorted(set(out)):
+        raise ValueError(
+            "bucket_edges must be strictly ascending positive ints, "
+            f"got {edges!r}"
+        )
+    return out
+
+
+@dataclasses.dataclass(frozen=True)
+class NetConfig:
+    """Configuration for the network serving tier
+    (:mod:`tpu_stencil.net`): the HTTP frontend, the per-device replica
+    fleet, and the router's admission-control knobs. Jax-free, like
+    every other config here, so ``python -m tpu_stencil net`` validates
+    flags before backend bring-up.
+
+    One :class:`ServeConfig` is derived per replica
+    (:meth:`serve_config`), each pinned to its own local device, so the
+    per-replica backpressure/deadline contracts are exactly the
+    in-process serve engine's — the net tier only adds placement,
+    admission and drain on top (docs/SERVING.md "Network tier").
+    """
+
+    host: str = "127.0.0.1"
+    port: int = 8080           # 0 = ephemeral (the bound port is printed)
+    replicas: int = 0          # engines in the fleet; 0 = one per device
+    filter_name: str = "gaussian"
+    backend: str = "auto"      # same vocabulary as ServeConfig.backend
+    max_queue: int = 256       # per-replica bounded-queue depth
+    max_batch: int = 8         # per-replica micro-batch bound
+    # Shape-bucket ladder override shared by every replica (None = the
+    # serve default) — one ladder fleet-wide, so a shape warmed on one
+    # replica lands in the SAME bucket executable key on the others.
+    bucket_edges: Optional[Tuple[int, ...]] = None
+    # Load-shedding watermark: when admitting a request would push the
+    # router's tracked in-flight bytes (request + response buffers)
+    # past this, the request is shed with 503 + Retry-After BEFORE it
+    # touches any replica queue. 0 disables the watermark (the
+    # per-replica bounded queues still reject with 429).
+    max_inflight_mb: float = 256.0
+    # Default per-request deadline (seconds; 0 = none), forwarded to
+    # each replica's ServeConfig.request_timeout_s and overridable per
+    # request via the X-Request-Timeout header. Expired requests map to
+    # HTTP 504 (DeadlineExceeded).
+    request_timeout_s: float = 0.0
+    # Graceful-drain budget (seconds): on SIGTERM (or an explicit
+    # drain), every replica gets close(timeout=) within this window;
+    # a replica whose worker does not join in time is reported
+    # abandoned (serve_close_abandoned_total) instead of hanging the
+    # shutdown forever.
+    drain_timeout_s: float = 30.0
+    # Shared executable-cache warming: the first time the router sees a
+    # new (filter, bucket, channels, reps) key it fires one discarded
+    # zero-frame warm request at every OTHER replica, so the shape's
+    # compile overlaps the first real request and later traffic hits
+    # warm caches fleet-wide (the per-platform tuning-cache discipline,
+    # arxiv 2406.08923, applied across replicas).
+    warm_fleet: bool = True
+
+    def __post_init__(self) -> None:
+        if not self.host:
+            raise ValueError("host must be non-empty")
+        if not 0 <= self.port <= 65535:
+            raise ValueError(
+                f"port must be in [0, 65535] (0 = ephemeral), got {self.port}"
+            )
+        if self.replicas < 0:
+            raise ValueError(
+                f"replicas must be >= 0 (0 = one per local device), got "
+                f"{self.replicas}"
+            )
+        if self.backend not in BACKENDS:
+            raise ValueError(f"unknown backend {self.backend!r}")
+        if self.max_queue < 1:
+            raise ValueError(f"max_queue must be >= 1, got {self.max_queue}")
+        if self.max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {self.max_batch}")
+        if self.max_inflight_mb < 0:
+            raise ValueError(
+                f"max_inflight_mb must be >= 0 (0 = no shed watermark), "
+                f"got {self.max_inflight_mb}"
+            )
+        if self.request_timeout_s < 0:
+            raise ValueError(
+                f"request_timeout_s must be >= 0 (0 = none), got "
+                f"{self.request_timeout_s}"
+            )
+        if self.drain_timeout_s <= 0:
+            raise ValueError(
+                f"drain_timeout_s must be > 0, got {self.drain_timeout_s}"
+            )
+        # Jax-free (the filter bank is pure numpy): a typo'd --filter
+        # must die as a usage error, not boot a tier that answers 500
+        # to every request.
+        from tpu_stencil import filters as _filters
+
+        try:
+            _filters.get_filter(self.filter_name)
+        except KeyError as e:
+            raise ValueError(str(e)) from None
+        if self.bucket_edges is not None:
+            object.__setattr__(
+                self, "bucket_edges", _normalize_bucket_edges(self.bucket_edges)
+            )
+
+    @property
+    def max_inflight_bytes(self) -> int:
+        return int(self.max_inflight_mb * (1 << 20))
+
+    def serve_config(self, device_index: int) -> ServeConfig:
+        """The per-replica engine config: one engine pinned to one
+        local device. The device-memory sampler stays off per replica
+        (N background threads sampling one allocator would be noise);
+        the fleet's merged exposition is the scrape surface."""
+        return ServeConfig(
+            filter_name=self.filter_name,
+            backend=self.backend,
+            max_queue=self.max_queue,
+            max_batch=self.max_batch,
+            bucket_edges=self.bucket_edges,
+            request_timeout_s=self.request_timeout_s,
+            device_index=device_index,
+            mem_sample_interval_s=0.0,
+        )
 
 
 def build_parser() -> argparse.ArgumentParser:

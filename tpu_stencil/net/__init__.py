@@ -1,0 +1,38 @@
+"""Network serving tier (docs/SERVING.md "Network tier").
+
+The real edge over the serve engines — the piece PR 9 left in-process:
+
+* :mod:`~tpu_stencil.net.fleet` — one
+  :class:`~tpu_stencil.serve.engine.StencilServer` per local device
+  with shared executable-cache warming, concurrent drain, and rolling
+  single-replica restart.
+* :mod:`~tpu_stencil.net.router` — least-outstanding placement plus
+  the three admission layers (drain gate, inflight-bytes load shed,
+  per-replica bounded-queue backpressure).
+* :mod:`~tpu_stencil.net.http` — the stdlib threaded HTTP frontend
+  (``POST /v1/blur`` raw frames incl. chunked uploads, ``/healthz``,
+  ``/metrics``, ``/statusz``, ``/admin/restart``) and
+  :class:`~tpu_stencil.net.http.NetFrontend`, the whole-tier
+  lifecycle object.
+* :mod:`~tpu_stencil.net.cli` — ``python -m tpu_stencil net`` with
+  SIGTERM graceful drain.
+
+>>> from tpu_stencil.config import NetConfig
+>>> from tpu_stencil.net import NetFrontend
+>>> with NetFrontend(NetConfig(port=0, replicas=2)) as fe:
+...     ...  # POST frames at fe.url
+"""
+
+from tpu_stencil.config import NetConfig
+from tpu_stencil.net.fleet import ReplicaFleet
+from tpu_stencil.net.http import NetFrontend
+from tpu_stencil.net.router import Draining, Overloaded, Router
+
+__all__ = [
+    "Draining",
+    "NetConfig",
+    "NetFrontend",
+    "Overloaded",
+    "ReplicaFleet",
+    "Router",
+]

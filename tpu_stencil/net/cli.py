@@ -1,0 +1,163 @@
+"""``python -m tpu_stencil net`` — run the network serving tier.
+
+Starts the per-device replica fleet behind the stdlib HTTP frontend and
+serves until SIGTERM/SIGINT, then runs the graceful-drain sequence:
+flip ``/healthz`` to draining, stop admission, ``close(timeout=)``
+every replica under ``--drain-timeout``, report which (if any) replica
+hung, write ``--metrics-text`` / ``--stats-json`` artifacts, exit 0
+when every replica drained (1 when one was abandoned — a monitor can
+tell a clean roll from a wedged one by rc alone).
+
+Flag validation is jax-free (:class:`~tpu_stencil.config.NetConfig`):
+a bad flag dies as a usage error before backend bring-up.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import signal
+import sys
+import threading
+import time
+
+from tpu_stencil.config import BACKENDS, NetConfig
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="tpu_stencil net",
+        description="Network serving tier: an HTTP frontend over a "
+                    "per-device replica fleet with admission control "
+                    "and graceful drain (docs/SERVING.md).",
+    )
+    p.add_argument("--host", default="127.0.0.1",
+                   help="bind address (default 127.0.0.1; 0.0.0.0 to "
+                        "accept off-host traffic)")
+    p.add_argument("--port", type=int, default=8080,
+                   help="listen port; 0 binds an ephemeral port and "
+                        "prints it (default 8080)")
+    p.add_argument("--replicas", type=int, default=0,
+                   help="serving engines in the fleet, one pinned per "
+                        "local device (0 = one per device; default 0)")
+    p.add_argument("--filter", dest="filter_name", default="gaussian",
+                   help="default filter (per-request override via "
+                        "X-Filter; default gaussian)")
+    p.add_argument("--backend", default="auto", choices=list(BACKENDS),
+                   help="compute backend for every replica (default auto)")
+    p.add_argument("--max-queue", type=int, default=256,
+                   help="per-replica bounded queue depth; beyond it the "
+                        "router tries the next replica, and when every "
+                        "queue is full the request gets 429 (default 256)")
+    p.add_argument("--max-batch", type=int, default=8,
+                   help="per-replica micro-batch bound (default 8)")
+    p.add_argument("--max-inflight-mb", type=float, default=256.0,
+                   help="load-shed watermark: past this many MB of "
+                        "tracked in-flight request+response bytes, new "
+                        "requests get 503 + Retry-After before touching "
+                        "any queue (0 = off; default 256)")
+    p.add_argument("--request-timeout", dest="request_timeout_s",
+                   type=float, default=0.0, metavar="SECONDS",
+                   help="default per-request deadline: expired requests "
+                        "fail 504 (DeadlineExceeded) instead of occupying "
+                        "a batch slot; X-Request-Timeout overrides per "
+                        "request (0 = none)")
+    p.add_argument("--drain-timeout", dest="drain_timeout_s", type=float,
+                   default=30.0, metavar="SECONDS",
+                   help="graceful-drain budget on SIGTERM: every replica "
+                        "gets close(timeout=) within it; a replica that "
+                        "does not join is reported abandoned and the "
+                        "process exits 1 (default 30)")
+    p.add_argument("--no-warm", dest="warm_fleet", action="store_false",
+                   help="disable shared executable-cache warming across "
+                        "replicas (on by default: a shape compiled on one "
+                        "replica pre-warms the others)")
+    p.add_argument("--platform", default=None,
+                   choices=["cpu", "tpu", "gpu"],
+                   help="force the JAX platform before backend init")
+    p.add_argument("--metrics-text", default=None, metavar="PATH",
+                   help="after the drain, write the fleet-wide metrics "
+                        "(the /metrics exposition) to PATH ('-' = stdout)")
+    p.add_argument("--stats-json", default=None, metavar="PATH",
+                   help="after the drain, dump the /statusz payload as "
+                        "JSON to PATH ('-' = stdout); versioned schema")
+    return p
+
+
+def main(argv=None) -> int:
+    parser = build_parser()
+    ns = parser.parse_args(argv)
+    try:
+        cfg = NetConfig(
+            host=ns.host, port=ns.port, replicas=ns.replicas,
+            filter_name=ns.filter_name, backend=ns.backend,
+            max_queue=ns.max_queue, max_batch=ns.max_batch,
+            max_inflight_mb=ns.max_inflight_mb,
+            request_timeout_s=ns.request_timeout_s,
+            drain_timeout_s=ns.drain_timeout_s,
+            warm_fleet=ns.warm_fleet,
+        )
+    except ValueError as e:
+        parser.error(str(e))
+    if ns.platform:
+        import jax
+
+        jax.config.update("jax_platforms", ns.platform)
+
+    from tpu_stencil.net.http import NetFrontend
+
+    fe = NetFrontend(cfg).start()
+    stop = threading.Event()
+
+    def _on_signal(signum, _frame) -> None:
+        print(f"net: received {signal.Signals(signum).name}, draining",
+              flush=True)
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _on_signal)
+    signal.signal(signal.SIGINT, _on_signal)
+    print(
+        f"net: serving on {fe.url} with {len(fe.fleet)} replica(s) "
+        f"(max_queue={cfg.max_queue}/replica, "
+        f"shed>{cfg.max_inflight_mb:g}MB inflight, "
+        f"warm={'on' if cfg.warm_fleet else 'off'}); "
+        f"POST /v1/blur, GET /healthz /metrics /statusz; "
+        f"SIGTERM drains",
+        flush=True,
+    )
+    # Timed waits, not a bare stop.wait(): an untimed Event.wait parks
+    # the main thread in an uninterruptible lock acquire, so a Python
+    # signal handler that only sets the event would never run — the
+    # classic self-deadlock. A timed wait re-checks pending signals on
+    # every expiry.
+    while not stop.wait(0.5):
+        pass
+    t0 = time.perf_counter()
+    report = fe.drain(cfg.drain_timeout_s)
+    hung = sorted(i for i, ok in report.items() if not ok)
+    if hung:
+        print(f"net: drain ABANDONED replica(s) {hung} after "
+              f"{cfg.drain_timeout_s:g}s "
+              f"({time.perf_counter() - t0:.2f}s elapsed)", flush=True)
+    else:
+        print(f"net: drained {len(report)} replica(s) cleanly in "
+              f"{time.perf_counter() - t0:.2f}s", flush=True)
+    if ns.metrics_text:
+        from tpu_stencil.obs import exposition
+
+        exposition.write_text(ns.metrics_text, fe.metrics_snapshot(),
+                              prefix="tpu_stencil_net")
+    if ns.stats_json:
+        payload = json.dumps(fe.statusz(), indent=2, sort_keys=True)
+        if ns.stats_json == "-":
+            print(payload)
+        else:
+            with open(ns.stats_json, "w") as fh:
+                fh.write(payload + "\n")
+            print(f"wrote {ns.stats_json}")
+    fe.close()
+    return 1 if hung else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,253 @@
+"""Per-device replica fleet: one serving engine per local chip.
+
+The in-process halves of the network tier already exist — PR 9 built
+per-device fan-out lanes for the stream and per-device admission
+counters for serve; what a network edge needs is N *independent*
+serving engines, one pinned to each local device, so N concurrent HTTP
+requests compute on N chips instead of stacking on device 0. Each
+replica is a stock :class:`~tpu_stencil.serve.engine.StencilServer`
+(bounded queue, micro-batching, executable cache, deadlines — every
+contract unchanged) built from ``NetConfig.serve_config(i)`` with
+``device_index=i``.
+
+**Shared executable-cache warming.** Compiled executables are per
+replica (each owns its jit cache entries), so without help every
+replica pays a cold compile for every shape — 8 replicas, 8 compiles of
+the same program. The fleet applies the tuning-cache discipline of the
+AMD/Nvidia stencil study (arxiv 2406.08923, "never re-pay a tune the
+platform has already done") across replicas: the first time a (filter,
+bucket, channels, reps) key is routed, one discarded zero-frame warm
+request is fired at every OTHER replica, so their compiles overlap the
+first real request and later traffic hits warm caches fleet-wide
+(``warm_submits_total``; dedup-bounded so a long-lived fleet never
+re-warms a known key).
+
+**Drain.** :meth:`drain` closes every replica concurrently under one
+deadline and reports PER REPLICA whether it drained or was abandoned
+(the :meth:`StencilServer.close` bool — the satellite bugfix this PR
+makes), so a SIGTERM shutdown can say *which* replica hung instead of
+silently timing out. :meth:`restart` is the rolling-restart primitive:
+drain one replica, build a fresh engine on the same device, swap it in
+while the rest keep serving — the router uses it to recover a
+``WorkerCrashed`` replica (the PR-7 resilience ladder's
+degrade-don't-die discipline at fleet scope).
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from tpu_stencil.config import NetConfig
+from tpu_stencil.obs import span as _obs_span
+from tpu_stencil.serve import bucketing
+from tpu_stencil.serve.engine import StencilServer
+from tpu_stencil.serve.metrics import Registry
+
+# Warm-key dedup bound: the key space is client-controlled (reps,
+# oversized buckets), so the seen-set must not grow unboundedly on a
+# long-lived fleet — past the cap the oldest keys age out and would
+# simply re-warm (idempotent, just a little redundant work).
+_WARM_KEY_CAP = 4096
+
+# Warm-cost bound: a warm request runs the FULL rep count on its zero
+# frame (reps is part of the executable key — a cheaper rep count
+# would warm the wrong executable). Past this many reps the sibling
+# compute burned per warm outweighs the compile saved, and a client
+# scanning rep values could otherwise amplify one request into
+# (replicas-1) full computations each — so big-rep keys warm lazily,
+# on their own first request per replica.
+_WARM_MAX_REPS = 1024
+
+
+class ReplicaFleet:
+    """N per-device serving engines plus the warming/drain/restart
+    lifecycle. Construct, :meth:`start` (touches JAX — device count),
+    route submits at ``fleet.replicas[i]``, :meth:`drain` when done."""
+
+    def __init__(self, cfg: NetConfig, registry: Optional[Registry] = None,
+                 start_workers: bool = True) -> None:
+        self.cfg = cfg
+        self.registry = registry if registry is not None else Registry()
+        self.replicas: List[StencilServer] = []
+        self._lock = threading.Lock()
+        # Serializes whole restart operations (close -> build -> swap):
+        # a concurrent /admin/restart and a WorkerCrashed reroute on the
+        # same replica must not each build an engine and leak the loser.
+        self._restart_lock = threading.Lock()
+        self._warmed: "collections.OrderedDict" = collections.OrderedDict()
+        # Tests park the fleet (start_workers=False) to pin queues
+        # deterministically, then release with start_workers().
+        self._start_workers = start_workers
+        self._m_warm = self.registry.counter("warm_submits_total")
+        self._m_restarts = self.registry.counter("replica_restarts_total")
+        self._m_abandoned = self.registry.counter(
+            "drain_abandoned_replicas_total"
+        )
+
+    # -- lifecycle -----------------------------------------------------
+
+    def start(self) -> "ReplicaFleet":
+        """Build the replicas (idempotent). The first JAX touch: the
+        device count resolves here, never at construction."""
+        if self.replicas:
+            return self
+        import jax
+
+        n_dev = len(jax.local_devices())
+        n = self.cfg.replicas or n_dev
+        if n > n_dev:
+            raise ValueError(
+                f"replicas={n} exceeds {n_dev} local device(s); the fleet "
+                f"runs one engine per device (0 = all)"
+            )
+        self.replicas = [self._build(i) for i in range(n)]
+        return self
+
+    def _build(self, i: int) -> StencilServer:
+        return StencilServer(self.cfg.serve_config(i),
+                             start=self._start_workers)
+
+    def start_workers(self) -> None:
+        """Release a parked fleet (tests): start every replica worker."""
+        self._start_workers = True
+        for rep in self.replicas:
+            rep.start()
+
+    def __len__(self) -> int:
+        return len(self.replicas)
+
+    # -- shared cache warming ------------------------------------------
+
+    @staticmethod
+    def _warm_key(cfg: NetConfig, image: np.ndarray, reps: int,
+                  filter_name: str) -> Tuple:
+        h, w = image.shape[:2]
+        channels = image.shape[2] if image.ndim == 3 else 1
+        edges = cfg.bucket_edges or bucketing.DEFAULT_EDGES
+        return (filter_name, bucketing.bucket_shape(h, w, edges),
+                channels, int(reps))
+
+    def prewarm_others(self, chosen: int, image: np.ndarray, reps: int,
+                       filter_name: Optional[str] = None) -> int:
+        """Fire one discarded zero-frame warm request at every replica
+        except ``chosen`` the first time this executable key is seen
+        (the chosen replica warms via the real request itself). Returns
+        how many warm submits were offered; best-effort — a full or
+        closed sibling is skipped, never an error (warming is an
+        optimization, not a correctness dependency)."""
+        if not self.cfg.warm_fleet or len(self.replicas) < 2:
+            return 0
+        if int(reps) > _WARM_MAX_REPS:
+            # See _WARM_MAX_REPS: the warm would burn more sibling
+            # compute than the compile it saves.
+            return 0
+        fname = filter_name or self.cfg.filter_name
+        key = self._warm_key(self.cfg, image, reps, fname)
+        with self._lock:
+            if key in self._warmed:
+                return 0
+            self._warmed[key] = True
+            while len(self._warmed) > _WARM_KEY_CAP:
+                self._warmed.popitem(last=False)
+        zeros = np.zeros(image.shape, np.uint8)
+        n = 0
+        for j, rep in enumerate(list(self.replicas)):
+            if j == chosen:
+                continue
+            try:
+                rep.submit(zeros, reps, fname)
+            except Exception:
+                continue  # full/closed/crashed sibling: skip, don't fail
+            self._m_warm.inc()
+            n += 1
+        return n
+
+    # -- drain / restart -----------------------------------------------
+
+    def drain(self, timeout_s: Optional[float] = None) -> Dict[int, bool]:
+        """Close every replica CONCURRENTLY under one deadline; returns
+        ``{replica_index: drained}`` — False names a replica whose
+        worker did not join in time (abandoned, counted both in its own
+        ``serve_close_abandoned_total`` and the fleet's
+        ``drain_abandoned_replicas_total``). Every accepted request
+        either completes during the drain or fails typed
+        (``ServerClosed``) — never a silent drop."""
+        budget = (
+            timeout_s if timeout_s is not None else self.cfg.drain_timeout_s
+        )
+        results: Dict[int, bool] = {}
+        with _obs_span("net.drain", "net", replicas=len(self.replicas)):
+            threads = []
+
+            def _close(i: int, rep: StencilServer) -> None:
+                results[i] = bool(rep.close(timeout=budget))
+
+            for i, rep in enumerate(self.replicas):
+                t = threading.Thread(
+                    target=_close, args=(i, rep),
+                    name=f"tpu-stencil-drain-{i}", daemon=True,
+                )
+                t.start()
+                threads.append(t)
+            deadline = time.perf_counter() + budget + 5.0
+            for t in threads:
+                t.join(max(0.0, deadline - time.perf_counter()))
+            for i in range(len(self.replicas)):
+                if results.get(i) is None:
+                    results[i] = False  # the close itself overran
+            for i, ok in sorted(results.items()):
+                if not ok:
+                    self._m_abandoned.inc()
+        return results
+
+    def restart(self, i: int, timeout_s: Optional[float] = None,
+                expect: Optional[StencilServer] = None) -> bool:
+        """Rolling single-replica restart: drain replica ``i`` (bounded
+        by ``timeout_s`` / the config drain budget), build a fresh
+        engine on the same device, swap it in. The rest of the fleet
+        keeps serving throughout. Returns the old replica's drained
+        bool (False = it was abandoned still running; the new engine
+        takes over the device regardless — the resilience ladder's
+        degraded-but-alive rung). ``expect`` makes the restart
+        conditional: when the slot no longer holds that engine (a
+        concurrent restart already swapped it), return True without
+        restarting the fresh replacement."""
+        with self._restart_lock:
+            with self._lock:
+                old = self.replicas[i]
+                if expect is not None and old is not expect:
+                    return True  # already replaced by a sibling restart
+            drained = bool(old.close(
+                timeout=timeout_s if timeout_s is not None
+                else self.cfg.drain_timeout_s
+            ))
+            new = self._build(i)
+            if self._start_workers:
+                new.start()
+            with self._lock:
+                self.replicas[i] = new
+            self._m_restarts.inc()
+            return drained
+
+    # -- introspection -------------------------------------------------
+
+    def merged_counters(self) -> Dict[str, int]:
+        """Counters summed across every replica's registry — the
+        fleet-wide view the ``/metrics`` exposition folds in as
+        ``fleet_<name>`` (per-device ``..._dev<i>`` counters stay
+        distinct because each replica charges its own pinned index)."""
+        out: Dict[str, int] = {}
+        for rep in list(self.replicas):
+            for k, v in rep.stats()["counters"].items():
+                out[k] = out.get(k, 0) + v
+        return out
+
+    def stats(self) -> List[dict]:
+        """Per-replica ``StencilServer.stats()`` snapshots, in device
+        order (the ``/statusz`` payload)."""
+        return [rep.stats() for rep in list(self.replicas)]

@@ -1,0 +1,515 @@
+"""The HTTP network edge: stdlib-only threaded frontend over the fleet.
+
+Endpoints (docs/SERVING.md "Network tier" is the contract):
+
+* ``POST /v1/blur`` — one raw frame in, one blurred raw frame out.
+  Geometry rides headers (``X-Width``/``X-Height``/``X-Reps``/
+  ``X-Channels``/``X-Filter``) or query params (``w``/``h``/``reps``/
+  ``channels``/``filter``; headers win); the body is the headerless
+  frame bytes (``Content-Length`` or ``Transfer-Encoding: chunked`` —
+  large frames stream up in chunks, the reference's headerless ``.raw``
+  contract carried onto the wire). ``X-Request-Timeout`` (seconds)
+  overrides the per-request deadline. Responses: 200 with the blurred
+  bytes (+ the same geometry headers), 400 validation, 404 wrong path,
+  413 oversized body, 429 + ``Retry-After`` when every replica queue
+  is full, 503 + ``Retry-After`` when shedding or draining, 504 when
+  the deadline expired (``DeadlineExceeded``), 500 anything else.
+* ``GET /healthz`` — 200 ``ok`` serving / 503 ``draining`` after
+  SIGTERM. The readiness probe: a load balancer stops routing here the
+  moment the drain begins.
+* ``GET /metrics`` — Prometheus-style text exposition (the PR-2
+  renderer, prefix ``tpu_stencil_net``): the net registry (router +
+  fleet + per-request HTTP metrics) with every replica's counters
+  folded in as ``fleet_<name>`` — one scrape, one prefix, exact
+  parse round-trip.
+* ``GET /statusz`` — the JSON operator view: per-replica snapshots,
+  router outstanding/inflight, drain state (versioned schema).
+* ``POST /admin/restart?replica=i`` — rolling single-replica restart
+  (:meth:`ReplicaFleet.restart`); the rest of the fleet serves on.
+
+:class:`NetFrontend` owns the whole tier lifecycle: fleet → router →
+threaded HTTP server, then ``begin_drain`` (flip healthz, stop
+admission) → ``drain`` (close every replica under the budget, report
+which hung) → ``close`` (stop the listener). SIGTERM in the CLI maps
+onto exactly that sequence.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional
+from urllib.parse import parse_qs, urlsplit
+
+import numpy as np
+
+from tpu_stencil.config import NetConfig
+from tpu_stencil.net.fleet import ReplicaFleet
+from tpu_stencil.net.router import Draining, Overloaded, Router
+from tpu_stencil.obs import span as _obs_span
+from tpu_stencil.resilience.errors import DeadlineExceeded, WorkerCrashed
+from tpu_stencil.serve.engine import QueueFull, ServerClosed
+from tpu_stencil.serve.metrics import Registry
+
+# /statusz + --stats-json payload schema. Bump on breaking changes.
+STATUS_SCHEMA_VERSION = 1
+
+# Retry-After hints (seconds): queue-full clears within a batch or two;
+# a shed watermark needs the in-flight backlog to drain.
+RETRY_AFTER_QUEUE_FULL = 1
+RETRY_AFTER_SHED = 2
+
+# Hard cap on how long a handler thread waits for an accepted request
+# with no explicit deadline — the never-hang discipline at the edge.
+_RESULT_TIMEOUT_S = 600.0
+
+# Upload bound: a request body may not exceed the declared frame bytes
+# (chunked uploads have no Content-Length to sanity-check up front).
+_MAX_EXTRA_BODY = 2
+
+
+class _Oversized(ValueError):
+    """Body larger than the declared frame (→ 413; a malformed framing
+    header is a plain ValueError → 400 — shrinking won't fix it)."""
+
+
+class _NetHTTPServer(ThreadingHTTPServer):
+    daemon_threads = True
+    # Restart fast after a drain in tests/ops (no TIME_WAIT bind error).
+    allow_reuse_address = True
+
+    def __init__(self, addr, frontend: "NetFrontend") -> None:
+        self.frontend = frontend
+        super().__init__(addr, _Handler)
+
+
+class _Handler(BaseHTTPRequestHandler):
+    # 1.1 so chunked request bodies are legal; every response carries an
+    # explicit Content-Length, keeping keep-alive connections coherent.
+    protocol_version = "HTTP/1.1"
+    server_version = "tpu-stencil-net/1"
+    # Socket timeout: a client that declares Content-Length and goes
+    # quiet mid-body would otherwise pin this handler thread forever
+    # (the never-hang discipline covers the READ side of the edge too;
+    # stdlib maps the timeout onto the connection socket and drops it).
+    timeout = 120.0
+
+    # -- plumbing ------------------------------------------------------
+
+    def log_message(self, *args) -> None:
+        pass  # metrics, not stderr chatter, are the observability story
+
+    @property
+    def fe(self) -> "NetFrontend":
+        return self.server.frontend
+
+    def _respond(self, code: int, body: bytes,
+                 content_type: str = "text/plain; charset=utf-8",
+                 headers: Optional[Dict[str, str]] = None) -> None:
+        klass = f"responses_{code // 100}xx_total"
+        self.fe.registry.counter(klass).inc()
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _error(self, code: int, msg: str,
+               headers: Optional[Dict[str, str]] = None) -> None:
+        # Close after every error response: the early-error paths
+        # (bad params, oversized/malformed framing, unknown path)
+        # answer BEFORE the request body was consumed, and unread body
+        # bytes on a kept-alive connection would be parsed as the next
+        # request line — garbage for the whole connection.
+        self.close_connection = True
+        self._respond(code, (msg.rstrip("\n") + "\n").encode(),
+                      headers={**(headers or {}), "Connection": "close"})
+
+    def _param(self, query: dict, header: str, qname: str,
+               default: Optional[str] = None) -> Optional[str]:
+        v = self.headers.get(header)
+        if v is not None:
+            return v
+        if qname in query:
+            return query[qname][0]
+        return default
+
+    def _read_body(self, limit: int) -> bytes:
+        """The upload: ``Content-Length`` bodies in one read, chunked
+        transfer decoded chunk by chunk (stdlib handlers do NOT
+        de-chunk). ``limit`` bounds either path — a body past the
+        declared frame size fails typed instead of buffering."""
+        te = (self.headers.get("Transfer-Encoding") or "").lower()
+        if "chunked" in te:
+            chunks = []
+            total = 0
+            while True:
+                # 1024 accommodates spec-legal chunk extensions; a line
+                # that still lacks its newline was truncated mid-line,
+                # and parsing it would desync the stream (the unread
+                # tail would be consumed as payload) — fail typed.
+                size_line = self.rfile.readline(1024)
+                if size_line and not size_line.endswith(b"\n"):
+                    raise ValueError(
+                        "chunk-size line exceeds 1024 bytes"
+                    )
+                try:
+                    size = int(
+                        size_line.split(b";")[0].strip() or b"0", 16
+                    )
+                except ValueError:
+                    raise ValueError(
+                        f"malformed chunk-size line {size_line!r}"
+                    ) from None
+                if size == 0:
+                    # Consume trailers (none expected) up to blank line.
+                    while self.rfile.readline(1024).strip():
+                        pass
+                    break
+                total += size
+                if total > limit + _MAX_EXTRA_BODY:
+                    raise _Oversized(
+                        f"chunked body exceeds declared frame size "
+                        f"({limit} bytes)"
+                    )
+                chunks.append(self.rfile.read(size))
+                self.rfile.read(2)  # chunk-terminating CRLF
+            return b"".join(chunks)
+        try:
+            n = int(self.headers.get("Content-Length") or 0)
+        except ValueError:
+            raise ValueError(
+                f"malformed Content-Length "
+                f"{self.headers.get('Content-Length')!r}"
+            ) from None
+        if n > limit + _MAX_EXTRA_BODY:
+            raise _Oversized(
+                f"body of {n} bytes exceeds declared frame size "
+                f"({limit} bytes)"
+            )
+        return self.rfile.read(n)
+
+    # -- GET -----------------------------------------------------------
+
+    def do_GET(self) -> None:  # noqa: N802 (stdlib handler contract)
+        path = urlsplit(self.path).path
+        if path == "/healthz":
+            if self.fe.router.draining:
+                self._error(503, "draining")
+            else:
+                self._respond(200, b"ok\n")
+        elif path == "/metrics":
+            text = self.fe.render_metrics()
+            self._respond(200, text.encode(),
+                          content_type="text/plain; version=0.0.4")
+        elif path == "/statusz":
+            payload = json.dumps(self.fe.statusz(), indent=2,
+                                 sort_keys=True)
+            self._respond(200, payload.encode(),
+                          content_type="application/json")
+        else:
+            self._error(404, f"no such endpoint: {path}")
+
+    # -- POST ----------------------------------------------------------
+
+    def do_POST(self) -> None:  # noqa: N802
+        split = urlsplit(self.path)
+        if split.path == "/v1/blur":
+            self._blur(parse_qs(split.query))
+        elif split.path == "/admin/restart":
+            self._restart(parse_qs(split.query))
+        else:
+            self._error(404, f"no such endpoint: {split.path}")
+
+    def _restart(self, query: dict) -> None:
+        # Consume any request body first: an unread body corrupts the
+        # keep-alive stream for the next request on this connection.
+        n = int(self.headers.get("Content-Length") or 0)
+        if n:
+            self.rfile.read(min(n, 1 << 20))
+        try:
+            idx = int(query.get("replica", ["-1"])[0])
+            if not 0 <= idx < len(self.fe.fleet):
+                raise ValueError
+        except ValueError:
+            self._error(
+                400, f"replica must be 0..{len(self.fe.fleet) - 1}"
+            )
+            return
+        drained = self.fe.fleet.restart(idx)
+        self._respond(200, json.dumps(
+            {"replica": idx, "restarted": True, "old_drained": drained}
+        ).encode(), content_type="application/json")
+
+    def _blur(self, query: dict) -> None:
+        fe = self.fe
+        t0 = time.perf_counter()
+        with _obs_span("net.request", "net"):
+            try:
+                w = int(self._param(query, "X-Width", "w"))
+                h = int(self._param(query, "X-Height", "h"))
+                reps = int(self._param(query, "X-Reps", "reps"))
+                channels = int(
+                    self._param(query, "X-Channels", "channels", "1")
+                )
+                fname = self._param(query, "X-Filter", "filter")
+                boundary = self._param(
+                    query, "X-Boundary", "boundary", "zero"
+                )
+                timeout = self._param(
+                    query, "X-Request-Timeout", "timeout"
+                )
+                deadline_s = float(timeout) if timeout else None
+                if w < 1 or h < 1:
+                    raise ValueError(f"bad frame geometry {w}x{h}")
+                if reps < 0:
+                    raise ValueError(f"reps must be >= 0, got {reps}")
+                if channels not in (1, 3):
+                    raise ValueError(
+                        f"channels must be 1 (grey) or 3 (rgb), got "
+                        f"{channels}"
+                    )
+                if fname:
+                    # Validate HERE (numpy-only lookup): an unknown
+                    # X-Filter is a 400, not a worker-side KeyError
+                    # surfacing as 500 — and it must never reach the
+                    # warm-key dedup cache.
+                    from tpu_stencil import filters as _filters
+
+                    try:
+                        _filters.get_filter(fname)
+                    except KeyError as e:
+                        raise ValueError(str(e)) from None
+            except (TypeError, ValueError) as e:
+                self._error(400, f"bad request parameters: {e}")
+                return
+            if boundary != "zero":
+                # The serve engines preserve zero semantics only (pad
+                # re-zeroing; docs/SERVING.md) — answer typed, never
+                # silently wrong pixels.
+                self._error(
+                    400,
+                    f"boundary={boundary!r} is not servable over the "
+                    "bucket-padded engines (zero only); run it via "
+                    "`python -m tpu_stencil` instead",
+                )
+                return
+            expected = w * h * channels
+            try:
+                body = self._read_body(expected)
+            except _Oversized as e:
+                self._error(413, str(e))
+                return
+            except ValueError as e:
+                self._error(400, str(e))
+                return
+            if len(body) != expected:
+                self._error(
+                    400,
+                    f"body is {len(body)} bytes; {w}x{h}x{channels} "
+                    f"needs exactly {expected}",
+                )
+                return
+            shape = (h, w) if channels == 1 else (h, w, channels)
+            img = np.frombuffer(body, np.uint8).reshape(shape)
+            try:
+                fut, idx = fe.router.submit(
+                    img, reps, fname, deadline_s=deadline_s
+                )
+            except Draining as e:
+                self._error(503, str(e),
+                            {"Retry-After": str(RETRY_AFTER_SHED)})
+                return
+            except Overloaded as e:
+                self._error(503, str(e),
+                            {"Retry-After": str(RETRY_AFTER_SHED)})
+                return
+            except QueueFull as e:
+                self._error(429, str(e),
+                            {"Retry-After": str(RETRY_AFTER_QUEUE_FULL)})
+                return
+            except ValueError as e:
+                self._error(400, str(e))
+                return
+            wait = (
+                deadline_s + 5.0 if deadline_s
+                else (fe.cfg.request_timeout_s + 5.0
+                      if fe.cfg.request_timeout_s else _RESULT_TIMEOUT_S)
+            )
+            try:
+                out = fut.result(timeout=wait)
+            except DeadlineExceeded as e:
+                self._error(504, str(e))
+                return
+            except (TimeoutError, concurrent.futures.TimeoutError):
+                # (One name on 3.11+; two distinct classes before.)
+                fut.cancel()
+                self._error(504,
+                            f"request still pending after {wait:g}s")
+                return
+            except (ServerClosed, WorkerCrashed) as e:
+                self._error(503, f"{type(e).__name__}: {e}",
+                            {"Retry-After": str(RETRY_AFTER_SHED)})
+                return
+            except Exception as e:
+                self._error(500, f"{type(e).__name__}: {e}")
+                return
+            fe.registry.histogram("request_latency_seconds").observe(
+                time.perf_counter() - t0
+            )
+            self._respond(
+                200, np.ascontiguousarray(out).tobytes(),
+                content_type="application/octet-stream",
+                headers={
+                    "X-Width": str(w), "X-Height": str(h),
+                    "X-Channels": str(channels), "X-Reps": str(reps),
+                    "X-Replica": str(idx),
+                },
+            )
+
+
+class NetFrontend:
+    """The whole network tier: fleet + router + threaded HTTP server.
+
+    >>> fe = NetFrontend(NetConfig(port=0, replicas=2)).start()
+    >>> ...  # POST frames at fe.url
+    >>> fe.drain(); fe.close()
+    """
+
+    def __init__(self, cfg: NetConfig,
+                 start_workers: bool = True) -> None:
+        self.cfg = cfg
+        self.registry = Registry()
+        # Pre-create the latency histogram (otherwise born lazily on
+        # the first 200): a scrape/statusz of a tier that has served
+        # only errors must still carry the key the loadgen report and
+        # dashboards read.
+        self.registry.histogram("request_latency_seconds")
+        self.fleet = ReplicaFleet(cfg, registry=self.registry,
+                                  start_workers=start_workers)
+        self.router: Optional[Router] = None
+        self._httpd: Optional[_NetHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self._drain_report: Optional[Dict[int, bool]] = None
+        self._t_start = time.monotonic()
+
+    # -- lifecycle -----------------------------------------------------
+
+    def start(self) -> "NetFrontend":
+        self.fleet.start()
+        self.router = Router(
+            self.fleet, self.registry,
+            max_inflight_bytes=self.cfg.max_inflight_bytes,
+        )
+        self._httpd = _NetHTTPServer((self.cfg.host, self.cfg.port), self)
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name="tpu-stencil-net-http", daemon=True,
+        )
+        self._thread.start()
+        return self
+
+    @property
+    def port(self) -> int:
+        assert self._httpd is not None, "not started"
+        return self._httpd.server_address[1]
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.cfg.host}:{self.port}"
+
+    def begin_drain(self) -> None:
+        """Stop admission + flip ``/healthz`` to draining (idempotent);
+        the listener keeps answering so in-flight requests respond and
+        probes observe the flip."""
+        assert self.router is not None, "not started"
+        self.router.begin_drain()
+
+    def drain(self, timeout_s: Optional[float] = None) -> Dict[int, bool]:
+        """The SIGTERM sequence minus the process exit: stop admission,
+        close every replica under the budget, report per replica
+        drained-vs-abandoned. The HTTP listener stays up (``close()``
+        stops it) so every accepted request gets its response."""
+        self.begin_drain()
+        report = self.fleet.drain(timeout_s)
+        self._drain_report = report
+        return report
+
+    def close(self) -> None:
+        """Stop the listener (drains first if nobody did)."""
+        if self.router is not None and not self.router.draining:
+            self.drain()
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+            self._thread = None
+
+    def __enter__(self) -> "NetFrontend":
+        if self._httpd is None:
+            self.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- scrape surfaces -----------------------------------------------
+
+    def metrics_snapshot(self) -> dict:
+        """The net registry with every replica's counters folded in as
+        ``fleet_<name>`` — ONE snapshot under ONE prefix, so the
+        exposition's exact parse round-trip holds for the whole scrape
+        (per-replica histograms stay on ``/statusz``: reservoir merges
+        are not well-defined, and faking one would lie to dashboards)."""
+        snap = self.registry.snapshot()
+        for k, v in sorted(self.fleet.merged_counters().items()):
+            snap["counters"][f"fleet_{k}"] = v
+        snap["replicas"] = len(self.fleet)
+        return snap
+
+    def render_metrics(self) -> str:
+        from tpu_stencil.obs import exposition
+
+        return exposition.render_text(
+            self.metrics_snapshot(), prefix="tpu_stencil_net"
+        )
+
+    def statusz(self) -> dict:
+        assert self.router is not None, "not started"
+        return {
+            "schema_version": STATUS_SCHEMA_VERSION,
+            "ts": time.monotonic(),
+            "uptime_s": time.monotonic() - self._t_start,
+            "draining": self.router.draining,
+            "replicas": len(self.fleet),
+            "outstanding": {
+                str(k): v for k, v in self.router.outstanding().items()
+            },
+            "drain_report": (
+                None if self._drain_report is None
+                else {str(k): v for k, v in self._drain_report.items()}
+            ),
+            # The merged view (net registry + fleet_<name> counter
+            # fold-in): the same snapshot /metrics renders, so a JSON
+            # consumer and a scraper read identical numbers.
+            "net": self.metrics_snapshot(),
+            "per_replica": self.fleet.stats(),
+            "config": {
+                "replicas": self.cfg.replicas,
+                "max_queue": self.cfg.max_queue,
+                "max_batch": self.cfg.max_batch,
+                "max_inflight_mb": self.cfg.max_inflight_mb,
+                "request_timeout_s": self.cfg.request_timeout_s,
+                "drain_timeout_s": self.cfg.drain_timeout_s,
+                "warm_fleet": self.cfg.warm_fleet,
+                "backend": self.cfg.backend,
+                "filter": self.cfg.filter_name,
+            },
+        }

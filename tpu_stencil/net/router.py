@@ -1,0 +1,217 @@
+"""Request routing + admission control over the replica fleet.
+
+Three admission layers, cheapest first, each mapped to a distinct HTTP
+status by the frontend so clients can react correctly:
+
+1. **drain gate** — once the tier is draining (SIGTERM) no new request
+   enters (:class:`Draining` → 503); accepted requests keep completing.
+2. **load shed** — the router tracks in-flight bytes (request +
+   response buffers of every accepted-but-unresolved request); past the
+   ``max_inflight_mb`` watermark a request is shed
+   (:class:`Overloaded` → 503 + Retry-After) BEFORE touching any
+   replica queue — host memory stays bounded even when every queue
+   still has room for small requests.
+3. **per-replica backpressure** — the existing bounded-queue contract:
+   replicas are tried in least-outstanding order and a full queue moves
+   to the next; only when EVERY replica rejects does
+   :class:`~tpu_stencil.serve.engine.QueueFull` escape (→ 429 +
+   Retry-After, counted in ``rejected_total``). Never a hang, never an
+   unbounded buffer.
+
+Placement is **least outstanding requests** (ties break to the lowest
+device index): outstanding per replica is tracked router-side via
+future done-callbacks, so a replica stuck on a cold compile naturally
+stops receiving traffic while its siblings absorb the load — and the
+fleet's shared cache warming (:meth:`ReplicaFleet.prewarm_others`)
+fires on first sight of a new executable key, right after placement.
+
+A replica that answers ``WorkerCrashed`` is restarted in place through
+:meth:`ReplicaFleet.restart` (the PR-7 ladder's degrade-don't-die
+rung at fleet scope, ``worker_crash_reroutes_total``) and the request
+retries on the fresh engine — one crashed worker costs one rebuild,
+not an outage.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from tpu_stencil.net.fleet import ReplicaFleet
+from tpu_stencil.obs import span as _obs_span
+from tpu_stencil.resilience.errors import WorkerCrashed
+from tpu_stencil.serve.engine import QueueFull, ServerClosed
+from tpu_stencil.serve.metrics import Registry
+
+
+class Overloaded(RuntimeError):
+    """Load shed: admitting this request would push tracked in-flight
+    bytes past the watermark. Transient — retry after the backlog
+    drains (the frontend answers 503 + Retry-After)."""
+
+
+class Draining(RuntimeError):
+    """Admission is stopped: the tier is draining (SIGTERM). Accepted
+    requests keep completing; new ones go to another instance."""
+
+
+class Router:
+    """Least-outstanding placement + the three admission layers."""
+
+    def __init__(self, fleet: ReplicaFleet, registry: Registry,
+                 max_inflight_bytes: int = 0) -> None:
+        self._fleet = fleet
+        self.registry = registry
+        self._lock = threading.Lock()
+        self._outstanding: Dict[int, int] = {
+            i: 0 for i in range(len(fleet))
+        }
+        self._inflight_bytes = 0
+        self._max_inflight = int(max_inflight_bytes)
+        self._draining = False
+        m = registry
+        self._m_requests = m.counter("requests_total")
+        self._m_rejected = m.counter("rejected_total")
+        self._m_shed = m.counter("shed_total")
+        self._m_crash = m.counter("worker_crash_reroutes_total")
+        self._m_inflight = m.gauge("inflight_bytes")
+        self._m_bytes = m.histogram("request_bytes")
+        m.gauge("draining").set(0)
+        for i in self._outstanding:
+            m.gauge(f"replica_depth_dev{i}").set(0)
+
+    # -- drain gate ----------------------------------------------------
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def begin_drain(self) -> None:
+        """Flip the admission gate (idempotent): every subsequent
+        submit raises :class:`Draining`; in-flight requests are
+        untouched. The ``draining`` gauge makes the flip scrapeable."""
+        with self._lock:
+            self._draining = True
+        self.registry.gauge("draining").set(1)
+
+    # -- placement -----------------------------------------------------
+
+    def outstanding(self) -> Dict[int, int]:
+        with self._lock:
+            return dict(self._outstanding)
+
+    def submit(self, image: np.ndarray, reps: int,
+               filter_name: Optional[str] = None,
+               deadline_s: Optional[float] = None) -> Tuple[object, int]:
+        """Admit + place one request; returns ``(future, replica_idx)``.
+        Raises :class:`Draining` / :class:`Overloaded` /
+        :class:`QueueFull` (all replicas full) / ``ValueError``
+        (validation, from the replica) — each mapped to its own status
+        code by the HTTP frontend."""
+        image = np.asarray(image)
+        # Request + response buffers both live for the request's
+        # lifetime — the honest in-flight footprint is 2x the frame.
+        nbytes = 2 * int(image.nbytes)
+        with _obs_span("net.route", "net", bytes=int(image.nbytes)):
+            with self._lock:
+                if self._draining:
+                    raise Draining(
+                        "draining: admission stopped; retry against "
+                        "another instance"
+                    )
+                if (self._max_inflight
+                        and self._inflight_bytes + nbytes
+                        > self._max_inflight):
+                    self._m_shed.inc()
+                    raise Overloaded(
+                        f"shedding: {self._inflight_bytes + nbytes} "
+                        f"in-flight bytes would exceed the "
+                        f"{self._max_inflight} watermark; retry later"
+                    )
+                # Reserve under the SAME lock as the watermark check:
+                # concurrent admits each see the others' reservation, so
+                # the bound holds under load. Released below if no
+                # replica accepts the request.
+                self._inflight_bytes += nbytes
+                order = sorted(
+                    self._outstanding,
+                    key=lambda i: (self._outstanding[i], i),
+                )
+            admitted = False
+            try:
+                last_exc: Optional[BaseException] = None
+                for idx in order:
+                    rep = self._fleet.replicas[idx]
+                    try:
+                        fut = rep.submit(image, reps, filter_name,
+                                         deadline_s=deadline_s)
+                    except (QueueFull, ServerClosed) as e:
+                        # ServerClosed: the replica is mid-restart
+                        # (fleet.restart drains the old engine before
+                        # swapping in the new one) — try a sibling.
+                        last_exc = e
+                        continue
+                    except WorkerCrashed:
+                        # Dead engine: rebuild it on the same device and
+                        # retry THIS request on the fresh replica (its
+                        # queue is empty — the best placement there is).
+                        self._m_crash.inc()
+                        try:
+                            self._fleet.restart(idx, timeout_s=1.0,
+                                                expect=rep)
+                            fut = self._fleet.replicas[idx].submit(
+                                image, reps, filter_name,
+                                deadline_s=deadline_s,
+                            )
+                        except Exception as e:
+                            last_exc = e
+                            continue
+                    self._track(idx, fut, nbytes)
+                    # Once tracked, the done callback owns the release
+                    # — nothing below may fail the accepted request (or
+                    # the finally would double-release the bytes).
+                    admitted = True
+                    try:
+                        self._fleet.prewarm_others(
+                            idx, image, reps, filter_name
+                        )
+                    except Exception:
+                        pass  # warming is best-effort
+                    return fut, idx
+                self._m_rejected.inc()
+                if isinstance(last_exc, QueueFull):
+                    raise last_exc
+                raise QueueFull(
+                    f"all {len(self._fleet)} replica queues at capacity"
+                ) from last_exc
+            finally:
+                if not admitted:
+                    with self._lock:
+                        self._inflight_bytes -= nbytes
+                        inflight = self._inflight_bytes
+                    self._m_inflight.set(inflight)
+
+    def _track(self, idx: int, fut, nbytes: int) -> None:
+        # nbytes was already reserved into _inflight_bytes at admission
+        # (under the watermark-check lock); this only tracks placement.
+        self._m_requests.inc()
+        self._m_bytes.observe(nbytes // 2)  # the true request bytes
+        with self._lock:
+            self._outstanding[idx] += 1
+            depth = self._outstanding[idx]
+            inflight = self._inflight_bytes
+        self.registry.gauge(f"replica_depth_dev{idx}").set(depth)
+        self._m_inflight.set(inflight)
+
+        def _done(_fut) -> None:
+            with self._lock:
+                self._outstanding[idx] -= 1
+                self._inflight_bytes -= nbytes
+                depth = self._outstanding[idx]
+                inflight = self._inflight_bytes
+            self.registry.gauge(f"replica_depth_dev{idx}").set(depth)
+            self._m_inflight.set(inflight)
+
+        fut.add_done_callback(_done)

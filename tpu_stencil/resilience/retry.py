@@ -73,8 +73,12 @@ _PERMANENT_ERRNOS = frozenset(
 )
 # Backpressure/overload signals classified by type NAME: the classes
 # live in tpu_stencil.serve.engine, which imports this package — naming
-# them here by string keeps the dependency one-way.
+# them here by string keeps the dependency one-way. A closed/draining
+# server never reopens for this process, so re-offering is futile —
+# the documented submit_retrying contract ("ServerClosed raises
+# immediately") lives here.
 _TRANSIENT_TYPE_NAMES = frozenset({"QueueFull"})
+_PERMANENT_TYPE_NAMES = frozenset({"ServerClosed", "Draining"})
 
 
 def classify(exc: BaseException) -> str:
@@ -86,6 +90,8 @@ def classify(exc: BaseException) -> str:
         return TRANSIENT
     if type(exc).__name__ in _TRANSIENT_TYPE_NAMES:
         return TRANSIENT
+    if type(exc).__name__ in _PERMANENT_TYPE_NAMES:
+        return PERMANENT
     msg = str(exc)
     if any(tok in msg for tok in _PERMANENT_TOKENS):
         return PERMANENT
@@ -183,3 +189,44 @@ def retry_call(
                           error=type(e).__name__):
                 time.sleep(policy.delay(attempt))
     raise last  # unreachable (the loop always returns or raises)
+
+
+def reoffer_call(
+    fn: Callable,
+    policy: Optional[RetryPolicy] = None,
+    give_up_after_s: Optional[float] = 300.0,
+    base_delay: float = 0.001,
+    max_delay: float = 0.05,
+    label: str = "reoffer",
+):
+    """:func:`retry_call` under the closed-loop RE-OFFER contract the
+    serving clients share (in-process ``StencilServer.submit_retrying``
+    and the HTTP ``loadgen.HttpTarget``): transient backpressure
+    (``QueueFull``) backs off and re-offers with an effectively
+    unbounded attempt budget, bounded instead by the wall-clock
+    ``give_up_after_s`` — past it the next re-offer raises
+    ``TimeoutError('gave up re-offering ...')``. Permanent errors
+    (validation, expired deadlines) raise immediately as always."""
+    from tpu_stencil.resilience import deadline as _deadline_mod
+
+    budget = (
+        _deadline_mod.Deadline.after(give_up_after_s)
+        if give_up_after_s else None
+    )
+
+    def on_retry(_attempt: int, exc: BaseException) -> None:
+        if budget is not None and budget.expired():
+            raise TimeoutError(
+                f"gave up re-offering after {give_up_after_s}s of "
+                f"backpressure"
+            ) from exc
+
+    return retry_call(
+        fn,
+        policy=policy or RetryPolicy(
+            attempts=1_000_000, base_delay=base_delay, multiplier=1.0,
+            max_delay=max_delay, jitter=0.5,
+        ),
+        on_retry=on_retry,
+        label=label,
+    )

@@ -34,6 +34,16 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--self-test", action="store_true",
                    help="run the deterministic correctness/backpressure "
                         "smoke test and exit (0 = OK)")
+    p.add_argument("--http", default=None, metavar="URL",
+                   help="drive a NETWORK tier (python -m tpu_stencil "
+                        "net) at URL instead of an in-process server: "
+                        "the same closed/open load models (incl. "
+                        "--rate-fps) POST raw frames at /v1/blur and "
+                        "the report reads the tier's own /statusz "
+                        "registry — identical schema, remote target "
+                        "(docs/SERVING.md 'Network tier'). Engine flags "
+                        "(--max-queue/--max-batch/--overlap/...) are "
+                        "ignored: the tier's own CLI owns them")
     p.add_argument("--mode", default="closed", choices=["closed", "open"],
                    help="load model: closed (submit-and-wait workers) or "
                         "open (fixed-rate arrivals; overload rejects)")
@@ -257,26 +267,38 @@ def main(argv=None) -> int:
             raise ValueError
     except ValueError:
         parser.error(f"--channels must be 1 and/or 3, got {ns.channels!r}")
-    try:
-        cfg = ServeConfig(
-            filter_name=ns.filter_name, backend=ns.backend,
-            max_queue=ns.max_queue, max_batch=ns.max_batch,
-            overlap=ns.overlap,
-            shard_min_pixels=ns.shard_min_pixels,
-            request_timeout_s=ns.request_timeout_s,
-        )
-    except ValueError as e:
-        parser.error(str(e))
+    if not ns.http:
+        try:
+            cfg = ServeConfig(
+                filter_name=ns.filter_name, backend=ns.backend,
+                max_queue=ns.max_queue, max_batch=ns.max_batch,
+                overlap=ns.overlap,
+                shard_min_pixels=ns.shard_min_pixels,
+                request_timeout_s=ns.request_timeout_s,
+            )
+        except ValueError as e:
+            parser.error(str(e))
     try:
         if ns.rate_fps is not None and not ns.rate_fps > 0:
             parser.error(f"--rate-fps must be > 0, got {ns.rate_fps}")
-        with StencilServer(cfg) as server:
-            report = loadgen.run(
-                server, mode=ns.mode, requests=ns.requests,
-                concurrency=ns.concurrency, rate=ns.rate, reps=ns.reps,
-                shapes=shapes, channels=channels, seed=ns.seed,
-                rate_fps=ns.rate_fps,
-            )
+        loadgen_kwargs = dict(
+            mode=ns.mode, requests=ns.requests,
+            concurrency=ns.concurrency, rate=ns.rate, reps=ns.reps,
+            shapes=shapes, channels=channels, seed=ns.seed,
+            rate_fps=ns.rate_fps,
+        )
+        if ns.http:
+            # The network-tier target: same loops, same report schema,
+            # remote fleet. No in-process server (and no jax import)
+            # on this path — the tier owns the engines.
+            target = loadgen.HttpTarget(ns.http)
+            try:
+                report = loadgen.run(target, **loadgen_kwargs)
+            finally:
+                target.close()
+        else:
+            with StencilServer(cfg) as server:
+                report = loadgen.run(server, **loadgen_kwargs)
         if ns.trace:
             _export_trace(ns.trace)
     finally:
@@ -288,21 +310,34 @@ def main(argv=None) -> int:
     if ns.metrics_text:
         from tpu_stencil.obs import exposition
 
-        exposition.write_text(ns.metrics_text, report["stats"],
-                              prefix="tpu_stencil_serve")
+        exposition.write_text(
+            ns.metrics_text, report["stats"],
+            prefix="tpu_stencil_net" if ns.http else "tpu_stencil_serve",
+        )
     c = report["stats"]["counters"]
     print(
         f"served {report['completed']}/{report['requests']} requests "
         f"in {report['wall_seconds']:.3f}s "
-        f"({report['throughput_rps']:.1f} req/s, {report['mode']}-loop)"
+        f"({report['throughput_rps']:.1f} req/s, {report['mode']}-loop"
+        f"{', http' if ns.http else ''})"
     )
-    print(
-        f"latency p50={report['p50_s'] * 1e3:.2f}ms "
-        f"p99={report['p99_s'] * 1e3:.2f}ms; "
-        f"rejected={report['rejected']} batches={c['batches_total']} "
-        f"cache={c['cache_hits_total']}h/{c['cache_misses_total']}m "
-        f"padded_waste={c['padded_pixels_total']}px"
-    )
+    if ns.http:
+        print(
+            f"latency p50={report['p50_s'] * 1e3:.2f}ms "
+            f"p99={report['p99_s'] * 1e3:.2f}ms; "
+            f"rejected={report['rejected']} "
+            f"shed={c.get('shed_total', 0)} "
+            f"fleet_batches={c.get('fleet_batches_total', 0)} "
+            f"warm={c.get('warm_submits_total', 0)}"
+        )
+    else:
+        print(
+            f"latency p50={report['p50_s'] * 1e3:.2f}ms "
+            f"p99={report['p99_s'] * 1e3:.2f}ms; "
+            f"rejected={report['rejected']} batches={c['batches_total']} "
+            f"cache={c['cache_hits_total']}h/{c['cache_misses_total']}m "
+            f"padded_waste={c['padded_pixels_total']}px"
+        )
     if "requested_fps" in report:
         print(
             f"frame rate: requested {report['requested_fps']:.2f} fps, "
@@ -328,7 +363,11 @@ def main(argv=None) -> int:
             load = f"fps{ns.rate_fps:g}"
         else:
             load = f"rate{ns.rate:g}"
-        metric = f"serve.p50_s.{ran_mode}.{load}.reps{ns.reps}"
+        # The network tier measures HTTP+routing on top of the engine,
+        # so its p50 is its own sentry series — never compared against
+        # the in-process numbers as a false regression.
+        tier = ".net" if ns.http else ""
+        metric = f"serve.p50_s.{ran_mode}.{load}.reps{ns.reps}{tier}"
         if report["p50_s"] > 0:
             rec = sentry.make_record(
                 metric=metric, value=report["p50_s"],

@@ -387,7 +387,18 @@ class StencilServer:
     def start(self) -> None:
         """Start the worker loop (idempotent). ``start=False`` at
         construction lets tests exercise backpressure with a parked
-        queue."""
+        queue. A pinned ``device_index`` is range-checked HERE (jax in
+        hand), so a bad index is an immediate ValueError instead of a
+        WorkerCrashed on the first batch."""
+        if self.cfg.device_index is not None:
+            import jax
+
+            n = len(jax.local_devices())
+            if self.cfg.device_index >= n:
+                raise ValueError(
+                    f"device_index {self.cfg.device_index} out of "
+                    f"range: {n} local device(s)"
+                )
         if self._worker is None or not self._worker.is_alive():
             self._worker = threading.Thread(
                 target=self._worker_loop, name="tpu-stencil-serve",
@@ -395,24 +406,43 @@ class StencilServer:
             )
             self._worker.start()
 
-    def close(self, timeout: Optional[float] = None) -> None:
-        """Stop accepting work, drain the queue, join the worker."""
+    def close(self, timeout: Optional[float] = None) -> bool:
+        """Stop accepting work, drain the queue, join the worker.
+
+        Returns True when the server drained (the worker joined, or
+        there was no live worker to join) and False when the join timed
+        out and the worker was ABANDONED still running — counted in
+        ``serve_close_abandoned_total`` so a fleet drain can report
+        WHICH replica hung instead of silently returning. An abandoned
+        worker keeps draining in the background (daemon thread); what
+        the bool buys the caller is a truthful drain report within its
+        deadline, never a hang."""
         with self._cond:
             self._closing = True
             self._cond.notify_all()
+        drained = True
         if self._worker is not None and self._worker.is_alive():
             self._worker.join(timeout)
+            if self._worker.is_alive():
+                drained = False
+                self.registry.counter("serve_close_abandoned_total").inc()
         self._memsampler.stop()
-        # No live worker to drain (never started, join timed out, or the
-        # worker already exited): a queued future must never hang — fail
-        # it with the same error a post-close submit gets.
-        with self._lock:
-            leftovers = list(self._pending)
-            self._pending.clear()
-            self._m_depth.set(0)
-        for r in leftovers:
-            if not r.future.done():
-                _resolve(r.future, exc=ServerClosed("server closed"))
+        # No live worker to drain (never started, or already exited): a
+        # queued future must never hang — fail it with the same error a
+        # post-close submit gets. An ABANDONED worker (join timed out,
+        # still running) keeps ownership of the queue: it is still
+        # draining, and failing its pending requests out from under it
+        # here would turn a slow drain into spurious ServerClosed
+        # errors for requests that were about to complete.
+        if drained:
+            with self._lock:
+                leftovers = list(self._pending)
+                self._pending.clear()
+                self._m_depth.set(0)
+            for r in leftovers:
+                if not r.future.done():
+                    _resolve(r.future, exc=ServerClosed("server closed"))
+        return drained
 
     def __enter__(self) -> "StencilServer":
         return self
@@ -518,28 +548,10 @@ class StencilServer:
         closed-loop client shape loadgen uses. ``give_up_after_s``
         bounds the total retry window regardless of the policy's
         attempt budget."""
-        from tpu_stencil.resilience import deadline as _deadline_mod
-
-        budget = (
-            _deadline_mod.Deadline.after(give_up_after_s)
-            if give_up_after_s else None
-        )
-
-        def on_retry(_attempt: int, exc: BaseException) -> None:
-            if budget is not None and budget.expired():
-                raise TimeoutError(
-                    f"gave up re-offering after {give_up_after_s}s of "
-                    f"backpressure"
-                ) from exc
-
-        return _retry.retry_call(
+        return _retry.reoffer_call(
             lambda: self.submit(image, reps, filter_name,
                                 deadline_s=deadline_s),
-            policy=policy or _retry.RetryPolicy(
-                attempts=1_000_000, base_delay=0.001, multiplier=1.0,
-                max_delay=0.05, jitter=0.5,
-            ),
-            on_retry=on_retry,
+            policy=policy, give_up_after_s=give_up_after_s,
             label="serve.submit",
         )
 
@@ -688,16 +700,17 @@ class StencilServer:
         return None if runner is self._SHARDED_UNSERVABLE else runner
 
     def _account_devices(self, n_devices: int, total_bytes: int,
-                         n_requests: int) -> None:
+                         n_requests: int, first: int = 0) -> None:
         """Per-device admission accounting: every dispatch charges each
         device it lands on — ``device_requests_total_dev<i>`` (a
         sharded request occupies every mesh device; a bucket batch
-        occupies device 0) and ``device_bytes_dispatched_total_dev<i>``
-        (its share of the dispatched bytes) — so a dashboard sees how
-        admission spreads load across the mesh, not just an aggregate
-        that hides an idle fan."""
+        occupies its pinned device — ``cfg.device_index``, else device
+        0) and ``device_bytes_dispatched_total_dev<i>`` (its share of
+        the dispatched bytes) — so a dashboard sees how admission
+        spreads load across the mesh, not just an aggregate that hides
+        an idle fan."""
         per = total_bytes // max(1, n_devices)
-        for i in range(n_devices):
+        for i in range(first, first + n_devices):
             self.registry.counter(
                 f"device_requests_total_dev{i}"
             ).inc(n_requests)
@@ -769,7 +782,6 @@ class StencilServer:
 
     def _dispatch_inner(self, batch: List[Request]):
         import jax
-        import jax.numpy as jnp
 
         bh, bw = batch[0].bucket_hw
         channels = (
@@ -788,9 +800,11 @@ class StencilServer:
         self._m_padded.inc(bucketing.waste_pixels(true_shapes, (bh, bw), nb))
         self._m_real.inc(sum(h * w for h, w in true_shapes))
         # Bucket batches run single-device: the whole canvas lands on
-        # device 0 (same per-device accounting the sharded path spreads
-        # across its mesh).
-        self._account_devices(1, int(canvas.nbytes), len(batch))
+        # the pinned device (cfg.device_index; default device 0) —
+        # same per-device accounting the sharded path spreads across
+        # its mesh, so a fleet's replicas charge their own chips.
+        self._account_devices(1, int(canvas.nbytes), len(batch),
+                              first=self.cfg.device_index or 0)
 
         model = self._model_for(batch[0].filter_name)
         backend, _sched = model.resolved_config((bh, bw), channels)
@@ -818,9 +832,25 @@ class StencilServer:
             self._fault_compute()
         # Explicit transfer, then launch: under async dispatch both return
         # immediately, so the NEXT batch's host-side assembly (and its
-        # transfer) overlaps this batch's device compute.
-        canvas_dev = jax.device_put(jnp.asarray(canvas))
-        vh_dev, vw_dev = jnp.asarray(vh), jnp.asarray(vw)
+        # transfer) overlaps this batch's device compute. With a pinned
+        # device (cfg.device_index — the replica-fleet contract) every
+        # input is committed there, so the donated launch runs on that
+        # chip; N replicas on N devices then compute truly in parallel.
+        pin = None
+        if self.cfg.device_index is not None:
+            devices = jax.local_devices()
+            if self.cfg.device_index >= len(devices):
+                raise ValueError(
+                    f"device_index {self.cfg.device_index} out of range: "
+                    f"{len(devices)} local device(s)"
+                )
+            pin = devices[self.cfg.device_index]
+        # device_put takes the numpy arrays directly: host -> pin in one
+        # transfer (a jnp.asarray first would stage the canvas through
+        # the DEFAULT device, serializing every replica on device 0).
+        canvas_dev = jax.device_put(canvas, pin)
+        vh_dev = jax.device_put(vh, pin)
+        vw_dev = jax.device_put(vw, pin)
         if (_introspect.enabled() and exe_key not in self._introspected
                 and len(self._introspected) < _INTROSPECT_KEY_CAP):
             # One AOT capture per cache entry (cost/memory analysis,

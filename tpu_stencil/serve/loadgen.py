@@ -26,15 +26,143 @@ is reproducible on CPU in tier-1 and on TPU via bench_sweep.
 
 from __future__ import annotations
 
+import concurrent.futures
 import threading
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from tpu_stencil.serve.engine import QueueFull, StencilServer
+from tpu_stencil.serve.engine import (
+    QueueFull,
+    ServerClosed,
+    StencilServer,
+)
 
 DEFAULT_SHAPES: Tuple[Tuple[int, int], ...] = ((48, 36), (64, 48), (30, 50))
+
+
+class HttpTarget:
+    """Duck-typed :class:`StencilServer` stand-in that drives the
+    NETWORK tier (``python -m tpu_stencil net``) over ``POST /v1/blur``
+    — the same closed/open loops, ``--rate-fps`` arrival law, and
+    report schema measure a remote fleet instead of an in-process
+    engine (``--http URL`` on the serve CLI).
+
+    The status-code mapping inverts the frontend's: 429 (and a
+    shedding 503) raise :class:`QueueFull` — transient backpressure
+    the loops already know how to retry or shed — a draining 503
+    raises :class:`ServerClosed` (permanent for that process: the
+    drain gate never reopens, so re-offering is futile), and 504
+    raises a typed ``DeadlineExceeded``. ``stats()`` scrapes
+    ``/statusz`` and returns the tier's net-registry snapshot, whose
+    ``rejected_total`` counter and ``request_latency_seconds``
+    histogram are exactly the keys the report reads — so an HTTP
+    report, like an in-process one, shows what an operator would
+    scrape, not client-side guesses."""
+
+    def __init__(self, url: str, max_workers: int = 32,
+                 timeout_s: float = 300.0) -> None:
+        self.url = url.rstrip("/")
+        self._timeout = timeout_s
+        self._pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=max_workers,
+            thread_name_prefix="tpu-stencil-httpgen",
+        )
+
+    def _post(self, image: np.ndarray, reps: int,
+              filter_name: Optional[str],
+              deadline_s: Optional[float]) -> np.ndarray:
+        import urllib.error
+        import urllib.request
+
+        from tpu_stencil.resilience.errors import DeadlineExceeded
+
+        h, w = image.shape[:2]
+        channels = image.shape[2] if image.ndim == 3 else 1
+        headers = {
+            "X-Width": str(w), "X-Height": str(h),
+            "X-Reps": str(reps), "X-Channels": str(channels),
+            "Content-Type": "application/octet-stream",
+        }
+        if filter_name:
+            headers["X-Filter"] = filter_name
+        if deadline_s:
+            headers["X-Request-Timeout"] = repr(float(deadline_s))
+        req = urllib.request.Request(
+            self.url + "/v1/blur", data=image.tobytes(),
+            headers=headers, method="POST",
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self._timeout) as r:
+                body = r.read()
+        except urllib.error.HTTPError as e:
+            detail = e.read().decode(errors="replace").strip()
+            if e.code == 503 and "draining" in detail:
+                # The drain gate is one-way for that process: re-offering
+                # is futile, unlike a shed 503 that clears with the
+                # backlog. ServerClosed classifies PERMANENT — fail fast,
+                # same as the in-process spelling.
+                raise ServerClosed(f"HTTP 503: {detail}") from None
+            if e.code in (429, 503):
+                raise QueueFull(f"HTTP {e.code}: {detail}") from None
+            if e.code == 504:
+                raise DeadlineExceeded(f"HTTP 504: {detail}") from None
+            # Anything else (400/404/413/500...) is deterministic: the
+            # same request fails the same way, so raise the type the
+            # retry classifier treats as PERMANENT — the closed loop
+            # must fail fast, not re-offer for the give-up budget.
+            raise ValueError(f"HTTP {e.code}: {detail}") from None
+        return np.frombuffer(body, np.uint8).reshape(image.shape)
+
+    def submit(self, image: np.ndarray, reps: int,
+               filter_name: Optional[str] = None,
+               deadline_s: Optional[float] = None,
+               ) -> "concurrent.futures.Future":
+        """Async POST. Unlike the in-process engine, backpressure
+        cannot raise synchronously (the 429 arrives with the response),
+        so :class:`QueueFull` surfaces from ``future.result()`` — the
+        open loop treats both spellings as a shed."""
+        img = np.array(image, copy=True)  # same buffer-reuse contract
+        return self._pool.submit(self._post, img, reps, filter_name,
+                                 deadline_s)
+
+    def submit_retrying(self, image: np.ndarray, reps: int,
+                        filter_name: Optional[str] = None,
+                        deadline_s: Optional[float] = None,
+                        policy=None,
+                        give_up_after_s: Optional[float] = 300.0,
+                        ) -> "concurrent.futures.Future":
+        """:meth:`submit` re-offering on backpressure under the shared
+        resilience retry policy — the closed-loop client shape, same
+        contract as :meth:`StencilServer.submit_retrying` (same
+        ``reoffer_call`` scaffolding; only the delays differ — an HTTP
+        round-trip per offer deserves a longer backoff)."""
+        from tpu_stencil.resilience import retry as _retry
+
+        img = np.array(image, copy=True)
+
+        def task() -> np.ndarray:
+            return _retry.reoffer_call(
+                lambda: self._post(img, reps, filter_name, deadline_s),
+                policy=policy, give_up_after_s=give_up_after_s,
+                base_delay=0.005, max_delay=0.1,
+                label="net.submit",
+            )
+
+        return self._pool.submit(task)
+
+    def stats(self) -> dict:
+        """The tier's net-registry snapshot, scraped from /statusz."""
+        import json as _json
+        import urllib.request
+
+        with urllib.request.urlopen(self.url + "/statusz",
+                                    timeout=self._timeout) as r:
+            return _json.loads(r.read())["net"]
+
+    def close(self) -> None:
+        self._pool.shutdown(wait=False)
 
 
 def synth_requests(
@@ -66,6 +194,11 @@ def run(
     rate_fps: Optional[float] = None,
 ) -> Dict:
     """Drive ``server`` with synthetic load; return the report dict.
+
+    ``server`` is a :class:`StencilServer` or any duck-typed stand-in
+    with ``submit``/``submit_retrying``/``stats`` — in particular
+    :class:`HttpTarget`, which points the same loops at the network
+    tier (``--http URL``) with the same report schema.
 
     Report keys: ``mode``, ``requests``, ``completed``, ``rejected``,
     ``wall_seconds``, ``throughput_rps``, ``p50_s``, ``p99_s`` (request
@@ -155,13 +288,28 @@ def run(
                 pass  # counted by the server; open loops shed, not wait
         offer_wall = time.perf_counter() - t_start
         deadline = time.perf_counter() + timeout
+        shed_in_flight = 0
         for f in futures:
-            f.result(timeout=max(0.0, deadline - time.perf_counter()))
-        completed = len(futures)
+            try:
+                f.result(timeout=max(0.0, deadline - time.perf_counter()))
+            except (QueueFull, ServerClosed):
+                # The HTTP target's backpressure arrives WITH the
+                # response (a 429/503 resolved into the future), not
+                # synchronously at submit — same shed, later spelling.
+                # ServerClosed is the draining tier's 503: the open
+                # loop measures the rejection, it does not abort.
+                # In-process futures never resolve to either, so this
+                # is a no-op for the classic path.
+                shed_in_flight += 1
+        completed = len(futures) - shed_in_flight
 
     wall = time.perf_counter() - t_start
     stats = server.stats()
-    rlat = stats["histograms"]["request_latency_seconds"]
+    # Absent only against a tier that served zero requests (every one
+    # shed/rejected): report zeros, don't crash the overload report.
+    rlat = stats["histograms"].get(
+        "request_latency_seconds", {"p50": 0.0, "p99": 0.0}
+    )
     report = {
         "mode": mode,
         "requests": requests,
