@@ -82,6 +82,16 @@ engine + response), with replica count, achieved req/s and response
 class counts as riders (``TPU_STENCIL_BENCH_NET_REQUESTS`` /
 ``_NET_REPLICAS`` / ``_NET_CONCURRENCY`` tune the run).
 
+Federation mode: ``TPU_STENCIL_BENCH_FED=N`` spawns N member hosts as
+real ``tpu_stencil net`` subprocesses (CPU members by default — N
+accelerator-locked processes cannot share one chip;
+``TPU_STENCIL_BENCH_FED_MEMBER_PLATFORM`` overrides), federates them
+behind an in-process front router (``tpu_stencil.fed``), and emits a
+versioned ``..._fed<N>_wall_per_request`` headline with a
+``weak_scaling_vs_linear`` rider against a same-load 1-host run — the
+arxiv 2605.07954 >=0.8x-linear acceptance bar one hop above meshfan
+(``TPU_STENCIL_BENCH_FED_REQUESTS`` tunes the run).
+
 Exit codes: 0 = capture landed (even partial-only); 1 = nothing
 parseable; 2 = the requested backend is unavailable (init failed — the
 parent does NOT retry: a 4-attempt backoff loop against a dead backend
@@ -760,6 +770,188 @@ def _measure_net(platform: str) -> dict:
     }
 
 
+def _spawn_fed_member(platform: str, timeout_s: float = 120.0):
+    """Start one ``tpu_stencil net`` member host as a real subprocess
+    and wait (bounded by ``timeout_s``) for its bound-URL line.
+    Returns (proc, url). Output goes to an unlinked temp file, never a
+    PIPE — a member chatty past the pipe buffer mid-run would block on
+    write and stall its own requests inside the timed window."""
+    import tempfile
+
+    # The child inherits a dup of logf's fd; polling must go through a
+    # SEPARATE open (its own file description/offset) — seeking the
+    # shared handle would move the child's write position too.
+    logf = tempfile.NamedTemporaryFile(
+        mode="w", prefix="tpu-stencil-fed-member-", suffix=".log",
+        delete=False,
+    )
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "tpu_stencil", "net", "--port", "0",
+         "--replicas", "1", "--platform", platform,
+         "--drain-timeout", "30"],
+        stdout=logf, stderr=subprocess.STDOUT, text=True,
+        env=dict(os.environ, JAX_PLATFORMS=platform),
+    )
+    try:
+        deadline = time.perf_counter() + timeout_s
+        url = None
+        while url is None and time.perf_counter() < deadline:
+            with open(logf.name) as reader:
+                for line in reader:
+                    if "net: serving on http://" in line:
+                        url = line.split()[3]
+                        break
+            if url is None:
+                if proc.poll() is not None:
+                    break
+                time.sleep(0.2)
+        if url is None:
+            proc.kill()
+            with open(logf.name) as reader:
+                tail = reader.read()[-500:]
+            raise RuntimeError(
+                f"member host failed to start within {timeout_s:g}s "
+                f"(rc={proc.poll()}): {tail!r}"
+            )
+        return proc, url
+    finally:
+        logf.close()  # the child keeps writing to its own dup
+        try:
+            os.unlink(logf.name)
+        except OSError:
+            pass
+
+
+def _measure_fed(platform: str) -> dict:
+    """Federation capture (``TPU_STENCIL_BENCH_FED=N``): N member
+    hosts as REAL ``tpu_stencil net`` subprocesses on this machine,
+    federated behind an in-process front router, north-star frames
+    POSTed through the federation endpoint — the whole two-hop path
+    (fed admission + forward + member edge + engine) measured end to
+    end, emitting a ``..._fed<N>_wall_per_request`` headline.
+
+    Weak-scaling rider (the arxiv 2605.07954 yardstick one hop up,
+    the meshfan bar's sibling): the same load is first run against a
+    1-host federation, and ``weak_scaling_vs_linear`` =
+    throughput(N) / (N x throughput(1)) rides the capture with the
+    >=0.8x acceptance bar — CI fakes hosts as processes on one
+    machine, so the bar is advisory off real hardware but the series
+    is sentry-gated like every headline.
+
+    Knobs: ``TPU_STENCIL_BENCH_FED_REQUESTS`` (default 8),
+    ``TPU_STENCIL_BENCH_FED_MEMBER_PLATFORM`` (default cpu — N
+    accelerator-locked processes cannot share one chip)."""
+    import concurrent.futures
+    import signal as _signal
+    import urllib.request
+
+    from tpu_stencil.config import FedConfig
+    from tpu_stencil.fed.http import FedFrontend
+
+    n_hosts = int(os.environ["TPU_STENCIL_BENCH_FED"])
+    n_req = int(os.environ.get("TPU_STENCIL_BENCH_FED_REQUESTS", "8"))
+    member_platform = os.environ.get(
+        "TPU_STENCIL_BENCH_FED_MEMBER_PLATFORM", "cpu"
+    )
+    rng = np.random.default_rng(0)
+    img = rng.integers(0, 256, size=(H, W, C), dtype=np.uint8)
+    body = img.tobytes()
+
+    def run_federation(k: int):
+        """(wall_seconds, counters) for n_req requests over k hosts."""
+        procs = []
+        try:
+            urls = []
+            for _ in range(k):
+                proc, url = _spawn_fed_member(member_platform)
+                procs.append(proc)
+                urls.append(url)
+            # Warm every member's executable outside the timed window.
+            for url in urls:
+                req = urllib.request.Request(
+                    url + f"/v1/blur?w={W}&h={H}&reps={REPS}"
+                          f"&channels={C}",
+                    data=body, method="POST",
+                )
+                with urllib.request.urlopen(
+                    req, timeout=CHILD_TIMEOUT
+                ) as r:
+                    r.read()
+            fed = FedFrontend(FedConfig(
+                port=0, members=tuple(urls),
+                heartbeat_interval_s=0.5, reoffer_s=1.0,
+            )).start()
+            try:
+                def post():
+                    req = urllib.request.Request(
+                        fed.url + f"/v1/blur?w={W}&h={H}&reps={REPS}"
+                                  f"&channels={C}",
+                        data=body, method="POST",
+                    )
+                    with urllib.request.urlopen(
+                        req, timeout=CHILD_TIMEOUT
+                    ) as r:
+                        r.read()
+
+                post()  # one warm pass through the fed hop itself
+                t0 = time.perf_counter()
+                conc = min(8, 2 * k)
+                with concurrent.futures.ThreadPoolExecutor(conc) as p:
+                    for f in [p.submit(post) for _ in range(n_req)]:
+                        f.result(timeout=CHILD_TIMEOUT)
+                wall = time.perf_counter() - t0
+                counters = fed.registry.snapshot()["counters"]
+            finally:
+                fed.close()
+            return wall, counters
+        finally:
+            for proc in procs:
+                if proc.poll() is None:
+                    proc.send_signal(_signal.SIGTERM)
+            for proc in procs:
+                try:
+                    proc.wait(timeout=60)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+
+    wall_1, counters_1 = run_federation(1)
+    if n_hosts > 1:
+        wall_n, counters = run_federation(n_hosts)
+    else:
+        wall_n, counters = wall_1, counters_1
+    per_req = wall_n / max(1, n_req)
+    rps_1 = n_req / wall_1 if wall_1 > 0 else 0.0
+    rps_n = n_req / wall_n if wall_n > 0 else 0.0
+    weak = rps_n / (n_hosts * rps_1) if rps_1 > 0 else 0.0
+    log(f"fed x{n_hosts} hosts: {per_req * 1e3:.1f} ms/request "
+        f"({n_req} requests through the federation; weak scaling "
+        f"{weak:.2f}x linear vs 1 host, bar 0.80)")
+    return {
+        "metric": f"{W}x{H}_rgb_{REPS}reps_fed{n_hosts}"
+                  f"_wall_per_request",
+        "value": round(per_req, 6),
+        "unit": "s",
+        "vs_baseline": round(BASELINE_S / per_req, 2),
+        "backend": "fed",
+        "platform": platform,
+        "member_platform": member_platform,
+        "hosts": n_hosts,
+        "requests": n_req,
+        "requests_per_second": round(rps_n, 3),
+        "weak_scaling_vs_linear": round(weak, 3),
+        "weak_scaling_bar": 0.8,
+        "weak_scaling_pass": bool(weak >= 0.8),
+        "hedges_total": counters.get("hedges_total", 0),
+        "reroutes_total": counters.get("reroutes_total", 0),
+        "shape": f"{W}x{H}",
+        "reps": REPS,
+        "filter": "gaussian",
+        "dtype": "uint8",
+        "schema_version": 1,
+        "ts": round(time.monotonic(), 6),
+    }
+
+
 def _measure_schedule_headlines(schedules, platform: str) -> list:
     """Per-schedule headline mode (``TPU_STENCIL_BENCH_SCHEDULE=s1,s2``):
     one versioned capture line PER named Pallas schedule, the schedule
@@ -874,6 +1066,15 @@ def child_main() -> int:
             result = _measure_net(platform)
         except Exception as e:
             log(f"net: FAILED {type(e).__name__}: {e}")
+            return 1
+        print(json.dumps(result), flush=True)
+        return 0
+
+    if int(os.environ.get("TPU_STENCIL_BENCH_FED") or 0) > 0:
+        try:
+            result = _measure_fed(platform)
+        except Exception as e:
+            log(f"fed: FAILED {type(e).__name__}: {e}")
             return 1
         print(json.dumps(result), flush=True)
         return 0

@@ -1,0 +1,1109 @@
+"""Federation front-router tier: membership, breakers, hedging,
+quotas, host-loss chaos.
+
+The contract under test is docs/DEPLOY.md "Federation runbook" +
+docs/RESILIENCE.md "Federation verdicts":
+
+* a federated HTTP round-trip is byte-identical to ``driver.run_job``
+  and the NumPy golden model;
+* kill -9 of a member host under concurrent load: every accepted
+  request completes (hedge/reroute) or fails with a typed status —
+  never a hang, never a connection-reset traceback — the breaker
+  opens, the member is evicted, and both are visible in /metrics and
+  /statusz while survivors keep serving;
+* rolling drain of every member in sequence completes all accepted
+  requests with zero drops (member processes exit rc 0, clean);
+* per-tenant quotas reject the hot tenant typed (429 + Retry-After)
+  and leave every other tenant untouched; premium tenants keep
+  headroom past the standard shed watermark;
+* the ``net.accept`` / ``net.body`` chaos sites produce the real
+  socket-level failures (reset, mid-body EOF) the federation's
+  verdict classifier is built for;
+* the loadgen honors Retry-After as the re-offer backoff floor.
+"""
+
+import http.client
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from tpu_stencil import filters
+from tpu_stencil.config import FedConfig, NetConfig
+from tpu_stencil.ops import stencil
+
+pytestmark = pytest.mark.filterwarnings("ignore::ResourceWarning")
+
+EDGES = (8, 16, 32, 64)
+
+
+def _golden(img, reps, name="gaussian"):
+    return stencil.reference_stencil_numpy(img, filters.get_filter(name), reps)
+
+
+def _post(url, img, reps, *, filter_name=None, tenant=None,
+          http_timeout=300.0):
+    """POST one frame; returns (status, body_bytes, headers_dict)."""
+    h, w = img.shape[:2]
+    channels = img.shape[2] if img.ndim == 3 else 1
+    headers = {"X-Width": str(w), "X-Height": str(h),
+               "X-Reps": str(reps), "X-Channels": str(channels)}
+    if filter_name:
+        headers["X-Filter"] = filter_name
+    if tenant:
+        headers["X-Tenant"] = tenant
+    req = urllib.request.Request(url + "/v1/blur", data=img.tobytes(),
+                                 headers=headers, method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=http_timeout) as r:
+            return r.status, r.read(), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, e.read(), dict(e.headers)
+
+
+def _get(url, path, http_timeout=60.0):
+    try:
+        with urllib.request.urlopen(url + path, timeout=http_timeout) as r:
+            return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def _post_admin(url, path, http_timeout=60.0):
+    req = urllib.request.Request(url + path, data=b"", method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=http_timeout) as r:
+            return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def _make_member(**overrides):
+    from tpu_stencil.net import NetFrontend
+
+    kw = dict(port=0, replicas=1, bucket_edges=EDGES, max_queue=64)
+    start_workers = overrides.pop("start_workers", True)
+    kw.update(overrides)
+    return NetFrontend(NetConfig(**kw),
+                       start_workers=start_workers).start()
+
+
+def _make_fed(members, **overrides):
+    from tpu_stencil.fed import FedFrontend
+
+    kw = dict(port=0, members=tuple(m.url for m in members),
+              heartbeat_interval_s=10.0)  # tests drive beats explicitly
+    kw.update(overrides)
+    return FedFrontend(FedConfig(**kw)).start()
+
+
+# -- config / CLI validation -------------------------------------------
+
+
+def test_fedconfig_validation():
+    with pytest.raises(ValueError, match="port"):
+        FedConfig(port=70000)
+    with pytest.raises(ValueError, match="member URL"):
+        FedConfig(members=("localhost:8080",))
+    with pytest.raises(ValueError, match="heartbeat_interval_s"):
+        FedConfig(heartbeat_interval_s=0)
+    with pytest.raises(ValueError, match="suspect_after"):
+        FedConfig(suspect_after=0)
+    with pytest.raises(ValueError, match="evict_after"):
+        FedConfig(suspect_after=3, evict_after=2)
+    with pytest.raises(ValueError, match="breaker_threshold"):
+        FedConfig(breaker_threshold=0)
+    with pytest.raises(ValueError, match="breaker_cooldown_s"):
+        FedConfig(breaker_cooldown_s=0)
+    with pytest.raises(ValueError, match="forward_timeout_s"):
+        FedConfig(forward_timeout_s=0)
+    with pytest.raises(ValueError, match="reoffer_s"):
+        FedConfig(reoffer_s=-1)
+    with pytest.raises(ValueError, match="tenant_quota"):
+        FedConfig(tenant_quota=0)
+    with pytest.raises(ValueError, match="premium_quota_factor"):
+        FedConfig(premium_quota_factor=0)
+    with pytest.raises(ValueError, match="drain_timeout_s"):
+        FedConfig(drain_timeout_s=0)
+    cfg = FedConfig(members=("http://h1:1", "http://h2:2"),
+                    max_inflight_mb=1.5)
+    assert cfg.max_inflight_bytes == 3 << 19
+    assert cfg.members == ("http://h1:1", "http://h2:2")
+
+
+def test_fed_cli_rejects_bad_flags():
+    from tpu_stencil.fed import cli as fed_cli
+
+    for argv in (["--port", "70000"],
+                 ["--member", "nohost:1"],
+                 ["--heartbeat-interval", "0"],
+                 ["--evict-after", "1", "--suspect-after", "2"],
+                 ["--breaker-threshold", "0"],
+                 ["--tenant-quota", "0"],
+                 ["--drain-timeout", "0"]):
+        with pytest.raises(SystemExit) as exc:
+            fed_cli.main(argv)
+        assert exc.value.code == 2, argv
+
+
+def test_host_id_is_metric_safe():
+    from tpu_stencil.fed import host_id_for
+
+    hid = host_id_for("http://127.0.0.1:8080/")
+    assert hid == "127_0_0_1_8080"
+    assert hid.replace("_", "").isalnum()
+
+
+# -- breaker unit ------------------------------------------------------
+
+
+def test_breaker_lifecycle():
+    from tpu_stencil.fed.breaker import CLOSED, HALF_OPEN, OPEN, Breaker
+
+    b = Breaker(threshold=2, cooldown_s=0.1)
+    assert b.state == CLOSED and b.allow()
+    b.record_failure()
+    assert b.state == CLOSED and b.allow()  # one failure: still closed
+    b.record_failure()
+    assert b.state == OPEN
+    assert not b.allow()  # open, cooldown not elapsed
+    time.sleep(0.12)
+    assert b.allow()  # the half-open probe slot
+    assert b.state == HALF_OPEN
+    assert not b.allow()  # one probe at a time
+    b.record_failure()  # the probe died: re-open
+    assert b.state == OPEN
+    time.sleep(0.12)
+    assert b.allow()
+    assert b.record_success() is True  # probe landed: breaker closes
+    assert b.state == CLOSED and b.allow()
+    # A cancelled probe releases its slot without judging the host.
+    b.record_failure(), b.record_failure()
+    time.sleep(0.12)
+    assert b.allow() and not b.allow()
+    b.release_probe()
+    assert b.allow()  # slot free again, still half-open evidence-less
+
+
+def test_verdict_classification():
+    import socket
+
+    from tpu_stencil.fed.router import _verdict_exc
+    from tpu_stencil.resilience.errors import InjectedFault
+
+    assert _verdict_exc(ConnectionRefusedError()) == "connect"
+    assert _verdict_exc(socket.timeout()) == "timeout"
+    assert _verdict_exc(TimeoutError()) == "timeout"
+    assert _verdict_exc(
+        http.client.IncompleteRead(b"partial")
+    ) == "eof"
+    assert _verdict_exc(ConnectionResetError()) == "reset"
+    assert _verdict_exc(
+        http.client.RemoteDisconnected("gone")
+    ) == "reset"
+    assert _verdict_exc(OSError("no route")) == "connect"
+    assert _verdict_exc(InjectedFault("chaos")) == "injected"
+    assert _verdict_exc(RuntimeError("??")) == "error"
+
+
+def test_host_unavailable_classifies_transient():
+    from tpu_stencil.resilience import retry
+    from tpu_stencil.resilience.errors import HostUnavailable
+
+    e = HostUnavailable("breaker open", host="h1")
+    assert e.host == "h1"
+    assert retry.classify(e) == retry.TRANSIENT
+
+
+def test_new_fault_points_registered():
+    from tpu_stencil.resilience import faults
+
+    for point in ("net.accept", "net.body", "fed.heartbeat",
+                  "fed.forward", "fed.hedge"):
+        assert point in faults.POINTS
+        assert faults.site(point) is None  # unarmed: zero-overhead
+
+
+def test_retry_after_floor_honored():
+    # The satellite bugfix at its root: an exception carrying the
+    # server's Retry-After hint floors the backoff sleep, counted in
+    # resilience_retry_after_honored_total.
+    from tpu_stencil import obs
+    from tpu_stencil.resilience import retry
+    from tpu_stencil.serve.engine import QueueFull
+
+    counter = obs.registry().counter(
+        "resilience_retry_after_honored_total"
+    )
+    before = counter.value
+    calls = []
+
+    def flaky():
+        calls.append(time.perf_counter())
+        if len(calls) < 2:
+            e = QueueFull("busy")
+            e.retry_after_s = 0.3
+            raise e
+        return "ok"
+
+    t0 = time.perf_counter()
+    assert retry.retry_call(
+        flaky,
+        policy=retry.RetryPolicy(attempts=3, base_delay=0.001,
+                                 max_delay=0.01),
+    ) == "ok"
+    assert time.perf_counter() - t0 >= 0.3  # floored, not exp-jitter
+    assert counter.value == before + 1
+
+
+# -- the in-process federation -----------------------------------------
+
+
+@pytest.fixture(scope="module")
+def fedpair():
+    """Two in-process member hosts behind one federation frontend —
+    the same warm-executable economy test_net.py's module fixture
+    uses, one hop up."""
+    m1 = _make_member()
+    m2 = _make_member()
+    fed = _make_fed([m1, m2], reoffer_s=0.2)
+    yield fed, m1, m2
+    fed.close()
+    m1.close()
+    m2.close()
+
+
+def test_fed_round_trip_bit_exact(fedpair, rng, tmp_path):
+    # The acceptance criterion verbatim: the federated round-trip is
+    # byte-identical to run_job and the NumPy golden.
+    from tpu_stencil import driver
+    from tpu_stencil.config import ImageType, JobConfig
+
+    fed, _, _ = fedpair
+    img = rng.integers(0, 256, (20, 28, 3), dtype=np.uint8)
+    src = tmp_path / "frame.raw"
+    out = tmp_path / "blur.raw"
+    img.tofile(src)
+    driver.run_job(JobConfig(
+        image=str(src), width=28, height=20, repetitions=4,
+        image_type=ImageType.RGB, output=str(out),
+    ))
+    want = np.fromfile(out, np.uint8).reshape(img.shape)
+    np.testing.assert_array_equal(want, _golden(img, 4))
+    status, body, headers = _post(fed.url, img, 4)
+    assert status == 200
+    assert headers["X-Fed-Member"]  # which host computed is visible
+    np.testing.assert_array_equal(
+        np.frombuffer(body, np.uint8).reshape(img.shape), want
+    )
+
+
+def test_fed_grey_round_trip_and_filter(fedpair, rng):
+    fed, _, _ = fedpair
+    img = rng.integers(0, 256, (17, 23), dtype=np.uint8)
+    status, body, _ = _post(fed.url, img, 2, filter_name="box")
+    assert status == 200
+    np.testing.assert_array_equal(
+        np.frombuffer(body, np.uint8).reshape(img.shape),
+        _golden(img, 2, "box"),
+    )
+
+
+def test_fed_member_400_passes_through(fedpair, rng):
+    fed, _, _ = fedpair
+    img = rng.integers(0, 256, (8, 8), dtype=np.uint8)
+    status, body, _ = _post(fed.url, img, 1, filter_name="bogus")
+    assert status == 400 and b"unknown filter" in body
+    # Fed-side validation is its own 400 (never forwarded).
+    req = urllib.request.Request(fed.url + "/v1/blur",
+                                 data=img.tobytes(), method="POST")
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        urllib.request.urlopen(req, timeout=60)
+    assert exc.value.code == 400
+
+
+def test_fed_metrics_fold_and_round_trip(fedpair, rng):
+    from tpu_stencil.fed import host_id_for
+    from tpu_stencil.obs import exposition
+
+    fed, m1, m2 = fedpair
+    img = rng.integers(0, 256, (10, 10), dtype=np.uint8)
+    assert _post(fed.url, img, 1)[0] == 200
+    status, body = _get(fed.url, "/metrics")
+    assert status == 200
+    text = body.decode()
+    snap = exposition.parse_text(text, prefix="tpu_stencil_fed")
+    assert snap["counters"]["requests_total"] >= 1
+    assert snap["counters"]["forwarded_total"] >= 1
+    # Member scrapes folded under fleet_<host>_, the net tier's
+    # replica fold one hop up.
+    for m in (m1, m2):
+        hid = host_id_for(m.url)
+        assert f"fleet_{hid}_requests_total" in snap["counters"]
+    assert "forward_latency_seconds" in snap["histograms"]
+    assert "request_latency_seconds" in snap["histograms"]
+    assert snap["members"] == 2  # scalar rider
+    # The exact inverse property every exposition surface guarantees.
+    assert exposition.render_text(snap, prefix="tpu_stencil_fed") == text
+
+
+def test_fed_statusz_schema(fedpair):
+    fed, _, _ = fedpair
+    status, body = _get(fed.url, "/statusz")
+    assert status == 200
+    payload = json.loads(body)
+    assert payload["schema_version"] == 1
+    assert payload["draining"] is False
+    assert len(payload["members"]) == 2
+    for m in payload["members"]:
+        assert m["state"] == "healthy"
+    assert "breakers" in payload and "tenants" in payload
+    assert "net" in payload and "counters" in payload["net"]
+    assert payload["config"]["tenant_quota"] == 32
+
+
+def test_fed_healthz(fedpair):
+    fed, _, _ = fedpair
+    status, body = _get(fed.url, "/healthz")
+    assert status == 200 and body == b"ok\n"
+
+
+def test_registration_endpoint(fedpair):
+    fed, _, _ = fedpair
+    # A dead URL fails its registration health check typed.
+    status, body = _post_admin(
+        fed.url, "/admin/register?url=http%3A%2F%2F127.0.0.1%3A9"
+    )
+    assert status == 400 and b"health check" in body
+    # Missing url param.
+    assert _post_admin(fed.url, "/admin/register")[0] == 400
+    # A live third member registers and serves.
+    m3 = _make_member()
+    try:
+        import urllib.parse
+
+        status, body = _post_admin(
+            fed.url,
+            "/admin/register?url="
+            + urllib.parse.quote(m3.url, safe=""),
+        )
+        assert status == 200
+        payload = json.loads(body)
+        assert payload["state"] == "healthy"
+        assert any(m["host_id"] == payload["host_id"]
+                   for m in json.loads(_get(fed.url, "/statusz")[1])
+                   ["members"])
+    finally:
+        m3.close()
+
+
+def test_loadgen_http_against_federation(fedpair):
+    # The satellite criterion: serve's loadgen --http pointed at a
+    # federation works unchanged — same loops, same report schema,
+    # stats scraped from the federation's own registry.
+    from tpu_stencil.serve import loadgen
+
+    fed, _, _ = fedpair
+    target = loadgen.HttpTarget(fed.url)
+    try:
+        report = loadgen.run(
+            target, mode="closed", requests=6, concurrency=2, reps=1,
+            shapes=((10, 12),), channels=(3,), seed=1,
+        )
+    finally:
+        target.close()
+    assert report["completed"] == 6
+    assert report["stats"]["counters"]["requests_total"] >= 6
+    assert "retry_after_honored_total" in report
+
+
+# -- membership / host loss (in-process) -------------------------------
+
+
+def test_heartbeat_suspicion_window_and_eviction(rng):
+    m1 = _make_member()
+    m2 = _make_member()
+    fed = _make_fed([m1, m2], suspect_after=2, evict_after=3,
+                    reoffer_s=0.0)
+    try:
+        img = rng.integers(0, 256, (10, 10), dtype=np.uint8)
+        assert _post(fed.url, img, 1)[0] == 200
+        hid1 = fed.membership.members()[0].host_id
+        # Kill member 1's listener (drain first so close() is quick).
+        m1.drain(10.0)
+        m1.close()
+        # One missed beat: still HEALTHY — never a single-timeout
+        # demotion; the window is the whole point.
+        fed.membership.beat()
+        assert fed.membership.get(hid1).state == "healthy"
+        assert fed.membership.get(hid1).misses == 1
+        # Second miss: SUSPECT (routable, but after every healthy host).
+        fed.membership.beat()
+        assert fed.membership.get(hid1).state == "suspect"
+        assert len(fed.membership.routable()) == 2
+        # Third miss: evicted.
+        fed.membership.beat()
+        assert fed.membership.get(hid1).state == "evicted"
+        assert fed.membership.routable()[0].state == "healthy"
+        snap = fed.registry.snapshot()
+        assert snap["counters"]["evictions_total"] == 1
+        assert snap["gauges"]["members_evicted"]["value"] == 1
+        # Survivor keeps serving, bit-exact.
+        status, body, headers = _post(fed.url, img, 1)
+        assert status == 200
+        np.testing.assert_array_equal(
+            np.frombuffer(body, np.uint8).reshape(img.shape),
+            _golden(img, 1),
+        )
+        # The eviction is visible in the text scrape too.
+        text = _get(fed.url, "/metrics")[1].decode()
+        assert "tpu_stencil_fed_evictions_total 1" in text
+    finally:
+        fed.close()
+        m2.close()
+
+
+def test_draining_member_leaves_routing_before_failing(rng):
+    # A member whose healthz answers 503 is removed from routing by
+    # the next beat — the drain-ahead-of-failure contract.
+    m1 = _make_member()
+    m2 = _make_member()
+    fed = _make_fed([m1, m2])
+    try:
+        m1.begin_drain()  # healthz now 503, requests would 503 too
+        fed.membership.beat()
+        routable = fed.membership.routable()
+        assert len(routable) == 1
+        img = np.zeros((8, 8), np.uint8)
+        for _ in range(3):
+            status, _, headers = _post(fed.url, img, 1)
+            assert status == 200
+            from tpu_stencil.fed import host_id_for
+
+            assert headers["X-Fed-Member"] == host_id_for(m2.url)
+    finally:
+        fed.close()
+        m1.close()
+        m2.close()
+
+
+def test_admin_drain_is_sticky_against_heartbeat_healing():
+    # An ADMIN drain (pinned) must survive a heartbeat 200 — the
+    # member's healthz can race the drain POST, and a quiet re-admit
+    # would undo the operator's rolling restart. Only re-registration
+    # readmits.
+    m1 = _make_member()
+    m2 = _make_member()
+    fed = _make_fed([m1, m2])
+    try:
+        from tpu_stencil.fed import host_id_for
+
+        hid = host_id_for(m1.url)
+        # Self-reported drains (healthz 503) DO heal on a later 200:
+        fed.membership.mark_draining(hid)
+        fed.membership.beat()  # m1 healthz still answers 200
+        assert fed.membership.get(hid).state == "healthy"
+        # A pinned admin drain does not:
+        fed.membership.mark_draining(hid, pinned=True)
+        fed.membership.beat()
+        assert fed.membership.get(hid).state == "draining"
+        assert len(fed.membership.routable()) == 1
+        # Re-registration is the explicit way back in.
+        fed.membership.register(m1.url)
+        assert fed.membership.get(hid).state == "healthy"
+        assert not fed.membership.get(hid).pinned_draining
+    finally:
+        fed.close()
+        m1.close()
+        m2.close()
+
+
+def test_breaker_opens_after_consecutive_failures(rng):
+    # One member, killed: requests classify connect/reset, the breaker
+    # opens at the threshold, and the next request fails typed
+    # HostUnavailable WITHOUT paying a connect attempt.
+    m1 = _make_member()
+    fed = _make_fed([m1], breaker_threshold=2, breaker_cooldown_s=30.0,
+                    reoffer_s=0.0, hedge=False)
+    try:
+        img = np.zeros((8, 8), np.uint8)
+        assert _post(fed.url, img, 1)[0] == 200
+        m1.drain(10.0)
+        m1.close()
+        hid = fed.membership.members()[0].host_id
+        for _ in range(2):
+            status, body, headers = _post(fed.url, img, 1)
+            assert status == 503
+            assert b"HostUnavailable" in body
+            assert headers.get("Retry-After")
+        assert fed.breakers.get(hid).state == "open"
+        # Breaker-open rejection: typed, instant, no connect.
+        status, body, _ = _post(fed.url, img, 1)
+        assert status == 503 and b"breaker" in body
+        snap = fed.registry.snapshot()
+        assert snap["counters"]["breaker_open_total"] == 1
+        assert snap["counters"]["forward_connect_total"] >= 2
+        assert json.loads(_get(fed.url, "/statusz")[1])["breakers"][
+            hid]["state"] == "open"
+    finally:
+        fed.close()
+
+
+def test_hedge_fires_on_stalled_member(rng, monkeypatch):
+    # net.body stall chaos on the primary: the hedge fires at the p99
+    # trigger, the OTHER member answers, first-response-wins, and the
+    # stalled loser is cancelled typed — visible in the counters.
+    from tpu_stencil.resilience import faults
+
+    monkeypatch.setenv("TPU_STENCIL_FAULT_STALL_S", "6")
+    faults.configure("net.body:at=0:raise=TimeoutError")
+    try:
+        m1 = _make_member()
+        m2 = _make_member()
+        fed = _make_fed([m1, m2], hedge_min_s=0.1, reoffer_s=0.0)
+        try:
+            img = rng.integers(0, 256, (10, 10), dtype=np.uint8)
+            t0 = time.perf_counter()
+            status, body, headers = _post(fed.url, img, 2)
+            wall = time.perf_counter() - t0
+            assert status == 200
+            assert headers["X-Fed-Hedged"] == "1"
+            np.testing.assert_array_equal(
+                np.frombuffer(body, np.uint8).reshape(img.shape),
+                _golden(img, 2),
+            )
+            assert wall < 5.0  # the stall never reached the client
+            snap = fed.registry.snapshot()
+            assert snap["counters"]["hedges_total"] == 1
+            assert snap["counters"]["hedge_wins_total"] == 1
+        finally:
+            fed.close()
+            m1.close()
+            m2.close()
+    finally:
+        faults.clear()
+
+
+# -- federation-scope admission ----------------------------------------
+
+
+def test_tenant_quota_isolates_hot_client(rng):
+    # The hot tenant degrades to ITS quota (429 + Retry-After); a
+    # different tenant is untouched. Parked member workers pin the hot
+    # tenant's request outstanding.
+    m1 = _make_member(start_workers=False, warm_fleet=False)
+    fed = _make_fed([m1], tenant_quota=1, reoffer_s=0.0, hedge=False)
+    try:
+        img = rng.integers(0, 256, (10, 10), dtype=np.uint8)
+        results = {}
+
+        def client(key, tenant):
+            results[key] = _post(fed.url, img, 1, tenant=tenant)
+
+        t_hot = threading.Thread(target=client, args=("hot1", "hot"),
+                                 daemon=True)
+        t_hot.start()
+        deadline = time.perf_counter() + 30
+        while (fed.router.tenants().get("hot", 0) < 1
+               and time.perf_counter() < deadline):
+            time.sleep(0.01)
+        assert fed.router.tenants() == {"hot": 1}
+        # The hot tenant's second request: typed 429, instant.
+        status, body, headers = _post(fed.url, img, 1, tenant="hot")
+        assert status == 429
+        assert b"quota" in body and b"'hot'" in body
+        assert headers.get("Retry-After")
+        # A different tenant is admitted (and queued) just fine.
+        t_other = threading.Thread(target=client,
+                                   args=("other1", "calm"), daemon=True)
+        t_other.start()
+        deadline = time.perf_counter() + 30
+        while (fed.router.tenants().get("calm", 0) < 1
+               and time.perf_counter() < deadline):
+            time.sleep(0.01)
+        assert fed.router.tenants().get("calm") == 1
+        m1.fleet.start_workers()
+        t_hot.join(timeout=300)
+        t_other.join(timeout=300)
+        assert results["hot1"][0] == 200
+        assert results["other1"][0] == 200
+        snap = fed.registry.snapshot()
+        assert snap["counters"]["tenant_quota_rejections_total"] == 1
+        assert fed.router.tenants() == {}  # bounded: released on done
+    finally:
+        fed.close()
+        m1.close()
+
+
+def test_premium_tenant_headroom_past_shed_watermark(rng):
+    # Byte-shed priority classes: standard sheds at the watermark,
+    # premium keeps 25% headroom — the degradation ORDER is the
+    # two-class contract.
+    m1 = _make_member()
+    # 10x10 grey: nbytes = 2*100 = 200; watermark 160 bytes. Standard
+    # sheds (200 > 160); premium limit is 200 (160*1.25) and admits.
+    fed = _make_fed([m1], max_inflight_mb=160 / (1 << 20),
+                    premium_tenants=("vip",), reoffer_s=0.0)
+    try:
+        img = rng.integers(0, 256, (10, 10), dtype=np.uint8)
+        status, body, headers = _post(fed.url, img, 1, tenant="std")
+        assert status == 503 and b"shed" in body
+        assert headers.get("Retry-After")
+        status, body, _ = _post(fed.url, img, 1, tenant="vip")
+        assert status == 200
+        np.testing.assert_array_equal(
+            np.frombuffer(body, np.uint8).reshape(img.shape),
+            _golden(img, 1),
+        )
+        assert fed.registry.snapshot()["counters"]["shed_total"] == 1
+    finally:
+        fed.close()
+        m1.close()
+
+
+def test_fed_drain_gate_and_report(rng):
+    m1 = _make_member()
+    fed = _make_fed([m1])
+    try:
+        img = np.zeros((8, 8), np.uint8)
+        assert _post(fed.url, img, 1)[0] == 200
+        report = fed.drain(10.0)
+        assert all(report.values()) and len(report) == 1
+        assert _get(fed.url, "/healthz")[0] == 503
+        status, body, _ = _post(fed.url, img, 1)
+        assert status == 503 and b"draining" in body
+        assert fed.registry.snapshot()["gauges"]["draining"]["value"] == 1
+    finally:
+        fed.close()
+        m1.close()
+
+
+def test_rolling_member_drain_in_process(rng):
+    # POST /admin/drain?host= bleeds the member out of routing AND
+    # drives its own SIGTERM-equivalent admin path.
+    m1 = _make_member()
+    m2 = _make_member()
+    fed = _make_fed([m1, m2])
+    try:
+        from tpu_stencil.fed import host_id_for
+
+        img = np.zeros((8, 8), np.uint8)
+        assert _post(fed.url, img, 1)[0] == 200
+        hid1 = host_id_for(m1.url)
+        status, body = _post_admin(fed.url, f"/admin/drain?host={hid1}")
+        assert status == 200
+        payload = json.loads(body)
+        assert payload["draining"] is True
+        assert payload["member_response"]["draining"] is True
+        # The member's own admin path ran: healthz flipped, CLI flag up.
+        assert m1.admin_drain_requested.is_set()
+        assert _get(m1.url, "/healthz")[0] == 503
+        assert fed.membership.get(hid1).state == "draining"
+        # Traffic now lands only on the survivor.
+        for _ in range(3):
+            status, _, headers = _post(fed.url, img, 1)
+            assert status == 200
+            assert headers["X-Fed-Member"] == host_id_for(m2.url)
+        # Unknown host: typed 404.
+        assert _post_admin(fed.url, "/admin/drain?host=nope")[0] == 404
+    finally:
+        fed.close()
+        m1.close()
+        m2.close()
+
+
+# -- net.accept / net.body chaos sites ---------------------------------
+
+
+def test_net_accept_fault_drops_connection(rng):
+    from tpu_stencil.resilience import faults
+
+    faults.configure("net.accept:at=0")
+    try:
+        m = _make_member()
+        try:
+            img = rng.integers(0, 256, (8, 8), dtype=np.uint8)
+            # First request: the connection drops with no response —
+            # the transport-level failure the fed classifies "reset".
+            with pytest.raises((http.client.RemoteDisconnected,
+                                http.client.BadStatusLine,
+                                ConnectionError, OSError)):
+                req = urllib.request.Request(
+                    m.url + "/v1/blur?w=8&h=8&reps=1&channels=1",
+                    data=img.tobytes(), method="POST",
+                )
+                urllib.request.urlopen(req, timeout=60)
+            # times=1 default: the next request is clean and bit-exact.
+            status, body, _ = _post(m.url, img, 1)
+            assert status == 200
+            np.testing.assert_array_equal(
+                np.frombuffer(body, np.uint8).reshape(img.shape),
+                _golden(img, 1),
+            )
+        finally:
+            m.close()
+    finally:
+        faults.clear()
+
+
+def test_net_body_fault_truncates_mid_body(rng):
+    from tpu_stencil.resilience import faults
+
+    faults.configure("net.body:at=0")
+    try:
+        m = _make_member()
+        try:
+            img = rng.integers(0, 256, (16, 16), dtype=np.uint8)
+            conn = http.client.HTTPConnection("127.0.0.1", m.port,
+                                              timeout=60)
+            try:
+                conn.request(
+                    "POST", "/v1/blur?w=16&h=16&reps=1&channels=1",
+                    body=img.tobytes(),
+                )
+                resp = conn.getresponse()
+                assert resp.status == 200  # headers promise the body...
+                with pytest.raises(http.client.IncompleteRead):
+                    resp.read()  # ...the wire delivers half, then EOF
+            finally:
+                conn.close()
+            status, body, _ = _post(m.url, img, 1)
+            assert status == 200 and len(body) == img.size
+        finally:
+            m.close()
+    finally:
+        faults.clear()
+
+
+def test_fed_survives_injected_mid_body_eof(rng):
+    # The chaos path end to end: net.body truncation on the member,
+    # the fed classifies "eof", charges the breaker, reroutes, and the
+    # client sees one clean 200.
+    from tpu_stencil.resilience import faults
+
+    faults.configure("net.body:at=0")
+    try:
+        m1 = _make_member()
+        m2 = _make_member()
+        fed = _make_fed([m1, m2], hedge=False, reoffer_s=0.0)
+        try:
+            img = rng.integers(0, 256, (12, 12), dtype=np.uint8)
+            status, body, _ = _post(fed.url, img, 2)
+            assert status == 200
+            np.testing.assert_array_equal(
+                np.frombuffer(body, np.uint8).reshape(img.shape),
+                _golden(img, 2),
+            )
+            snap = fed.registry.snapshot()
+            assert snap["counters"]["forward_eof_total"] == 1
+            assert snap["counters"]["reroutes_total"] == 1
+        finally:
+            fed.close()
+            m1.close()
+            m2.close()
+    finally:
+        faults.clear()
+
+
+def test_retrying_client_honors_retry_after_floor(rng):
+    # Satellite end to end: a queue-full 429 carries Retry-After: 1;
+    # the re-offering client must floor its backoff there instead of
+    # hammering with millisecond jitter.
+    from tpu_stencil import obs
+    from tpu_stencil.serve import loadgen
+
+    m = _make_member(start_workers=False, max_queue=1, warm_fleet=False)
+    try:
+        img = rng.integers(0, 256, (8, 8), dtype=np.uint8)
+        fill = loadgen.HttpTarget(m.url)
+        try:
+            pinned = fill.submit(img, 1)  # occupies the 1-deep queue
+            deadline = time.perf_counter() + 30
+            while (sum(m.router.outstanding().values()) < 1
+                   and time.perf_counter() < deadline):
+                time.sleep(0.01)
+            before = obs.registry().counter(
+                "resilience_retry_after_honored_total"
+            ).value
+            offers = []
+            target = loadgen.HttpTarget(m.url)
+            orig_post = target._post
+
+            def counting_post(*a, **k):
+                offers.append(time.perf_counter())
+                return orig_post(*a, **k)
+
+            target._post = counting_post
+            fut = target.submit_retrying(img, 1, give_up_after_s=60.0)
+            time.sleep(0.3)
+            m.fleet.start_workers()
+            np.testing.assert_array_equal(
+                fut.result(timeout=300), _golden(img, 1)
+            )
+            pinned.result(timeout=300)
+            target.close()
+            assert obs.registry().counter(
+                "resilience_retry_after_honored_total"
+            ).value > before
+            # Re-offers were spaced by the server's hint (1s), not
+            # millisecond jitter.
+            assert len(offers) >= 2
+            assert offers[1] - offers[0] >= 1.0
+        finally:
+            fill.close()
+    finally:
+        m.close()
+
+
+# -- host-loss chaos with real subprocess members ----------------------
+
+
+def _spawn_member(register_url=None, extra=()):
+    repo = os.path.join(os.path.dirname(__file__), os.pardir)
+    argv = [sys.executable, "-m", "tpu_stencil", "net", "--port", "0",
+            "--replicas", "1", "--platform", "cpu",
+            "--drain-timeout", "60"]
+    if register_url:
+        argv += ["--register", register_url]
+    argv += list(extra)
+    proc = subprocess.Popen(
+        argv, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True, cwd=repo,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+    )
+    line = proc.stdout.readline()
+    assert "net: serving on http://" in line, (
+        line, proc.stderr.read()[-2000:]
+    )
+    return proc, line.split()[3]
+
+
+def _reap(proc):
+    if proc.poll() is None:
+        proc.kill()
+        proc.wait(timeout=30)
+    proc.stdout.close()
+    proc.stderr.close()
+
+
+def test_kill9_member_under_load_every_request_typed(rng):
+    # THE acceptance criterion: kill -9 one member host under
+    # concurrent load — every request completes or fails with a typed
+    # status (never a hang, never a connection-reset traceback out of
+    # the federation), the breaker/eviction land in the scrape, and
+    # survivors keep serving.
+    from tpu_stencil.fed import FedFrontend, host_id_for
+
+    p1, url1 = _spawn_member()
+    p2, url2 = _spawn_member()
+    fed = FedFrontend(FedConfig(
+        port=0, members=(url1, url2), heartbeat_interval_s=0.1,
+        suspect_after=2, evict_after=3, breaker_threshold=2,
+        breaker_cooldown_s=60.0, forward_timeout_s=60.0,
+        reoffer_s=0.2,
+    )).start()
+    try:
+        img = rng.integers(0, 256, (24, 24), dtype=np.uint8)
+        want = _golden(img, 3)
+        # Warm both member executables through the federation.
+        for _ in range(4):
+            assert _post(fed.url, img, 3)[0] == 200
+        results = []
+        results_lock = threading.Lock()
+        kill_at = threading.Event()
+
+        def client(i):
+            for j in range(4):
+                if i == 0 and j == 2:
+                    kill_at.set()
+                try:
+                    status, body, _ = _post(fed.url, img, 3,
+                                            http_timeout=120)
+                except Exception as e:  # noqa: BLE001
+                    with results_lock:
+                        results.append(("exc", type(e).__name__))
+                    continue
+                with results_lock:
+                    results.append((status, body))
+
+        threads = [threading.Thread(target=client, args=(i,),
+                                    daemon=True) for i in range(6)]
+        for t in threads:
+            t.start()
+        kill_at.wait(timeout=60)
+        os.kill(p1.pid, signal.SIGKILL)  # the host is GONE, mid-load
+        for t in threads:
+            t.join(timeout=300)
+        assert results, "no requests completed"
+        for status, payload in results:
+            # Typed statuses only: 200 (served, possibly via
+            # hedge/reroute) or a typed rejection — NEVER a transport
+            # exception escaping the federation edge.
+            assert status in (200, 429, 503, 504), (status, payload)
+            if status == 200:
+                np.testing.assert_array_equal(
+                    np.frombuffer(payload, np.uint8).reshape(img.shape),
+                    want,
+                )
+        # Post-mortem: the eviction walks through the suspicion window
+        # and the survivors keep serving.
+        hid1 = host_id_for(url1)
+        deadline = time.perf_counter() + 30
+        while (fed.membership.get(hid1).state != "evicted"
+               and time.perf_counter() < deadline):
+            time.sleep(0.05)
+        assert fed.membership.get(hid1).state == "evicted"
+        status, body, headers = _post(fed.url, img, 3)
+        assert status == 200
+        assert headers["X-Fed-Member"] == host_id_for(url2)
+        np.testing.assert_array_equal(
+            np.frombuffer(body, np.uint8).reshape(img.shape), want
+        )
+        # The loss is visible in both scrape surfaces.
+        text = _get(fed.url, "/metrics")[1].decode()
+        assert "tpu_stencil_fed_evictions_total 1" in text
+        snap = fed.registry.snapshot()
+        assert (snap["counters"].get("breaker_open_total", 0) >= 1
+                or snap["counters"].get("reroutes_total", 0) >= 1
+                or snap["counters"].get("hedges_total", 0) >= 1)
+        stz = json.loads(_get(fed.url, "/statusz")[1])
+        assert any(m["state"] == "evicted" for m in stz["members"])
+    finally:
+        fed.close()
+        _reap(p1)
+        _reap(p2)
+
+
+def test_rolling_drain_of_every_member_zero_drops(rng):
+    # Satellite (b): drain every member in sequence through the
+    # federation's admin path while load runs — every accepted request
+    # completes (zero drops), each member process exits rc 0 reporting
+    # a clean drain.
+    from tpu_stencil.fed import FedFrontend, host_id_for
+
+    fed = FedFrontend(FedConfig(
+        port=0, heartbeat_interval_s=0.2, reoffer_s=0.2,
+    )).start()
+    p1 = p2 = None
+    try:
+        # Members register THEMSELVES (`net --register`, the live
+        # registration path).
+        p1, url1 = _spawn_member(register_url=fed.url)
+        p2, url2 = _spawn_member(register_url=fed.url)
+        deadline = time.perf_counter() + 60
+        while (len(fed.membership.routable()) < 2
+               and time.perf_counter() < deadline):
+            time.sleep(0.05)
+        assert len(fed.membership.routable()) == 2
+        img = rng.integers(0, 256, (16, 16), dtype=np.uint8)
+        want = _golden(img, 2)
+        assert _post(fed.url, img, 2)[0] == 200
+        results = []
+        results_lock = threading.Lock()
+
+        def client():
+            for _ in range(3):
+                status, body, _ = _post(fed.url, img, 2,
+                                        http_timeout=120)
+                with results_lock:
+                    results.append((status, body))
+
+        threads = [threading.Thread(target=client, daemon=True)
+                   for _ in range(4)]
+        for t in threads:
+            t.start()
+        # Roll member 1 out mid-load.
+        status, _ = _post_admin(fed.url,
+                                f"/admin/drain?host={host_id_for(url1)}")
+        assert status == 200
+        rc1 = p1.wait(timeout=120)
+        out1 = p1.stdout.read()
+        assert rc1 == 0, out1
+        assert "drained 1 replica(s) cleanly" in out1
+        for t in threads:
+            t.join(timeout=300)
+        # ZERO drops: every request in the rolling window answered 200
+        # bit-exact (the load was light enough that none were shed).
+        assert len(results) == 12
+        for status, body in results:
+            assert status == 200, status
+            np.testing.assert_array_equal(
+                np.frombuffer(body, np.uint8).reshape(img.shape), want
+            )
+        # Roll the last member too: its accepted work also completes.
+        status, _ = _post_admin(fed.url,
+                                f"/admin/drain?host={host_id_for(url2)}")
+        assert status == 200
+        rc2 = p2.wait(timeout=120)
+        assert rc2 == 0
+        assert "drained 1 replica(s) cleanly" in p2.stdout.read()
+        # The federation is now memberless: typed 503, never a hang.
+        status, body, _ = _post(fed.url, img, 2)
+        assert status == 503 and b"HostUnavailable" in body
+    finally:
+        fed.close()
+        if p1:
+            _reap(p1)
+        if p2:
+            _reap(p2)
+
+
+# -- bench rider -------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_bench_fed_capture_subprocess():
+    repo = os.path.join(os.path.dirname(__file__), os.pardir)
+    proc = subprocess.run(
+        [sys.executable, "bench.py"],
+        capture_output=True, text=True, timeout=580, cwd=repo,
+        env=dict(os.environ, JAX_PLATFORMS="cpu",
+                 TPU_STENCIL_BENCH_PLATFORM="cpu",
+                 TPU_STENCIL_BENCH_SHAPE="48x32",
+                 TPU_STENCIL_BENCH_FED="2",
+                 TPU_STENCIL_BENCH_FED_REQUESTS="4",
+                 TPU_STENCIL_BENCH_SENTRY="off"),
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [l for l in proc.stdout.splitlines() if l.strip()]
+    cap = json.loads(lines[-1])
+    assert cap["metric"].endswith("_fed2_wall_per_request")
+    assert cap["value"] > 0
+    assert cap["hosts"] == 2
+    # The arxiv 2605.07954 weak-scaling rider always rides the capture
+    # (the >=0.8x bar is advisory on a shared CI box).
+    assert "weak_scaling_vs_linear" in cap
+    assert cap["weak_scaling_bar"] == 0.8
+
+
+# -- fed CLI, end to end -----------------------------------------------
+
+
+def test_fed_cli_sigterm_drain_subprocess():
+    repo = os.path.join(os.path.dirname(__file__), os.pardir)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "tpu_stencil", "fed", "--port", "0",
+         "--drain-timeout", "30"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        cwd=repo, env=dict(os.environ, JAX_PLATFORMS="cpu"),
+    )
+    try:
+        line = proc.stdout.readline()
+        assert "fed: serving on http://" in line, line
+        url = line.split()[3]
+        assert _get(url, "/healthz")[0] == 200
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=60)
+        out = proc.stdout.read()
+        assert rc == 0, (out, proc.stderr.read()[-2000:])
+        assert "drained 0 host(s) cleanly" in out
+    finally:
+        _reap(proc)

@@ -11,8 +11,10 @@ writes ``blur_<input>``. Extra flags expose what the reference hard-codes:
 Subcommands: ``python -m tpu_stencil serve ...`` (the micro-batching
 inference service), ``python -m tpu_stencil net ...`` (the network
 serving tier: HTTP frontend + per-device replica fleet,
-docs/SERVING.md "Network tier"), ``python -m tpu_stencil stream ...``
-(the pipelined multi-frame streaming engine, docs/STREAMING.md) and
+docs/SERVING.md "Network tier"), ``python -m tpu_stencil fed ...``
+(the federation front router over many net hosts, docs/DEPLOY.md
+"Federation runbook"), ``python -m tpu_stencil stream ...`` (the
+pipelined multi-frame streaming engine, docs/STREAMING.md) and
 ``python -m tpu_stencil perf {log,check,report}`` (the perf-regression
 sentry, docs/OBSERVABILITY.md).
 """
@@ -47,6 +49,13 @@ def main(argv=None) -> int:
         from tpu_stencil.net import cli as net_cli
 
         return net_cli.main(argv[1:])
+    if argv and argv[0] == "fed":
+        # The federation front router: membership + breakers + hedged
+        # forwarding over many net hosts (docs/DEPLOY.md "Federation
+        # runbook"). Entirely jax-free — it never touches a device.
+        from tpu_stencil.fed import cli as fed_cli
+
+        return fed_cli.main(argv[1:])
     if argv and argv[0] == "perf":
         # The perf-regression sentry (log/check/report) is jax-free by
         # design: a history query must exit without backend bring-up.

@@ -556,6 +556,148 @@ class NetConfig:
         )
 
 
+@dataclasses.dataclass(frozen=True)
+class FedConfig:
+    """Configuration for the federation front-router tier
+    (:mod:`tpu_stencil.fed`): health-checked membership, per-host
+    circuit breakers, hedged forwarding, and federation-scope admission
+    with per-tenant quotas. Jax-free — the whole tier is; a federation
+    router never touches a device, it only moves routing metadata plus
+    the one forwarded body per request (the data-movement discipline of
+    arxiv 2112.14216 applied to the hop).
+
+    Membership timing is a *suspicion window*, not a single timeout:
+    ``suspect_after`` consecutive missed heartbeats demote a member to
+    suspect (routed only after every healthy host), ``evict_after``
+    misses evict it. A member whose ``/healthz`` answers 503 (draining)
+    is removed from routing immediately — before its requests would
+    start failing.
+    """
+
+    host: str = "127.0.0.1"
+    port: int = 8090           # 0 = ephemeral (the bound port is printed)
+    members: Tuple[str, ...] = ()  # seed member URLs; more register live
+    # Membership / heartbeats.
+    heartbeat_interval_s: float = 1.0
+    suspect_after: int = 2     # consecutive misses -> suspect
+    evict_after: int = 5       # consecutive misses -> evicted
+    # Per-host circuit breaker: this many consecutive transport-level
+    # forward failures open the breaker (typed HostUnavailable); after
+    # the cooldown one half-open probe request is let through — success
+    # closes it, failure re-opens for another cooldown.
+    breaker_threshold: int = 3
+    breaker_cooldown_s: float = 2.0
+    # Hedged requests: a forward still pending past the observed p99
+    # forward latency (floored by hedge_min_s) fires ONE hedge at the
+    # next least-outstanding member; first response wins, the loser is
+    # cancelled typed.
+    hedge: bool = True
+    hedge_min_s: float = 0.05
+    # Per-attempt member socket timeout (connect + response): past it
+    # the attempt classifies as a member timeout (breaker counts, the
+    # request reroutes). Matches the net handler's read-side guard.
+    forward_timeout_s: float = 120.0
+    # Re-offer window when EVERY routable member answers backpressure:
+    # transient all-busy blips re-offer (resilience.retry.reoffer_call)
+    # for up to this long before the typed 429/503 surfaces. 0 = off.
+    reoffer_s: float = 0.5
+    # Federation-scope load shed: past this many MB of tracked
+    # in-flight request+response bytes, standard-class requests are
+    # shed 503 + Retry-After before any forward; premium tenants get
+    # PREMIUM_HEADROOM more before shedding. 0 disables.
+    max_inflight_mb: float = 512.0
+    # Per-tenant quota (X-Tenant header; absent = tenant "anon"): max
+    # outstanding requests per standard tenant — the hot client
+    # degrades to ITS quota, never the fleet. Premium tenants (listed
+    # in premium_tenants) get quota * premium_quota_factor.
+    tenant_quota: int = 32
+    premium_tenants: Tuple[str, ...] = ()
+    premium_quota_factor: int = 4
+    # Graceful-drain budget (seconds): on SIGTERM, admission stops and
+    # every member gets this long for its outstanding forwarded
+    # requests to bleed to zero; a member still busy past it is
+    # reported abandoned (rc 1), mirroring the net CLI's discipline.
+    drain_timeout_s: float = 30.0
+
+    def __post_init__(self) -> None:
+        if not self.host:
+            raise ValueError("host must be non-empty")
+        if not 0 <= self.port <= 65535:
+            raise ValueError(
+                f"port must be in [0, 65535] (0 = ephemeral), got {self.port}"
+            )
+        object.__setattr__(self, "members", tuple(self.members))
+        for url in self.members:
+            if not url.startswith(("http://", "https://")):
+                raise ValueError(
+                    f"member URL must start with http:// or https://, "
+                    f"got {url!r}"
+                )
+        if self.heartbeat_interval_s <= 0:
+            raise ValueError(
+                f"heartbeat_interval_s must be > 0, got "
+                f"{self.heartbeat_interval_s}"
+            )
+        if self.suspect_after < 1:
+            raise ValueError(
+                f"suspect_after must be >= 1, got {self.suspect_after}"
+            )
+        if self.evict_after < self.suspect_after:
+            raise ValueError(
+                f"evict_after must be >= suspect_after "
+                f"({self.suspect_after}), got {self.evict_after}"
+            )
+        if self.breaker_threshold < 1:
+            raise ValueError(
+                f"breaker_threshold must be >= 1, got "
+                f"{self.breaker_threshold}"
+            )
+        if self.breaker_cooldown_s <= 0:
+            raise ValueError(
+                f"breaker_cooldown_s must be > 0, got "
+                f"{self.breaker_cooldown_s}"
+            )
+        if self.hedge_min_s < 0:
+            raise ValueError(
+                f"hedge_min_s must be >= 0, got {self.hedge_min_s}"
+            )
+        if self.forward_timeout_s <= 0:
+            raise ValueError(
+                f"forward_timeout_s must be > 0, got "
+                f"{self.forward_timeout_s}"
+            )
+        if self.reoffer_s < 0:
+            raise ValueError(
+                f"reoffer_s must be >= 0 (0 = no re-offer window), got "
+                f"{self.reoffer_s}"
+            )
+        if self.max_inflight_mb < 0:
+            raise ValueError(
+                f"max_inflight_mb must be >= 0 (0 = no shed watermark), "
+                f"got {self.max_inflight_mb}"
+            )
+        if self.tenant_quota < 1:
+            raise ValueError(
+                f"tenant_quota must be >= 1, got {self.tenant_quota}"
+            )
+        object.__setattr__(
+            self, "premium_tenants", tuple(self.premium_tenants)
+        )
+        if self.premium_quota_factor < 1:
+            raise ValueError(
+                f"premium_quota_factor must be >= 1, got "
+                f"{self.premium_quota_factor}"
+            )
+        if self.drain_timeout_s <= 0:
+            raise ValueError(
+                f"drain_timeout_s must be > 0, got {self.drain_timeout_s}"
+            )
+
+    @property
+    def max_inflight_bytes(self) -> int:
+        return int(self.max_inflight_mb * (1 << 20))
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="tpu_stencil",

@@ -1,0 +1,51 @@
+"""Federation front-router tier (docs/DEPLOY.md "Federation runbook").
+
+One endpoint over many ``tpu_stencil net`` hosts, built so the loss of
+a *host* — the failure mode the reference's fixed-rank MPI world and
+the single-process net tier both assume away — is survivable:
+
+* :mod:`~tpu_stencil.fed.membership` — health-checked membership:
+  HTTP registration, heartbeat suspicion window
+  (healthy → suspect → evicted, never a single-timeout eviction),
+  draining hosts removed from routing before their requests fail.
+* :mod:`~tpu_stencil.fed.breaker` — per-host circuit breakers:
+  consecutive transport failures open (typed ``HostUnavailable``),
+  one half-open probe per cooldown closes.
+* :mod:`~tpu_stencil.fed.router` — least-outstanding placement,
+  hedged requests (observed-p99 trigger, first-response-wins, typed
+  cancellation), the federation verdict taxonomy
+  (docs/RESILIENCE.md), and federation-scope admission with
+  per-tenant quotas + two priority classes (``X-Tenant``).
+* :mod:`~tpu_stencil.fed.http` — the stdlib threaded HTTP frontend
+  (``POST /v1/blur`` with the net tier's wire contract,
+  ``/admin/register``, ``/admin/drain``, ``/healthz``, ``/metrics``
+  with member scrapes folded in, ``/statusz``).
+* :mod:`~tpu_stencil.fed.cli` — ``python -m tpu_stencil fed`` with
+  the net CLI's SIGTERM drain discipline, per host.
+
+Entirely jax-free: the federation hop moves routing metadata plus the
+one forwarded body per request, never a device byte.
+
+>>> from tpu_stencil.config import FedConfig
+>>> from tpu_stencil.fed import FedFrontend
+>>> with FedFrontend(FedConfig(port=0, members=(m.url,))) as fe:
+...     ...  # POST frames at fe.url
+"""
+
+from tpu_stencil.config import FedConfig
+from tpu_stencil.fed.breaker import Breaker, BreakerBoard
+from tpu_stencil.fed.http import FedFrontend
+from tpu_stencil.fed.membership import Member, Membership, host_id_for
+from tpu_stencil.fed.router import FedRouter, TenantQuotaExceeded
+
+__all__ = [
+    "Breaker",
+    "BreakerBoard",
+    "FedConfig",
+    "FedFrontend",
+    "FedRouter",
+    "Member",
+    "Membership",
+    "TenantQuotaExceeded",
+    "host_id_for",
+]

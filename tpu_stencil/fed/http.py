@@ -1,0 +1,536 @@
+"""The federation front router's HTTP edge: one endpoint over N hosts.
+
+Endpoints (docs/SERVING.md "Federation tier" is the contract):
+
+* ``POST /v1/blur`` — the same wire contract as the net tier (geometry
+  via ``X-*`` headers or query params, raw frame body, chunked uploads
+  legal); the frontend admits (drain gate 503 / federation byte-shed
+  503 + Retry-After / per-tenant quota 429 + Retry-After, classes keyed
+  on ``X-Tenant``), then the router forwards to a member host with
+  hedging and typed rerouting. Success responses carry
+  ``X-Fed-Member`` (which host computed) and ``X-Fed-Hedged``.
+* ``GET /healthz`` — 200 serving / 503 draining, same readiness
+  contract as the net tier, one hop up.
+* ``GET /metrics`` — the fed registry rendered under
+  ``tpu_stencil_fed``, with every live member's ``/metrics`` scrape
+  folded in as ``fleet_<host>_<name>`` (counters) — one scrape walks
+  the whole federation, the way the net tier folds its replicas.
+* ``GET /statusz`` — members (state/misses/breaker), tenants,
+  outstanding per host, drain state; the ``net`` key carries the same
+  merged snapshot ``/metrics`` renders, so ``loadgen.HttpTarget``
+  pointed at a federation works unchanged.
+* ``POST /admin/register?url=U`` — backend host registration
+  (health-checked; ``tpu_stencil net --register`` drives it).
+* ``POST /admin/drain?host=ID`` — rolling whole-host drain: the router
+  bleeds traffic off the member (state → draining) and then drives the
+  member's own ``/admin/drain`` SIGTERM-equivalent path. Without
+  ``host``, drains the federation itself (the fed's own
+  SIGTERM-equivalent, mirroring the net tier's).
+
+:class:`FedFrontend` owns the tier lifecycle: membership (+ heartbeat
+thread) → breakers → router → threaded HTTP server, then
+``begin_drain`` → ``drain`` (bleed members, report clean-vs-abandoned
+per host) → ``close``.
+
+Jax-free — the federation never touches a device.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional
+from urllib.parse import parse_qs, urlsplit
+
+from tpu_stencil.config import FedConfig
+from tpu_stencil.fed.breaker import BreakerBoard
+from tpu_stencil.fed.membership import Membership
+from tpu_stencil.fed.router import (
+    DEFAULT_TENANT,
+    FedRouter,
+    TenantQuotaExceeded,
+)
+from tpu_stencil.net.http import _Oversized, read_request_body
+from tpu_stencil.net.router import Draining, Overloaded
+from tpu_stencil.obs import span as _obs_span
+from tpu_stencil.resilience.errors import (
+    DeadlineExceeded,
+    HostUnavailable,
+)
+from tpu_stencil.serve.engine import QueueFull
+from tpu_stencil.serve.metrics import Registry
+
+FED_STATUS_SCHEMA_VERSION = 1
+
+# Retry-After hints (seconds) when no member supplied one: breaker
+# cooldowns and shed backlogs clear in seconds, tenant quotas as soon
+# as the tenant's own requests complete.
+RETRY_AFTER_SHED = 2
+RETRY_AFTER_QUOTA = 1
+
+#: Optional request headers forwarded to the member verbatim (header
+#: name, query-param spelling — the net tier's vocabulary). The hop
+#: carries routing metadata + the one body, nothing else (the arxiv
+#: 2112.14216 data-movement discipline applied to the federation hop).
+_FORWARD_HEADERS = (
+    ("X-Filter", "filter"),
+    ("X-Boundary", "boundary"),
+    ("X-Request-Timeout", "timeout"),
+)
+
+
+class _FedHTTPServer(ThreadingHTTPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+
+    def __init__(self, addr, frontend: "FedFrontend") -> None:
+        self.frontend = frontend
+        super().__init__(addr, _FedHandler)
+
+
+class _FedHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    server_version = "tpu-stencil-fed/1"
+    timeout = 120.0  # read-side guard, same as the net handler
+
+    def log_message(self, *args) -> None:
+        pass
+
+    @property
+    def fe(self) -> "FedFrontend":
+        return self.server.frontend
+
+    def _respond(self, code: int, body: bytes,
+                 content_type: str = "text/plain; charset=utf-8",
+                 headers: Optional[Dict[str, str]] = None) -> None:
+        self.fe.registry.counter(f"responses_{code // 100}xx_total").inc()
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _error(self, code: int, msg: str,
+               headers: Optional[Dict[str, str]] = None) -> None:
+        # Close after errors answered before the body was consumed —
+        # the same keep-alive-coherence rule as the net handler.
+        self.close_connection = True
+        self._respond(code, (msg.rstrip("\n") + "\n").encode(),
+                      headers={**(headers or {}), "Connection": "close"})
+
+    def _param(self, query: dict, header: str, qname: str,
+               default: Optional[str] = None) -> Optional[str]:
+        v = self.headers.get(header)
+        if v is not None:
+            return v
+        if qname in query:
+            return query[qname][0]
+        return default
+
+    # -- GET -----------------------------------------------------------
+
+    def do_GET(self) -> None:  # noqa: N802
+        path = urlsplit(self.path).path
+        if path == "/healthz":
+            if self.fe.router.draining:
+                self._error(503, "draining")
+            else:
+                self._respond(200, b"ok\n")
+        elif path == "/metrics":
+            self._respond(200, self.fe.render_metrics().encode(),
+                          content_type="text/plain; version=0.0.4")
+        elif path == "/statusz":
+            self._respond(
+                200,
+                json.dumps(self.fe.statusz(), indent=2,
+                           sort_keys=True).encode(),
+                content_type="application/json",
+            )
+        else:
+            self._error(404, f"no such endpoint: {path}")
+
+    # -- POST ----------------------------------------------------------
+
+    def do_POST(self) -> None:  # noqa: N802
+        split = urlsplit(self.path)
+        if split.path == "/v1/blur":
+            self._blur(parse_qs(split.query))
+        elif split.path == "/admin/register":
+            self._register(parse_qs(split.query))
+        elif split.path == "/admin/drain":
+            self._drain(parse_qs(split.query))
+        else:
+            self._error(404, f"no such endpoint: {split.path}")
+
+    def _consume_body(self) -> None:
+        n = int(self.headers.get("Content-Length") or 0)
+        if n:
+            self.rfile.read(min(n, 1 << 20))
+
+    def _register(self, query: dict) -> None:
+        self._consume_body()
+        url = (query.get("url") or [None])[0]
+        if not url:
+            self._error(400, "missing url=<member base URL>")
+            return
+        try:
+            member = self.fe.membership.register(url)
+        except ValueError as e:
+            self._error(400, str(e))
+            return
+        self._respond(200, json.dumps({
+            "host_id": member.host_id, "url": member.url,
+            "state": member.state,
+        }).encode(), content_type="application/json")
+
+    def _drain(self, query: dict) -> None:
+        self._consume_body()
+        host = (query.get("host") or [None])[0]
+        if host is None:
+            # The federation's own SIGTERM-equivalent.
+            self.fe.request_admin_drain()
+            self._respond(200, json.dumps(
+                {"draining": True, "scope": "federation"}
+            ).encode(), content_type="application/json")
+            return
+        result = self.fe.drain_member(host)
+        if result is None:
+            self._error(404, f"no such member host: {host}")
+            return
+        self._respond(200, json.dumps(result).encode(),
+                      content_type="application/json")
+
+    def _blur(self, query: dict) -> None:
+        fe = self.fe
+        t0 = time.perf_counter()
+        with _obs_span("fed.request", "fed"):
+            try:
+                w = int(self._param(query, "X-Width", "w"))
+                h = int(self._param(query, "X-Height", "h"))
+                reps = int(self._param(query, "X-Reps", "reps"))
+                channels = int(
+                    self._param(query, "X-Channels", "channels", "1")
+                )
+                if w < 1 or h < 1:
+                    raise ValueError(f"bad frame geometry {w}x{h}")
+                if reps < 0:
+                    raise ValueError(f"reps must be >= 0, got {reps}")
+                if channels not in (1, 3):
+                    raise ValueError(
+                        f"channels must be 1 (grey) or 3 (rgb), got "
+                        f"{channels}"
+                    )
+            except (TypeError, ValueError) as e:
+                self._error(400, f"bad request parameters: {e}")
+                return
+            tenant = self._param(query, "X-Tenant", "tenant",
+                                 DEFAULT_TENANT)
+            expected = w * h * channels
+            try:
+                body = read_request_body(self.rfile, self.headers,
+                                         expected)
+            except _Oversized as e:
+                self._error(413, str(e))
+                return
+            except ValueError as e:
+                self._error(400, str(e))
+                return
+            if len(body) != expected:
+                self._error(
+                    400,
+                    f"body is {len(body)} bytes; {w}x{h}x{channels} "
+                    f"needs exactly {expected}",
+                )
+                return
+            # Forward geometry as headers (canonical form regardless
+            # of how the client sent it) + the passthrough set.
+            fwd = {
+                "X-Width": str(w), "X-Height": str(h),
+                "X-Reps": str(reps), "X-Channels": str(channels),
+                "Content-Type": "application/octet-stream",
+            }
+            for name, qname in _FORWARD_HEADERS:
+                v = self._param(query, name, qname)
+                if v is not None:
+                    fwd[name] = v
+            # Request + response buffers both live for the hop's
+            # lifetime: the honest in-flight footprint is 2x the frame.
+            nbytes = 2 * expected
+            try:
+                status, rh, data, host_id, hedged = fe.router.submit(
+                    body, fwd, nbytes, tenant=tenant
+                )
+            except Draining as e:
+                self._error(503, str(e),
+                            {"Retry-After": str(RETRY_AFTER_SHED)})
+                return
+            except Overloaded as e:
+                # A member-supplied Retry-After (all-members-shedding)
+                # beats the static hint — the members know their
+                # backlog.
+                self._error(503, str(e), {"Retry-After": str(
+                    getattr(e, "retry_after_s", None)
+                    or RETRY_AFTER_SHED
+                )})
+                return
+            except TenantQuotaExceeded as e:
+                self._error(429, str(e),
+                            {"Retry-After": str(RETRY_AFTER_QUOTA)})
+                return
+            except QueueFull as e:
+                self._error(429, str(e), {"Retry-After": str(
+                    getattr(e, "retry_after_s", None)
+                    or RETRY_AFTER_QUOTA
+                )})
+                return
+            except HostUnavailable as e:
+                self._error(503, f"HostUnavailable: {e}",
+                            {"Retry-After": str(RETRY_AFTER_SHED)})
+                return
+            except DeadlineExceeded as e:
+                self._error(504, str(e))
+                return
+            except Exception as e:
+                self._error(500, f"{type(e).__name__}: {e}")
+                return
+            if status == 200:
+                fe.registry.histogram(
+                    "request_latency_seconds"
+                ).observe(time.perf_counter() - t0)
+            out_headers = {
+                k.title(): v for k, v in rh.items()
+                if k.startswith("x-")
+            }
+            out_headers["X-Fed-Member"] = host_id
+            out_headers["X-Fed-Hedged"] = "1" if hedged else "0"
+            if status != 200:
+                # Pass a member's 4xx through verbatim, connection
+                # closed (the body was consumed here, but the verdict
+                # is deterministic — keep the client's view simple).
+                self.close_connection = True
+                out_headers["Connection"] = "close"
+            self._respond(
+                status, data,
+                content_type=rh.get("content-type",
+                                    "application/octet-stream"),
+                headers=out_headers,
+            )
+
+
+class FedFrontend:
+    """The whole federation tier: membership + breakers + router +
+    threaded HTTP server.
+
+    >>> fe = FedFrontend(FedConfig(port=0, members=(m1.url, m2.url)))
+    >>> fe.start()
+    >>> ...  # POST frames at fe.url; members register/evict live
+    >>> fe.drain(); fe.close()
+    """
+
+    def __init__(self, cfg: FedConfig) -> None:
+        self.cfg = cfg
+        self.registry = Registry()
+        # Pre-create the keys loadgen's report reads, so a federation
+        # that has served only errors still scrapes them.
+        self.registry.histogram("request_latency_seconds")
+        self.registry.counter("rejected_total")
+        self.registry.counter("member_scrape_failures_total")
+        self.membership = Membership(cfg, self.registry)
+        self.breakers = BreakerBoard(
+            cfg.breaker_threshold, cfg.breaker_cooldown_s, self.registry
+        )
+        self.router = FedRouter(cfg, self.membership, self.breakers,
+                                self.registry)
+        self._httpd: Optional[_FedHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self._drain_report: Optional[Dict[str, bool]] = None
+        self._t_start = time.monotonic()
+        self.admin_drain_requested = threading.Event()
+
+    # -- lifecycle -----------------------------------------------------
+
+    def start(self) -> "FedFrontend":
+        for url in self.cfg.members:
+            self.membership.register_seed(url)
+        self.membership.start()
+        self.router.start()
+        self._httpd = _FedHTTPServer((self.cfg.host, self.cfg.port), self)
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name="tpu-stencil-fed-http", daemon=True,
+        )
+        self._thread.start()
+        return self
+
+    @property
+    def port(self) -> int:
+        assert self._httpd is not None, "not started"
+        return self._httpd.server_address[1]
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.cfg.host}:{self.port}"
+
+    def request_admin_drain(self) -> None:
+        self.begin_drain()
+        self.admin_drain_requested.set()
+
+    def begin_drain(self) -> None:
+        self.router.begin_drain()
+
+    def drain(self, timeout_s: Optional[float] = None) -> Dict[str, bool]:
+        """The SIGTERM sequence minus the process exit: stop
+        admission, bleed every member's outstanding forwarded requests
+        to zero under the budget, report per host clean-vs-abandoned.
+        The listener stays up so in-flight responses deliver."""
+        self.begin_drain()
+        report = self.router.drain_wait(
+            timeout_s if timeout_s is not None
+            else self.cfg.drain_timeout_s
+        )
+        self._drain_report = report
+        return report
+
+    def drain_member(self, host_id: str) -> Optional[dict]:
+        """Rolling whole-host drain: bleed traffic off the member
+        (routing stops instantly), then drive its own
+        ``POST /admin/drain`` SIGTERM-equivalent path. Returns the
+        report dict, or None for an unknown host."""
+        m = self.membership.get(host_id)
+        if m is None:
+            return None
+        # Pinned: a heartbeat 200 must not re-admit the host behind
+        # the operator's back (e.g. when the drain POST below fails
+        # before the member flips its healthz).
+        self.membership.mark_draining(host_id, pinned=True)
+        self.registry.counter("member_drains_total").inc()
+        member_resp: object = None
+        try:
+            req = urllib.request.Request(
+                m.url + "/admin/drain", data=b"", method="POST"
+            )
+            with urllib.request.urlopen(req, timeout=10.0) as r:
+                member_resp = json.loads(r.read())
+        except Exception as e:
+            member_resp = f"unreachable: {type(e).__name__}: {e}"
+        return {
+            "host_id": host_id,
+            "draining": True,
+            "member_response": member_resp,
+        }
+
+    def close(self) -> None:
+        if self.router is not None and not self.router.draining:
+            self.drain()
+        self.membership.close()
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+            self._thread = None
+
+    def __enter__(self) -> "FedFrontend":
+        if self._httpd is None:
+            self.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- scrape surfaces -----------------------------------------------
+
+    def metrics_snapshot(self) -> dict:
+        """The fed registry with every live member's counters folded
+        in as ``fleet_<host>_<name>`` — the net tier's replica fold,
+        one hop up. Members scrape CONCURRENTLY (one wedged host costs
+        one timeout, not members x timeout — a scrape is how an
+        operator diagnoses exactly that host); a member whose scrape
+        fails is skipped and counted: a scrape must never hang or die
+        on one lost host."""
+        import concurrent.futures
+
+        snap = self.registry.snapshot()
+        from tpu_stencil.obs import exposition
+
+        def scrape(m) -> dict:
+            with urllib.request.urlopen(m.url + "/metrics",
+                                        timeout=5.0) as r:
+                return exposition.parse_text(r.read().decode(),
+                                             prefix="tpu_stencil_net")
+
+        live = [m for m in self.membership.members()
+                if m.state != "evicted"]
+        if live:
+            with concurrent.futures.ThreadPoolExecutor(
+                max_workers=min(8, len(live)),
+                thread_name_prefix="tpu-stencil-fed-scrape",
+            ) as pool:
+                futs = [(m, pool.submit(scrape, m)) for m in live]
+                for m, fut in futs:
+                    try:
+                        member = fut.result()
+                    except Exception:
+                        self.registry.counter(
+                            "member_scrape_failures_total"
+                        ).inc()
+                        # Re-snapshot the counter so the failure
+                        # itself is in this scrape.
+                        snap["counters"][
+                            "member_scrape_failures_total"
+                        ] = self.registry.counter(
+                            "member_scrape_failures_total"
+                        ).value
+                        continue
+                    for k, v in sorted(
+                        member.get("counters", {}).items()
+                    ):
+                        snap["counters"][f"fleet_{m.host_id}_{k}"] = v
+        snap["members"] = len(live)
+        return snap
+
+    def render_metrics(self) -> str:
+        from tpu_stencil.obs import exposition
+
+        return exposition.render_text(self.metrics_snapshot(),
+                                      prefix="tpu_stencil_fed")
+
+    def statusz(self) -> dict:
+        return {
+            "schema_version": FED_STATUS_SCHEMA_VERSION,
+            "ts": time.monotonic(),
+            "uptime_s": time.monotonic() - self._t_start,
+            "draining": self.router.draining,
+            "members": self.membership.statusz(),
+            "breakers": self.breakers.statusz(),
+            "outstanding": self.router.outstanding(),
+            "tenants": self.router.tenants(),
+            "drain_report": self._drain_report,
+            # The same merged snapshot /metrics renders; loadgen's
+            # HttpTarget.stats() reads this key, so --http against a
+            # federation works unchanged.
+            "net": self.metrics_snapshot(),
+            "config": {
+                "members": list(self.cfg.members),
+                "heartbeat_interval_s": self.cfg.heartbeat_interval_s,
+                "suspect_after": self.cfg.suspect_after,
+                "evict_after": self.cfg.evict_after,
+                "breaker_threshold": self.cfg.breaker_threshold,
+                "breaker_cooldown_s": self.cfg.breaker_cooldown_s,
+                "hedge": self.cfg.hedge,
+                "hedge_min_s": self.cfg.hedge_min_s,
+                "forward_timeout_s": self.cfg.forward_timeout_s,
+                "reoffer_s": self.cfg.reoffer_s,
+                "max_inflight_mb": self.cfg.max_inflight_mb,
+                "tenant_quota": self.cfg.tenant_quota,
+                "premium_tenants": list(self.cfg.premium_tenants),
+                "premium_quota_factor": self.cfg.premium_quota_factor,
+                "drain_timeout_s": self.cfg.drain_timeout_s,
+            },
+        }

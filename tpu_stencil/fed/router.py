@@ -1,0 +1,589 @@
+"""Federation routing: placement, hedged forwarding, typed verdicts,
+federation-scope admission with per-tenant quotas.
+
+The net tier's router places requests on in-process replicas; this one
+places them on *member hosts* over HTTP, where every failure mode of a
+distributed system is on the table. Robustness is the organizing
+principle, not a bolt-on:
+
+**Verdict taxonomy** (the PR-7 transient/permanent classifier extended
+one hop — docs/RESILIENCE.md "Federation verdicts"). Each forward
+attempt resolves to exactly one verdict, and each verdict has its own
+consequence:
+
+=================  ==============================  =====================
+verdict            evidence                        consequence
+=================  ==============================  =====================
+``ok``             HTTP 200                        respond; breaker closes
+``draining``       503 + "draining" body           member → draining, reroute
+``shed``           other 503                       backpressure: reroute,
+                                                   else 503 + Retry-After
+``queue_full``     429                             backpressure: reroute,
+                                                   else 429 + Retry-After
+``deadline``       504                             DeadlineExceeded to the
+                                                   client (permanent: the
+                                                   request's budget burned)
+``client_error``   other 4xx                       pass through verbatim
+                                                   (deterministic — every
+                                                   member answers the same)
+``http_5xx``       500/502/...                     breaker counts, reroute
+``connect``        refused/unreachable             breaker counts, reroute
+``reset``          connection reset / no status    breaker counts, reroute
+``eof``            mid-body EOF (IncompleteRead)   breaker counts, reroute
+                                                   (the body never arrived,
+                                                   so a re-send is safe —
+                                                   the compute is pure)
+``timeout``        socket timeout                  breaker counts, reroute
+``injected``       armed ``fed.forward`` fault     breaker counts, reroute
+=================  ==============================  =====================
+
+**Hedged requests.** A forward still pending past the observed p99
+forward latency (``forward_latency_seconds``, floored by
+``hedge_min_s``) fires ONE hedge at the next least-outstanding
+breaker-allowed member. First full response wins; the loser is
+cancelled typed (its socket closed, ``hedge_cancelled_total``) — never
+abandoned to run its course against a host we no longer care about.
+
+**Federation-scope admission**, the PR-10 three-layer ladder one hop
+up, applied BEFORE any forward: drain gate (503), inflight-bytes shed
+(503 + Retry-After; premium tenants get 25% headroom past the standard
+watermark), and per-tenant outstanding quotas keyed on the
+``X-Tenant`` header (:class:`TenantQuotaExceeded` → 429 +
+Retry-After) — one hot client degrades to *its* quota, never the
+fleet.
+
+All-member backpressure re-offers under the shared
+:func:`~tpu_stencil.resilience.retry.reoffer_call` contract for
+``reoffer_s`` before the typed rejection surfaces.
+
+Jax-free, like the whole federation tier.
+"""
+
+from __future__ import annotations
+
+import http.client
+import queue
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+from urllib.parse import urlsplit
+
+from tpu_stencil.config import FedConfig
+from tpu_stencil.fed.breaker import BreakerBoard
+from tpu_stencil.fed.membership import Member, Membership
+from tpu_stencil.net.router import Draining, Overloaded
+from tpu_stencil.obs import span as _obs_span
+from tpu_stencil.resilience.errors import (
+    DeadlineExceeded,
+    HostUnavailable,
+    InjectedFault,
+)
+from tpu_stencil.serve.engine import QueueFull
+from tpu_stencil.serve.metrics import Registry
+
+#: Premium tenants keep being admitted past the standard shed watermark
+#: up to this factor — the two-priority-class degradation order: under
+#: byte pressure, standard traffic sheds first.
+PREMIUM_HEADROOM = 1.25
+
+#: The tenant a request without an X-Tenant header is accounted to.
+DEFAULT_TENANT = "anon"
+
+
+class TenantQuotaExceeded(RuntimeError):
+    """This tenant is at its outstanding-request quota. Transient for
+    the tenant (its own completions free quota), invisible to everyone
+    else — the frontend answers 429 + Retry-After."""
+
+
+def _verdict_exc(e: BaseException) -> str:
+    """Classify a transport-level forward failure (module docstring
+    table). Every one of these counts against the member's breaker."""
+    if isinstance(e, InjectedFault):
+        return "injected"
+    if isinstance(e, TimeoutError):  # socket.timeout is an alias
+        return "timeout"
+    if isinstance(e, ConnectionRefusedError):
+        return "connect"
+    if isinstance(e, http.client.IncompleteRead):
+        return "eof"
+    if isinstance(e, (ConnectionResetError, BrokenPipeError,
+                      http.client.RemoteDisconnected,
+                      http.client.BadStatusLine)):
+        return "reset"
+    if isinstance(e, OSError):
+        return "connect"  # unreachable/DNS/route: never got a byte back
+    return "error"
+
+
+class _Attempt:
+    """One forward attempt against one member, run on its own thread
+    so the race loop can hedge and cancel. The thread owns ALL
+    bookkeeping for its attempt (outstanding, breaker, verdict
+    counters) — a cancelled loser whose result nobody reads still
+    settles its accounts."""
+
+    def __init__(self, router: "FedRouter", member: Member,
+                 body: bytes, headers: Dict[str, str],
+                 is_hedge: bool = False) -> None:
+        self.router = router
+        self.member = member
+        self.body = body
+        self.headers = headers
+        self.is_hedge = is_hedge
+        self.cancelled = False
+        self.elapsed: Optional[float] = None
+        self._conn: Optional[http.client.HTTPConnection] = None
+
+    def start(self, results: "queue.Queue") -> None:
+        threading.Thread(
+            target=self._run_into, args=(results,),
+            name=f"tpu-stencil-fed-fwd-{self.member.host_id}",
+            daemon=True,
+        ).start()
+
+    def cancel(self) -> None:
+        """Typed cancellation of a racing loser: closing the socket
+        from here makes the attempt thread's in-flight read fail
+        immediately — the member may finish the compute, but no one
+        waits on it."""
+        self.cancelled = True
+        conn = self._conn
+        if conn is not None:
+            try:
+                conn.close()
+            except Exception:
+                pass
+
+    def _run(self) -> Tuple[int, Dict[str, str], bytes]:
+        u = urlsplit(self.member.url)
+        conn_cls = (http.client.HTTPSConnection if u.scheme == "https"
+                    else http.client.HTTPConnection)
+        conn = conn_cls(
+            u.hostname, u.port, timeout=self.router.cfg.forward_timeout_s
+        )
+        self._conn = conn
+        try:
+            conn.request("POST", "/v1/blur", body=self.body,
+                         headers=self.headers)
+            resp = conn.getresponse()
+            data = resp.read()  # mid-body EOF raises IncompleteRead
+            rh = {k.lower(): v for k, v in resp.getheaders()}
+            return resp.status, rh, data
+        finally:
+            conn.close()
+
+    def _run_into(self, results: "queue.Queue") -> None:
+        r = self.router
+        hid = self.member.host_id
+        r._track_launch(hid)
+        t0 = time.monotonic()
+        try:
+            if r._fault_forward is not None:
+                r._fault_forward()
+            kind, payload = "resp", self._run()
+        except BaseException as e:
+            kind, payload = "exc", (_verdict_exc(e), e)
+        finally:
+            self.elapsed = time.monotonic() - t0
+            r._track_done(hid)
+        if self.cancelled:
+            # Our own cancellation is not evidence about the host:
+            # release any half-open probe slot, record nothing.
+            r.breakers.get(hid).release_probe()
+            r.registry.counter("hedge_cancelled_total").inc()
+        elif kind == "resp":
+            status = payload[0]
+            if status >= 500 and status not in (503, 504):
+                # 500/502/...: the host answered, but brokenly.
+                r.breakers.record_failure(hid)
+                r.registry.counter("forward_http_5xx_total").inc()
+            else:
+                # ANY coherent response (200, 4xx, 503, 504) proves
+                # the host alive — the breaker's question, not the
+                # request's.
+                r.breakers.record_success(hid)
+        else:
+            r.breakers.record_failure(hid)
+            r.registry.counter(f"forward_{payload[0]}_total").inc()
+        results.put((self.member, self, kind, payload))
+
+
+class FedRouter:
+    """Admission + placement + the hedged forward race."""
+
+    def __init__(self, cfg: FedConfig, membership: Membership,
+                 breakers: BreakerBoard, registry: Registry) -> None:
+        self.cfg = cfg
+        self.membership = membership
+        self.breakers = breakers
+        self.registry = registry
+        self._lock = threading.Lock()
+        self._draining = False
+        self._inflight_bytes = 0
+        self._tenants: Dict[str, int] = {}
+        self._host_outstanding: Dict[str, int] = {}
+        self._premium = frozenset(cfg.premium_tenants)
+        self._fault_forward = None  # resolved at start()
+        self._fault_hedge = None
+        m = registry
+        self._m_requests = m.counter("requests_total")
+        self._m_forwarded = m.counter("forwarded_total")
+        self._m_rejected = m.counter("rejected_total")
+        self._m_shed = m.counter("shed_total")
+        self._m_tenant_rej = m.counter("tenant_quota_rejections_total")
+        self._m_reroutes = m.counter("reroutes_total")
+        self._m_drain_reroutes = m.counter("draining_reroutes_total")
+        self._m_hedges = m.counter("hedges_total")
+        self._m_hedge_wins = m.counter("hedge_wins_total")
+        m.counter("hedge_cancelled_total")
+        self._m_inflight = m.gauge("inflight_bytes")
+        self._g_tenants = m.gauge("tenants_active")
+        self._h_fwd = m.histogram("forward_latency_seconds")
+        m.histogram("request_bytes")
+        m.gauge("draining").set(0)
+
+    def start(self) -> "FedRouter":
+        from tpu_stencil.resilience import faults as _faults
+
+        self._fault_forward = _faults.site("fed.forward")
+        self._fault_hedge = _faults.site("fed.hedge")
+        return self
+
+    # -- drain gate ----------------------------------------------------
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def begin_drain(self) -> None:
+        with self._lock:
+            self._draining = True
+        self.registry.gauge("draining").set(1)
+
+    # -- admission (the PR-10 ladder, one hop up) ----------------------
+
+    def _admit(self, tenant: str, nbytes: int) -> Callable[[], None]:
+        """Drain gate → byte shed (premium headroom) → tenant quota.
+        Returns the release callable; raises typed on rejection."""
+        premium = tenant in self._premium
+        quota = self.cfg.tenant_quota * (
+            self.cfg.premium_quota_factor if premium else 1
+        )
+        with self._lock:
+            if self._draining:
+                raise Draining(
+                    "draining: federation admission stopped; retry "
+                    "against another front router"
+                )
+            watermark = self.cfg.max_inflight_bytes
+            if watermark:
+                limit = (
+                    int(watermark * PREMIUM_HEADROOM) if premium
+                    else watermark
+                )
+                if self._inflight_bytes + nbytes > limit:
+                    self._m_shed.inc()
+                    raise Overloaded(
+                        f"shedding: {self._inflight_bytes + nbytes} "
+                        f"in-flight bytes would exceed the {limit} "
+                        f"federation watermark"
+                        f"{' (standard class)' if not premium else ''}; "
+                        f"retry later"
+                    )
+            cur = self._tenants.get(tenant, 0)
+            if cur >= quota:
+                self._m_tenant_rej.inc()
+                self._m_rejected.inc()
+                raise TenantQuotaExceeded(
+                    f"tenant {tenant!r} is at its quota of {quota} "
+                    f"outstanding requests "
+                    f"({'premium' if premium else 'standard'} class); "
+                    f"its own completions free slots — other tenants "
+                    f"are unaffected"
+                )
+            self._tenants[tenant] = cur + 1
+            self._inflight_bytes += nbytes
+            inflight, ntenants = self._inflight_bytes, len(self._tenants)
+        self._m_inflight.set(inflight)
+        self._g_tenants.set(ntenants)
+
+        def release() -> None:
+            with self._lock:
+                self._tenants[tenant] -= 1
+                if self._tenants[tenant] <= 0:
+                    del self._tenants[tenant]
+                self._inflight_bytes -= nbytes
+                left, nt = self._inflight_bytes, len(self._tenants)
+            self._m_inflight.set(left)
+            self._g_tenants.set(nt)
+
+        return release
+
+    # -- placement -----------------------------------------------------
+
+    def _track_launch(self, host_id: str) -> None:
+        with self._lock:
+            self._host_outstanding[host_id] = (
+                self._host_outstanding.get(host_id, 0) + 1
+            )
+            depth = self._host_outstanding[host_id]
+        self.registry.gauge(f"member_outstanding_{host_id}").set(depth)
+
+    def _track_done(self, host_id: str) -> None:
+        with self._lock:
+            self._host_outstanding[host_id] -= 1
+            depth = self._host_outstanding[host_id]
+        self.registry.gauge(f"member_outstanding_{host_id}").set(depth)
+
+    def outstanding(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._host_outstanding)
+
+    def tenants(self) -> Dict[str, int]:
+        """Current outstanding requests per active tenant (empty
+        entries are dropped on release, so the table is bounded by
+        concurrency, not tenant cardinality)."""
+        with self._lock:
+            return dict(self._tenants)
+
+    def _candidates(self) -> List[Member]:
+        """Routable members in placement order: healthy before suspect
+        (membership's contract), least-outstanding first within each,
+        host_id as the tie-break. Breaker admission happens at launch
+        time (:meth:`_next_allowed`) so half-open probe slots are only
+        consumed by attempts that actually launch."""
+        members = self.membership.routable()
+        with self._lock:
+            out = dict(self._host_outstanding)
+        # routable() returns healthy-then-suspect; a stable sort on
+        # outstanding preserves that class order on ties but must not
+        # interleave classes — sort each class independently.
+        healthy = [m for m in members if m.state == "healthy"]
+        suspect = [m for m in members if m.state != "healthy"]
+        key = lambda m: (out.get(m.host_id, 0), m.host_id)  # noqa: E731
+        return sorted(healthy, key=key) + sorted(suspect, key=key)
+
+    def _next_allowed(self, it) -> Optional[Member]:
+        for m in it:
+            if self.breakers.get(m.host_id).allow():
+                return m
+        return None
+
+    def _hedge_after(self) -> float:
+        """The hedge trigger: the observed p99 forward latency,
+        floored by ``hedge_min_s`` (an empty histogram reads 0.0, so
+        the floor carries the cold start)."""
+        return max(self.cfg.hedge_min_s,
+                   self._h_fwd.percentile(99))
+
+    # -- the forward race ----------------------------------------------
+
+    def submit(self, body: bytes, headers: Dict[str, str], nbytes: int,
+               tenant: str = DEFAULT_TENANT,
+               ) -> Tuple[int, Dict[str, str], bytes, str, bool]:
+        """Admit + forward one request; returns ``(status,
+        response_headers, response_body, member_host_id, hedged)``.
+        Raises :class:`~tpu_stencil.net.router.Draining` /
+        :class:`~tpu_stencil.net.router.Overloaded` /
+        :class:`TenantQuotaExceeded` /
+        :class:`~tpu_stencil.serve.engine.QueueFull` /
+        :class:`~tpu_stencil.resilience.errors.HostUnavailable` /
+        :class:`~tpu_stencil.resilience.errors.DeadlineExceeded` —
+        each mapped to its own HTTP status by the frontend."""
+        release = self._admit(tenant, nbytes)
+        try:
+            self._m_requests.inc()
+            # The frame itself, not the caller's 2x request+response
+            # admission accounting in nbytes.
+            self.registry.histogram("request_bytes").observe(len(body))
+            if self.cfg.reoffer_s > 0:
+                from tpu_stencil.resilience import retry as _retry
+
+                try:
+                    return _retry.reoffer_call(
+                        lambda: self._forward(body, headers),
+                        give_up_after_s=self.cfg.reoffer_s,
+                        base_delay=0.01, max_delay=0.1,
+                        label="fed.forward",
+                    )
+                except TimeoutError as te:
+                    # Surface the LAST typed rejection, not the
+                    # give-up wrapper — the client needs the real
+                    # status (429 vs 503) and its Retry-After.
+                    if te.__cause__ is not None:
+                        raise te.__cause__ from None
+                    raise
+            return self._forward(body, headers)
+        finally:
+            release()
+
+    def _forward(self, body: bytes, headers: Dict[str, str],
+                 ) -> Tuple[int, Dict[str, str], bytes, str, bool]:
+        cands = self._candidates()
+        if not cands:
+            raise HostUnavailable(
+                "no routable member host (every member is draining, "
+                "evicted, or unregistered)"
+            )
+        it = iter(cands)
+        first = self._next_allowed(it)
+        if first is None:
+            raise HostUnavailable(
+                f"every routable member's circuit breaker is open "
+                f"({len(cands)} member(s) failing)"
+            )
+        results: "queue.Queue" = queue.Queue()
+        active: Dict[str, _Attempt] = {}
+        backpressure: List[Tuple[int, Optional[str]]] = []
+        failures: List[Tuple[str, str]] = []
+
+        def launch(m: Member, is_hedge: bool = False) -> None:
+            att = _Attempt(self, m, body, headers, is_hedge=is_hedge)
+            active[m.host_id] = att
+            att.start(results)
+
+        def reroute() -> bool:
+            nxt = self._next_allowed(it)
+            if nxt is None:
+                return False
+            self._m_reroutes.inc()
+            launch(nxt)
+            return True
+
+        def cancel_rest() -> None:
+            for att in active.values():
+                att.cancel()
+
+        launch(first)
+        hedged = False        # a hedge attempt actually LAUNCHED
+        hedge_armed = self.cfg.hedge  # the one-shot trigger timer
+        hedge_deadline = (
+            time.monotonic() + self._hedge_after()
+            if self.cfg.hedge else None
+        )
+        while active:
+            timeout = None
+            if hedge_deadline is not None and hedge_armed:
+                timeout = max(0.0, hedge_deadline - time.monotonic())
+            try:
+                m, att, kind, payload = results.get(timeout=timeout)
+            except queue.Empty:
+                # The hedge trigger: the attempt has been pending past
+                # the observed p99 — fire ONE hedge at the next
+                # breaker-allowed member (the armed ``fed.hedge``
+                # fault point suppresses it, chaos-testing the
+                # no-hedge path).
+                hedge_armed = False
+                if self._fault_hedge is not None:
+                    try:
+                        self._fault_hedge()
+                    except Exception:
+                        continue
+                nxt = self._next_allowed(it)
+                if nxt is not None:
+                    self._m_hedges.inc()
+                    hedged = True
+                    with _obs_span("fed.hedge", "fed",
+                                   host=nxt.host_id):
+                        launch(nxt, is_hedge=True)
+                continue
+            active.pop(m.host_id, None)
+            if att.cancelled:
+                continue
+            if kind == "resp":
+                status, rh, data = payload
+                if status == 200:
+                    cancel_rest()
+                    if att.elapsed is not None:
+                        self._h_fwd.observe(att.elapsed)
+                    self._m_forwarded.inc()
+                    if att.is_hedge:
+                        self._m_hedge_wins.inc()
+                    return status, rh, data, m.host_id, (
+                        hedged or att.is_hedge
+                    )
+                if status == 504:
+                    # The member burned this request's deadline; a
+                    # reroute can only expire again. Permanent.
+                    cancel_rest()
+                    raise DeadlineExceeded(
+                        f"member {m.host_id}: "
+                        f"{data.decode(errors='replace').strip()}"
+                    )
+                if status == 503 and b"draining" in data:
+                    # Membership verdict, not a failure: bleed the
+                    # host out of routing and move on.
+                    self.membership.mark_draining(m.host_id)
+                    self._m_drain_reroutes.inc()
+                    if not reroute() and not active:
+                        break
+                    continue
+                if status in (429, 503):
+                    backpressure.append(
+                        (status, rh.get("retry-after"))
+                    )
+                    if not reroute() and not active:
+                        break
+                    continue
+                if 400 <= status < 500:
+                    # Deterministic client error: every member answers
+                    # the same, so pass the first one through verbatim.
+                    cancel_rest()
+                    return status, rh, data, m.host_id, hedged
+                # Remaining 5xx: the attempt thread already charged
+                # the breaker; reroute.
+                failures.append((m.host_id, f"http_{status}"))
+                if not reroute() and not active:
+                    break
+                continue
+            # Transport-level failure (verdict already counted and
+            # breaker-charged by the attempt thread).
+            verdict, _exc = payload
+            failures.append((m.host_id, verdict))
+            if not reroute() and not active:
+                break
+        # Every candidate consumed, no winner.
+        if backpressure:
+            status, retry_after = backpressure[-1]
+            if any(s == 503 for s, _ in backpressure):
+                e: Exception = Overloaded(
+                    f"every routable member is shedding "
+                    f"({len(backpressure)} backpressure answers)"
+                )
+            else:
+                e = QueueFull(
+                    f"every routable member queue is at capacity "
+                    f"({len(backpressure)} backpressure answers)"
+                )
+            if retry_after:
+                try:
+                    # HTTP-date Retry-After values are spec-legal; an
+                    # unparseable hint is no hint, never a 500.
+                    e.retry_after_s = float(retry_after)
+                except ValueError:
+                    pass
+            raise e
+        detail = ", ".join(f"{h}: {v}" for h, v in failures) or "none"
+        raise HostUnavailable(
+            f"every forward attempt failed ({detail}); breakers are "
+            f"counting — retry after a cooldown",
+            host=failures[-1][0] if failures else None,
+        )
+
+    # -- drain ---------------------------------------------------------
+
+    def drain_wait(self, timeout_s: float) -> Dict[str, bool]:
+        """Wait for every member's outstanding forwarded requests to
+        bleed to zero; returns ``{host_id: clean}`` — False names a
+        member still holding requests past the budget (the net CLI's
+        drained-vs-abandoned discipline, per host)."""
+        deadline = time.monotonic() + timeout_s
+        hosts = {m.host_id for m in self.membership.members()}
+        while time.monotonic() < deadline:
+            out = self.outstanding()
+            if all(out.get(h, 0) == 0 for h in hosts):
+                break
+            time.sleep(0.05)
+        out = self.outstanding()
+        return {h: out.get(h, 0) == 0 for h in sorted(hosts)}

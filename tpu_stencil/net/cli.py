@@ -75,6 +75,17 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--platform", default=None,
                    choices=["cpu", "tpu", "gpu"],
                    help="force the JAX platform before backend init")
+    p.add_argument("--register", default=None, metavar="FED_URL",
+                   help="announce this host to a federation front "
+                        "router (tpu_stencil fed) at FED_URL on "
+                        "startup: POSTs the advertised URL to "
+                        "FED_URL/admin/register with backoff retries "
+                        "(best-effort — the fed may start later and "
+                        "seed-list this host instead)")
+    p.add_argument("--advertise", default=None, metavar="URL",
+                   help="the URL to register (default "
+                        "http://<host>:<bound port>; set it when this "
+                        "host binds 0.0.0.0 or sits behind NAT)")
     p.add_argument("--metrics-text", default=None, metavar="PATH",
                    help="after the drain, write the fleet-wide metrics "
                         "(the /metrics exposition) to PATH ('-' = stdout)")
@@ -82,6 +93,44 @@ def build_parser() -> argparse.ArgumentParser:
                    help="after the drain, dump the /statusz payload as "
                         "JSON to PATH ('-' = stdout); versioned schema")
     return p
+
+
+def _register_with_fed(fed_url: str, advertise: str) -> None:
+    """Announce this host to the federation in the background: POST
+    the advertised URL to ``<fed_url>/admin/register`` under the
+    shared retry policy (the fed may still be starting). Best-effort —
+    a federation that never answers is logged, not fatal: the fed can
+    seed-list this host instead."""
+    import urllib.parse
+    import urllib.request
+
+    from tpu_stencil.resilience import retry as _retry
+
+    target = (fed_url.rstrip("/") + "/admin/register?url="
+              + urllib.parse.quote(advertise, safe=""))
+
+    def announce() -> None:
+        req = urllib.request.Request(target, data=b"", method="POST")
+        with urllib.request.urlopen(req, timeout=10.0):
+            pass
+
+    def run() -> None:
+        try:
+            _retry.retry_call(
+                announce,
+                policy=_retry.RetryPolicy(attempts=8, base_delay=0.25,
+                                          multiplier=2.0, max_delay=5.0),
+                label="net.register",
+            )
+            print(f"net: registered {advertise} with federation "
+                  f"{fed_url}", flush=True)
+        except Exception as e:
+            print(f"net: federation registration with {fed_url} "
+                  f"failed ({type(e).__name__}: {e}); serving "
+                  f"unfederated", flush=True)
+
+    threading.Thread(target=run, name="tpu-stencil-net-register",
+                     daemon=True).start()
 
 
 def main(argv=None) -> int:
@@ -125,13 +174,19 @@ def main(argv=None) -> int:
         f"SIGTERM drains",
         flush=True,
     )
+    if ns.register:
+        _register_with_fed(ns.register, ns.advertise or fe.url)
     # Timed waits, not a bare stop.wait(): an untimed Event.wait parks
     # the main thread in an uninterruptible lock acquire, so a Python
     # signal handler that only sets the event would never run — the
     # classic self-deadlock. A timed wait re-checks pending signals on
     # every expiry.
     while not stop.wait(0.5):
-        pass
+        if fe.admin_drain_requested.is_set():
+            # POST /admin/drain: the SIGTERM-equivalent admin path —
+            # same drain sequence, same rc discipline.
+            print("net: admin drain requested, draining", flush=True)
+            break
     t0 = time.perf_counter()
     report = fe.drain(cfg.drain_timeout_s)
     hung = sorted(i for i, ok in report.items() if not ok)

@@ -26,6 +26,15 @@ Endpoints (docs/SERVING.md "Network tier" is the contract):
   router outstanding/inflight, drain state (versioned schema).
 * ``POST /admin/restart?replica=i`` — rolling single-replica restart
   (:meth:`ReplicaFleet.restart`); the rest of the fleet serves on.
+* ``POST /admin/drain`` — the SIGTERM-equivalent admin path (the
+  federation's rolling whole-host drain drives it): flips healthz,
+  stops admission, and signals the CLI loop to run the full drain
+  sequence and exit with its usual rc discipline.
+
+Chaos sites ``net.accept`` (drop/stall a connection before any
+response) and ``net.body`` (truncate a 200 mid-body, or stall) arm via
+the standard ``TPU_STENCIL_FAULTS`` grammar — the socket-level failure
+modes the federation's verdict classifier must survive.
 
 :class:`NetFrontend` owns the whole tier lifecycle: fleet → router →
 threaded HTTP server, then ``begin_drain`` (flip healthz, stop
@@ -70,10 +79,81 @@ _RESULT_TIMEOUT_S = 600.0
 # (chunked uploads have no Content-Length to sanity-check up front).
 _MAX_EXTRA_BODY = 2
 
+# How long an armed net.accept/net.body rule with raise=TimeoutError
+# stalls the handler (the chaos stand-in for a wedged host; the default
+# outlasts the 120s read-side socket timeout and typical forward
+# timeouts, so the PEER's timeout path fires — tests shrink it).
+STALL_ENV = "TPU_STENCIL_FAULT_STALL_S"
+_DEFAULT_STALL_S = 150.0
+
+
+def _fault_stall_s() -> float:
+    import os
+
+    return float(os.environ.get(STALL_ENV, _DEFAULT_STALL_S))
+
 
 class _Oversized(ValueError):
     """Body larger than the declared frame (→ 413; a malformed framing
     header is a plain ValueError → 400 — shrinking won't fix it)."""
+
+
+def read_request_body(rfile, headers, limit: int) -> bytes:
+    """The upload: ``Content-Length`` bodies in one read, chunked
+    transfer decoded chunk by chunk (stdlib handlers do NOT de-chunk).
+    ``limit`` bounds either path — a body past the declared frame size
+    fails typed (:class:`_Oversized` → 413) instead of buffering.
+    Module-level so the federation frontend (:mod:`tpu_stencil.fed`)
+    reads its uploads under the exact same framing contract."""
+    te = (headers.get("Transfer-Encoding") or "").lower()
+    if "chunked" in te:
+        chunks = []
+        total = 0
+        while True:
+            # 1024 accommodates spec-legal chunk extensions; a line
+            # that still lacks its newline was truncated mid-line,
+            # and parsing it would desync the stream (the unread
+            # tail would be consumed as payload) — fail typed.
+            size_line = rfile.readline(1024)
+            if size_line and not size_line.endswith(b"\n"):
+                raise ValueError(
+                    "chunk-size line exceeds 1024 bytes"
+                )
+            try:
+                size = int(
+                    size_line.split(b";")[0].strip() or b"0", 16
+                )
+            except ValueError:
+                raise ValueError(
+                    f"malformed chunk-size line {size_line!r}"
+                ) from None
+            if size == 0:
+                # Consume trailers (none expected) up to blank line.
+                while rfile.readline(1024).strip():
+                    pass
+                break
+            total += size
+            if total > limit + _MAX_EXTRA_BODY:
+                raise _Oversized(
+                    f"chunked body exceeds declared frame size "
+                    f"({limit} bytes)"
+                )
+            chunks.append(rfile.read(size))
+            rfile.read(2)  # chunk-terminating CRLF
+        return b"".join(chunks)
+    try:
+        n = int(headers.get("Content-Length") or 0)
+    except ValueError:
+        raise ValueError(
+            f"malformed Content-Length "
+            f"{headers.get('Content-Length')!r}"
+        ) from None
+    if n > limit + _MAX_EXTRA_BODY:
+        raise _Oversized(
+            f"body of {n} bytes exceeds declared frame size "
+            f"({limit} bytes)"
+        )
+    return rfile.read(n)
 
 
 class _NetHTTPServer(ThreadingHTTPServer):
@@ -140,59 +220,7 @@ class _Handler(BaseHTTPRequestHandler):
         return default
 
     def _read_body(self, limit: int) -> bytes:
-        """The upload: ``Content-Length`` bodies in one read, chunked
-        transfer decoded chunk by chunk (stdlib handlers do NOT
-        de-chunk). ``limit`` bounds either path — a body past the
-        declared frame size fails typed instead of buffering."""
-        te = (self.headers.get("Transfer-Encoding") or "").lower()
-        if "chunked" in te:
-            chunks = []
-            total = 0
-            while True:
-                # 1024 accommodates spec-legal chunk extensions; a line
-                # that still lacks its newline was truncated mid-line,
-                # and parsing it would desync the stream (the unread
-                # tail would be consumed as payload) — fail typed.
-                size_line = self.rfile.readline(1024)
-                if size_line and not size_line.endswith(b"\n"):
-                    raise ValueError(
-                        "chunk-size line exceeds 1024 bytes"
-                    )
-                try:
-                    size = int(
-                        size_line.split(b";")[0].strip() or b"0", 16
-                    )
-                except ValueError:
-                    raise ValueError(
-                        f"malformed chunk-size line {size_line!r}"
-                    ) from None
-                if size == 0:
-                    # Consume trailers (none expected) up to blank line.
-                    while self.rfile.readline(1024).strip():
-                        pass
-                    break
-                total += size
-                if total > limit + _MAX_EXTRA_BODY:
-                    raise _Oversized(
-                        f"chunked body exceeds declared frame size "
-                        f"({limit} bytes)"
-                    )
-                chunks.append(self.rfile.read(size))
-                self.rfile.read(2)  # chunk-terminating CRLF
-            return b"".join(chunks)
-        try:
-            n = int(self.headers.get("Content-Length") or 0)
-        except ValueError:
-            raise ValueError(
-                f"malformed Content-Length "
-                f"{self.headers.get('Content-Length')!r}"
-            ) from None
-        if n > limit + _MAX_EXTRA_BODY:
-            raise _Oversized(
-                f"body of {n} bytes exceeds declared frame size "
-                f"({limit} bytes)"
-            )
-        return self.rfile.read(n)
+        return read_request_body(self.rfile, self.headers, limit)
 
     # -- GET -----------------------------------------------------------
 
@@ -223,8 +251,71 @@ class _Handler(BaseHTTPRequestHandler):
             self._blur(parse_qs(split.query))
         elif split.path == "/admin/restart":
             self._restart(parse_qs(split.query))
+        elif split.path == "/admin/drain":
+            self._admin_drain()
         else:
             self._error(404, f"no such endpoint: {split.path}")
+
+    # -- socket-level fault sites (net.accept / net.body) --------------
+
+    def _socket_fault(self, site) -> bool:
+        """Fire an armed ``net.accept`` rule. A ``raise=TimeoutError``
+        rule STALLS the handler (the wedged-host chaos mode — the
+        peer's socket/forward timeout is what fires); any other rule
+        DROPS the connection with no response at all (the client sees
+        a reset/empty reply, the federation's ``reset`` verdict).
+        Returns True when the handler must return immediately."""
+        try:
+            site()
+        except TimeoutError:
+            time.sleep(_fault_stall_s())
+            return False
+        except Exception:
+            self.close_connection = True
+            return True
+        return False
+
+    def _body_fault(self, site, payload: bytes) -> bool:
+        """Fire an armed ``net.body`` rule on a success response. A
+        ``raise=TimeoutError`` rule stalls before the body is written;
+        any other rule declares the FULL Content-Length, writes half
+        the body, and drops the connection — the mid-body EOF the
+        federation's ``eof`` verdict classifies. Returns True when the
+        (truncated) response was already written."""
+        try:
+            site()
+        except TimeoutError:
+            time.sleep(_fault_stall_s())
+            return False
+        except Exception:
+            self.close_connection = True
+            self.send_response(200)
+            self.send_header("Content-Type", "application/octet-stream")
+            self.send_header("Content-Length", str(len(payload)))
+            self.send_header("Connection", "close")
+            self.end_headers()
+            self.wfile.write(payload[: max(1, len(payload) // 2)])
+            try:
+                self.wfile.flush()
+            except Exception:
+                pass
+            return True
+        return False
+
+    def _admin_drain(self) -> None:
+        """The SIGTERM-equivalent admin path (the federation's rolling
+        whole-host drain calls it): flip /healthz to draining, stop
+        admission, and signal the CLI loop to run the full drain
+        sequence and exit with its usual rc discipline. Responds
+        BEFORE the replicas drain — the drain takes seconds and the
+        caller only needs the acknowledgement."""
+        n = int(self.headers.get("Content-Length") or 0)
+        if n:
+            self.rfile.read(min(n, 1 << 20))
+        self.fe.request_admin_drain()
+        self._respond(200, json.dumps(
+            {"draining": True, "replicas": len(self.fe.fleet)}
+        ).encode(), content_type="application/json")
 
     def _restart(self, query: dict) -> None:
         # Consume any request body first: an unread body corrupts the
@@ -248,6 +339,10 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _blur(self, query: dict) -> None:
         fe = self.fe
+        if fe.fault_accept is not None and self._socket_fault(
+            fe.fault_accept
+        ):
+            return  # injected connection drop: no response at all
         t0 = time.perf_counter()
         with _obs_span("net.request", "net"):
             try:
@@ -362,8 +457,13 @@ class _Handler(BaseHTTPRequestHandler):
             fe.registry.histogram("request_latency_seconds").observe(
                 time.perf_counter() - t0
             )
+            payload = np.ascontiguousarray(out).tobytes()
+            if fe.fault_body is not None and self._body_fault(
+                fe.fault_body, payload
+            ):
+                return  # injected mid-body EOF: truncated 200 written
             self._respond(
-                200, np.ascontiguousarray(out).tobytes(),
+                200, payload,
                 content_type="application/octet-stream",
                 headers={
                     "X-Width": str(w), "X-Height": str(h),
@@ -397,10 +497,20 @@ class NetFrontend:
         self._thread: Optional[threading.Thread] = None
         self._drain_report: Optional[Dict[int, bool]] = None
         self._t_start = time.monotonic()
+        # Set by POST /admin/drain (the SIGTERM-equivalent admin
+        # path); the CLI main loop watches it next to the signal flag.
+        self.admin_drain_requested = threading.Event()
+        # net.accept / net.body chaos sites, resolved once at start().
+        self.fault_accept = None
+        self.fault_body = None
 
     # -- lifecycle -----------------------------------------------------
 
     def start(self) -> "NetFrontend":
+        from tpu_stencil.resilience import faults as _faults
+
+        self.fault_accept = _faults.site("net.accept")
+        self.fault_body = _faults.site("net.body")
         self.fleet.start()
         self.router = Router(
             self.fleet, self.registry,
@@ -429,6 +539,14 @@ class NetFrontend:
         probes observe the flip."""
         assert self.router is not None, "not started"
         self.router.begin_drain()
+
+    def request_admin_drain(self) -> None:
+        """The ``POST /admin/drain`` semantics: flip healthz + stop
+        admission NOW, and raise the flag the CLI loop treats exactly
+        like SIGTERM (full replica drain, rc discipline). Library
+        embedders watch ``admin_drain_requested`` themselves."""
+        self.begin_drain()
+        self.admin_drain_requested.set()
 
     def drain(self, timeout_s: Optional[float] = None) -> Dict[int, bool]:
         """The SIGTERM sequence minus the process exit: stop admission,

@@ -31,6 +31,7 @@ from tpu_stencil.resilience.errors import (
     DeadlineExceeded,
     DispatchTimeout,
     FatalInjectedFault,
+    HostUnavailable,
     InjectedFault,
     InjectedOOM,
     ResilienceError,
@@ -42,6 +43,7 @@ __all__ = [
     "DeadlineExceeded",
     "DispatchTimeout",
     "FatalInjectedFault",
+    "HostUnavailable",
     "InjectedFault",
     "InjectedOOM",
     "ResilienceError",

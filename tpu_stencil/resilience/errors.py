@@ -110,3 +110,19 @@ class WorkerCrashed(ResilienceError):
     exception. Every queued and in-flight future fails with this (they
     would otherwise wait forever), and subsequent submits are rejected
     with it — a crashed server stays typed-dead until reconstructed."""
+
+
+class HostUnavailable(ResilienceError):
+    """A federation member host cannot take traffic right now: its
+    circuit breaker is open after consecutive transport failures, every
+    routable member's forward attempt failed, or no routable member
+    remains at all (:mod:`tpu_stencil.fed`). Transient by
+    classification — breakers half-open after their cooldown and the
+    membership heartbeat re-admits recovering hosts, so a later attempt
+    may land (the federation frontend answers 503 + Retry-After).
+    ``host`` names the member when the failure is host-scoped (None for
+    the no-routable-member case)."""
+
+    def __init__(self, msg: str, host: Optional[str] = None) -> None:
+        super().__init__(msg)
+        self.host = host

@@ -69,6 +69,18 @@ POINTS = (
     "compute",     # per-rep / per-frame / per-batch compute dispatch
     "collective",  # sharded halo-exchange launch
     "checkpoint",  # checkpoint sidecar/data write
+    # Socket-level sites in the HTTP tier (net/http.py): `net.accept`
+    # drops (or, with raise=TimeoutError, stalls) a connection before
+    # any response; `net.body` truncates (or stalls) a 200 response
+    # mid-body — the chaos stand-ins for a host dying mid-request, so
+    # the federation's connect/mid-body-EOF/timeout verdicts are
+    # testable against a real socket, not just unit mocks.
+    "net.accept",  # HTTP request handling entry (drop/stall connection)
+    "net.body",    # HTTP response body write (mid-body EOF / stall)
+    # Federation-hop sites (fed/): each boundary of the front router.
+    "fed.heartbeat",  # membership /healthz probe (injected = a miss)
+    "fed.forward",    # one member forward attempt launch
+    "fed.hedge",      # hedge-request launch decision
 )
 
 #: Resolvable ``raise=`` names. A short allow-list, not arbitrary eval:

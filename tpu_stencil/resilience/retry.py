@@ -43,6 +43,7 @@ from typing import Callable, Optional
 from tpu_stencil.resilience.errors import (
     DeadlineExceeded,
     DispatchTimeout,
+    HostUnavailable,
     InjectedFault,
 )
 
@@ -87,6 +88,10 @@ def classify(exc: BaseException) -> str:
     if isinstance(exc, DeadlineExceeded):
         return PERMANENT  # an expired request can only expire again
     if isinstance(exc, (InjectedFault, DispatchTimeout)):
+        return TRANSIENT
+    if isinstance(exc, HostUnavailable):
+        # Federation verdict: a breaker half-opens after its cooldown
+        # and heartbeats re-admit recovering hosts — worth a re-offer.
         return TRANSIENT
     if type(exc).__name__ in _TRANSIENT_TYPE_NAMES:
         return TRANSIENT
@@ -184,10 +189,28 @@ def retry_call(
             obs.registry().counter("resilience_retries_total").inc()
             if on_retry is not None:
                 on_retry(attempt, e)
+            pause = policy.delay(attempt)
+            # A server that answered with an explicit Retry-After hint
+            # (the net/fed tiers' shed 503 and queue-full 429 carry
+            # one, attached by HttpTarget as ``retry_after_s``) knows
+            # its own backlog better than our jitter schedule does:
+            # honor the hint as the backoff FLOOR — never re-offer
+            # sooner than the server asked, while a longer computed
+            # backoff still stands.
+            hint = getattr(e, "retry_after_s", None)
+            try:
+                hint = float(hint) if hint is not None else None
+            except (TypeError, ValueError):
+                hint = None  # an unparseable hint is no hint
+            if hint is not None and hint > pause:
+                pause = hint
+                obs.registry().counter(
+                    "resilience_retry_after_honored_total"
+                ).inc()
             with obs.span("resilience.retry", "resilience",
                           attempt=attempt, label=label,
                           error=type(e).__name__):
-                time.sleep(policy.delay(attempt))
+                time.sleep(pause)
     raise last  # unreachable (the loop always returns or raises)
 
 
@@ -215,10 +238,28 @@ def reoffer_call(
     )
 
     def on_retry(_attempt: int, exc: BaseException) -> None:
-        if budget is not None and budget.expired():
+        if budget is None:
+            return
+        if budget.expired():
             raise TimeoutError(
                 f"gave up re-offering after {give_up_after_s}s of "
                 f"backpressure"
+            ) from exc
+        # A server Retry-After hint past the remaining budget means the
+        # next legal re-offer cannot happen inside the window — give up
+        # NOW instead of floor-sleeping past the budget (the caller is
+        # holding admission slots for the duration of this call).
+        hint = getattr(exc, "retry_after_s", None)
+        try:
+            hint = float(hint) if hint is not None else None
+        except (TypeError, ValueError):
+            hint = None
+        if hint is not None and hint > budget.remaining():
+            raise TimeoutError(
+                f"gave up re-offering: the server asked for "
+                f"{hint:g}s of backoff but only "
+                f"{max(0.0, budget.remaining()):.3g}s of the "
+                f"{give_up_after_s}s budget remains"
             ) from exc
 
     return retry_call(

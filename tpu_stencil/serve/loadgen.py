@@ -51,7 +51,10 @@ class HttpTarget:
 
     The status-code mapping inverts the frontend's: 429 (and a
     shedding 503) raise :class:`QueueFull` — transient backpressure
-    the loops already know how to retry or shed — a draining 503
+    the loops already know how to retry or shed, carrying the
+    response's ``Retry-After`` hint as ``retry_after_s`` so the shared
+    retry loop honors it as the backoff floor
+    (``retry_after_honored_total`` in the report) — a draining 503
     raises :class:`ServerClosed` (permanent for that process: the
     drain gate never reopens, so re-offering is futile), and 504
     raises a typed ``DeadlineExceeded``. ``stats()`` scrapes
@@ -105,7 +108,19 @@ class HttpTarget:
                 # same as the in-process spelling.
                 raise ServerClosed(f"HTTP 503: {detail}") from None
             if e.code in (429, 503):
-                raise QueueFull(f"HTTP {e.code}: {detail}") from None
+                exc = QueueFull(f"HTTP {e.code}: {detail}")
+                # The shed/queue-full responses carry a Retry-After
+                # hint; attach it so the shared retry loop honors it
+                # as the backoff FLOOR (retry_call) instead of pure
+                # exp-jitter — re-offering sooner than the server
+                # asked just burns its admission path.
+                ra = e.headers.get("Retry-After")
+                if ra:
+                    try:
+                        exc.retry_after_s = float(ra)
+                    except ValueError:
+                        pass  # an unparseable hint is no hint
+                raise exc from None
             if e.code == 504:
                 raise DeadlineExceeded(f"HTTP 504: {detail}") from None
             # Anything else (400/404/413/500...) is deterministic: the
@@ -220,6 +235,14 @@ def run(
         mode, rate = "open", float(rate_fps)
     if mode not in ("closed", "open"):
         raise ValueError(f"mode must be closed|open, got {mode!r}")
+    from tpu_stencil import obs
+
+    # Client-side counter delta: how many re-offers this run slept to
+    # a server-provided Retry-After floor (retry_call honors the hint
+    # the shed 503 / queue-full 429 responses carry).
+    honored0 = obs.registry().counter(
+        "resilience_retry_after_honored_total"
+    ).value
     images = synth_requests(requests, shapes, channels, seed)
     completed = 0
     completed_lock = threading.Lock()
@@ -319,6 +342,9 @@ def run(
         "throughput_rps": completed / wall if wall > 0 else 0.0,
         "p50_s": rlat["p50"],
         "p99_s": rlat["p99"],
+        "retry_after_honored_total": obs.registry().counter(
+            "resilience_retry_after_honored_total"
+        ).value - honored0,
         "stats": stats,
     }
     if rate_fps is not None:
