@@ -328,7 +328,10 @@ def test_legacy_checkpoint_without_boundary_key_resumes(tmp_path, rng):
     ckpt.save(cfg, 2, img)
     meta_path = cfg.output_path + ".ckpt.json"
     meta = json.load(open(meta_path))
-    del meta["boundary"]  # simulate a pre-upgrade checkpoint
+    del meta["boundary"]  # simulate a pre-upgrade checkpoint...
+    # ...which also predates the embedded integrity CRC (a stale stamp
+    # over the edited payload would be refused as corrupt, correctly).
+    meta.pop("crc32c", None)
     json.dump(meta, open(meta_path, "w"))
     rep, frame = ckpt.restore(cfg)
     assert rep == 2

@@ -1,0 +1,997 @@
+"""Integrity suite (ISSUE 12): end-to-end checksums, witness
+re-execution, replica quarantine, durable-state CRCs.
+
+The contract every chaos case asserts: an injected corruption
+(``integrity.corrupt_ingest`` / ``integrity.corrupt_result`` /
+``net.corrupt_body``, plus bit flips in durable state) is **detected
+and typed** — a 4xx, a quarantine transition, or a refused resume —
+never a silently returned wrong byte. The clean-path cases assert the
+layer itself never perturbs results (stamped CRCs match, witnesses
+agree, verified streams stay bit-exact).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+import warnings
+
+import numpy as np
+import pytest
+
+from tpu_stencil import filters, obs
+from tpu_stencil.config import (
+    ImageType,
+    NetConfig,
+    ServeConfig,
+    StreamConfig,
+)
+from tpu_stencil.integrity import checksum, quarantine, witness
+from tpu_stencil.integrity.checksum import ChecksumMismatch, WitnessMismatch
+from tpu_stencil.ops import stencil
+from tpu_stencil.resilience import faults
+
+H, W, C, REPS = 32, 24, 3, 3
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    faults.clear()
+    obs.reset()
+    yield
+    faults.clear()
+    obs.reset()
+
+
+def _golden(img, reps, filter_name="gaussian"):
+    return stencil.reference_stencil_numpy(
+        img, filters.get_filter(filter_name), reps
+    )
+
+
+def _img(rng=None, shape=(H, W, C)):
+    rng = rng or np.random.default_rng(7)
+    return rng.integers(0, 256, size=shape, dtype=np.uint8)
+
+
+# -- checksum primitives ------------------------------------------------
+
+def test_crc32c_known_vector():
+    # The standard CRC32C check value (RFC 3720 appendix B.4 et al).
+    assert checksum.crc32c(b"123456789") == 0xE3069283
+    assert checksum._crc32c_py(b"123456789") == 0xE3069283
+
+
+def test_crc32c_fast_and_fallback_agree_incrementally():
+    data = os.urandom(1000)
+    assert checksum._crc32c_py(data) == checksum.crc32c(data)
+    assert checksum.crc32c(data[500:], checksum.crc32c(data[:500])) \
+        == checksum.crc32c(data)
+
+
+def test_crc32c_array_equals_bytes():
+    a = _img()
+    assert checksum.crc32c(a) == checksum.crc32c(a.tobytes())
+    # Non-contiguous views checksum their logical row-major bytes.
+    v = a[::2]
+    assert checksum.crc32c(v) == checksum.crc32c(
+        np.ascontiguousarray(v).tobytes()
+    )
+
+
+def test_verify_raises_typed_and_permanent():
+    from tpu_stencil.resilience import retry
+
+    checksum.verify(b"abc", checksum.crc32c(b"abc"), "here")
+    with pytest.raises(ChecksumMismatch) as ei:
+        checksum.verify(b"abc", 1, "the hop")
+    assert "the hop" in str(ei.value)
+    assert isinstance(ei.value, ValueError)
+    assert not retry.is_transient(ei.value)  # re-sending re-fails
+    assert not retry.is_transient(WitnessMismatch("w"))
+
+
+def test_parse_crc_rejects_malformed():
+    assert checksum.parse_crc("123", "h") == 123
+    for bad in ("abc", "", "-1", str(1 << 32)):
+        with pytest.raises(ValueError):
+            checksum.parse_crc(bad, "h")
+
+
+def test_corrupt_helpers_flip_exactly_one_bit():
+    data = bytes(range(256))
+    bad = checksum.corrupt_bytes(data)
+    assert len(bad) == len(data)
+    diff = [i for i in range(len(data)) if data[i] != bad[i]]
+    assert len(diff) == 1 and bad[diff[0]] == data[diff[0]] ^ 0x01
+    assert checksum.corrupt_bytes(b"") == b""
+    arr = _img()
+    before = arr.copy()
+    out = checksum.corrupt_array(arr)
+    assert out is arr  # writable: corrupted in place
+    assert np.sum(before != arr) == 1
+    ro = before.copy()
+    ro.flags.writeable = False
+    out2 = checksum.corrupt_array(ro)
+    assert out2 is not ro and np.sum(out2 != before) == 1
+
+
+# -- witness sampling ---------------------------------------------------
+
+def test_witness_sampler_deterministic_per_seed():
+    a = witness.WitnessSampler(0.3, seed=5)
+    b = witness.WitnessSampler(0.3, seed=5)
+    seq = [a.pick() for _ in range(200)]
+    assert seq == [b.pick() for _ in range(200)]
+    assert any(seq) and not all(seq)
+    c = witness.WitnessSampler(0.3, seed=6)
+    assert seq != [c.pick() for _ in range(200)]
+
+
+def test_witness_sampler_edges():
+    assert not any(witness.WitnessSampler(0.0).pick() for _ in range(50))
+    assert all(witness.WitnessSampler(1.0).pick() for _ in range(50))
+    with pytest.raises(ValueError):
+        witness.WitnessSampler(1.5)
+
+
+def test_device_witness_matches_golden():
+    img = _img()
+    assert np.array_equal(
+        witness.device_witness(img, "gaussian", REPS), _golden(img, REPS)
+    )
+    grey = _img(shape=(17, 23))
+    assert witness.golden_witness(
+        grey, "gaussian", 2, witness.device_witness(grey, "gaussian", 2)
+    )
+
+
+# -- quarantine board ---------------------------------------------------
+
+def _board(**kw):
+    from tpu_stencil.serve.metrics import Registry
+
+    reg = Registry()
+    kw.setdefault("quarantine_after", 3)
+    kw.setdefault("window_s", 60.0)
+    kw.setdefault("readmit_after", 2)
+    return quarantine.QuarantineBoard(reg, **kw), reg
+
+
+def test_board_trips_after_k_mismatches():
+    board, reg = _board()
+    assert not board.record_witness(0, False)
+    assert not board.record_witness(0, False)
+    assert not board.is_quarantined(0)
+    assert board.record_witness(0, False)  # K=3 trips
+    assert board.is_quarantined(0)
+    assert reg.counter("integrity_quarantines_total").value == 1
+    assert reg.gauge("replica_quarantined_dev0").value == 1
+    # Verdicts against a quarantined replica are ignored.
+    assert not board.record_witness(0, False)
+    assert reg.counter("integrity_quarantines_total").value == 1
+
+
+def test_board_window_expires_old_mismatches():
+    board, _ = _board(window_s=0.05)
+    board.record_witness(0, False)
+    board.record_witness(0, False)
+    time.sleep(0.08)
+    assert not board.record_witness(0, False)  # the first two aged out
+    assert not board.is_quarantined(0)
+
+
+def test_board_ok_verdicts_never_trip():
+    board, _ = _board()
+    for _ in range(10):
+        board.record_witness(1, True)
+    assert not board.is_quarantined(1)
+
+
+def test_board_readmits_after_consecutive_clean_probes():
+    board, reg = _board(readmit_after=2)
+    board.quarantine(0, "test")
+    assert not board.record_probe(0, True)
+    assert not board.record_probe(0, False)  # dirty: streak resets
+    assert not board.record_probe(0, True)
+    assert board.record_probe(0, True)       # 2 consecutive clean
+    assert not board.is_quarantined(0)
+    assert reg.counter("integrity_readmits_total").value == 1
+    # Probes against a healthy replica are no-ops.
+    assert not board.record_probe(0, True)
+
+
+def test_board_operator_release():
+    board, _ = _board()
+    board.quarantine(2, "operator")
+    assert board.release(2, "operator")
+    assert not board.is_quarantined(2)
+    assert not board.release(2, "operator")  # idempotent
+    assert "quarantine_after" in board.statusz()
+
+
+# -- serve: witness + corrupt_result ------------------------------------
+
+def _serve(**kw):
+    from tpu_stencil.serve.engine import StencilServer
+
+    kw.setdefault("witness_rate", 1.0)
+    return StencilServer(ServeConfig(**kw))
+
+
+def _wait_for(pred, timeout=30.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def test_serve_witness_clean_verdict():
+    verdicts = []
+    with _serve() as s:
+        s.on_witness = verdicts.append
+        img = _img()
+        out = s.submit(img, REPS).result(timeout=300)
+        assert np.array_equal(out, _golden(img, REPS))
+        assert _wait_for(lambda: len(verdicts) == 1)
+        stats = s.stats()
+    assert verdicts == [True]
+    assert stats["counters"]["integrity_witness_total"] == 1
+    assert stats["counters"]["integrity_witness_mismatch_total"] == 0
+
+
+@pytest.mark.chaos
+def test_serve_corrupt_result_caught_by_witness():
+    faults.configure("integrity.corrupt_result")
+    verdicts = []
+    with _serve() as s:
+        s.on_witness = verdicts.append
+        img = _img()
+        out = s.submit(img, REPS).result(timeout=300)
+        # The client really received wrong bytes (the failure mode
+        # under test)...
+        assert not np.array_equal(out, _golden(img, REPS))
+        # ...and the witness filed the verdict against the replica.
+        assert _wait_for(lambda: len(verdicts) == 1)
+        stats = s.stats()
+    assert verdicts == [False]
+    assert stats["counters"]["integrity_witness_mismatch_total"] == 1
+
+
+def test_serve_witness_sampling_deterministic():
+    # rate=0.5 seed=0: the picked request positions are a pure function
+    # of the seed — two identical servers witness identical positions.
+    def picked(n):
+        s = witness.WitnessSampler(0.5, seed=0)
+        return [i for i in range(n) if s.pick()]
+
+    assert picked(64) == picked(64)
+    with _serve(witness_rate=0.5, witness_seed=0, max_batch=1) as s:
+        img = _img(shape=(8, 8))
+        for i in range(16):
+            s.submit(img, 1).result(timeout=300)
+        want = len([i for i in picked(16)])
+        assert _wait_for(
+            lambda: s.stats()["counters"]["integrity_witness_total"]
+            == want
+        ), (s.stats()["counters"], want)
+
+
+def test_serve_witness_skips_huge_rep_counts():
+    with _serve() as s:
+        img = _img(shape=(8, 8))
+        s.submit(img, witness.WITNESS_MAX_REPS + 1).result(timeout=300)
+        time.sleep(0.2)
+        assert s.stats()["counters"]["integrity_witness_total"] == 0
+
+
+@pytest.mark.chaos
+def test_stream_corrupt_ingest_fails_typed_at_h2d(tmp_path):
+    from tpu_stencil.stream.engine import StreamFailure, run_stream
+
+    clip = np.random.default_rng(3).integers(
+        0, 256, (3, H, W, C), dtype=np.uint8
+    )
+    clip.tofile(tmp_path / "clip.raw")
+    faults.configure("integrity.corrupt_ingest:frame=1")
+    with pytest.raises(StreamFailure) as ei:
+        run_stream(StreamConfig(
+            input=str(tmp_path / "clip.raw"), width=W, height=H,
+            repetitions=REPS, image_type=ImageType.RGB, frames=3,
+            output=str(tmp_path / "out.raw"), witness_rate=0.0,
+        ))
+    assert ei.value.stage == "h2d" and ei.value.frame_index == 1
+    assert isinstance(ei.value.__cause__, ChecksumMismatch)
+    snap = obs.registry().snapshot()
+    assert snap["counters"]["integrity_ingest_failures_total"] == 1
+
+
+@pytest.mark.chaos
+def test_stream_corrupt_result_caught_before_the_sink(tmp_path):
+    from tpu_stencil.stream.engine import StreamFailure, run_stream
+
+    clip = np.random.default_rng(3).integers(
+        0, 256, (3, H, W, C), dtype=np.uint8
+    )
+    clip.tofile(tmp_path / "clip.raw")
+    faults.configure("integrity.corrupt_result:frame=1")
+    with pytest.raises(StreamFailure) as ei:
+        run_stream(StreamConfig(
+            input=str(tmp_path / "clip.raw"), width=W, height=H,
+            repetitions=REPS, image_type=ImageType.RGB, frames=3,
+            output=str(tmp_path / "out.raw"), witness_rate=1.0,
+        ))
+    assert ei.value.stage == "write" and ei.value.frame_index == 1
+    assert isinstance(ei.value.__cause__, WitnessMismatch)
+    # The corrupt frame never reached the sink: frame 0 only.
+    assert os.path.getsize(tmp_path / "out.raw") == H * W * C
+
+
+def test_stream_full_witness_stays_bit_exact(tmp_path):
+    from tpu_stencil.stream.engine import run_stream
+
+    clip = np.random.default_rng(3).integers(
+        0, 256, (3, H, W, C), dtype=np.uint8
+    )
+    clip.tofile(tmp_path / "clip.raw")
+    run_stream(StreamConfig(
+        input=str(tmp_path / "clip.raw"), width=W, height=H,
+        repetitions=REPS, image_type=ImageType.RGB, frames=3,
+        output=str(tmp_path / "out.raw"), witness_rate=1.0,
+    ))
+    want = b"".join(
+        np.asarray(_golden(f, REPS)).tobytes() for f in clip
+    )
+    assert (tmp_path / "out.raw").read_bytes() == want
+    snap = obs.registry().snapshot()
+    assert snap["counters"]["integrity_witness_total"] == 3
+    assert snap["counters"]["integrity_ingest_verified_total"] >= 2
+    assert snap["counters"].get("integrity_witness_mismatch_total", 0) == 0
+
+
+def _meshfan_cfg(tmp_path, **kw):
+    kw.setdefault("witness_rate", 1.0)
+    return StreamConfig(
+        input=str(tmp_path / "clip.raw"), width=W, height=H,
+        repetitions=REPS, image_type=ImageType.RGB, frames=4,
+        output=str(tmp_path / "out.raw"), mesh_frames=2, **kw,
+    )
+
+
+def _meshfan_clip(tmp_path):
+    clip = np.random.default_rng(3).integers(
+        0, 256, (4, H, W, C), dtype=np.uint8
+    )
+    clip.tofile(tmp_path / "clip.raw")
+    return clip
+
+
+def test_meshfan_full_witness_stays_bit_exact(tmp_path):
+    # The fan-out lanes honor the same integrity contract as the
+    # single-device pipeline (same shared helpers, so no drift).
+    from tpu_stencil.stream.engine import run_stream
+
+    clip = _meshfan_clip(tmp_path)
+    res = run_stream(_meshfan_cfg(tmp_path))
+    assert res.n_devices == 2
+    want = b"".join(
+        np.asarray(_golden(f, REPS)).tobytes() for f in clip
+    )
+    assert (tmp_path / "out.raw").read_bytes() == want
+    snap = obs.registry().snapshot()
+    assert snap["counters"]["integrity_witness_total"] == 4
+    assert snap["counters"]["integrity_ingest_verified_total"] >= 4
+    assert snap["counters"].get("integrity_witness_mismatch_total", 0) == 0
+
+
+@pytest.mark.chaos
+def test_meshfan_corrupt_ingest_fails_typed_at_h2d(tmp_path):
+    from tpu_stencil.stream.engine import StreamFailure, run_stream
+
+    _meshfan_clip(tmp_path)
+    faults.configure("integrity.corrupt_ingest:frame=2")
+    with pytest.raises(StreamFailure) as ei:
+        run_stream(_meshfan_cfg(tmp_path, witness_rate=0.0))
+    assert ei.value.stage == "h2d" and ei.value.frame_index == 2
+    assert isinstance(ei.value.__cause__, ChecksumMismatch)
+
+
+@pytest.mark.chaos
+def test_meshfan_corrupt_result_caught_before_the_sink(tmp_path):
+    from tpu_stencil.stream.engine import StreamFailure, run_stream
+
+    _meshfan_clip(tmp_path)
+    faults.configure("integrity.corrupt_result:frame=1")
+    with pytest.raises(StreamFailure) as ei:
+        run_stream(_meshfan_cfg(tmp_path))
+    assert ei.value.stage == "write" and ei.value.frame_index == 1
+    assert isinstance(ei.value.__cause__, WitnessMismatch)
+    # In-order merge: only frame 0 reached the sink.
+    assert os.path.getsize(tmp_path / "out.raw") == H * W * C
+
+
+# -- net tier -----------------------------------------------------------
+
+def _net(**kw):
+    from tpu_stencil.net.http import NetFrontend
+
+    kw.setdefault("port", 0)
+    kw.setdefault("replicas", 2)
+    kw.setdefault("witness_rate", 0.0)
+    kw.setdefault("probe_interval_s", 0.0)
+    return NetFrontend(NetConfig(**kw)).start()
+
+
+def _post(url, body, headers=None, timeout=300):
+    req = urllib.request.Request(url, data=body, method="POST",
+                                 headers=headers or {})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        # r.headers is an HTTPMessage: case-insensitive lookups, which
+        # header names (and the fed's .title() passthrough) require.
+        return r.read(), r.headers
+
+
+def _blur_url(fe, w=W, h=H, reps=REPS, c=C):
+    return fe.url + f"/v1/blur?w={w}&h={h}&reps={reps}&channels={c}"
+
+
+def _http_error(url, body, headers=None):
+    try:
+        _post(url, body, headers)
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+    raise AssertionError("expected an HTTP error")
+
+
+def test_net_request_crc_validated_and_result_stamped():
+    img = _img()
+    body = img.tobytes()
+    fe = _net()
+    try:
+        out, headers = _post(_blur_url(fe), body, {
+            checksum.CRC_HEADER: str(checksum.crc32c(body)),
+        })
+        assert out == _golden(img, REPS).tobytes()
+        assert int(headers[checksum.RESULT_HEADER]) == checksum.crc32c(out)
+        code, detail = _http_error(_blur_url(fe), body, {
+            checksum.CRC_HEADER: "12345",
+        })
+        assert code == 400 and "ChecksumMismatch" in detail
+        code, detail = _http_error(_blur_url(fe), body, {
+            checksum.CRC_HEADER: "not-a-crc",
+        })
+        assert code == 400 and "malformed" in detail
+        snap = fe.metrics_snapshot()
+        assert snap["counters"]["integrity_checksum_failures_total"] == 1
+    finally:
+        fe.close()
+
+
+def test_net_no_integrity_disables_the_layer():
+    img = _img()
+    body = img.tobytes()
+    fe = _net(integrity=False)
+    try:
+        # A wrong declared CRC is ignored (validation off) and the
+        # response is unstamped — the bench A/B's "off" arm.
+        out, headers = _post(_blur_url(fe), body, {
+            checksum.CRC_HEADER: "12345",
+        })
+        assert out == _golden(img, REPS).tobytes()
+        assert checksum.RESULT_HEADER not in headers
+    finally:
+        fe.close()
+
+
+@pytest.mark.chaos
+def test_net_corrupt_ingest_dies_typed_with_client_crc():
+    img = _img()
+    body = img.tobytes()
+    faults.configure("integrity.corrupt_ingest")
+    fe = _net()
+    try:
+        code, detail = _http_error(_blur_url(fe), body, {
+            checksum.CRC_HEADER: str(checksum.crc32c(body)),
+        })
+        assert code == 400 and "ChecksumMismatch" in detail
+    finally:
+        fe.close()
+
+
+@pytest.mark.chaos
+def test_net_corrupt_body_detected_by_client_verify():
+    img = _img()
+    body = img.tobytes()
+    faults.configure("net.corrupt_body")
+    fe = _net()
+    try:
+        out, headers = _post(_blur_url(fe), body)
+        # Wire corruption AFTER stamping: the stamp convicts the body.
+        assert checksum.crc32c(out) != int(headers[checksum.RESULT_HEADER])
+        assert out != _golden(img, REPS).tobytes()
+    finally:
+        fe.close()
+
+
+def test_net_admin_quarantine_routes_around_replica():
+    img = _img()
+    body = img.tobytes()
+    fe = _net()
+    try:
+        out, _ = _post(
+            fe.url + "/admin/quarantine?replica=0", b"")
+        j = json.loads(out)
+        assert j["quarantined"] is True and j["changed"] is True
+        for _ in range(3):
+            _, headers = _post(_blur_url(fe), body)
+            assert headers["X-Replica"] == "1"
+        # statusz + scrape visibility.
+        with urllib.request.urlopen(fe.url + "/statusz",
+                                    timeout=60) as r:
+            status = json.loads(r.read())
+        assert status["quarantine"]["quarantined"] == {
+            "0": "operator request (POST /admin/quarantine)"
+        }
+        with urllib.request.urlopen(fe.url + "/metrics",
+                                    timeout=60) as r:
+            text = r.read().decode()
+        assert "tpu_stencil_net_integrity_quarantines_total 1" in text
+        assert "tpu_stencil_net_replica_quarantined_dev0 1" in text
+        # action=clear releases.
+        out, _ = _post(
+            fe.url + "/admin/quarantine?replica=0&action=clear", b"")
+        assert json.loads(out)["quarantined"] is False
+        code, _ = _http_error(
+            fe.url + "/admin/quarantine?replica=9", b"")
+        assert code == 400
+    finally:
+        fe.close()
+
+
+def test_net_all_replicas_quarantined_rejects_typed():
+    img = _img()
+    body = img.tobytes()
+    fe = _net()
+    try:
+        for i in (0, 1):
+            fe.router.quarantine_replica(i, "test")
+        code, detail = _http_error(_blur_url(fe), body)
+        assert code == 503 and "quarantined" in detail
+        snap = fe.metrics_snapshot()
+        assert snap["counters"]["quarantine_unroutable_total"] == 1
+    finally:
+        fe.close()
+
+
+@pytest.mark.chaos
+def test_net_quarantine_full_cycle():
+    """The acceptance scenario: a replica corrupting results is
+    witnessed K times -> QUARANTINED (out of routing, scrape-visible)
+    while the sibling serves bit-exact output; once the corruption
+    stops, N clean background probes re-admit it — the full cycle in
+    /metrics."""
+    img = _img()
+    body = img.tobytes()
+    want = _golden(img, REPS).tobytes()
+    # warm_fleet off: sibling zero-frame warms would race the shared
+    # corruption budget and could convict the healthy replica.
+    faults.configure("integrity.corrupt_result:times=3")
+    fe = _net(witness_rate=1.0, warm_fleet=False,
+              quarantine_after=3, readmit_after=2,
+              probe_interval_s=0.05)
+    try:
+        # Sequential posts all land on replica 0 (least-outstanding
+        # ties break low): 3 corrupted+witnessed results trip it.
+        for _ in range(3):
+            out, headers = _post(_blur_url(fe), body)
+            assert headers["X-Replica"] == "0"
+            assert out != want  # the corruption really went out
+        assert _wait_for(lambda: fe.quarantine.is_quarantined(0))
+        # The sibling carries the traffic, bit-exact.
+        out, headers = _post(_blur_url(fe), body)
+        assert headers["X-Replica"] == "1" and out == want
+        # Fault budget exhausted -> probes run clean -> re-admission.
+        assert _wait_for(lambda: not fe.quarantine.is_quarantined(0),
+                         timeout=60)
+        snap = fe.metrics_snapshot()
+        assert snap["counters"]["integrity_quarantines_total"] == 1
+        assert snap["counters"]["integrity_readmits_total"] == 1
+        assert snap["counters"]["integrity_probes_total"] >= 2
+        assert snap["counters"]["fleet_integrity_witness_mismatch_total"] \
+            >= 3
+        # Back in routing: replica 0 serves again, exactly.
+        for _ in range(4):
+            out, headers = _post(_blur_url(fe), body)
+            assert out == want
+    finally:
+        fe.close()
+
+
+def test_net_statusz_reports_integrity_config():
+    fe = _net(witness_rate=0.25)
+    try:
+        with urllib.request.urlopen(fe.url + "/statusz", timeout=60) as r:
+            cfgz = json.loads(r.read())["config"]
+        assert cfgz["integrity"] is True
+        assert cfgz["witness_rate"] == 0.25
+    finally:
+        fe.close()
+
+
+# -- loadgen --verify ---------------------------------------------------
+
+def test_loadgen_verify_golden_in_process():
+    from tpu_stencil.serve import loadgen
+    from tpu_stencil.serve.engine import StencilServer
+
+    with StencilServer(ServeConfig(max_queue=64)) as s:
+        report = loadgen.run(s, requests=6, concurrency=2, reps=2,
+                             verify="golden")
+    assert report["verify"] == "golden"
+    assert report["verify_failures_total"] == 0
+    assert report["completed"] == 6
+
+
+@pytest.mark.chaos
+def test_loadgen_verify_golden_catches_corrupt_results():
+    from tpu_stencil.serve import loadgen
+    from tpu_stencil.serve.engine import StencilServer
+
+    faults.configure("integrity.corrupt_result:times=0:p=1.0")
+    with StencilServer(ServeConfig(max_queue=64)) as s:
+        with pytest.raises(WitnessMismatch):
+            loadgen.run(s, requests=6, concurrency=2, reps=2,
+                        verify="golden")
+    snap = obs.registry().snapshot()
+    assert snap["counters"]["integrity_verify_failures_total"] >= 1
+
+
+@pytest.mark.chaos
+def test_loadgen_http_verify_crc_counts_wire_corruption():
+    from tpu_stencil.serve import loadgen
+
+    faults.configure("net.corrupt_body:times=0:p=1.0")
+    fe = _net()
+    try:
+        target = loadgen.HttpTarget(fe.url, verify="crc")
+        try:
+            # Open loop: corruption is counted, never silently passed.
+            report = loadgen.run(target, mode="open", requests=4,
+                                 rate=50.0, reps=2, verify="crc")
+        finally:
+            target.close()
+        assert report["verify_failures_total"] == 4
+    finally:
+        fe.close()
+
+
+def test_loadgen_http_verify_crc_clean():
+    from tpu_stencil.serve import loadgen
+
+    fe = _net()
+    try:
+        target = loadgen.HttpTarget(fe.url, verify="crc")
+        try:
+            report = loadgen.run(target, requests=4, concurrency=2,
+                                 reps=2, verify="crc")
+        finally:
+            target.close()
+        assert report["verify_failures_total"] == 0
+        assert report["completed"] == 4
+    finally:
+        fe.close()
+
+
+# -- fed tier -----------------------------------------------------------
+
+@pytest.mark.chaos
+def test_fed_bad_payload_verdict_reroutes_to_exact_bytes():
+    from tpu_stencil.fed.http import FedFrontend
+    from tpu_stencil.config import FedConfig
+
+    img = _img()
+    body = img.tobytes()
+    want = _golden(img, REPS).tobytes()
+    m1 = _net(replicas=1)
+    m2 = _net(replicas=1)
+    # hedge=False: a cold first forward outlives the hedge trigger, and
+    # a clean hedge winning the race would mask the reroute under test.
+    fed = FedFrontend(FedConfig(
+        port=0, members=(m1.url, m2.url), heartbeat_interval_s=5.0,
+        hedge=False,
+    )).start()
+    try:
+        # Arm AFTER the members started (their sites resolve at
+        # start()): one member 200 gets its body flipped on the wire.
+        faults.configure("net.corrupt_body:times=1")
+        m1.fault_corrupt_body = faults.site("net.corrupt_body")
+        m2.fault_corrupt_body = faults.site("net.corrupt_body")
+        url = fed.url + f"/v1/blur?w={W}&h={H}&reps={REPS}&channels={C}"
+        out, headers = _post(url, body, {
+            checksum.CRC_HEADER: str(checksum.crc32c(body)),
+        })
+        # The fed hop caught the corrupt 200 (bad_payload), charged
+        # the breaker, rerouted — the client never saw wrong bytes.
+        assert out == want
+        assert int(headers[checksum.RESULT_HEADER]) == checksum.crc32c(out)
+        snap = fed.registry.snapshot()
+        assert snap["counters"]["forward_bad_payload_total"] == 1
+        assert snap["counters"]["reroutes_total"] >= 1
+    finally:
+        fed.close()
+        m1.close()
+        m2.close()
+
+
+def test_fed_edge_validates_request_crc():
+    from tpu_stencil.fed.http import FedFrontend
+    from tpu_stencil.config import FedConfig
+
+    m1 = _net(replicas=1)
+    fed = FedFrontend(FedConfig(
+        port=0, members=(m1.url,), heartbeat_interval_s=5.0,
+    )).start()
+    try:
+        url = fed.url + f"/v1/blur?w={W}&h={H}&reps={REPS}&channels={C}"
+        code, detail = _http_error(url, _img().tobytes(), {
+            checksum.CRC_HEADER: "999",
+        })
+        assert code == 400 and "ChecksumMismatch" in detail
+        # No member round-trip was spent on the corrupt body.
+        assert fed.registry.snapshot()["counters"].get(
+            "forwarded_total", 0) == 0
+    finally:
+        fed.close()
+        m1.close()
+
+
+def test_fed_bad_payload_on_length_contradiction():
+    from tpu_stencil.fed.router import BadPayload, _Attempt, _verdict_exc
+
+    att = _Attempt.__new__(_Attempt)
+    good = _img().tobytes()
+    stamp = {checksum.RESULT_HEADER.lower(): str(checksum.crc32c(good))}
+    att._verify_payload(dict(stamp), good)  # clean: no raise
+    with pytest.raises(BadPayload):
+        att._verify_payload(
+            {checksum.RESULT_HEADER.lower(): "1"}, good
+        )
+    with pytest.raises(BadPayload):
+        att._verify_payload(
+            {"x-width": "10", "x-height": "10", "x-channels": "3"},
+            b"short",
+        )
+    assert _verdict_exc(BadPayload("x")) == "bad_payload"
+
+
+# -- durable-state integrity --------------------------------------------
+
+def _stream_cfg(tmp_path, **kw):
+    return StreamConfig(
+        input=str(tmp_path / "clip.raw"), width=W, height=H,
+        repetitions=REPS, image_type=ImageType.RGB, frames=3,
+        output=str(tmp_path / "out.raw"), **kw,
+    )
+
+
+def test_stream_sidecar_crc_refuses_corrupt_resume(tmp_path):
+    from tpu_stencil.runtime import checkpoint as ckpt
+    from tpu_stencil.runtime.checkpoint import CorruptCheckpoint
+
+    cfg = _stream_cfg(tmp_path)
+    ckpt.save_stream_progress(cfg, 2)
+    path = ckpt._stream_paths(cfg)
+    assert ckpt.restore_stream_progress(cfg) == 2
+    raw = bytearray(open(path, "rb").read())
+    i = raw.index(b"frames_done") + 14  # a digit inside the payload
+    raw[i] ^= 0x01
+    open(path, "wb").write(bytes(raw))
+    with pytest.raises(CorruptCheckpoint) as ei:
+        ckpt.restore_stream_progress(cfg)
+    assert path in str(ei.value)  # typed refusal NAMES the file
+    assert ei.value.path == path
+
+
+def test_stream_resume_refuses_corrupt_sidecar_end_to_end(tmp_path):
+    from tpu_stencil.runtime import checkpoint as ckpt
+    from tpu_stencil.runtime.checkpoint import CorruptCheckpoint
+    from tpu_stencil.stream.engine import run_stream
+
+    clip = np.random.default_rng(3).integers(
+        0, 256, (3, H, W, C), dtype=np.uint8
+    )
+    clip.tofile(tmp_path / "clip.raw")
+    cfg = _stream_cfg(tmp_path, checkpoint_every=1)
+    ckpt.save_stream_progress(cfg, 1)
+    path = ckpt._stream_paths(cfg)
+    raw = bytearray(open(path, "rb").read())
+    raw[raw.index(b"frames_done") + 14] ^= 0x01
+    open(path, "wb").write(bytes(raw))
+    (tmp_path / "out.raw").write_bytes(b"\0" * (H * W * C))
+    with pytest.raises(CorruptCheckpoint):
+        run_stream(cfg, resume=True)
+
+
+def test_job_sidecar_crc_refuses_corrupt_restore(tmp_path):
+    from tpu_stencil.config import JobConfig
+    from tpu_stencil.runtime import checkpoint as ckpt
+    from tpu_stencil.runtime.checkpoint import CorruptCheckpoint
+
+    img = _img()
+    img.tofile(tmp_path / "in.raw")
+    cfg = JobConfig(
+        image=str(tmp_path / "in.raw"), width=W, height=H,
+        repetitions=REPS, image_type=ImageType.RGB,
+        output=str(tmp_path / "out.raw"),
+    )
+    ckpt.save(cfg, 2, img)
+    rep, frame = ckpt.restore(cfg)
+    assert rep == 2 and np.array_equal(frame, img)
+    _, meta_path = ckpt._paths(cfg)
+    raw = bytearray(open(meta_path, "rb").read())
+    raw[raw.index(b'"rep"') + 7] ^= 0x01
+    open(meta_path, "wb").write(bytes(raw))
+    with pytest.raises(CorruptCheckpoint):
+        ckpt.restore(cfg)
+    # Unparseable sidecars are the same typed refusal, not a JSON
+    # traceback.
+    open(meta_path, "w").write("{truncated")
+    with pytest.raises(CorruptCheckpoint):
+        ckpt.restore(cfg)
+
+
+def test_legacy_sidecars_without_crc_still_restore(tmp_path):
+    from tpu_stencil.runtime import checkpoint as ckpt
+
+    cfg = _stream_cfg(tmp_path)
+    path = ckpt._stream_paths(cfg)
+    meta = dict(ckpt._stream_fingerprint(cfg), frames_done=4)
+    open(path, "w").write(json.dumps(meta))
+    assert ckpt.restore_stream_progress(cfg) == 4
+
+
+def test_autotune_corrupt_entry_drops_to_cold_miss(tmp_path, monkeypatch):
+    import jax
+
+    from tpu_stencil.runtime import autotune
+
+    monkeypatch.setenv("TPU_STENCIL_AUTOTUNE_CACHE",
+                       str(tmp_path / "at.json"))
+    v = jax.__version__
+    good_key = f"tpu|{v}|exact|16|t|64x48x3"
+    sibling = f"tpu|{v}|exact|16|u|32x32x1"
+    autotune._store_cache({
+        good_key: {"backend": "pallas", "fuse": 8},
+        sibling: {"backend": "xla", "fuse": None},
+    })
+    raw = json.load(open(tmp_path / "at.json"))
+    assert set(raw["entry_crcs"]) == {good_key, sibling}
+    # Flip a digit INSIDE a value: still valid JSON, caught by the CRC.
+    raw["entries"][good_key]["fuse"] = 9
+    json.dump(raw, open(tmp_path / "at.json", "w"))
+    with pytest.warns(RuntimeWarning, match="crc32c"):
+        cache = autotune._load_cache()
+    # The corrupt entry is a cold miss; the sibling survives.
+    assert good_key not in cache
+    assert cache[sibling] == {"backend": "xla", "fuse": None}
+
+
+# -- fsync-atomic output writers ----------------------------------------
+
+def test_write_raw_crash_fuzz_never_publishes_torn_output(tmp_path):
+    """Kill the writer at every byte offset of a simulated atomic
+    write_raw: the output path must always hold the complete OLD or
+    the complete NEW image, never partial bytes — the property the
+    tmp+fsync+rename sequence exists for."""
+    from tpu_stencil.io import raw as raw_io
+
+    path = str(tmp_path / "blur_x.raw")
+    old = _img(np.random.default_rng(1), (8, 6)).tobytes()
+    new = _img(np.random.default_rng(2), (8, 6)).tobytes()
+    raw_io.write_raw(path, np.frombuffer(old, np.uint8).reshape(8, 6))
+    assert open(path, "rb").read() == old
+    tmp = f"{path}.tmp.{os.getpid()}"
+    for k in range(len(new) + 1):
+        # Crash mid-tmp-write (before the rename): k bytes landed in
+        # the tmp file, the published path untouched.
+        with open(tmp, "wb") as f:
+            f.write(new[:k])
+        assert open(path, "rb").read() == old
+        os.remove(tmp)
+    # Crash after the rename: the new image is fully visible.
+    with open(tmp, "wb") as f:
+        f.write(new)
+    os.replace(tmp, path)
+    assert open(path, "rb").read() == new
+    # And the real writer converges to the same end state, tmp-free.
+    raw_io.write_raw(path, np.frombuffer(new, np.uint8).reshape(8, 6))
+    assert open(path, "rb").read() == new
+    assert not [n for n in os.listdir(tmp_path) if ".tmp" in n]
+
+
+def test_write_raw_failure_preserves_old_and_cleans_tmp(
+        tmp_path, monkeypatch):
+    from tpu_stencil.io import native, raw as raw_io
+
+    path = str(tmp_path / "blur_x.raw")
+    old = _img(np.random.default_rng(1), (8, 6))
+    raw_io.write_raw(path, old)
+
+    def boom(p, off, data, truncate=False):
+        with open(p, "wb") as f:
+            f.write(data[: len(data) // 2])  # half landed, then died
+        raise IOError("disk full")
+
+    monkeypatch.setattr(native, "pwrite_full", boom)
+    with pytest.raises(IOError):
+        raw_io.write_raw(path, _img(np.random.default_rng(2), (8, 6)))
+    assert open(path, "rb").read() == old.tobytes()
+    assert not [n for n in os.listdir(tmp_path) if ".tmp" in n]
+
+
+def test_run_cli_output_is_atomic_and_exact(tmp_path):
+    """End-to-end: the blur_ artifact of a real run is complete and
+    exact (the driver's store goes through the atomic writer now)."""
+    from tpu_stencil import cli
+
+    img = _img()
+    img.tofile(tmp_path / "beach.raw")
+    out = tmp_path / "blur_beach.raw"
+    rc = cli.main([str(tmp_path / "beach.raw"), str(W), str(H),
+                   str(REPS), "rgb", "--output", str(out),
+                   "--platform", "cpu"])
+    assert rc in (0, None)
+    assert out.read_bytes() == _golden(img, REPS).tobytes()
+    assert not [n for n in os.listdir(tmp_path) if ".tmp" in n]
+
+
+def test_directory_sink_fsyncs_before_publish(tmp_path, monkeypatch):
+    from tpu_stencil.stream import frames as frames_io
+
+    synced = []
+    real_fsync = os.fsync
+    monkeypatch.setattr(
+        os, "fsync", lambda fd: (synced.append(fd), real_fsync(fd))
+    )
+    sink = frames_io.RawDirectorySink(str(tmp_path / "frames"),
+                                      H * W * C)
+    frame = _img()
+    sink.write(0, frame)
+    assert synced, "directory sink published without fsync"
+    assert (tmp_path / "frames" / "frame_000000.raw").read_bytes() \
+        == frame.tobytes()
+
+
+def test_stream_sink_flush_fsyncs_regular_files(tmp_path, monkeypatch):
+    from tpu_stencil.stream import frames as frames_io
+
+    synced = []
+    real_fsync = os.fsync
+    monkeypatch.setattr(
+        os, "fsync", lambda fd: (synced.append(fd), real_fsync(fd))
+    )
+    sink = frames_io.RawStreamSink(str(tmp_path / "out.raw"), H * W * C)
+    sink.write(0, _img())
+    sink.flush()
+    assert synced, "durability point without fsync"
+    sink.close()
+
+
+# -- breakdown rows -----------------------------------------------------
+
+def test_breakdown_renders_integrity_rows():
+    from tpu_stencil.obs import breakdown
+
+    table = breakdown.render_resilience({"counters": {
+        "integrity_witness_mismatch_total": 2,
+        "integrity_quarantines_total": 1,
+    }})
+    assert "witness mismatches" in table
+    assert "replicas quarantined" in table
+    assert breakdown.render_resilience({"counters": {}}) == ""

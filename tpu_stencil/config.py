@@ -239,6 +239,19 @@ class StreamConfig:
     # restartable source — a regular file or frame directory; a pipe's
     # consumed frames cannot be re-read). 0 disables.
     max_engine_restarts: int = 1
+    # Ingest integrity (tpu_stencil.integrity): CRC32C each frame as the
+    # reader fills its staging buffer and re-verify at the H2D boundary,
+    # so a torn staging buffer fails typed (ChecksumMismatch) before it
+    # burns a device launch. Nearly free with a native crc32c; --no-
+    # verify-ingest turns it off.
+    verify_ingest: bool = True
+    # Witness re-execution: this fraction of frames (seeded Bernoulli,
+    # deterministic per seed) re-runs through a DIFFERENT measured-
+    # equivalent program in the writer and must agree bit-exact before
+    # the frame is written; a divergence fails the run typed
+    # (WitnessMismatch) with the frame withheld from the sink. 0 = off.
+    witness_rate: float = 1.0 / 256.0
+    witness_seed: int = 0
 
     def __post_init__(self) -> None:
         _validate_common(self)
@@ -278,6 +291,10 @@ class StreamConfig:
             raise ValueError(
                 f"max_engine_restarts must be >= 0, got "
                 f"{self.max_engine_restarts}"
+            )
+        if not 0.0 <= self.witness_rate <= 1.0:
+            raise ValueError(
+                f"witness_rate must be in [0, 1], got {self.witness_rate}"
             )
 
     @property
@@ -376,6 +393,15 @@ class ServeConfig:
     # devices in parallel instead of all stacking on device 0. Sharded
     # routing (overlap != off) still spans the whole mesh regardless.
     device_index: Optional[int] = None
+    # Witness re-execution (tpu_stencil.integrity): this fraction of
+    # completed requests (seeded Bernoulli per request) re-runs through
+    # a DIFFERENT measured-equivalent program and is compared bit-exact;
+    # a mismatch counts integrity_witness_mismatch_total and files a
+    # verdict via the server's on_witness hook (the net tier's
+    # quarantine path). 0 = off (the in-process default; the network
+    # tier arms it fleet-wide via NetConfig.witness_rate).
+    witness_rate: float = 0.0
+    witness_seed: int = 0
 
     def __post_init__(self) -> None:
         if self.backend not in BACKENDS:
@@ -418,6 +444,10 @@ class ServeConfig:
             raise ValueError(
                 f"request_timeout_s must be >= 0 (0 = none), got "
                 f"{self.request_timeout_s}"
+            )
+        if not 0.0 <= self.witness_rate <= 1.0:
+            raise ValueError(
+                f"witness_rate must be in [0, 1], got {self.witness_rate}"
             )
         if self.bucket_edges is not None:
             object.__setattr__(
@@ -488,6 +518,27 @@ class NetConfig:
     # warm caches fleet-wide (the per-platform tuning-cache discipline,
     # arxiv 2406.08923, applied across replicas).
     warm_fleet: bool = True
+    # The integrity layer (tpu_stencil.integrity, docs/RESILIENCE.md
+    # "Integrity model"): when on, request bodies carrying
+    # X-Content-Crc32c are validated (mismatch → typed 400), every 200
+    # payload is stamped X-Result-Crc32c, and witness_rate of completed
+    # requests re-execute through a different measured-equivalent
+    # program per replica. --no-integrity turns ALL of it off (the
+    # bench A/B's "off" arm; quarantine then only trips via the admin
+    # endpoint).
+    integrity: bool = True
+    # Fraction of requests witnessed per replica (seeded per device
+    # index so replicas don't sample in lockstep). K mismatches within
+    # the window quarantine the replica; N consecutive clean background
+    # probes re-admit it.
+    witness_rate: float = 1.0 / 256.0
+    quarantine_after: int = 3
+    quarantine_window_s: float = 60.0
+    readmit_after: int = 3
+    # Background re-verify probe period for quarantined replicas
+    # (seconds; 0 disables the prober — probes can then only be driven
+    # by tests/operators calling probe_once).
+    probe_interval_s: float = 1.0
 
     def __post_init__(self) -> None:
         if not self.host:
@@ -521,6 +572,28 @@ class NetConfig:
             raise ValueError(
                 f"drain_timeout_s must be > 0, got {self.drain_timeout_s}"
             )
+        if not 0.0 <= self.witness_rate <= 1.0:
+            raise ValueError(
+                f"witness_rate must be in [0, 1], got {self.witness_rate}"
+            )
+        if self.quarantine_after < 1:
+            raise ValueError(
+                f"quarantine_after must be >= 1, got {self.quarantine_after}"
+            )
+        if self.quarantine_window_s <= 0:
+            raise ValueError(
+                f"quarantine_window_s must be > 0, got "
+                f"{self.quarantine_window_s}"
+            )
+        if self.readmit_after < 1:
+            raise ValueError(
+                f"readmit_after must be >= 1, got {self.readmit_after}"
+            )
+        if self.probe_interval_s < 0:
+            raise ValueError(
+                f"probe_interval_s must be >= 0 (0 = no background "
+                f"prober), got {self.probe_interval_s}"
+            )
         # Jax-free (the filter bank is pure numpy): a typo'd --filter
         # must die as a usage error, not boot a tier that answers 500
         # to every request.
@@ -553,6 +626,11 @@ class NetConfig:
             request_timeout_s=self.request_timeout_s,
             device_index=device_index,
             mem_sample_interval_s=0.0,
+            # Witness sampling seeded per device index so the fleet's
+            # replicas never pick the same request positions in
+            # lockstep (diverse coverage for the same total cost).
+            witness_rate=self.witness_rate if self.integrity else 0.0,
+            witness_seed=device_index,
         )
 
 

@@ -47,6 +47,7 @@ from urllib.parse import parse_qs, urlsplit
 
 from tpu_stencil.config import FedConfig
 from tpu_stencil.fed.breaker import BreakerBoard
+from tpu_stencil.integrity import checksum as _checksum
 from tpu_stencil.fed.membership import Membership
 from tpu_stencil.fed.router import (
     DEFAULT_TENANT,
@@ -79,6 +80,10 @@ _FORWARD_HEADERS = (
     ("X-Filter", "filter"),
     ("X-Boundary", "boundary"),
     ("X-Request-Timeout", "timeout"),
+    # Checksums on every hop: the client's body CRC rides to the member,
+    # which re-validates it — the fed edge's own validation (below) does
+    # not spend the member's trust.
+    ("X-Content-Crc32c", "crc32c"),
 )
 
 
@@ -247,6 +252,22 @@ class _FedHandler(BaseHTTPRequestHandler):
                     f"needs exactly {expected}",
                 )
                 return
+            # Checksum hop #1: a client-declared body CRC is validated
+            # HERE, before any forward — a body damaged on the client→
+            # fed leg dies typed at the front, never burning a member
+            # round-trip (the member re-validates the forwarded header
+            # for the fed→member leg).
+            claim = self._param(query, _checksum.CRC_HEADER, "crc32c")
+            if claim is not None:
+                err = _checksum.claim_error(claim, body)
+                if err is not None:
+                    msg, mismatch = err
+                    if mismatch:
+                        fe.registry.counter(
+                            "integrity_checksum_failures_total"
+                        ).inc()
+                    self._error(400, msg)
+                    return
             # Forward geometry as headers (canonical form regardless
             # of how the client sent it) + the passthrough set.
             fwd = {

@@ -35,6 +35,11 @@ verdict            evidence                        consequence
                                                    the compute is pure)
 ``timeout``        socket timeout                  breaker counts, reroute
 ``injected``       armed ``fed.forward`` fault     breaker counts, reroute
+``bad_payload``    200 whose body fails its        breaker counts, reroute
+                   ``X-Result-Crc32c`` stamp or    (wrong bytes with a 200:
+                   declared geometry length        a garbage-returning
+                                                   member is ejected as
+                                                   surely as a dead one)
 =================  ==============================  =====================
 
 **Hedged requests.** A forward still pending past the observed p99
@@ -96,11 +101,24 @@ class TenantQuotaExceeded(RuntimeError):
     else — the frontend answers 429 + Retry-After."""
 
 
+class BadPayload(RuntimeError):
+    """A member answered 200 but the body is provably wrong: it fails
+    its own ``X-Result-Crc32c`` stamp, or its length contradicts the
+    geometry it declares. The one failure mode a health check cannot
+    see — treated as a transport-level forward failure
+    (``bad_payload`` verdict): the breaker counts it, the request
+    reroutes to a sibling (the compute is pure, a re-send is safe),
+    and a member returning garbage consistently is breaker-ejected as
+    surely as a dead one."""
+
+
 def _verdict_exc(e: BaseException) -> str:
     """Classify a transport-level forward failure (module docstring
     table). Every one of these counts against the member's breaker."""
     if isinstance(e, InjectedFault):
         return "injected"
+    if isinstance(e, BadPayload):
+        return "bad_payload"
     if isinstance(e, TimeoutError):  # socket.timeout is an alias
         return "timeout"
     if isinstance(e, ConnectionRefusedError):
@@ -169,9 +187,44 @@ class _Attempt:
             resp = conn.getresponse()
             data = resp.read()  # mid-body EOF raises IncompleteRead
             rh = {k.lower(): v for k, v in resp.getheaders()}
+            if resp.status == 200:
+                self._verify_payload(rh, data)
             return resp.status, rh, data
         finally:
             conn.close()
+
+    def _verify_payload(self, rh: Dict[str, str], data: bytes) -> None:
+        """The forward hop's own integrity check on a member 200: the
+        body must match its ``X-Result-Crc32c`` stamp and the length
+        its declared geometry implies. Raises :class:`BadPayload` —
+        wrong bytes never reach the client just because they arrived
+        with a happy status code. (A member with integrity disabled
+        stamps nothing; absence is not a failure — the hop then only
+        has the length to go on.)"""
+        from tpu_stencil.integrity import checksum as _checksum
+
+        stamp = rh.get(_checksum.RESULT_HEADER.lower())
+        if stamp is not None:
+            try:
+                want = _checksum.parse_crc(stamp, _checksum.RESULT_HEADER)
+            except ValueError as e:
+                raise BadPayload(str(e)) from None
+            got = _checksum.crc32c(data)
+            if got != want:
+                raise BadPayload(
+                    f"member 200 body crc32c {got} != stamped {want}"
+                )
+        try:
+            w = int(rh["x-width"])
+            h = int(rh["x-height"])
+            c = int(rh.get("x-channels", "1"))
+        except (KeyError, ValueError):
+            return  # no declared geometry to check against
+        if len(data) != w * h * c:
+            raise BadPayload(
+                f"member 200 body is {len(data)} bytes but declares "
+                f"{w}x{h}x{c} = {w * h * c}"
+            )
 
     def _run_into(self, results: "queue.Queue") -> None:
         r = self.router

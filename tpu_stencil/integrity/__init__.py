@@ -1,0 +1,63 @@
+"""End-to-end payload integrity: checksums, witness re-execution,
+quarantine.
+
+PRs 7 and 11 made the stack survive *loud* failures (crashes, timeouts,
+host loss); this subsystem detects the *silent* ones — a flipped bit in
+an HTTP body, a torn host staging buffer, a device returning corrupt
+pixels with a 200 and a healthy heartbeat. The organizing contract is
+the reference's own: bit-exact output, now *enforced at runtime* rather
+than only asserted in tests (docs/RESILIENCE.md "Integrity model").
+
+Three mechanisms, composable per tier:
+
+* **content checksums** (:mod:`.checksum`) — CRC32C of every frame at
+  each hop: HTTP bodies validated against ``X-Content-Crc32c`` (typed
+  400 :class:`ChecksumMismatch`), results stamped ``X-Result-Crc32c``,
+  the stream staging ring re-verified at the H2D boundary, durable
+  state (checkpoint sidecars, autotune cache entries) carrying embedded
+  CRCs. Checksumming touches only bytes the pipeline already touches
+  (the arxiv 2112.14216 data-movement framing: the tax is movement, not
+  compute — a CRC over moved bytes is nearly free).
+* **witness re-execution** (:mod:`.witness`) — a sampled fraction of
+  requests/frames re-runs through a *different* measured-equivalent
+  program (the single-frame model path vs the bucket-batch executable;
+  the NumPy golden for quarantine probes) and compares bit-exact. The
+  repo-wide schedule-bit-exactness discipline makes any divergence a
+  hardware/runtime fault by construction.
+* **replica quarantine** (:mod:`.quarantine`) — K witness mismatches
+  within a window move a net-tier replica out of routing (like drain,
+  but earned); background probes checked against the independent NumPy
+  golden re-admit it after N consecutive clean verdicts.
+
+Jax-free at import (numpy + stdlib; the witness *executors* live in the
+engines that own the programs), like the config/CLI layers.
+"""
+
+from tpu_stencil.integrity.checksum import (
+    CRC_HEADER,
+    RESULT_HEADER,
+    ChecksumMismatch,
+    WitnessMismatch,
+    corrupt_array,
+    corrupt_bytes,
+    crc32c,
+    fired,
+    verify,
+)
+from tpu_stencil.integrity.quarantine import QuarantineBoard, QuarantineProber
+from tpu_stencil.integrity.witness import WitnessSampler
+
+__all__ = [
+    "CRC_HEADER",
+    "RESULT_HEADER",
+    "ChecksumMismatch",
+    "WitnessMismatch",
+    "QuarantineBoard",
+    "QuarantineProber",
+    "WitnessSampler",
+    "corrupt_array",
+    "corrupt_bytes",
+    "crc32c",
+    "fired",
+    "verify",
+]

@@ -1,0 +1,231 @@
+"""CRC32C (Castagnoli) content checksums and the typed mismatch errors.
+
+CRC32C is the end-to-end content checksum of the integrity subsystem:
+stamped on every serving response (``X-Result-Crc32c``), validated
+against client-supplied ``X-Content-Crc32c`` request headers, carried in
+stream-checkpoint sidecars and autotune cache entries, and re-checked at
+the stream engine's H2D boundary. One algorithm everywhere, so any two
+hops can compare values directly.
+
+Wire format: the **unsigned decimal** CRC32C of the raw payload bytes
+(no base64, no hex — trivially greppable in a curl transcript, and a
+Prometheus counter away from a dashboard).
+
+Implementation: ``google_crc32c`` (C, ~6 GB/s — effectively free next
+to the PCIe transfer of the same bytes) when importable, else a pure-
+Python table fallback with identical values — the same bake-nothing-in
+discipline as :mod:`tpu_stencil.io.native`. Both are deterministic and
+standard (poly 0x1EDC6F41 reflected; ``crc32c(b"123456789") ==
+0xE3069283``), so a client with a real CRC32C library interoperates
+with either.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+#: Optional request header: the client's CRC32C of the request body. A
+#: mismatch is a typed 400 (the body was damaged in flight or torn at
+#: the sender) — never a silent compute over corrupt pixels.
+CRC_HEADER = "X-Content-Crc32c"
+
+#: Response header stamped on every 200 payload: the CRC32C of the
+#: result bytes, computed server-side AFTER the compute. Clients (and
+#: the federation forward path) verify it; a mismatch means the wire or
+#: a buffer corrupted the result after it was correct.
+RESULT_HEADER = "X-Result-Crc32c"
+
+
+class ChecksumMismatch(ValueError):
+    """Payload bytes do not match their declared/recorded CRC32C.
+
+    A ``ValueError`` on purpose: the retry classifier treats it as
+    PERMANENT (re-sending identical corrupt bytes re-fails identically)
+    and the HTTP edges map it to a typed 400. ``where`` names the hop
+    that caught it; ``expected``/``got`` are the two CRC values."""
+
+    def __init__(self, where: str, expected: int, got: int) -> None:
+        super().__init__(
+            f"ChecksumMismatch at {where}: crc32c {got} != expected "
+            f"{expected} (payload corrupted in flight or torn in a "
+            f"buffer)"
+        )
+        self.where = where
+        self.expected = int(expected)
+        self.got = int(got)
+
+
+class WitnessMismatch(ValueError):
+    """A witness re-execution disagreed bit-exact with the served
+    result. Under the repo-wide schedule-bit-exactness discipline two
+    measured-equivalent programs MUST agree, so a divergence is a
+    hardware/runtime fault on the serving path — permanent for this
+    result (``ValueError``), and a verdict against the replica that
+    computed it (:mod:`tpu_stencil.integrity.quarantine`)."""
+
+    def __init__(self, where: str, detail: str = "") -> None:
+        super().__init__(
+            f"WitnessMismatch at {where}: witness re-execution disagrees "
+            f"with the served result{': ' if detail else ''}{detail}"
+        )
+        self.where = where
+
+
+# -- the CRC32C implementation ------------------------------------------
+
+def _make_table() -> list:
+    poly = 0x82F63B78  # 0x1EDC6F41 reflected
+    table = []
+    for i in range(256):
+        crc = i
+        for _ in range(8):
+            crc = (crc >> 1) ^ poly if crc & 1 else crc >> 1
+        table.append(crc)
+    return table
+
+
+_TABLE = _make_table()
+
+
+def _crc32c_py(data: bytes, value: int = 0) -> int:
+    """Pure-Python fallback (table-driven, byte at a time). Correct but
+    slow (~tens of MB/s) — fine for sidecars and test frames; install
+    ``google_crc32c`` for production streams."""
+    crc = (~value) & 0xFFFFFFFF
+    table = _TABLE
+    for b in data:
+        crc = table[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return (~crc) & 0xFFFFFFFF
+
+
+try:  # the C fast path, when the wheel is present
+    import google_crc32c as _gcrc
+
+    def _crc32c_fast(data: bytes, value: int = 0) -> int:
+        return _gcrc.extend(value, data)
+
+    IMPLEMENTATION = f"google_crc32c ({_gcrc.implementation})"
+except ImportError:  # pragma: no cover - exercised where the wheel is absent
+    _crc32c_fast = _crc32c_py
+    IMPLEMENTATION = "python"
+
+
+def crc32c(data, value: int = 0) -> int:
+    """The CRC32C of ``data`` (bytes-like or a uint8 ndarray), optionally
+    extending a running ``value``. Arrays are checksummed over their
+    contiguous row-major bytes — the exact bytes the raw container
+    holds, so an array CRC and the CRC of its ``.tobytes()`` agree."""
+    if isinstance(data, np.ndarray):
+        data = np.ascontiguousarray(data).view(np.uint8)
+        data = memoryview(data.reshape(-1)).cast("B")
+    return _crc32c_fast(bytes(data) if isinstance(data, memoryview)
+                        else data, value)
+
+
+def verify(data, expected: int, where: str) -> None:
+    """Raise :class:`ChecksumMismatch` unless ``crc32c(data)`` equals
+    ``expected``."""
+    got = crc32c(data)
+    if got != int(expected):
+        raise ChecksumMismatch(where, int(expected), got)
+
+
+def parse_crc(value: str, where: str) -> int:
+    """Parse a wire CRC header (unsigned decimal). A malformed header is
+    a plain ``ValueError`` (→ 400 bad request, not a mismatch)."""
+    try:
+        n = int(value)
+    except (TypeError, ValueError):
+        raise ValueError(
+            f"{where}: malformed crc32c {value!r} (unsigned decimal "
+            f"expected)"
+        ) from None
+    if not 0 <= n <= 0xFFFFFFFF:
+        raise ValueError(f"{where}: crc32c {n} outside uint32 range")
+    return n
+
+
+def claim_error(claim: str, body: bytes):
+    """Validate a client ``X-Content-Crc32c`` claim against ``body`` —
+    the ONE request-validation rule both HTTP edges (net and fed)
+    apply, so their wire behavior can never drift. Returns None when
+    the claim matches, else ``(error_text, is_mismatch)`` for the 400:
+    ``is_mismatch`` distinguishes a real corruption (count it) from a
+    malformed header (a client bug, not a detection)."""
+    try:
+        want = parse_crc(claim, CRC_HEADER)
+    except ValueError as e:
+        return f"bad request parameters: {e}", False
+    got = crc32c(body)
+    if got != want:
+        return (
+            f"ChecksumMismatch: request body crc32c {got} != declared "
+            f"{want} (body corrupted in flight or torn at the sender)",
+            True,
+        )
+    return None
+
+
+def stamp_matches(stamp: Optional[str], data: bytes) -> bool:
+    """Whether a response's ``X-Result-Crc32c`` stamp verifies ``data``
+    — the client-side check (``--verify crc``, the bench riders). A
+    missing OR malformed stamp is a failure: a verifying client trusts
+    only what it can actually check, and wire corruption can hit the
+    header bytes as easily as the body."""
+    if stamp is None:
+        return False
+    try:
+        want = parse_crc(stamp, RESULT_HEADER)
+    except ValueError:
+        return False
+    return crc32c(data) == want
+
+
+# -- deterministic corruption (the chaos side of the contract) ----------
+#
+# The integrity.corrupt_ingest / integrity.corrupt_result /
+# net.corrupt_body fault points do not RAISE like other points — they
+# flip bits, so every detection path is exercised against genuinely
+# wrong bytes, not mocks. The flip is deterministic (middle byte, low
+# bit) so a detected corruption replays exactly under the seeded
+# grammar.
+
+def fired(site, index: Optional[int] = None) -> bool:
+    """Fire an armed corruption rule at ``site``; True when it fired.
+    The harness signals a firing by raising — here the raise is the
+    signal to corrupt, not an error (``FatalInjectedFault`` still
+    escapes: corruption points are not thread-death simulators)."""
+    if site is None:
+        return False
+    try:
+        site(index)
+    except Exception:
+        return True
+    return False
+
+
+def corrupt_bytes(data: bytes) -> bytes:
+    """``data`` with one deterministic bit flipped (middle byte, bit 0).
+    Empty payloads return empty — nothing to corrupt."""
+    if not data:
+        return data
+    i = len(data) // 2
+    out = bytearray(data)
+    out[i] ^= 0x01
+    return bytes(out)
+
+
+def corrupt_array(arr: np.ndarray) -> np.ndarray:
+    """A uint8 array with one deterministic bit flipped (same rule as
+    :func:`corrupt_bytes`). Writable arrays are corrupted IN PLACE (the
+    torn-staging-buffer simulation must damage the real buffer);
+    read-only views are copied first."""
+    if arr.size == 0:
+        return arr
+    if not arr.flags.writeable:
+        arr = arr.copy()
+    flat = arr.reshape(-1)
+    flat[flat.size // 2] ^= 0x01
+    return arr

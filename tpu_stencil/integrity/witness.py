@@ -1,0 +1,99 @@
+"""Witness sampling: which requests/frames get re-executed.
+
+The sampler is the POLICY half of witness re-execution (the engines own
+the EXECUTION half — they know their programs): a seeded, thread-safe
+Bernoulli draw per request/frame at ``rate`` (``--witness-rate``,
+default 1/256 on the network tier). Seeded so a chaos run replays: two
+samplers with the same seed pick the same indices in the same order —
+the same determinism contract as the fault harness's ``p=`` rules
+(``TPU_STENCIL_FAULTS_SEED``).
+
+Also home to :func:`golden_witness`, the NumPy-golden comparator the
+quarantine prober uses: unlike the engines' fast measured-equivalent
+witness (a different compiled program on the same stack), the golden
+shares NO code with any device path — the right referee when the
+question is "is this device lying", at probe-sized frames where its
+per-pixel Python loops cost milliseconds.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+
+import numpy as np
+
+#: The network tier's default sampling rate: ~4 witnesses per 1024
+#: requests — cheap enough to leave on, frequent enough that a replica
+#: corrupting every result trips quarantine within ~K/rate requests.
+DEFAULT_RATE = 1.0 / 256.0
+
+#: Requests/frames above this rep count are never witnessed: the
+#: witness executor runs one eager step per rep (that is what makes it
+#: a *different* program), so its cost is linear in reps while the
+#: served program's HBM traffic is amortized by fusion/residency — past
+#: this bound a witness would cost more than the request it checks (the
+#: _WARM_MAX_REPS discipline applied to verification).
+WITNESS_MAX_REPS = 512
+
+
+class WitnessSampler:
+    """Seeded Bernoulli sampler: ``pick()`` per request/frame."""
+
+    def __init__(self, rate: float, seed: int = 0) -> None:
+        if not 0.0 <= rate <= 1.0:
+            raise ValueError(f"witness rate must be in [0, 1], got {rate}")
+        self.rate = float(rate)
+        self.seed = int(seed)
+        self._rng = random.Random(self.seed)
+        self._lock = threading.Lock()
+
+    def pick(self) -> bool:
+        """Whether THIS request/frame is witnessed. Thread-safe; each
+        call consumes exactly one draw, so the picked index sequence is
+        a pure function of (seed, call order)."""
+        if self.rate <= 0.0:
+            return False
+        if self.rate >= 1.0:
+            return True
+        with self._lock:
+            return self._rng.random() < self.rate
+
+
+def device_witness(img: np.ndarray, filter_name: str, reps: int,
+                   boundary: str = "zero") -> np.ndarray:
+    """Measured-equivalent re-execution through a deliberately
+    DIFFERENT program shape: one eager XLA ``padded_step`` dispatch per
+    rep. Every serving path runs a fused/jitted program (the bucket
+    executable's vmapped+masked ``fori_loop``, the stream's donated
+    traced-rep launch, the Pallas kernels), so the eager per-rep chain
+    shares none of their compiled artifacts while the repo-wide
+    bit-exactness discipline guarantees identical bytes — any
+    divergence is a hardware/runtime fault on the serving path, not a
+    schedule difference. O(reps) dispatches: callers gate on
+    :data:`WITNESS_MAX_REPS`."""
+    import jax.numpy as jnp
+
+    from tpu_stencil import filters
+    from tpu_stencil.ops import lowering
+
+    plan = lowering.plan_filter(filters.get_filter(filter_name))
+    x = jnp.asarray(img, jnp.uint8)
+    for _ in range(int(reps)):
+        x = lowering.padded_step(x, plan, boundary)
+    return np.asarray(x)
+
+
+def golden_witness(img: np.ndarray, filter_name: str, reps: int,
+                   got: np.ndarray, boundary: str = "zero") -> bool:
+    """True when ``got`` equals the independent NumPy golden of
+    ``reps`` filter applications on ``img`` — the referee that shares
+    no code with any device path. O(H*W*reps) Python loops: probe-sized
+    frames only (the quarantine prober's 24x32 probes cost ~ms)."""
+    from tpu_stencil import filters
+    from tpu_stencil.ops import stencil
+
+    want = stencil.reference_stencil_numpy(
+        img, filters.get_filter(filter_name), reps, boundary=boundary
+    )
+    return bool(np.array_equal(np.asarray(got), want))

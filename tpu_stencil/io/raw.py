@@ -33,6 +33,38 @@ def _expected_bytes(width: int, height: int, channels: int) -> int:
     return width * height * channels
 
 
+def fsync_path(path: str) -> None:
+    """fsync ``path``'s data to stable storage. The missing half of the
+    tmp-then-rename discipline: ``os.replace`` orders the NAME change,
+    but without an fsync the DATA behind the new name can still be
+    dirty page cache — a power cut after the rename publishes a torn
+    file under a complete-looking name. Callers fsync the tmp file
+    BEFORE the rename (and the directory after, :func:`fsync_dir`)."""
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def fsync_dir(path: str) -> None:
+    """fsync the directory containing ``path`` (the rename itself lives
+    in directory metadata). Best-effort: some filesystems refuse
+    directory fsync — the data fsync already happened, so a refusal
+    degrades durability, never correctness."""
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    try:
+        fd = os.open(d, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
 def read_raw(path: str, width: int, height: int, channels: int) -> np.ndarray:
     """Read a whole raw image into an (H, W, C) uint8 array (C in {1, 3})."""
     return read_raw_rows(path, 0, height, width, channels)
@@ -134,9 +166,28 @@ def read_raw_rows(
 
 
 def write_raw(path: str, img: np.ndarray) -> None:
-    """Write an (H, W, C) or (H, W) uint8 array as raw interleaved bytes."""
+    """Write an (H, W, C) or (H, W) uint8 array as raw interleaved
+    bytes — atomically: bytes land in a tmp file, are fsynced, and
+    ``os.replace`` publishes the final name. A crash (or power cut) at
+    ANY point leaves ``path`` holding its previous contents or the
+    complete new image, never a torn ``blur_`` file — the same
+    discipline as the checkpoint sidecars, applied to the artifact the
+    whole job exists to produce."""
     arr = np.ascontiguousarray(np.asarray(img, dtype=np.uint8))
-    _native.pwrite_full(path, 0, arr.tobytes(), truncate=True)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        _native.pwrite_full(tmp, 0, arr.tobytes(), truncate=True)
+        fsync_path(tmp)
+        os.replace(tmp, path)
+    except BaseException:
+        # Never leave a stray tmp beside the output on failure.
+        if os.path.exists(tmp):
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+        raise
+    fsync_dir(path)
 
 
 def write_raw_rows(

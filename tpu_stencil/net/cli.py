@@ -72,6 +72,35 @@ def build_parser() -> argparse.ArgumentParser:
                    help="disable shared executable-cache warming across "
                         "replicas (on by default: a shape compiled on one "
                         "replica pre-warms the others)")
+    p.add_argument("--no-integrity", dest="integrity",
+                   action="store_false",
+                   help="disable the integrity layer (on by default: "
+                        "X-Content-Crc32c request validation, "
+                        "X-Result-Crc32c response stamping, witness "
+                        "re-execution; docs/RESILIENCE.md 'Integrity "
+                        "model'). Quarantine then only trips via "
+                        "POST /admin/quarantine")
+    p.add_argument("--witness-rate", dest="witness_rate", type=float,
+                   default=1.0 / 256.0, metavar="RATE",
+                   help="fraction of completed requests re-executed "
+                        "through a different measured-equivalent program "
+                        "and compared bit-exact per replica (seeded, "
+                        "deterministic; default 1/256; 0 disables). K "
+                        "mismatches in the window quarantine the "
+                        "replica")
+    p.add_argument("--quarantine-after", dest="quarantine_after",
+                   type=int, default=3, metavar="K",
+                   help="witness mismatches within the window that "
+                        "quarantine a replica (default 3)")
+    p.add_argument("--readmit-after", dest="readmit_after", type=int,
+                   default=3, metavar="N",
+                   help="consecutive clean background probes that "
+                        "re-admit a quarantined replica (default 3)")
+    p.add_argument("--probe-interval", dest="probe_interval_s",
+                   type=float, default=1.0, metavar="SECONDS",
+                   help="background re-verify probe period for "
+                        "quarantined replicas (default 1.0; 0 disables "
+                        "the prober)")
     p.add_argument("--platform", default=None,
                    choices=["cpu", "tpu", "gpu"],
                    help="force the JAX platform before backend init")
@@ -145,6 +174,11 @@ def main(argv=None) -> int:
             request_timeout_s=ns.request_timeout_s,
             drain_timeout_s=ns.drain_timeout_s,
             warm_fleet=ns.warm_fleet,
+            integrity=ns.integrity,
+            witness_rate=ns.witness_rate,
+            quarantine_after=ns.quarantine_after,
+            readmit_after=ns.readmit_after,
+            probe_interval_s=ns.probe_interval_s,
         )
     except ValueError as e:
         parser.error(str(e))

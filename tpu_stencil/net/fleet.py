@@ -80,6 +80,10 @@ class ReplicaFleet:
         # same replica must not each build an engine and leak the loser.
         self._restart_lock = threading.Lock()
         self._warmed: "collections.OrderedDict" = collections.OrderedDict()
+        # Witness verdict sink: callable(replica_index, ok) installed by
+        # the router (the quarantine board's feed). Wired per replica in
+        # _build, so a restarted engine re-wires automatically.
+        self._witness_sink = None
         # Tests park the fleet (start_workers=False) to pin queues
         # deterministically, then release with start_workers().
         self._start_workers = start_workers
@@ -109,8 +113,20 @@ class ReplicaFleet:
         return self
 
     def _build(self, i: int) -> StencilServer:
-        return StencilServer(self.cfg.serve_config(i),
-                             start=self._start_workers)
+        srv = StencilServer(self.cfg.serve_config(i),
+                            start=self._start_workers)
+        srv.on_witness = lambda ok, i=i: self._emit_witness(i, ok)
+        return srv
+
+    def set_witness_sink(self, sink) -> None:
+        """Install the verdict sink (``callable(replica_index, ok)``) —
+        the router points it at the quarantine board."""
+        self._witness_sink = sink
+
+    def _emit_witness(self, i: int, ok: bool) -> None:
+        sink = self._witness_sink
+        if sink is not None:
+            sink(i, ok)
 
     def start_workers(self) -> None:
         """Release a parked fleet (tests): start every replica worker."""

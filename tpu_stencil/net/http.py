@@ -56,6 +56,11 @@ from urllib.parse import parse_qs, urlsplit
 import numpy as np
 
 from tpu_stencil.config import NetConfig
+from tpu_stencil.integrity import checksum as _checksum
+from tpu_stencil.integrity.quarantine import (
+    QuarantineBoard,
+    QuarantineProber,
+)
 from tpu_stencil.net.fleet import ReplicaFleet
 from tpu_stencil.net.router import Draining, Overloaded, Router
 from tpu_stencil.obs import span as _obs_span
@@ -253,6 +258,8 @@ class _Handler(BaseHTTPRequestHandler):
             self._restart(parse_qs(split.query))
         elif split.path == "/admin/drain":
             self._admin_drain()
+        elif split.path == "/admin/quarantine":
+            self._quarantine(parse_qs(split.query))
         else:
             self._error(404, f"no such endpoint: {split.path}")
 
@@ -316,6 +323,42 @@ class _Handler(BaseHTTPRequestHandler):
         self._respond(200, json.dumps(
             {"draining": True, "replicas": len(self.fe.fleet)}
         ).encode(), content_type="application/json")
+
+    def _quarantine(self, query: dict) -> None:
+        """Operator quarantine override (docs/DEPLOY.md runbook):
+        ``?replica=i`` trips quarantine (out of routing now, probes or
+        an explicit ``action=clear`` bring it back); ``action=clear``
+        releases without waiting for the probe streak."""
+        n = int(self.headers.get("Content-Length") or 0)
+        if n:
+            self.rfile.read(min(n, 1 << 20))
+        try:
+            idx = int(query.get("replica", ["-1"])[0])
+            if not 0 <= idx < len(self.fe.fleet):
+                raise ValueError
+        except ValueError:
+            self._error(
+                400, f"replica must be 0..{len(self.fe.fleet) - 1}"
+            )
+            return
+        action = query.get("action", ["quarantine"])[0]
+        if action == "clear":
+            changed = self.fe.router.release_replica(idx)
+        elif action == "quarantine":
+            changed = self.fe.router.quarantine_replica(
+                idx, "operator request (POST /admin/quarantine)"
+            )
+        else:
+            self._error(400,
+                        f"action must be quarantine|clear, got {action!r}")
+            return
+        self._respond(200, json.dumps({
+            "replica": idx, "action": action, "changed": changed,
+            "quarantined": bool(
+                self.fe.quarantine is not None
+                and self.fe.quarantine.is_quarantined(idx)
+            ),
+        }).encode(), content_type="application/json")
 
     def _restart(self, query: dict) -> None:
         # Consume any request body first: an unread body corrupts the
@@ -410,6 +453,23 @@ class _Handler(BaseHTTPRequestHandler):
                     f"needs exactly {expected}",
                 )
                 return
+            # Chaos site: flip a bit in the ingested body AFTER the
+            # framing checks, BEFORE checksum validation — the exact
+            # corruption the X-Content-Crc32c hop exists to catch.
+            if fe.fault_corrupt_ingest is not None and _checksum.fired(
+                    fe.fault_corrupt_ingest):
+                body = _checksum.corrupt_bytes(body)
+            claim = self._param(query, _checksum.CRC_HEADER, "crc32c")
+            if claim is not None and fe.cfg.integrity:
+                err = _checksum.claim_error(claim, body)
+                if err is not None:
+                    msg, mismatch = err
+                    if mismatch:
+                        fe.registry.counter(
+                            "integrity_checksum_failures_total"
+                        ).inc()
+                    self._error(400, msg)
+                    return
             shape = (h, w) if channels == 1 else (h, w, channels)
             img = np.frombuffer(body, np.uint8).reshape(shape)
             try:
@@ -458,6 +518,22 @@ class _Handler(BaseHTTPRequestHandler):
                 time.perf_counter() - t0
             )
             payload = np.ascontiguousarray(out).tobytes()
+            resp_headers = {
+                "X-Width": str(w), "X-Height": str(h),
+                "X-Channels": str(channels), "X-Reps": str(reps),
+                "X-Replica": str(idx),
+            }
+            if fe.cfg.integrity:
+                # Stamp the TRUE result's CRC, then let the wire-
+                # corruption chaos site flip bits: a client (or the
+                # federation forward path) verifying the stamp catches
+                # exactly what the wire damaged.
+                resp_headers[_checksum.RESULT_HEADER] = str(
+                    _checksum.crc32c(payload)
+                )
+            if fe.fault_corrupt_body is not None and _checksum.fired(
+                    fe.fault_corrupt_body):
+                payload = _checksum.corrupt_bytes(payload)
             if fe.fault_body is not None and self._body_fault(
                 fe.fault_body, payload
             ):
@@ -465,11 +541,7 @@ class _Handler(BaseHTTPRequestHandler):
             self._respond(
                 200, payload,
                 content_type="application/octet-stream",
-                headers={
-                    "X-Width": str(w), "X-Height": str(h),
-                    "X-Channels": str(channels), "X-Reps": str(reps),
-                    "X-Replica": str(idx),
-                },
+                headers=resp_headers,
             )
 
 
@@ -500,9 +572,23 @@ class NetFrontend:
         # Set by POST /admin/drain (the SIGTERM-equivalent admin
         # path); the CLI main loop watches it next to the signal flag.
         self.admin_drain_requested = threading.Event()
-        # net.accept / net.body chaos sites, resolved once at start().
+        # net.accept / net.body / corruption chaos sites, resolved once
+        # at start().
         self.fault_accept = None
         self.fault_body = None
+        self.fault_corrupt_ingest = None
+        self.fault_corrupt_body = None
+        # The quarantine state machine + its background re-verify
+        # prober (tpu_stencil.integrity.quarantine): witness verdicts
+        # from the replicas land on the board via the router; the
+        # prober golden-checks quarantined replicas back to health.
+        self.quarantine = QuarantineBoard(
+            self.registry,
+            quarantine_after=cfg.quarantine_after,
+            window_s=cfg.quarantine_window_s,
+            readmit_after=cfg.readmit_after,
+        )
+        self._prober: Optional[QuarantineProber] = None
 
     # -- lifecycle -----------------------------------------------------
 
@@ -511,11 +597,19 @@ class NetFrontend:
 
         self.fault_accept = _faults.site("net.accept")
         self.fault_body = _faults.site("net.body")
+        self.fault_corrupt_ingest = _faults.site("integrity.corrupt_ingest")
+        self.fault_corrupt_body = _faults.site("net.corrupt_body")
         self.fleet.start()
         self.router = Router(
             self.fleet, self.registry,
             max_inflight_bytes=self.cfg.max_inflight_bytes,
+            quarantine=self.quarantine,
         )
+        if self.cfg.probe_interval_s > 0:
+            self._prober = QuarantineProber(
+                self.fleet, self.quarantine, self.cfg.filter_name,
+                self.cfg.probe_interval_s, self.registry,
+            ).start()
         self._httpd = _NetHTTPServer((self.cfg.host, self.cfg.port), self)
         self._thread = threading.Thread(
             target=self._httpd.serve_forever,
@@ -560,6 +654,9 @@ class NetFrontend:
 
     def close(self) -> None:
         """Stop the listener (drains first if nobody did)."""
+        if self._prober is not None:
+            self._prober.stop()
+            self._prober = None
         if self.router is not None and not self.router.draining:
             self.drain()
         if self._httpd is not None:
@@ -610,6 +707,7 @@ class NetFrontend:
             "outstanding": {
                 str(k): v for k, v in self.router.outstanding().items()
             },
+            "quarantine": self.quarantine.statusz(),
             "drain_report": (
                 None if self._drain_report is None
                 else {str(k): v for k, v in self._drain_report.items()}
@@ -629,5 +727,9 @@ class NetFrontend:
                 "warm_fleet": self.cfg.warm_fleet,
                 "backend": self.cfg.backend,
                 "filter": self.cfg.filter_name,
+                "integrity": self.cfg.integrity,
+                "witness_rate": self.cfg.witness_rate,
+                "quarantine_after": self.cfg.quarantine_after,
+                "readmit_after": self.cfg.readmit_after,
             },
         }

@@ -30,6 +30,17 @@ A replica that answers ``WorkerCrashed`` is restarted in place through
 rung at fleet scope, ``worker_crash_reroutes_total``) and the request
 retries on the fresh engine — one crashed worker costs one rebuild,
 not an outage.
+
+**Quarantine** (``tpu_stencil.integrity``, docs/RESILIENCE.md
+"Integrity model"): replicas whose witness re-executions diverge are
+tracked on a :class:`~tpu_stencil.integrity.quarantine.QuarantineBoard`
+— K mismatches within the window remove the replica from placement
+exactly like a drain (``integrity_quarantines_total``,
+``replica_quarantined_dev<i>``), background golden-checked probes
+re-admit it after N consecutive clean verdicts, and
+``POST /admin/quarantine?replica=i`` is the operator override. A
+crash-restart does NOT clear quarantine: the engine is fresh but the
+distrusted device is the same silicon.
 """
 
 from __future__ import annotations
@@ -61,13 +72,21 @@ class Router:
     """Least-outstanding placement + the three admission layers."""
 
     def __init__(self, fleet: ReplicaFleet, registry: Registry,
-                 max_inflight_bytes: int = 0) -> None:
+                 max_inflight_bytes: int = 0,
+                 quarantine=None) -> None:
         self._fleet = fleet
         self.registry = registry
         self._lock = threading.Lock()
         self._outstanding: Dict[int, int] = {
             i: 0 for i in range(len(fleet))
         }
+        # QuarantineBoard (tpu_stencil.integrity.quarantine) or None:
+        # witness verdicts land here and quarantined replicas drop out
+        # of placement. The fleet's per-replica on_witness hooks feed
+        # record_witness.
+        self._quarantine = quarantine
+        if quarantine is not None:
+            fleet.set_witness_sink(self.record_witness)
         self._inflight_bytes = 0
         self._max_inflight = int(max_inflight_bytes)
         self._draining = False
@@ -95,6 +114,33 @@ class Router:
         with self._lock:
             self._draining = True
         self.registry.gauge("draining").set(1)
+
+    # -- quarantine ----------------------------------------------------
+
+    @property
+    def quarantine(self):
+        return self._quarantine
+
+    def record_witness(self, idx: int, ok: bool) -> None:
+        """One witness verdict from replica ``idx``'s engine (the
+        fleet's on_witness hook lands here, on the replica's worker
+        thread)."""
+        if self._quarantine is not None:
+            self._quarantine.record_witness(idx, ok)
+
+    def quarantine_replica(self, idx: int, reason: str) -> bool:
+        """Operator path (``POST /admin/quarantine``): out of placement
+        now; probes (or an explicit clear) bring it back."""
+        if self._quarantine is None:
+            return False
+        return self._quarantine.quarantine(idx, reason)
+
+    def release_replica(self, idx: int) -> bool:
+        """Operator clear: back into placement without waiting for the
+        probe streak."""
+        if self._quarantine is None:
+            return False
+        return self._quarantine.release(idx, "operator")
 
     # -- placement -----------------------------------------------------
 
@@ -141,6 +187,22 @@ class Router:
                 )
             admitted = False
             try:
+                # Quarantined replicas are out of placement like a
+                # draining host — earned distrust routes around them.
+                if self._quarantine is not None:
+                    routable = [i for i in order
+                                if not self._quarantine.is_quarantined(i)]
+                    if not routable:
+                        self.registry.counter(
+                            "quarantine_unroutable_total"
+                        ).inc()
+                        raise Overloaded(
+                            f"every replica ({len(order)}) is "
+                            f"quarantined pending re-verification; "
+                            f"retry after the background probes "
+                            f"re-admit one"
+                        )
+                    order = routable
                 last_exc: Optional[BaseException] = None
                 for idx in order:
                     rep = self._fleet.replicas[idx]

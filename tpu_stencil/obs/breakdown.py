@@ -368,6 +368,15 @@ _RESILIENCE_COUNTERS = (
     ("resilience_stream_restarts_total", "stream engine restarts"),
     ("resilience_worker_crashes_total", "serve worker crashes"),
     ("deadline_expired_total", "deadline-expired requests"),
+    # The integrity layer (docs/RESILIENCE.md "Integrity model"): every
+    # nonzero row here is a corruption DETECTED — the healthy-run table
+    # stays empty exactly like the resilience rows above.
+    ("integrity_checksum_failures_total", "checksum mismatches (ingest)"),
+    ("integrity_ingest_failures_total", "torn staging buffers"),
+    ("integrity_witness_mismatch_total", "witness mismatches"),
+    ("integrity_verify_failures_total", "client verify failures"),
+    ("integrity_quarantines_total", "replicas quarantined"),
+    ("integrity_readmits_total", "quarantine re-admissions"),
 )
 
 

@@ -73,6 +73,8 @@ import numpy as np
 
 from tpu_stencil import obs
 from tpu_stencil.config import StreamConfig
+from tpu_stencil.integrity import checksum as _checksum
+from tpu_stencil.integrity import witness as _witness_mod
 from tpu_stencil.resilience import deadline as _deadline
 from tpu_stencil.resilience import faults as _faults
 from tpu_stencil.stream import frames as frames_io
@@ -166,13 +168,21 @@ def device_cursors(frames_done: int, start_frame: int, n: int) -> List[int]:
 
 
 def _reader(ctrl: _Control, cfg: StreamConfig, source, lanes: List[_Lane],
-            start_frame: int, meter: _InflightMeter) -> None:
+            start_frame: int, meter: _InflightMeter,
+            witness=None) -> None:
     """Round-robin prefetch: frame ``i`` fills a staging slot of lane
     ``(i - start) % n``. Retry semantics: the engines' shared
-    :func:`~tpu_stencil.stream.engine._make_read_frame`."""
+    :func:`~tpu_stencil.stream.engine._make_read_frame`. Integrity
+    semantics are the single-device reader's too: each staged frame is
+    CRC'd at ingest (``verify_ingest``) for the dispatcher's
+    H2D-boundary re-check, the ``integrity.corrupt_ingest`` chaos site
+    tears the REAL lane slot, and witness sampling (``witness``, the
+    run's shared sampler) copies the pristine input aside for the
+    writer's re-execution."""
     n = len(lanes)
     idx = start_frame
     read_frame = _sengine._make_read_frame(cfg, source)
+    fault_corrupt = _faults.site("integrity.corrupt_ingest")
     try:
         while cfg.frames is None or idx < cfg.frames:
             lane = lanes[(idx - start_frame) % n]
@@ -187,8 +197,16 @@ def _reader(ctrl: _Control, cfg: StreamConfig, source, lanes: List[_Lane],
                     )
                 lane.free_q.put(buf_i)
                 break
+            crc = (_checksum.crc32c(lane.ring[buf_i])
+                   if cfg.verify_ingest else None)
+            if fault_corrupt is not None and _checksum.fired(
+                    fault_corrupt, idx):
+                _checksum.corrupt_array(lane.ring[buf_i])
+            wit = None
+            if witness is not None and witness.pick():
+                wit = lane.ring[buf_i].copy()
             meter.inc()  # in flight from read-complete to D2H-complete
-            ctrl.put(lane.filled_q, (idx, buf_i))
+            ctrl.put(lane.filled_q, (idx, buf_i, crc, wit))
             idx += 1
         for lane in lanes:
             ctrl.put(lane.filled_q, _EOF)
@@ -215,10 +233,13 @@ def _dispatcher(ctrl: _Control, cfg: StreamConfig, lane: _Lane, device,
             if item is _EOF:
                 ctrl.put(lane.inflight_q, _EOF)
                 return
-            idx, bi = item
+            idx, bi, crc, wit = item
             stage = "h2d"
             if fault_h2d is not None:
                 fault_h2d(idx)
+            # The shared H2D-boundary re-verification: a torn lane slot
+            # fails typed before this device's launch is burned.
+            _sengine._verify_staged(lane.ring[bi], crc, idx)
             with ctrl.stage("h2d", idx, dev=dev_index) as s:
                 dev_arr = s.fence(jax.device_put(
                     lane.ring[bi].reshape(cfg.frame_shape), device
@@ -229,7 +250,7 @@ def _dispatcher(ctrl: _Control, cfg: StreamConfig, lane: _Lane, device,
                 fault_compute(idx)
             t_disp = time.perf_counter()
             out = launch(dev_arr)  # async dispatch; donates dev_arr
-            ctrl.put(lane.inflight_q, (idx, out, t_disp))
+            ctrl.put(lane.inflight_q, (idx, out, t_disp, wit))
     except _sengine._Abort:
         pass
     except BaseException as e:
@@ -242,6 +263,7 @@ def _drainer(ctrl: _Control, cfg: StreamConfig, lane: _Lane,
     copy D2H, hand off to the writer's merge."""
     idx, stage = -1, "compute"
     fault_d2h = _faults.site("d2h")
+    fault_corrupt = _faults.site("integrity.corrupt_result")
     timeout_s = _deadline.resolve(cfg.dispatch_timeout_s)
     try:
         while True:
@@ -249,7 +271,7 @@ def _drainer(ctrl: _Control, cfg: StreamConfig, lane: _Lane,
             if item is _EOF:
                 ctrl.put(lane.done_q, _EOF)
                 return
-            idx, out_dev, t_disp = item
+            idx, out_dev, t_disp, wit = item
             stage = "compute"
             with ctrl.stage("compute", idx, t0=t_disp, dev=dev_index):
                 _deadline.fence(
@@ -261,8 +283,11 @@ def _drainer(ctrl: _Control, cfg: StreamConfig, lane: _Lane,
                 if fault_d2h is not None:
                     fault_d2h(idx)
                 arr = np.asarray(out_dev)
+            if fault_corrupt is not None and _checksum.fired(
+                    fault_corrupt, idx):
+                arr = _checksum.corrupt_array(np.asarray(arr))
             meter.dec()
-            ctrl.put(lane.done_q, (idx, arr))
+            ctrl.put(lane.done_q, (idx, arr, wit))
     except _sengine._Abort:
         pass
     except BaseException as e:
@@ -286,8 +311,12 @@ def _writer(ctrl: _Control, cfg: StreamConfig, sink, lanes: List[_Lane],
             item = ctrl.get(lane.done_q)
             if item is _EOF:
                 return
-            got, arr = item
+            got, arr, wit = item
             assert got == idx, (got, idx)  # per-lane FIFO + round-robin
+            if wit is not None:
+                # The shared pre-sink witness: a mismatching frame is
+                # withheld and the run fails typed at this frame.
+                _sengine._witness_frame(cfg, idx, wit, arr)
             with ctrl.stage("write", idx):
                 write_frame(idx, arr)
             lane.frames += 1
@@ -333,10 +362,20 @@ def run_mesh_frames(cfg: StreamConfig, devices, n: int, model,
     lanes = [_Lane(cfg) for _ in range(n)]
     done = [start_frame]
     meter = _InflightMeter()
+    # One witness sampler for the whole fan (the single-device engine's
+    # gating: off past WITNESS_MAX_REPS — the eager witness executor is
+    # linear in reps).
+    witness = (
+        _witness_mod.WitnessSampler(cfg.witness_rate,
+                                    seed=cfg.witness_seed)
+        if (cfg.witness_rate > 0
+            and cfg.repetitions <= _witness_mod.WITNESS_MAX_REPS)
+        else None
+    )
     threads = [
         threading.Thread(
             target=_reader,
-            args=(ctrl, cfg, source, lanes, start_frame, meter),
+            args=(ctrl, cfg, source, lanes, start_frame, meter, witness),
             name="fanout-reader", daemon=True,
         ),
         threading.Thread(

@@ -81,6 +81,15 @@ POINTS = (
     "fed.heartbeat",  # membership /healthz probe (injected = a miss)
     "fed.forward",    # one member forward attempt launch
     "fed.hedge",      # hedge-request launch decision
+    # Corruption sites (tpu_stencil.integrity): unlike every point
+    # above, an armed rule here does not RAISE into the engine — the
+    # firing is caught and converted into a deterministic bit flip
+    # (integrity.checksum.fired/corrupt_*), so the checksum/witness
+    # detection paths are chaos-tested against genuinely wrong bytes
+    # under the same point[:p=][:times=] grammar, never mocks.
+    "integrity.corrupt_ingest",  # flip bits in an ingested frame/body
+    "integrity.corrupt_result",  # flip bits in a computed result
+    "net.corrupt_body",          # flip bits in a 200 payload on the wire
 )
 
 #: Resolvable ``raise=`` names. A short allow-list, not arbitrary eval:

@@ -128,10 +128,43 @@ def _load_cache() -> dict:
     import jax
 
     cur = jax.__version__
-    return {
+    entries = {
         k: v for k, v in entries.items()
         if isinstance(k, str) and _entry_jax_version(k) == cur
     }
+    # Per-entry integrity CRCs (written by _store_cache): an entry
+    # whose recorded crc32c no longer matches its value was bit-flipped
+    # AFTER it was measured — JSON cannot see a changed digit inside
+    # "fuse": 8, but the CRC can. Corrupt entries drop to a cold miss
+    # (that one key re-measures) with a warning; siblings survive.
+    # Legacy files without recorded CRCs load unchecked.
+    crcs = raw.get("entry_crcs") if isinstance(raw, dict) else None
+    if isinstance(crcs, dict):
+        from tpu_stencil.integrity import checksum as _checksum
+
+        good = {}
+        for k, v in entries.items():
+            want = crcs.get(k)
+            if want is not None and _checksum.crc32c(
+                json.dumps(v, sort_keys=True).encode()
+            ) != want:
+                _corrupt_entry_warning(path, k)
+                continue
+            good[k] = v
+        entries = good
+    return entries
+
+
+def _corrupt_entry_warning(path: str, key: str) -> None:
+    import warnings
+
+    warnings.warn(
+        f"autotune cache entry {key!r} in {path} fails its embedded "
+        "crc32c (bit-flipped on disk); dropping it — that verdict "
+        "re-measures cold and the next store rewrites it",
+        RuntimeWarning,
+        stacklevel=4,
+    )
 
 
 def _store_cache(cache: dict) -> None:
@@ -141,6 +174,8 @@ def _store_cache(cache: dict) -> None:
     import jax
 
     try:
+        from tpu_stencil.integrity import checksum as _checksum
+
         os.makedirs(os.path.dirname(path), exist_ok=True)
         tmp = f"{path}.tmp.{os.getpid()}"
         with open(tmp, "w") as f:
@@ -148,7 +183,18 @@ def _store_cache(cache: dict) -> None:
                 "schema_version": SCHEMA_VERSION,
                 "jax_version": jax.__version__,
                 "entries": cache,
+                # Per-entry integrity CRCs over each value's canonical
+                # JSON: _load_cache drops (with a warning) any entry
+                # the disk bit-flipped, instead of tuning with it.
+                "entry_crcs": {
+                    k: _checksum.crc32c(
+                        json.dumps(v, sort_keys=True).encode()
+                    )
+                    for k, v in cache.items()
+                },
             }, f, indent=1)
+            f.flush()
+            os.fsync(f.fileno())
         os.replace(tmp, path)
     except OSError:
         pass  # read-only home: tuning still works, it just re-measures

@@ -22,7 +22,78 @@ from typing import Optional, Tuple
 import numpy as np
 
 from tpu_stencil.config import JobConfig
+from tpu_stencil.integrity import checksum as _checksum
 from tpu_stencil.io import native
+from tpu_stencil.io.raw import fsync_path
+
+
+class CorruptCheckpoint(ValueError):
+    """A checkpoint sidecar failed its embedded CRC (or no longer
+    parses): a flipped bit in durable state. Refuse-to-resume, typed,
+    NAMING the file — the operator deletes (or restores) that one
+    artifact instead of debugging why a resumed run diverged. A
+    ``ValueError`` so every resume path classifies it permanent."""
+
+    def __init__(self, path: str, why: str) -> None:
+        super().__init__(
+            f"checkpoint sidecar {path} is corrupt ({why}); refusing "
+            f"to resume from it — delete the file to start over, or "
+            f"restore it from a good copy"
+        )
+        self.path = path
+
+
+def _canonical_body(meta: dict) -> bytes:
+    """The bytes the sidecar CRC covers: canonical JSON of every field
+    except the stamp itself. ONE serialization shared by writer and
+    verifier — a drifting copy would reject every fresh sidecar."""
+    return json.dumps(
+        {k: meta[k] for k in sorted(meta) if k != "crc32c"},
+        sort_keys=True,
+    ).encode()
+
+
+def _stamp_crc(meta: dict) -> dict:
+    """``meta`` with its embedded integrity CRC: crc32c over the
+    canonical JSON of every OTHER field. A sidecar that parses but was
+    bit-flipped (a digit changed inside ``frames_done``) is exactly the
+    corruption JSON cannot see and this stamp can."""
+    return dict(meta, crc32c=_checksum.crc32c(_canonical_body(meta)))
+
+
+def _load_meta(path: str) -> dict:
+    """Parse + integrity-check a sidecar. Unparseable JSON or a CRC
+    mismatch raises :class:`CorruptCheckpoint` naming the file;
+    sidecars written before the CRC existed (no ``crc32c`` key) load
+    unchecked — fingerprint validation still applies to them."""
+    with open(path) as f:
+        raw = f.read()
+    try:
+        meta = json.loads(raw)
+    except ValueError as e:
+        raise CorruptCheckpoint(path, f"unparseable JSON: {e}") from None
+    if not isinstance(meta, dict):
+        raise CorruptCheckpoint(
+            path, f"top-level {type(meta).__name__}, expected object"
+        )
+    if "crc32c" in meta:
+        got = _checksum.crc32c(_canonical_body(meta))
+        if got != meta["crc32c"]:
+            raise CorruptCheckpoint(
+                path, f"embedded crc32c {meta['crc32c']} != computed {got}"
+            )
+    return meta
+
+
+def _write_meta(path: str, meta: dict) -> None:
+    """The one sidecar commit path: CRC-stamped, fsynced, atomically
+    renamed — torn on no axis (parse, content, publication)."""
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(_stamp_crc(meta), f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
 
 
 def _paths(cfg: JobConfig) -> Tuple[str, str]:
@@ -73,10 +144,7 @@ def _commit_meta(cfg: JobConfig, rep: int, versioned: str) -> None:
     if jax.process_index() == 0:
         meta = dict(_fingerprint(cfg), rep=rep,
                     data=os.path.basename(versioned))
-        tmp_meta = meta_path + ".tmp"
-        with open(tmp_meta, "w") as f:
-            json.dump(meta, f)
-        os.replace(tmp_meta, meta_path)
+        _write_meta(meta_path, meta)
         for name in _stale_versions(data_path, before_rep=rep):
             os.remove(name)
 
@@ -101,12 +169,9 @@ def save(cfg: JobConfig, rep: int, frame: np.ndarray) -> None:
     tmp = data_path + ".tmp"
     arr = np.ascontiguousarray(np.asarray(frame, np.uint8))
     native.pwrite_full(tmp, 0, arr.tobytes(), truncate=True)
+    fsync_path(tmp)  # the data must be stable before its name is
     os.replace(tmp, data_path)
-    meta = dict(_fingerprint(cfg), rep=rep)
-    tmp_meta = meta_path + ".tmp"
-    with open(tmp_meta, "w") as f:
-        json.dump(meta, f)
-    os.replace(tmp_meta, meta_path)
+    _write_meta(meta_path, dict(_fingerprint(cfg), rep=rep))
 
 
 def restore(cfg: JobConfig) -> Optional[Tuple[int, np.ndarray]]:
@@ -114,8 +179,7 @@ def restore(cfg: JobConfig) -> Optional[Tuple[int, np.ndarray]]:
     data_path, meta_path = _paths(cfg)
     if not os.path.exists(meta_path):
         return None
-    with open(meta_path) as f:
-        meta = json.load(f)
+    meta = _load_meta(meta_path)
     _check_meta(meta, cfg, data_path)
     path = data_path
     if meta.get("data"):  # sharded-format checkpoint: versioned data file
@@ -185,8 +249,7 @@ def restore_frames_sharded(
     data_path, meta_path = _paths(cfg)
     if not os.path.exists(meta_path):
         return None
-    with open(meta_path) as f:
-        meta = json.load(f)
+    meta = _load_meta(meta_path)
     _check_meta(meta, cfg, meta_path)
     frame_bytes = cfg.height * cfg.width * cfg.channels
     if meta.get("data"):
@@ -223,8 +286,7 @@ def restore_sharded(cfg: JobConfig, sharding) -> Optional[Tuple[int, "object"]]:
     data_path, meta_path = _paths(cfg)
     if not os.path.exists(meta_path):
         return None
-    with open(meta_path) as f:
-        meta = json.load(f)
+    meta = _load_meta(meta_path)
     _check_meta(meta, cfg, meta_path)
     if meta.get("data"):
         versioned = os.path.join(
@@ -332,10 +394,7 @@ def save_stream_progress(cfg, frames_done: int,
         meta["mesh_devices"] = int(mesh_devices)
         if cursors is not None:
             meta["device_cursors"] = [int(c) for c in cursors]
-    tmp = path + ".tmp"
-    with open(tmp, "w") as f:
-        json.dump(meta, f)
-    os.replace(tmp, path)
+    _write_meta(path, meta)
 
 
 def restore_stream_progress(cfg, mesh_devices: int = 1) -> Optional[int]:
@@ -344,12 +403,14 @@ def restore_stream_progress(cfg, mesh_devices: int = 1) -> Optional[int]:
     silently mix outputs); a device-count mismatch against a mesh-fan
     checkpoint raises typed (:class:`MeshCursorMismatch` — the recorded
     per-device cursors are aligned to the writing run's round-robin, so
-    a different count must never silently adopt them)."""
+    a different count must never silently adopt them); a sidecar that
+    fails its embedded CRC (or no longer parses) raises typed
+    (:class:`CorruptCheckpoint` naming the file) — a flipped bit in
+    ``frames_done`` would otherwise silently skip or rewrite frames."""
     path = _stream_paths(cfg)
     if not os.path.exists(path):
         return None
-    with open(path) as f:
-        meta = json.load(f)
+    meta = _load_meta(path)
     want = _stream_fingerprint(cfg)
     if {k: meta.get(k) for k in want} != want:
         raise ValueError(

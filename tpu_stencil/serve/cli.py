@@ -91,6 +91,24 @@ def build_parser() -> argparse.ArgumentParser:
                         "past it fails typed (DeadlineExceeded) instead "
                         "of occupying a batch slot (0 = none; "
                         "docs/RESILIENCE.md)")
+    p.add_argument("--verify", default=None, choices=["crc", "golden"],
+                   help="check every completed response "
+                        "(docs/RESILIENCE.md 'Integrity model'): crc "
+                        "validates each body against the tier's "
+                        "X-Result-Crc32c stamp (needs --http — only the "
+                        "network tiers stamp) and stamps requests with "
+                        "X-Content-Crc32c; golden compares small frames "
+                        "against the independent NumPy golden (works "
+                        "in-process too). Failures count "
+                        "verify_failures_total in the report; the "
+                        "closed loop fails fast on the first one")
+    p.add_argument("--witness-rate", dest="witness_rate", type=float,
+                   default=0.0, metavar="RATE",
+                   help="fraction of completed requests the in-process "
+                        "engine re-executes through a different "
+                        "measured-equivalent program (seeded; counted in "
+                        "integrity_witness_*; 0 = off, the in-process "
+                        "default — the net tier arms 1/256 fleet-wide)")
     p.add_argument("--faults", default=None, metavar="SPEC",
                    help="arm the fault-injection harness (chaos testing "
                         "/ failure reproduction); same grammar as "
@@ -267,6 +285,10 @@ def main(argv=None) -> int:
             raise ValueError
     except ValueError:
         parser.error(f"--channels must be 1 and/or 3, got {ns.channels!r}")
+    if ns.verify == "crc" and not ns.http:
+        parser.error("--verify crc needs --http: only the network "
+                     "tiers stamp X-Result-Crc32c (use --verify golden "
+                     "for an in-process server)")
     if not ns.http:
         try:
             cfg = ServeConfig(
@@ -275,6 +297,7 @@ def main(argv=None) -> int:
                 overlap=ns.overlap,
                 shard_min_pixels=ns.shard_min_pixels,
                 request_timeout_s=ns.request_timeout_s,
+                witness_rate=ns.witness_rate,
             )
         except ValueError as e:
             parser.error(str(e))
@@ -286,12 +309,13 @@ def main(argv=None) -> int:
             concurrency=ns.concurrency, rate=ns.rate, reps=ns.reps,
             shapes=shapes, channels=channels, seed=ns.seed,
             rate_fps=ns.rate_fps,
+            verify=ns.verify, verify_filter=ns.filter_name,
         )
         if ns.http:
             # The network-tier target: same loops, same report schema,
             # remote fleet. No in-process server (and no jax import)
             # on this path — the tier owns the engines.
-            target = loadgen.HttpTarget(ns.http)
+            target = loadgen.HttpTarget(ns.http, verify=ns.verify)
             try:
                 report = loadgen.run(target, **loadgen_kwargs)
             finally:
@@ -337,6 +361,12 @@ def main(argv=None) -> int:
             f"rejected={report['rejected']} batches={c['batches_total']} "
             f"cache={c['cache_hits_total']}h/{c['cache_misses_total']}m "
             f"padded_waste={c['padded_pixels_total']}px"
+        )
+    if "verify_failures_total" in report:
+        print(
+            f"verify ({report['verify']}): "
+            f"{report['verify_failures_total']} failure(s) over "
+            f"{report['completed']} completed"
         )
     if "requested_fps" in report:
         print(

@@ -45,6 +45,8 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from tpu_stencil.config import ServeConfig
+from tpu_stencil.integrity import checksum as _checksum
+from tpu_stencil.integrity import witness as _witness_mod
 from tpu_stencil.obs import introspect as _introspect
 from tpu_stencil.obs import span as _obs_span
 from tpu_stencil.resilience import faults as _faults
@@ -324,6 +326,21 @@ class StencilServer:
         self._fault_d2h = _faults.site("d2h")
         self._fault_compute = _faults.site("compute")
         self._fault_compile = _faults.site("compile")
+        # Corruption site (integrity.checksum.fired converts the firing
+        # into a bit flip in ONE request's result): the chaos stand-in
+        # for a device/runtime returning wrong bytes with a 200.
+        self._fault_corrupt_result = _faults.site("integrity.corrupt_result")
+        # Witness re-execution (tpu_stencil.integrity): sampled
+        # completed requests re-run through a different measured-
+        # equivalent program AFTER their futures resolve (verification
+        # must not stretch the tail) and verdicts go to on_witness —
+        # the net tier points it at the router's quarantine board.
+        self._witness = (
+            _witness_mod.WitnessSampler(self.cfg.witness_rate,
+                                        seed=self.cfg.witness_seed)
+            if self.cfg.witness_rate > 0 else None
+        )
+        self.on_witness = None  # callable(ok: bool), set by the fleet
         # Compile-site introspection bookkeeping: cache keys whose
         # executable has been AOT-introspected (one capture per entry,
         # only while introspection is armed — see _dispatch_inner).
@@ -348,6 +365,8 @@ class StencilServer:
         self._m_inflight = m.gauge("inflight_batches")
         self._m_deadline = m.counter("deadline_expired_total")
         self._m_crashes = m.counter("resilience_worker_crashes_total")
+        self._m_witness_total = m.counter("integrity_witness_total")
+        self._m_witness_bad = m.counter("integrity_witness_mismatch_total")
         # Sharded routing (overlap != "off"): oversized requests run the
         # shard_map path; the runner cache is the sharded analog of the
         # bucket-executable cache.
@@ -896,11 +915,19 @@ class StencilServer:
         t1 = time.perf_counter()
         self._m_batches.inc()
         self._m_blat.observe(t1 - t0)
+        witness_queue = []
         for r, out in zip(batch, results):
-            if not r.future.done() and _resolve(
-                    r.future, np.ascontiguousarray(out)):
+            res = np.ascontiguousarray(out)
+            if self._fault_corrupt_result is not None and _checksum.fired(
+                    self._fault_corrupt_result, r.req_id):
+                res = _checksum.corrupt_array(res)
+            if not r.future.done() and _resolve(r.future, res):
                 self._m_completed.inc()
                 self._m_rlat.observe(t1 - r.t_submit)
+            if self._witness is not None and self._witness.pick():
+                witness_queue.append((r, res))
+        for r, res in witness_queue:
+            self._witness_one(r, res)
 
     def _retire_inner(self, batch, out_dev, meta, t0) -> None:
         bh, bw, channels, nb, backend = meta
@@ -924,15 +951,59 @@ class StencilServer:
                 batch[0].filter_name, bh, fuse=1,
             )
             self._m_gbps.observe(gbps)
+        witness_queue = []
         for i, r in enumerate(batch):
             h, w = r.image.shape[:2]
+            res = out[i, :h, :w].copy()
+            # Corrupt INSIDE the request's true pixels (the canvas
+            # midpoint could land in the bucket pad, which the crop
+            # would silently heal — defeating the chaos test).
+            if self._fault_corrupt_result is not None and _checksum.fired(
+                    self._fault_corrupt_result, r.req_id):
+                res = _checksum.corrupt_array(res)
             # A client may have cancelled its (still-pending) future; the
             # result is simply dropped — one cancellation must never
             # poison its batch-mates' results.
-            if not r.future.done() and _resolve(
-                    r.future, out[i, :h, :w].copy()):
+            if not r.future.done() and _resolve(r.future, res):
                 self._m_completed.inc()
                 self._m_rlat.observe(t1 - r.t_submit)
+            if self._witness is not None and self._witness.pick():
+                witness_queue.append((r, res))
+        # Witness AFTER every future resolved: verification must never
+        # stretch the batch-mates' latency tail.
+        for r, res in witness_queue:
+            self._witness_one(r, res)
+
+    def _witness_one(self, r: Request, got: np.ndarray) -> None:
+        """Re-execute one sampled request through the eager measured-
+        equivalent program (:func:`integrity.witness.device_witness` —
+        none of this engine's compiled artifacts) and compare bit-exact.
+        The verdict is counted and handed to ``on_witness``; it never
+        touches the request's (already resolved) future — witnessing is
+        about the REPLICA, not the response. A witness that itself
+        errors is no verdict at all: it must neither kill the worker
+        nor count as evidence against the replica."""
+        if r.reps > _witness_mod.WITNESS_MAX_REPS:
+            return  # see WITNESS_MAX_REPS: verification must stay cheap
+        try:
+            with _obs_span("integrity.witness", "integrity",
+                           req_id=r.req_id, reps=r.reps):
+                want = _witness_mod.device_witness(
+                    r.image, r.filter_name, r.reps, self.cfg.boundary
+                )
+                ok = bool(np.array_equal(want, np.asarray(got)))
+        except Exception:
+            self.registry.counter("integrity_witness_errors_total").inc()
+            return
+        self._m_witness_total.inc()
+        if not ok:
+            self._m_witness_bad.inc()
+        cb = self.on_witness
+        if cb is not None:
+            try:
+                cb(ok)
+            except Exception:
+                pass  # a broken verdict sink must not crash the worker
 
     def _worker_loop(self) -> None:
         try:

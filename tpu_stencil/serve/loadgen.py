@@ -33,6 +33,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from tpu_stencil.integrity import checksum as _checksum
 from tpu_stencil.serve.engine import (
     QueueFull,
     ServerClosed,
@@ -40,6 +41,33 @@ from tpu_stencil.serve.engine import (
 )
 
 DEFAULT_SHAPES: Tuple[Tuple[int, int], ...] = ((48, 36), (64, 48), (30, 50))
+
+#: --verify golden only checks frames up to this many true pixels: the
+#: independent NumPy golden runs per-pixel Python loops, so it is a
+#: *small-frame* referee (the default loadgen shapes all qualify);
+#: larger frames silently skip golden verification (crc still covers
+#: the wire).
+GOLDEN_MAX_PIXELS = 1 << 12
+
+VERIFY_MODES = (None, "crc", "golden")
+
+
+def _verify_failure_counter():
+    from tpu_stencil import obs
+
+    return obs.registry().counter("integrity_verify_failures_total")
+
+
+def _golden_for(image: np.ndarray, reps: int,
+                filter_name: str) -> Optional[np.ndarray]:
+    if image.shape[0] * image.shape[1] > GOLDEN_MAX_PIXELS:
+        return None
+    from tpu_stencil import filters
+    from tpu_stencil.ops import stencil
+
+    return stencil.reference_stencil_numpy(
+        image, filters.get_filter(filter_name), reps
+    )
 
 
 class HttpTarget:
@@ -65,9 +93,22 @@ class HttpTarget:
     scrape, not client-side guesses."""
 
     def __init__(self, url: str, max_workers: int = 32,
-                 timeout_s: float = 300.0) -> None:
+                 timeout_s: float = 300.0,
+                 verify: Optional[str] = None) -> None:
+        if verify not in VERIFY_MODES:
+            raise ValueError(
+                f"verify must be one of {VERIFY_MODES}, got {verify!r}"
+            )
         self.url = url.rstrip("/")
         self._timeout = timeout_s
+        # --verify (docs/RESILIENCE.md "Integrity model"): any non-None
+        # mode stamps each request with X-Content-Crc32c (exercising
+        # the tier's ingest validation); "crc" additionally checks each
+        # 200 body against its X-Result-Crc32c stamp — a mismatch (or a
+        # missing stamp) counts integrity_verify_failures_total and
+        # raises typed. "golden" is checked in run() (it needs the
+        # request's pixels, which outlive this target).
+        self.verify = verify
         self._pool = concurrent.futures.ThreadPoolExecutor(
             max_workers=max_workers,
             thread_name_prefix="tpu-stencil-httpgen",
@@ -83,22 +124,36 @@ class HttpTarget:
 
         h, w = image.shape[:2]
         channels = image.shape[2] if image.ndim == 3 else 1
+        payload = image.tobytes()
         headers = {
             "X-Width": str(w), "X-Height": str(h),
             "X-Reps": str(reps), "X-Channels": str(channels),
             "Content-Type": "application/octet-stream",
         }
+        if self.verify is not None:
+            headers[_checksum.CRC_HEADER] = str(_checksum.crc32c(payload))
         if filter_name:
             headers["X-Filter"] = filter_name
         if deadline_s:
             headers["X-Request-Timeout"] = repr(float(deadline_s))
         req = urllib.request.Request(
-            self.url + "/v1/blur", data=image.tobytes(),
+            self.url + "/v1/blur", data=payload,
             headers=headers, method="POST",
         )
         try:
             with urllib.request.urlopen(req, timeout=self._timeout) as r:
                 body = r.read()
+                if self.verify == "crc":
+                    stamp = r.headers.get(_checksum.RESULT_HEADER)
+                    # stamp_matches treats a missing OR malformed stamp
+                    # as a failure (wire corruption hits header bytes
+                    # as easily as the body) — counted, then typed.
+                    if not _checksum.stamp_matches(stamp, body):
+                        _verify_failure_counter().inc()
+                        raise _checksum.ChecksumMismatch(
+                            f"loadgen --verify crc (stamp {stamp!r})",
+                            -1, _checksum.crc32c(body),
+                        )
         except urllib.error.HTTPError as e:
             detail = e.read().decode(errors="replace").strip()
             if e.code == 503 and "draining" in detail:
@@ -207,6 +262,8 @@ def run(
     seed: int = 0,
     timeout: float = 300.0,
     rate_fps: Optional[float] = None,
+    verify: Optional[str] = None,
+    verify_filter: str = "gaussian",
 ) -> Dict:
     """Drive ``server`` with synthetic load; return the report dict.
 
@@ -218,6 +275,16 @@ def run(
     Report keys: ``mode``, ``requests``, ``completed``, ``rejected``,
     ``wall_seconds``, ``throughput_rps``, ``p50_s``, ``p99_s`` (request
     latency from the registry), plus the full ``stats`` snapshot.
+
+    ``verify`` (``--verify {crc,golden}``, docs/RESILIENCE.md
+    "Integrity model"): every request is stamped with its
+    ``X-Content-Crc32c`` (HTTP targets), and each completed response is
+    checked — ``crc`` against the tier's ``X-Result-Crc32c`` stamp
+    (inside :class:`HttpTarget`), ``golden`` against the independent
+    NumPy golden for frames up to :data:`GOLDEN_MAX_PIXELS`. Failures
+    count ``verify_failures_total`` in the report; closed loops fail
+    fast on the first one (zero tolerance), open loops count and keep
+    offering.
 
     ``rate_fps``: the open-loop fixed-frame-rate mode (``--rate-fps``)
     — one frame is *due* every ``1/rate_fps`` seconds regardless of
@@ -235,6 +302,10 @@ def run(
         mode, rate = "open", float(rate_fps)
     if mode not in ("closed", "open"):
         raise ValueError(f"mode must be closed|open, got {mode!r}")
+    if verify not in VERIFY_MODES:
+        raise ValueError(
+            f"verify must be one of {VERIFY_MODES}, got {verify!r}"
+        )
     from tpu_stencil import obs
 
     # Client-side counter delta: how many re-offers this run slept to
@@ -246,6 +317,26 @@ def run(
     images = synth_requests(requests, shapes, channels, seed)
     completed = 0
     completed_lock = threading.Lock()
+    verify0 = _verify_failure_counter().value
+    goldens: Dict[int, Optional[np.ndarray]] = {}
+    goldens_lock = threading.Lock()
+
+    def _check_golden(i: int, got) -> bool:
+        """--verify golden: compare a completed result against the
+        independent NumPy golden (memoized per request index; frames
+        past GOLDEN_MAX_PIXELS skip). Returns False + counts on a
+        mismatch."""
+        if verify != "golden":
+            return True
+        with goldens_lock:
+            if i not in goldens:
+                goldens[i] = _golden_for(images[i], reps, verify_filter)
+            want = goldens[i]
+        if want is None or np.array_equal(np.asarray(got), want):
+            return True
+        _verify_failure_counter().inc()
+        return False
+
     t_start = time.perf_counter()
 
     if mode == "closed":
@@ -275,7 +366,13 @@ def run(
                             0.001, t_start + timeout - time.perf_counter()
                         ),
                     )
-                    fut.result(timeout=timeout)
+                    got = fut.result(timeout=timeout)
+                    if not _check_golden(i, got):
+                        # Zero tolerance in the closed loop: one wrong
+                        # result fails the run typed.
+                        raise _checksum.WitnessMismatch(
+                            f"loadgen --verify golden (request {i})"
+                        )
                 except BaseException as e:  # propagate via run(), never die silently
                     with completed_lock:
                         errors.append(e)
@@ -306,15 +403,25 @@ def run(
                 time.sleep(delay)
             offered += 1
             try:
-                futures.append(server.submit(images[i], reps))
+                # The request index rides with the future: a shed
+                # submission must not shift later results onto the
+                # wrong golden.
+                futures.append((i, server.submit(images[i], reps)))
             except QueueFull:
                 pass  # counted by the server; open loops shed, not wait
         offer_wall = time.perf_counter() - t_start
         deadline = time.perf_counter() + timeout
         shed_in_flight = 0
-        for f in futures:
+        for i, f in futures:
             try:
-                f.result(timeout=max(0.0, deadline - time.perf_counter()))
+                got = f.result(
+                    timeout=max(0.0, deadline - time.perf_counter())
+                )
+                _check_golden(i, got)  # open loop: count, keep draining
+            except _checksum.ChecksumMismatch:
+                # HttpTarget's --verify crc failure, already counted:
+                # the open loop measures corruption, it does not abort.
+                pass
             except (QueueFull, ServerClosed):
                 # The HTTP target's backpressure arrives WITH the
                 # response (a 429/503 resolved into the future), not
@@ -347,6 +454,11 @@ def run(
         ).value - honored0,
         "stats": stats,
     }
+    if verify is not None:
+        report["verify"] = verify
+        report["verify_failures_total"] = (
+            _verify_failure_counter().value - verify0
+        )
     if rate_fps is not None:
         # Achieved-vs-requested: offered over the submission window
         # (could the source keep its schedule?) and achieved over the

@@ -151,6 +151,25 @@ def build_parser() -> argparse.ArgumentParser:
              "directory input; default 1, 0 = off)",
     )
     p.add_argument(
+        "--no-verify-ingest", dest="verify_ingest",
+        action="store_false",
+        help="disable ingest integrity (on by default: each frame is "
+             "CRC32C'd as the reader stages it and re-verified at the "
+             "H2D boundary, so a torn staging buffer fails typed "
+             "before a device launch — docs/RESILIENCE.md 'Integrity "
+             "model')",
+    )
+    p.add_argument(
+        "--witness-rate", dest="witness_rate", type=float,
+        default=1.0 / 256.0, metavar="RATE",
+        help="fraction of frames re-executed through a different "
+             "measured-equivalent program in the writer and compared "
+             "bit-exact BEFORE the frame reaches the sink (seeded, "
+             "deterministic; a divergence fails the run typed with the "
+             "frame withheld; default 1/256, 0 = off; never applied "
+             "past 512 reps)",
+    )
+    p.add_argument(
         "--faults", default=None, metavar="SPEC",
         help="arm the fault-injection harness (chaos testing / failure "
              "reproduction); same grammar as TPU_STENCIL_FAULTS, which "
@@ -213,6 +232,8 @@ def main(argv=None) -> int:
             dispatch_timeout_s=ns.dispatch_timeout_s,
             io_retries=ns.io_retries,
             max_engine_restarts=ns.max_engine_restarts,
+            verify_ingest=ns.verify_ingest,
+            witness_rate=ns.witness_rate,
         )
         out_spec = cfg.output_path  # stdin + no --output dies here, pre-jax
     except ValueError as e:

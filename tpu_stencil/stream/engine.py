@@ -64,6 +64,8 @@ import numpy as np
 
 from tpu_stencil import obs
 from tpu_stencil.config import StreamConfig
+from tpu_stencil.integrity import checksum as _checksum
+from tpu_stencil.integrity import witness as _witness_mod
 from tpu_stencil.resilience import deadline as _deadline
 from tpu_stencil.resilience import faults as _faults
 from tpu_stencil.resilience import retry as _retry
@@ -198,6 +200,18 @@ class _Pipeline(_StageControl):
         self._inflight = 0
         self._inflight_lock = threading.Lock()
         self._gauge = obs.registry().gauge("stream_inflight_depth")
+        # Witness sampling (tpu_stencil.integrity): decided in the
+        # READER (the only stage holding the pristine input — the ring
+        # slot is recycled after H2D), executed in the writer. Disabled
+        # past WITNESS_MAX_REPS: the eager witness executor is linear
+        # in reps (docs/RESILIENCE.md "Integrity model").
+        self.witness = (
+            _witness_mod.WitnessSampler(cfg.witness_rate,
+                                        seed=cfg.witness_seed)
+            if (cfg.witness_rate > 0
+                and cfg.repetitions <= _witness_mod.WITNESS_MAX_REPS)
+            else None
+        )
 
     def acquire_window(self) -> None:
         while not self.window.acquire(timeout=0.05):
@@ -311,13 +325,60 @@ def _make_write_frame(cfg: StreamConfig, sink):
     return write_frame
 
 
+def _verify_staged(buf: np.ndarray, crc, idx: int) -> None:
+    """The H2D-boundary re-verification: the staging slot must still
+    hold the bytes the reader checksummed (``crc`` is None when
+    ``verify_ingest`` is off). A mismatch is a torn host buffer —
+    counted, and raised typed (:class:`ChecksumMismatch`, permanent:
+    the frame's true bytes are gone, a restart cannot recover them)."""
+    if crc is None:
+        return
+    try:
+        _checksum.verify(buf, crc, f"stream staging ring (frame {idx})")
+    except _checksum.ChecksumMismatch:
+        obs.registry().counter("integrity_ingest_failures_total").inc()
+        raise
+    obs.registry().counter("integrity_ingest_verified_total").inc()
+
+
+def _witness_frame(cfg: StreamConfig, idx: int, wit_buf: np.ndarray,
+                   arr: np.ndarray) -> None:
+    """Re-execute one sampled frame through the eager measured-
+    equivalent program and compare against the pipeline's result; a
+    divergence raises typed (:class:`WitnessMismatch` → a ``write``-
+    stage StreamFailure) BEFORE the frame reaches the sink."""
+    with obs.span("integrity.witness", "stream", frame=idx):
+        want = _witness_mod.device_witness(
+            wit_buf.reshape(cfg.frame_shape), cfg.filter_name,
+            cfg.repetitions, cfg.boundary,
+        )
+    obs.registry().counter("integrity_witness_total").inc()
+    if not np.array_equal(want, np.asarray(arr)):
+        obs.registry().counter("integrity_witness_mismatch_total").inc()
+        raise _checksum.WitnessMismatch(
+            f"stream frame {idx}",
+            "frame withheld from the sink (two measured-equivalent "
+            "programs disagree — hardware/runtime fault)",
+        )
+
+
 def _reader(pl: _Pipeline, source, start_frame: int) -> None:
     """Prefetch frames into the staging ring, honoring the dispatch
     window (a frame occupies a window slot from read start). Retry
-    semantics: :func:`_make_read_frame`."""
+    semantics: :func:`_make_read_frame`.
+
+    Integrity at ingest: each filled buffer is CRC32C'd HERE (the
+    moment the bytes arrive from the source), re-verified at the H2D
+    boundary in the dispatcher — anything that tears the staging slot
+    in between (the ``integrity.corrupt_ingest`` chaos site fires
+    right after the CRC, simulating exactly that) fails typed before a
+    device launch is burned. Witness sampling is also decided here:
+    the ring slot is recycled after H2D, so a sampled frame's pristine
+    input must be copied aside now."""
     cfg = pl.cfg
     idx = start_frame
     read_frame = _make_read_frame(cfg, source)
+    fault_corrupt = _faults.site("integrity.corrupt_ingest")
     try:
         while cfg.frames is None or idx < cfg.frames:
             pl.acquire_window()
@@ -333,7 +394,16 @@ def _reader(pl: _Pipeline, source, start_frame: int) -> None:
                 pl.free_q.put(buf_i)
                 pl.release_window()
                 break
-            pl.put(pl.filled_q, (idx, buf_i))
+            crc = (_checksum.crc32c(pl.ring[buf_i])
+                   if cfg.verify_ingest else None)
+            if fault_corrupt is not None and _checksum.fired(
+                    fault_corrupt, idx):
+                # In place: THE staging slot tears, like real memory.
+                _checksum.corrupt_array(pl.ring[buf_i])
+            wit = None
+            if pl.witness is not None and pl.witness.pick():
+                wit = pl.ring[buf_i].copy()
+            pl.put(pl.filled_q, (idx, buf_i, crc, wit))
             idx += 1
         pl.put(pl.filled_q, _EOF)
     except _Abort:
@@ -352,6 +422,7 @@ def _drain(pl: _Pipeline, eng: dict) -> None:
     instead of parking the drain thread forever."""
     idx, stage = -1, "compute"
     fault_d2h = _faults.site("d2h")  # resolved once, NOT per frame
+    fault_corrupt = _faults.site("integrity.corrupt_result")
     timeout_s = _deadline.resolve(pl.cfg.dispatch_timeout_s)
     try:
         while True:
@@ -359,7 +430,7 @@ def _drain(pl: _Pipeline, eng: dict) -> None:
             if item is _EOF:
                 pl.put(pl.write_q, _EOF)
                 return
-            idx, out_dev, t_disp = item
+            idx, out_dev, t_disp, wit = item
             stage = "compute"
             with pl.stage("compute", idx, t0=t_disp):
                 _deadline.fence(out_dev, timeout_s,
@@ -369,8 +440,11 @@ def _drain(pl: _Pipeline, eng: dict) -> None:
                 if fault_d2h is not None:
                     fault_d2h(idx)
                 arr = eng["fetch"](out_dev)
+            if fault_corrupt is not None and _checksum.fired(
+                    fault_corrupt, idx):
+                arr = _checksum.corrupt_array(np.asarray(arr))
             pl.release_window()
-            pl.put(pl.write_q, (idx, arr))
+            pl.put(pl.write_q, (idx, arr, wit))
     except _Abort:
         pass
     except BaseException as e:
@@ -389,7 +463,12 @@ def _writer(pl: _Pipeline, sink, done: list) -> None:
             item = pl.get(pl.write_q)
             if item is _EOF:
                 return
-            idx, arr = item
+            idx, arr, wit = item
+            if wit is not None:
+                # Witness BEFORE the write: a frame that fails its
+                # re-execution is withheld from the sink (the run fails
+                # typed at this frame), never published.
+                _witness_frame(cfg, idx, wit, arr)
             with pl.stage("write", idx):
                 write_frame(idx, arr)
             done[0] = idx + 1
@@ -455,12 +534,16 @@ def _dispatch(pl: _Pipeline, model, devices, eng: dict) -> None:
         if first is _EOF:
             pl.put(pl.inflight_q, _EOF)
             return
-        idx, b0 = first
+        idx, b0, crc0, wit0 = first
         # First frame bootstraps the engine: prepare_engine places it
         # and runs the 0-rep warm-up compile whose output equals its
         # input — the warm device array IS frame 0's input, no second
         # transfer (the run_job discipline). prepare_engine checks the
-        # h2d/compile injection sites itself.
+        # h2d/compile injection sites itself. The staged CRC is
+        # re-verified first: a torn slot must fail typed before the
+        # warm-up compile is paid for corrupt pixels.
+        stage = "h2d"
+        _verify_staged(pl.ring[b0], crc0, idx)
         frame0 = pl.ring[b0].reshape(cfg.frame_shape)
         img_dev, _step_fn, fetch = driver.prepare_engine(
             model, frame0, devices
@@ -478,15 +561,19 @@ def _dispatch(pl: _Pipeline, model, devices, eng: dict) -> None:
             fault_compute(idx)
         t_disp = time.perf_counter()
         out0 = launch(img_dev)
-        pl.put(pl.inflight_q, (idx, out0, t_disp))
+        pl.put(pl.inflight_q, (idx, out0, t_disp, wit0))
         while True:
             item = pl.get(pl.filled_q)
             if item is _EOF:
                 break
-            idx, bi = item
+            idx, bi, crc, wit = item
             stage = "h2d"
             if fault_h2d is not None:
                 fault_h2d(idx)
+            # The H2D-boundary re-verification: the staged bytes must
+            # still match their ingest CRC, or the device launch is
+            # refused typed (ChecksumMismatch — permanent, no restart).
+            _verify_staged(pl.ring[bi], crc, idx)
             with pl.stage("h2d", idx) as s:
                 # Fenced: device_put returns before the PCIe copy
                 # lands, and an unfenced span would misattribute the
@@ -504,7 +591,7 @@ def _dispatch(pl: _Pipeline, model, devices, eng: dict) -> None:
                 fault_compute(idx)
             t_disp = time.perf_counter()
             out = launch(dev)  # async dispatch; donates dev
-            pl.put(pl.inflight_q, (idx, out, t_disp))
+            pl.put(pl.inflight_q, (idx, out, t_disp, wit))
         pl.put(pl.inflight_q, _EOF)
     except _Abort:
         pass

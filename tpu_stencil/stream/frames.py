@@ -252,10 +252,21 @@ class RawStreamSink(FrameSink):
         self._f.write(memoryview(arr).cast("B"))
 
     def flush(self) -> None:
+        """Durability point (a progress checkpoint is about to commit):
+        flush AND fsync owned regular files — a checkpoint recording
+        "frames [0, n) are durable" must not be ordered ahead of the
+        frames themselves in the page cache. Pipes/stdout only flush
+        (fsync is meaningless there, and their sinks are not resumable
+        anyway)."""
         self._f.flush()
+        if self._owns:
+            try:
+                os.fsync(self._f.fileno())
+            except OSError:
+                pass  # non-regular sink (FIFO): flush is all there is
 
     def close(self) -> None:
-        self._f.flush()
+        self.flush()
         if self._owns:
             self._f.close()
 
@@ -280,6 +291,12 @@ class RawDirectorySink(FrameSink):
         tmp = name + ".tmp"
         with open(tmp, "wb") as f:
             f.write(memoryview(arr).cast("B"))
+            # fsync BEFORE the rename: without it a power cut can
+            # publish the name over still-dirty data — a torn frame
+            # under a complete-looking name, the exact hole the atomic
+            # publish exists to close.
+            f.flush()
+            os.fsync(f.fileno())
         os.replace(tmp, name)
 
 
