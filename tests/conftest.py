@@ -7,8 +7,21 @@ Must run before jax is imported anywhere in the test process.
 """
 
 import os
+import tempfile
 
 os.environ["JAX_PLATFORMS"] = "cpu"
+
+# Flight-recorder spool redirect: anomaly dumps fired by chaos tests
+# (deadline/breaker/witness triggers) must land in a throwaway dir, not
+# a flightrec/ folder inside the repo working tree. The env override
+# beats every configured spool path (tpu_stencil.obs.flight); tests
+# that assert on spool contents monkeypatch this to their tmp_path.
+# Guarded so an already-exported redirect never mints (and leaks) an
+# unused temp directory.
+if "TPU_STENCIL_FLIGHTREC_DIR" not in os.environ:
+    os.environ["TPU_STENCIL_FLIGHTREC_DIR"] = tempfile.mkdtemp(
+        prefix="tpu-stencil-flightrec-"
+    )
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (

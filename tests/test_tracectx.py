@@ -1,0 +1,818 @@
+"""Request-scoped tracing + the always-on flight recorder (ISSUE 13).
+
+Acceptance contract:
+
+* one request driven through fed → net → serve under fault injection
+  (a witness mismatch manufactured by ``integrity.corrupt_result``)
+  yields the SAME trace id on the wire at every hop and in the
+  response, a ``/debug/trace/<id>`` tree containing spans from >= 2
+  processes (the fed process + a subprocess member), and an automatic
+  flight-recorder dump whose JSON names the trigger and contains that
+  request's spans;
+* error responses from fed and net carry the trace id in the typed
+  JSON body as well as the header, for every admission rejection
+  class;
+* flight-recorder steady-state overhead is bounded (ring append on
+  the serve hot path, the analog of the disabled-tracer bound) and
+  recording never changes results;
+* two member netlocs that sanitize to the same host_id never silently
+  merge their ``fleet_<host_id>_`` counters;
+* ``tools/check_span_vocab.py`` passes against the tree (wired into
+  tier-1 here).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from io import StringIO
+
+import numpy as np
+import pytest
+
+from tpu_stencil import filters, obs
+from tpu_stencil.config import FedConfig, NetConfig, ServeConfig
+from tpu_stencil.obs import context as octx
+from tpu_stencil.obs import events as oevents
+from tpu_stencil.obs import flight as oflight
+from tpu_stencil.obs import tracing as otracing
+from tpu_stencil.ops import stencil
+
+pytestmark = pytest.mark.filterwarnings("ignore::ResourceWarning")
+
+EDGES = (8, 16, 32, 64)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_obs():
+    """Tracer/recorder/event-stream state must never leak between
+    tests (frontends install the process-global recorder)."""
+    obs.reset()
+    yield
+    obs.reset()
+    from tpu_stencil.resilience import faults
+
+    faults.clear()
+
+
+def _golden(img, reps, name="gaussian"):
+    return stencil.reference_stencil_numpy(
+        img, filters.get_filter(name), reps
+    )
+
+
+def _post(url, img, reps, *, headers=None, http_timeout=300.0):
+    h, w = img.shape[:2]
+    channels = img.shape[2] if img.ndim == 3 else 1
+    hdrs = {"X-Width": str(w), "X-Height": str(h),
+            "X-Reps": str(reps), "X-Channels": str(channels)}
+    hdrs.update(headers or {})
+    req = urllib.request.Request(url + "/v1/blur", data=img.tobytes(),
+                                 headers=hdrs, method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=http_timeout) as r:
+            return r.status, r.read(), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, e.read(), dict(e.headers)
+
+
+def _get(url, path, http_timeout=60.0):
+    try:
+        with urllib.request.urlopen(url + path, timeout=http_timeout) as r:
+            return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+# -- obs.context unit ---------------------------------------------------
+
+
+def test_context_mint_bind_adopt():
+    ctx = octx.fresh()
+    assert octx.valid_id(ctx.trace_id) and len(ctx.trace_id) == 32
+    assert octx.valid_id(ctx.span_id) and len(ctx.span_id) == 16
+    assert octx.current() is None
+    with octx.bind(ctx):
+        assert octx.current() is ctx
+        inner = octx.fresh()
+        with octx.bind(inner):
+            assert octx.current() is inner
+        assert octx.current() is ctx
+    assert octx.current() is None
+    # Adoption: a valid inbound pair keeps the trace id, mints a new
+    # span id, and records the inbound span id as the parent.
+    adopted = octx.from_headers({"X-Trace-Id": ctx.trace_id,
+                                 "X-Span-Id": ctx.span_id})
+    assert adopted.trace_id == ctx.trace_id
+    assert adopted.span_id != ctx.span_id
+    assert adopted.parent_span_id == ctx.span_id
+    # A hostile/malformed inbound id is DISCARDED, never echoed.
+    for bad in ("x" * 65, "abc def", "a/b", "", None, "\x00"):
+        minted = octx.from_headers({"X-Trace-Id": bad})
+        assert minted.trace_id != bad and octx.valid_id(minted.trace_id)
+
+
+def test_spans_carry_bound_context_into_both_sinks():
+    rec = oflight.install()
+    obs.enable()
+    ctx = octx.fresh()
+    with octx.bind(ctx):
+        with obs.span("net.request", "net"):
+            pass
+    with obs.span("net.request", "net"):  # outside any request scope
+        pass
+    ring = rec.spans_for(ctx.trace_id)
+    assert len(ring) == 1
+    assert ring[0].trace_id == ctx.trace_id
+    assert ring[0].span_id == ctx.span_id
+    traced = [r for r in obs.get_tracer().spans()
+              if r.trace_id == ctx.trace_id]
+    assert len(traced) == 1  # one SpanRecord reaches both sinks
+    assert traced[0] is ring[0]
+
+
+def test_batch_scope_trace_ids_arg_matches():
+    rec = oflight.install()
+    otracing.emit_span("serve.execute", "serve", 0.0, 1.0,
+                       trace_ids=("tid-a", "tid-b"))
+    assert rec.spans_for("tid-a") and rec.spans_for("tid-b")
+    assert not rec.spans_for("tid-c")
+
+
+# -- flight recorder unit -----------------------------------------------
+
+
+def test_flight_ring_is_fixed_size():
+    rec = oflight.FlightRecorder(capacity=16)
+    for i in range(50):
+        otracing_rec = otracing.SpanRecord(
+            name=f"s{i}", cat="t", t0=float(i), t1=float(i) + 1,
+            tid=0, tname="t", depth=0, args={},
+        )
+        rec.record(otracing_rec)
+    snap = rec.snapshot()
+    assert len(snap) == 16
+    assert [r.name for r in snap] == [f"s{i}" for i in range(34, 50)]
+
+
+def test_flight_dump_and_spool_cap(tmp_path, monkeypatch):
+    monkeypatch.setenv(oflight.ENV_SPOOL, str(tmp_path))
+    rec = oflight.install()
+    ctx = octx.fresh()
+    with octx.bind(ctx):
+        with obs.span("net.request", "net"):
+            pass
+    path = rec.dump("slow_request", trace_id=ctx.trace_id, tier="net",
+                    threshold_s=0.5)
+    assert path and os.path.exists(path)
+    doc = json.loads(open(path).read())
+    assert doc["trigger"] == "slow_request"
+    assert doc["trace_id"] == ctx.trace_id
+    assert doc["span_count"] == 1
+    assert doc["spans"][0]["name"] == "net.request"
+    # The spool is capped: oldest dumps pruned past SPOOL_CAP.
+    for _ in range(oflight.SPOOL_CAP + 10):
+        rec.dump("slow_request", trace_id=ctx.trace_id)
+    files = [n for n in os.listdir(tmp_path) if n.endswith(".json")]
+    assert len(files) == oflight.SPOOL_CAP
+    # Listing + fetch helpers (the /debug/flightrec surface).
+    index = oflight.spool_index(None)  # env override carries the dir
+    assert len(index) == oflight.SPOOL_CAP
+    assert index[0]["trigger"] == "slow_request"
+    raw = oflight.spool_read(None, index[0]["file"])
+    assert raw and json.loads(raw)["trigger"] == "slow_request"
+    # Path traversal / unsafe names die typed.
+    assert oflight.spool_read(None, "../evil.json") is None
+    assert oflight.spool_read(None, "no_such.json") is None
+
+
+def test_trace_scoped_dump_falls_back_to_recent_ring(tmp_path,
+                                                     monkeypatch):
+    """A trigger whose trace has no CLOSED spans yet (the edge span
+    that fired it is still open — the fed tier's whole record of a
+    request can be exactly that span) must dump the recent ring, not
+    an empty file."""
+    monkeypatch.setenv(oflight.ENV_SPOOL, str(tmp_path))
+    rec = oflight.install()
+    with obs.span("net.route", "net"):  # unrelated lead-up activity
+        pass
+    path = rec.dump("breaker_open", trace_id="a" * 32, tier="fed")
+    doc = json.loads(open(path).read())
+    assert doc["scope"] == "recent"
+    assert doc["span_count"] >= 1  # the lead-up, never an empty box
+    # With closed spans for the trace, the dump stays trace-scoped.
+    ctx = octx.fresh()
+    with octx.bind(ctx), obs.span("fed.request", "fed"):
+        pass
+    doc2 = json.loads(open(
+        rec.dump("slow_request", trace_id=ctx.trace_id, tier="fed")
+    ).read())
+    assert doc2["scope"] == "trace" and doc2["span_count"] == 1
+
+
+def test_trigger_silenced_under_scratch_registry(tmp_path, monkeypatch):
+    """Measurement probes run real engines under obs.scratch_registry;
+    a probe's anomaly must leak neither a spool dump nor an event line
+    into the real run's black box."""
+    monkeypatch.setenv(oflight.ENV_SPOOL, str(tmp_path))
+    oflight.install()
+    buf = StringIO()
+    oevents.set_stream(buf)
+    with otracing.scratch_registry():
+        assert oflight.trigger("witness_mismatch",
+                               trace_id="frame-3", tier="stream") is None
+    assert not list(tmp_path.iterdir())
+    assert buf.getvalue() == ""
+    # Outside the diversion the same trigger dumps + emits again.
+    assert oflight.trigger("witness_mismatch",
+                           trace_id="frame-3", tier="stream")
+    assert list(tmp_path.iterdir()) and buf.getvalue()
+
+
+def test_trigger_without_recorder_only_emits_event():
+    buf = StringIO()
+    oevents.set_stream(buf)
+    assert oflight.get() is None
+    path = oflight.trigger("breaker_open", trace_id="t1", tier="fed",
+                           host="h1")
+    assert path is None
+    line = json.loads(buf.getvalue().strip())
+    assert line["event"] == "flightrec.breaker_open"
+    assert line["trace_id"] == "t1" and line["host"] == "h1"
+
+
+def test_events_one_json_line_greppable():
+    buf = StringIO()
+    oevents.set_stream(buf)
+    oevents.emit("fed.forward", trace_id="abc123", tier="fed",
+                 verdict="timeout", duration_s=1.25, host="h2",
+                 weird=object())
+    lines = buf.getvalue().splitlines()
+    assert len(lines) == 1
+    doc = json.loads(lines[0])
+    assert doc["verdict"] == "timeout" and doc["duration_s"] == 1.25
+    assert "abc123" in lines[0]  # grep <trace_id> finds the event
+    assert isinstance(doc["weird"], str)  # non-JSON values repr'd
+
+
+def test_export_per_trace_filter(tmp_path):
+    obs.enable()
+    a, b = octx.fresh(), octx.fresh()
+    with octx.bind(a), obs.span("net.request", "net"):
+        pass
+    with octx.bind(b), obs.span("net.request", "net"):
+        pass
+    from tpu_stencil.obs import export
+
+    path = str(tmp_path / "one.json")
+    export.write_chrome_trace(path, obs.get_tracer(), trace_id=a.trace_id)
+    evs = [e for e in json.load(open(path))["traceEvents"]
+           if e.get("ph") == "X"]
+    assert len(evs) == 1
+    assert evs[0]["args"]["trace_id"] == a.trace_id
+    path_all = str(tmp_path / "all.json")
+    export.write_chrome_trace(path_all, obs.get_tracer())
+    assert len([e for e in json.load(open(path_all))["traceEvents"]
+                if e.get("ph") == "X"]) == 2
+
+
+# -- net tier: echo, JSON error bodies, /debug endpoints ----------------
+
+
+def _make_net(**overrides):
+    from tpu_stencil.net import NetFrontend
+
+    kw = dict(port=0, replicas=1, bucket_edges=EDGES, max_queue=64)
+    start_workers = overrides.pop("start_workers", True)
+    kw.update(overrides)
+    return NetFrontend(NetConfig(**kw),
+                       start_workers=start_workers).start()
+
+
+def test_net_trace_echo_and_adoption(rng):
+    fe = _make_net()
+    try:
+        img = rng.integers(0, 256, (12, 10), dtype=np.uint8)
+        # No client id: the edge mints one and echoes it.
+        status, body, headers = _post(fe.url, img, 2)
+        assert status == 200
+        assert octx.valid_id(headers["X-Trace-Id"])
+        assert octx.valid_id(headers["X-Span-Id"])
+        np.testing.assert_array_equal(
+            np.frombuffer(body, np.uint8).reshape(img.shape),
+            _golden(img, 2),
+        )
+        # A valid client id is ADOPTED verbatim; the span id is the
+        # edge's own.
+        ctx = octx.fresh()
+        status, _body, headers = _post(
+            fe.url, img, 2, headers=octx.headers_for(ctx)
+        )
+        assert status == 200
+        assert headers["X-Trace-Id"] == ctx.trace_id
+        assert headers["X-Span-Id"] != ctx.span_id
+        # A malformed client id is replaced, never echoed back.
+        status, _body, headers = _post(
+            fe.url, img, 2, headers={"X-Trace-Id": "bad id !!"}
+        )
+        assert status == 200
+        assert headers["X-Trace-Id"] != "bad id !!"
+        assert octx.valid_id(headers["X-Trace-Id"])
+    finally:
+        fe.close()
+
+
+def _assert_traced_error(status, body, headers, want_status):
+    """The satellite contract for one rejection class: trace id in the
+    header AND in the typed JSON error body."""
+    assert status == want_status, (status, body)
+    assert octx.valid_id(headers.get("X-Trace-Id")), headers
+    doc = json.loads(body)
+    assert doc["status"] == want_status
+    assert doc["trace_id"] == headers["X-Trace-Id"]
+    assert doc["error"]
+    return doc
+
+
+def test_net_error_bodies_carry_trace_id_every_class(rng):
+    # Parked fleet: the worker never starts, so queue space is
+    # deterministic — 429 is forceable without timing games.
+    fe = _make_net(start_workers=False, max_queue=1,
+                   max_inflight_mb=256.0)
+    try:
+        img = rng.integers(0, 256, (12, 10), dtype=np.uint8)
+        # 400 validation.
+        s, b, h = _post(fe.url, img, -1)
+        _assert_traced_error(s, b, h, 400)
+        # 413 oversized body vs declared frame.
+        req = urllib.request.Request(
+            fe.url + "/v1/blur", data=b"\x00" * 4096,
+            headers={"X-Width": "4", "X-Height": "4", "X-Reps": "1",
+                     "X-Channels": "1"},
+            method="POST",
+        )
+        try:
+            urllib.request.urlopen(req, timeout=60)
+            raise AssertionError("oversized body accepted")
+        except urllib.error.HTTPError as e:
+            _assert_traced_error(e.code, e.read(), dict(e.headers), 413)
+        # 429 queue full: one request occupies the single queue slot
+        # (its handler blocks on the parked worker), the next rejects.
+        first_done = threading.Event()
+
+        def occupy():
+            _post(fe.url, img, 1, http_timeout=120)
+            first_done.set()
+
+        t = threading.Thread(target=occupy, daemon=True)
+        t.start()
+        deadline = time.perf_counter() + 30
+        while time.perf_counter() < deadline:
+            if fe.fleet.replicas[0].stats()["gauges"][
+                    "queue_depth"]["value"] >= 1:
+                break
+            time.sleep(0.01)
+        s, b, h = _post(fe.url, img, 1)
+        _assert_traced_error(s, b, h, 429)
+        # 503 draining.
+        fe.begin_drain()
+        s, b, h = _post(fe.url, img, 1)
+        doc = _assert_traced_error(s, b, h, 503)
+        assert "draining" in doc["error"]
+        # Close the (parked) replicas: the occupying request fails
+        # typed (ServerClosed -> 503) instead of hanging its handler.
+        fe.drain(timeout_s=5.0)
+        assert first_done.wait(timeout=60)
+    finally:
+        fe.close()
+
+
+def test_net_debug_trace_and_slow_request_dump(tmp_path, monkeypatch,
+                                               rng):
+    monkeypatch.setenv(oflight.ENV_SPOOL, str(tmp_path))
+    # Threshold below any real latency: every 200 is an "anomalously
+    # slow" request — the deterministic spelling of a p99 straggler.
+    fe = _make_net(flight_latency_threshold_s=1e-7)
+    try:
+        img = rng.integers(0, 256, (12, 10), dtype=np.uint8)
+        ctx = octx.fresh()
+        status, _body, headers = _post(
+            fe.url, img, 2, headers=octx.headers_for(ctx)
+        )
+        assert status == 200
+        assert headers["X-Trace-Id"] == ctx.trace_id
+        # /debug/trace/<id>: the request's spans, serve tier included.
+        s, b = _get(fe.url, "/debug/trace/" + ctx.trace_id)
+        assert s == 200
+        doc = json.loads(b)
+        assert doc["trace_id"] == ctx.trace_id
+        (proc,) = doc["processes"]
+        names = {sp["name"] for sp in proc["spans"]}
+        assert {"net.request", "net.route", "serve.enqueue",
+                "serve.request"} <= names, names
+        # The per-request serve span carries the trace id explicitly.
+        (sreq,) = [sp for sp in proc["spans"]
+                   if sp["name"] == "serve.request"]
+        assert sreq["trace_id"] == ctx.trace_id
+        assert proc["tree"]  # nested, not just a flat list
+        # Unknown trace -> 404; malformed -> 400.
+        assert _get(fe.url, "/debug/trace/" + "f" * 32)[0] == 404
+        assert _get(fe.url, "/debug/trace/bad%20id")[0] == 400
+        # The slow_request trigger dumped automatically.
+        s, b = _get(fe.url, "/debug/flightrec")
+        assert s == 200
+        index = json.loads(b)
+        mine = [e for e in index if e.get("trace_id") == ctx.trace_id]
+        assert mine and mine[0]["trigger"] == "slow_request"
+        s, b = _get(fe.url, "/debug/flightrec/" + mine[0]["file"])
+        assert s == 200
+        dump = json.loads(b)
+        assert dump["trigger"] == "slow_request"
+        assert {sp["name"] for sp in dump["spans"]} >= {"serve.request"}
+    finally:
+        fe.close()
+
+
+# -- serve engine: witness-mismatch trigger, overhead, bit-exactness ----
+
+
+def test_witness_mismatch_triggers_flight_dump(tmp_path, monkeypatch,
+                                               rng):
+    from tpu_stencil.resilience import faults
+    from tpu_stencil.serve.engine import StencilServer
+
+    monkeypatch.setenv(oflight.ENV_SPOOL, str(tmp_path))
+    oflight.install()
+    faults.configure("integrity.corrupt_result:req=0")
+    ctx = octx.fresh()
+    img = rng.integers(0, 256, (12, 10), dtype=np.uint8)
+    with StencilServer(ServeConfig(max_queue=16, max_batch=4,
+                                   bucket_edges=EDGES,
+                                   witness_rate=1.0)) as server:
+        with octx.bind(ctx):
+            fut = server.submit(img, 2)
+        fut.result(timeout=300)
+        # The witness runs on the worker thread after the future
+        # resolves; wait for the dump to land.
+        deadline = time.perf_counter() + 60
+        dumps = []
+        while time.perf_counter() < deadline and not dumps:
+            dumps = [n for n in os.listdir(tmp_path)
+                     if "witness_mismatch" in n]
+            time.sleep(0.02)
+        assert dumps, "no witness_mismatch dump appeared"
+        doc = json.loads(open(tmp_path / dumps[0]).read())
+        assert doc["trigger"] == "witness_mismatch"
+        assert doc["trace_id"] == ctx.trace_id
+        assert any(sp["name"] == "serve.request"
+                   for sp in doc["spans"])
+        assert server.stats()["counters"][
+            "integrity_witness_mismatch_total"] == 1
+
+
+def test_recording_never_changes_results(rng):
+    """Bit-exactness with the recorder installed: same pixels as the
+    NumPy golden, same as an un-recorded server."""
+    from tpu_stencil.serve.engine import StencilServer
+
+    oflight.install()
+    with StencilServer(ServeConfig(max_queue=16, max_batch=4,
+                                   bucket_edges=EDGES)) as server:
+        for shape, reps in (((12, 10), 3), ((9, 17, 3), 2), ((1, 1), 1)):
+            img = rng.integers(0, 256, shape, dtype=np.uint8)
+            got = server.submit(img, reps).result(timeout=300)
+            np.testing.assert_array_equal(got, _golden(img, reps))
+
+
+@pytest.mark.timing
+def test_flight_recorder_overhead_bounded():
+    """The ring-append bound on the serve hot path: the analog of the
+    disabled-tracer overhead test — an installed recorder must not
+    make the recorder-less configuration look slow, and the per-span
+    micro-cost stays in the tens of microseconds."""
+    from tpu_stencil.serve.engine import StencilServer
+
+    rng = np.random.default_rng(3)
+    img = rng.integers(0, 256, (24, 18, 3), dtype=np.uint8)
+
+    def run_once():
+        with StencilServer(ServeConfig(max_queue=64, max_batch=4,
+                                       bucket_edges=(8, 16, 32))) as srv:
+            futs = [srv.submit(img, 2) for _ in range(24)]
+            for f in futs:
+                f.result(timeout=300)
+
+    run_once()  # prime
+    t0 = time.perf_counter()
+    run_once()
+    bare_s = time.perf_counter() - t0
+    oflight.install()
+    t0 = time.perf_counter()
+    run_once()
+    recorded_s = time.perf_counter() - t0
+    assert bare_s <= recorded_s * 1.75 + 0.25, (bare_s, recorded_s)
+    # Micro-bound: one recorded span = stack push/pop + one SpanRecord
+    # + one locked ring store.
+    n = 50_000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        with obs.span("x", "y"):
+            pass
+    per_call = (time.perf_counter() - t0) / n
+    assert per_call < 100e-6, f"{per_call * 1e6:.2f} us per recorded span"
+
+
+# -- loadgen: trace column + slowest trace ------------------------------
+
+
+def test_loadgen_reports_slowest_trace_and_per_request():
+    from tpu_stencil.serve import loadgen
+    from tpu_stencil.serve.engine import StencilServer
+
+    with StencilServer(ServeConfig(max_queue=64, max_batch=4,
+                                   bucket_edges=EDGES)) as server:
+        report = loadgen.run(server, mode="closed", requests=6,
+                             concurrency=2, reps=1, shapes=((10, 12),),
+                             channels=(3,), seed=1, per_request=True)
+    assert report["completed"] == 6
+    recs = report["per_request"]
+    assert len(recs) == 6
+    assert all(octx.valid_id(r["trace_id"]) for r in recs)
+    assert len({r["trace_id"] for r in recs}) == 6  # one id per request
+    slowest = max(recs, key=lambda r: r["latency_s"])
+    assert report["slowest_trace_id"] == slowest["trace_id"]
+    assert report["slowest_latency_s"] == slowest["latency_s"]
+
+
+def test_serve_cli_per_request_prints_trace_column(capsys):
+    from tpu_stencil.serve import cli as serve_cli
+
+    rc = serve_cli.main(["--requests", "4", "--reps", "1",
+                         "--concurrency", "2", "--shapes", "10x8",
+                         "--per-request"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "X-Trace-Id" in out
+    assert "slowest request:" in out
+    # The named slowest trace appears as a full id in the output.
+    slowest_line = [ln for ln in out.splitlines()
+                    if ln.startswith("slowest request:")][0]
+    tid = slowest_line.split("trace ")[1].split()[0]
+    assert octx.valid_id(tid) and tid in out
+
+
+# -- fed tier: error bodies + host-id fold collisions -------------------
+
+
+def test_fed_error_bodies_carry_trace_id(rng):
+    from tpu_stencil.fed import FedFrontend
+
+    fe = FedFrontend(FedConfig(port=0, heartbeat_interval_s=10.0,
+                               reoffer_s=0.0)).start()
+    try:
+        img = rng.integers(0, 256, (8, 8), dtype=np.uint8)
+        # 400 validation at the fed edge.
+        s, b, h = _post(fe.url, img, -1)
+        _assert_traced_error(s, b, h, 400)
+        # 503: no routable member at all.
+        s, b, h = _post(fe.url, img, 1)
+        doc = _assert_traced_error(s, b, h, 503)
+        assert "routable" in doc["error"]
+        # 503 draining, client id adopted into body AND header.
+        fe.begin_drain()
+        ctx = octx.fresh()
+        s, b, h = _post(fe.url, img, 1, headers=octx.headers_for(ctx))
+        doc = _assert_traced_error(s, b, h, 503)
+        assert doc["trace_id"] == ctx.trace_id
+        assert "draining" in doc["error"]
+    finally:
+        fe.close()
+
+
+def test_fed_tenant_quota_429_carries_trace_id(rng):
+    from tpu_stencil.fed import FedFrontend
+
+    member = _make_net(start_workers=False)
+    fe = FedFrontend(FedConfig(
+        port=0, members=(member.url,), heartbeat_interval_s=10.0,
+        tenant_quota=1, reoffer_s=0.0, hedge=False,
+        forward_timeout_s=30.0, drain_timeout_s=2.0,
+    )).start()
+    try:
+        img = rng.integers(0, 256, (8, 8), dtype=np.uint8)
+        done = threading.Event()
+
+        def occupy():  # parked member: this forward stays outstanding
+            _post(fe.url, img, 1, headers={"X-Tenant": "hot"},
+                  http_timeout=120)
+            done.set()
+
+        t = threading.Thread(target=occupy, daemon=True)
+        t.start()
+        deadline = time.perf_counter() + 30
+        while time.perf_counter() < deadline:
+            if fe.router.tenants().get("hot"):
+                break
+            time.sleep(0.01)
+        s, b, h = _post(fe.url, img, 1, headers={"X-Tenant": "hot"})
+        doc = _assert_traced_error(s, b, h, 429)
+        assert "quota" in doc["error"]
+    finally:
+        member.close()  # fails the parked forward typed
+        done.wait(timeout=60)
+        fe.close()
+
+
+def test_host_id_fold_collision_disambiguated():
+    from tpu_stencil.fed.membership import Membership, host_id_for
+    from tpu_stencil.serve.metrics import Registry
+
+    # Two DISTINCT netlocs, one sanitized spelling.
+    u1, u2 = "http://host-1:80", "http://host.1:80"
+    assert host_id_for(u1) == host_id_for(u2)
+    reg = Registry()
+    ms = Membership(FedConfig(port=0), reg)
+    m1 = ms.register(u1, check=False)
+    m2 = ms.register(u2, check=False)
+    assert m1.host_id != m2.host_id
+    assert m1.host_id == host_id_for(u1)  # first registrant keeps it
+    assert m2.host_id.startswith(host_id_for(u2) + "_")
+    # Metric-safe still (the whole point of the fold prefix).
+    assert m2.host_id.replace("_", "").isalnum()
+    assert reg.counter("host_id_collisions_total").value == 1
+    # Re-registration is stable: same url -> same disambiguated id.
+    assert ms.register(u2, check=False).host_id == m2.host_id
+    assert ms.register(u1, check=False).host_id == m1.host_id
+    assert len({m.host_id for m in ms.members()}) == 2
+
+
+def test_same_netloc_scheme_change_is_not_a_collision():
+    """One host re-registering under a changed scheme (http→https) is
+    a RE-registration — URL updated in place, never a phantom second
+    member that gets double-routed and double-counted in the fold."""
+    from tpu_stencil.fed.membership import Membership
+    from tpu_stencil.serve.metrics import Registry
+
+    reg = Registry()
+    ms = Membership(FedConfig(port=0), reg)
+    m = ms.register("http://10.0.0.5:8080", check=False)
+    m2 = ms.register("https://10.0.0.5:8080", check=False)
+    assert m2 is m and m.url == "https://10.0.0.5:8080"
+    assert len(ms.members()) == 1
+    assert reg.counter("host_id_collisions_total").value == 0
+
+
+# -- span-vocabulary drift gate (tools/check_span_vocab.py) -------------
+
+
+def test_span_vocab_checker_passes():
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+    try:
+        from tools import check_span_vocab
+    finally:
+        sys.path.pop(0)
+    assert check_span_vocab.main() == 0
+
+
+def test_span_vocab_checker_catches_drift(tmp_path, monkeypatch):
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+    try:
+        from tools import check_span_vocab
+    finally:
+        sys.path.pop(0)
+    src = tmp_path / "pkg"
+    src.mkdir()
+    (src / "m.py").write_text(
+        'with obs.span("totally.undocumented", "x"):\n    pass\n'
+    )
+    found = check_span_vocab.collect_span_literals(str(src))
+    assert "totally.undocumented" in found
+    assert "totally.undocumented" not in check_span_vocab.documented_spans()
+
+
+# -- THE acceptance test: fed -> subprocess net -> serve ----------------
+
+
+def _spawn_member(tmp_spool, env_extra=None, extra=()):
+    repo = os.path.join(os.path.dirname(__file__), os.pardir)
+    argv = [sys.executable, "-m", "tpu_stencil", "net", "--port", "0",
+            "--replicas", "1", "--platform", "cpu",
+            "--drain-timeout", "60",
+            "--flightrec-dir", str(tmp_spool)]
+    argv += list(extra)
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               TPU_STENCIL_FLIGHTREC_DIR=str(tmp_spool))
+    env.update(env_extra or {})
+    proc = subprocess.Popen(
+        argv, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True, cwd=repo, env=env,
+    )
+    line = proc.stdout.readline()
+    assert "net: serving on http://" in line, (
+        line, proc.stderr.read()[-2000:]
+    )
+    return proc, line.split()[3]
+
+
+def _reap(proc):
+    if proc.poll() is None:
+        proc.kill()
+        proc.wait(timeout=30)
+    proc.stdout.close()
+    proc.stderr.close()
+
+
+def test_fed_net_serve_trace_under_fault_injection(tmp_path, rng,
+                                                   monkeypatch):
+    """ISSUE 13 acceptance: one request through fed -> net -> serve
+    under fault injection (integrity.corrupt_result manufactures a
+    witness mismatch on the member) yields the same trace id on the
+    wire at every hop and in the response, a /debug/trace tree with
+    spans from >= 2 processes, and an automatic flight-recorder dump
+    naming the trigger and containing the request's spans."""
+    from tpu_stencil.fed import FedFrontend, host_id_for
+
+    member_spool = tmp_path / "member-flightrec"
+    fed_spool = tmp_path / "fed-flightrec"
+    monkeypatch.setenv(oflight.ENV_SPOOL, str(fed_spool))
+    # The member: witness every request; corrupt request 0's result so
+    # the witness disagrees — the injected silent-corruption anomaly.
+    proc, member_url = _spawn_member(
+        member_spool,
+        env_extra={"TPU_STENCIL_FAULTS": "integrity.corrupt_result:req=0"},
+        extra=["--witness-rate", "1"],
+    )
+    fed = FedFrontend(FedConfig(
+        port=0, members=(member_url,), heartbeat_interval_s=10.0,
+        hedge=False, reoffer_s=0.0, forward_timeout_s=120.0,
+    )).start()
+    try:
+        img = rng.integers(0, 256, (16, 12), dtype=np.uint8)
+        ctx = octx.fresh()
+        status, _body, headers = _post(
+            fed.url, img, 2, headers=octx.headers_for(ctx),
+            http_timeout=300,
+        )
+        assert status == 200
+        # (1) The SAME trace id on the wire and in the response.
+        assert headers["X-Trace-Id"] == ctx.trace_id
+        assert headers["X-Fed-Member"] == host_id_for(member_url)
+        # (3) The member's automatic witness-mismatch dump: trigger
+        # named, the request's spans inside, OUR trace id throughout —
+        # proof the id crossed both hops of the wire.
+        deadline = time.perf_counter() + 90
+        dump = None
+        while time.perf_counter() < deadline and dump is None:
+            if member_spool.is_dir():
+                for n in os.listdir(member_spool):
+                    if "witness_mismatch" in n:
+                        dump = json.loads(
+                            open(member_spool / n).read()
+                        )
+                        break
+            time.sleep(0.05)
+        assert dump is not None, "member never dumped the mismatch"
+        assert dump["trigger"] == "witness_mismatch"
+        assert dump["trace_id"] == ctx.trace_id
+        dump_names = {sp["name"] for sp in dump["spans"]}
+        assert {"net.request", "serve.request"} <= dump_names
+        assert all(
+            sp["trace_id"] == ctx.trace_id
+            or ctx.trace_id in (sp["args"].get("trace_ids") or ())
+            for sp in dump["spans"]
+        )
+        # The member's /debug/flightrec lists the same dump.
+        s, b = _get(member_url, "/debug/flightrec")
+        assert s == 200
+        assert any(e.get("trace_id") == ctx.trace_id
+                   and e.get("trigger") == "witness_mismatch"
+                   for e in json.loads(b))
+        # (2) The federated /debug/trace tree: spans from BOTH
+        # processes (the fed router here + the subprocess member).
+        s, b = _get(fed.url, "/debug/trace/" + ctx.trace_id)
+        assert s == 200
+        tree = json.loads(b)
+        sources = {p["source"] for p in tree["processes"]}
+        assert "fed" in sources
+        member_srcs = [src for src in sources if src != "fed"]
+        assert member_srcs, sources  # >= 2 processes contributed
+        by_src = {p["source"]: p for p in tree["processes"]}
+        assert any(sp["name"] == "fed.request"
+                   for sp in by_src["fed"]["spans"])
+        member_names = {sp["name"]
+                        for p in tree["processes"]
+                        if p["source"] != "fed"
+                        for sp in p["spans"]}
+        assert {"net.request", "serve.request"} <= member_names
+        for p in tree["processes"]:
+            for sp in p["spans"]:
+                assert (sp["trace_id"] == ctx.trace_id
+                        or ctx.trace_id
+                        in (sp["args"].get("trace_ids") or ()))
+    finally:
+        fed.close()
+        _reap(proc)

@@ -1,0 +1,147 @@
+#!/usr/bin/env python3
+"""Span-vocabulary drift check: every ``obs.span("...")`` literal in
+the tree must appear in the span table in docs/OBSERVABILITY.md.
+
+The span vocabulary is an API — ``/debug/trace`` consumers, the flight
+recorder's dumps, and the Chrome-trace tooling all key on span names —
+but nothing used to stop a new call site from minting an undocumented
+name (or a doc edit from orphaning a documented one). This static pass
+closes the gap:
+
+* every first-string-literal argument of ``obs.span(`` /
+  ``_obs_span(`` / ``tracing.span(`` / ``obs.phase(`` /
+  ``emit_span(`` under ``tpu_stencil/`` is extracted (f-string
+  placeholders normalize to ``*``: ``f"stream.{name}"`` → ``stream.*``);
+* each must appear, backticked, in the "Span vocabulary" section of
+  docs/OBSERVABILITY.md (a ``stream.*`` table entry covers every
+  ``stream.<stage>`` literal);
+* a missing name fails the check (exit 1); a documented name with no
+  remaining call site is reported as a warning (docs can legitimately
+  list conditional names).
+
+Wired into tier-1 via tests/test_tracectx.py, and runnable standalone:
+
+    python tools/check_span_vocab.py
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+from fnmatch import fnmatchcase
+from typing import Dict, List, Set
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SRC_DIR = os.path.join(REPO, "tpu_stencil")
+DOC = os.path.join(REPO, "docs", "OBSERVABILITY.md")
+SECTION = "## Span vocabulary"
+
+_CALL_RE = re.compile(
+    r"(?:\bobs\.span|\b_obs_span|\btracing\.span|\bobs\.phase"
+    r"|\bemit_span)\(\s*"
+    r"(?:f?\"(?P<dq>[^\"]+)\"|f?'(?P<sq>[^']+)')"
+)
+
+
+def _normalize(name: str) -> str:
+    """F-string placeholders become ``*`` so one doc entry covers a
+    templated family (``stream.{self.name}`` → ``stream.*``)."""
+    return re.sub(r"\{[^}]*\}", "*", name)
+
+
+def collect_span_literals(src_dir: str = SRC_DIR) -> Dict[str, List[str]]:
+    """``{span_name: [file:line, ...]}`` for every span/phase literal
+    under ``src_dir``. Whole-file scan, not per-line: the call's
+    string argument routinely sits on the line after the ``(``."""
+    found: Dict[str, List[str]] = {}
+    for dirpath, _dirs, files in os.walk(src_dir):
+        for fname in sorted(files):
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fname)
+            with open(path, encoding="utf-8") as fh:
+                text = fh.read()
+            rel = os.path.relpath(path, REPO)
+            for m in _CALL_RE.finditer(text):
+                name = _normalize(m.group("dq") or m.group("sq"))
+                lineno = text.count("\n", 0, m.start()) + 1
+                found.setdefault(name, []).append(f"{rel}:{lineno}")
+    return found
+
+
+def documented_spans(doc_path: str = DOC) -> Set[str]:
+    """The first-column backticked names of the "Span vocabulary"
+    table rows (prose backticks in the section don't count — only
+    table entries are the vocabulary)."""
+    with open(doc_path, encoding="utf-8") as fh:
+        text = fh.read()
+    start = text.find(SECTION)
+    if start < 0:
+        raise SystemExit(
+            f"check_span_vocab: no {SECTION!r} section in {doc_path}"
+        )
+    end = text.find("\n## ", start + len(SECTION))
+    section = text[start:end if end > 0 else len(text)]
+    names: Set[str] = set()
+    for line in section.splitlines():
+        m = re.match(r"\|\s*`([^`\s]+)`\s*\|", line)
+        if m:
+            names.add(m.group(1))
+    if not names:
+        raise SystemExit(
+            f"check_span_vocab: {SECTION!r} section has no table rows"
+        )
+    return names
+
+
+def check() -> int:
+    found = collect_span_literals()
+    documented = documented_spans()
+
+    def covered(name: str) -> bool:
+        if name in documented:
+            return True
+        # A doc wildcard entry (stream.*, sharded.exchange_edge[*])
+        # covers its whole family; a source-side family (stream.*)
+        # is likewise covered by itself.
+        return any(
+            "*" in doc and fnmatchcase(name, doc.replace("[", "[[]"))
+            for doc in documented
+        )
+
+    missing = {n: sites for n, sites in sorted(found.items())
+               if not covered(n)}
+    if missing:
+        print("span-vocabulary drift: these obs.span()/obs.phase() "
+              "literals are NOT in the span table in "
+              "docs/OBSERVABILITY.md ('Span vocabulary'):",
+              file=sys.stderr)
+        for name, sites in missing.items():
+            print(f"  {name!r}  ({', '.join(sites[:3])}"
+                  f"{', ...' if len(sites) > 3 else ''})",
+                  file=sys.stderr)
+        return 1
+    stale = sorted(
+        doc for doc in documented
+        if "*" not in doc and doc not in found
+        and not any(fnmatchcase(doc, f.replace("[", "[[]"))
+                    for f in found if "*" in f)
+    )
+    if stale:
+        # Warning only: the doc may legitimately list names whose call
+        # sites are conditional/templated beyond the normalizer.
+        print("check_span_vocab: documented but no literal call site "
+              f"found (stale docs?): {', '.join(stale)}",
+              file=sys.stderr)
+    print(f"span vocabulary OK: {len(found)} span literal(s) all "
+          f"documented ({len(documented)} table entries)")
+    return 0
+
+
+def main() -> int:
+    return check()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

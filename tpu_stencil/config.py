@@ -539,6 +539,16 @@ class NetConfig:
     # (seconds; 0 disables the prober — probes can then only be driven
     # by tests/operators calling probe_once).
     probe_interval_s: float = 1.0
+    # Flight recorder (tpu_stencil.obs.flight): anomaly dumps (slow
+    # request / deadline / witness mismatch / quarantine) spool here as
+    # capped per-trace JSON files; TPU_STENCIL_FLIGHTREC_DIR overrides.
+    # None disables the spool (the ring still records; /debug/trace
+    # still works).
+    flightrec_dir: Optional[str] = "flightrec"
+    # Slow-request anomaly threshold (seconds): a 200 whose wall time
+    # exceeds it triggers an automatic flight-recorder dump, so a p99
+    # straggler leaves a black-box record. 0 disables the trigger.
+    flight_latency_threshold_s: float = 0.0
 
     def __post_init__(self) -> None:
         if not self.host:
@@ -593,6 +603,12 @@ class NetConfig:
             raise ValueError(
                 f"probe_interval_s must be >= 0 (0 = no background "
                 f"prober), got {self.probe_interval_s}"
+            )
+        if self.flight_latency_threshold_s < 0:
+            raise ValueError(
+                f"flight_latency_threshold_s must be >= 0 (0 = no "
+                f"slow-request trigger), got "
+                f"{self.flight_latency_threshold_s}"
             )
         # Jax-free (the filter bank is pure numpy): a typo'd --filter
         # must die as a usage error, not boot a tier that answers 500
@@ -696,6 +712,12 @@ class FedConfig:
     # requests to bleed to zero; a member still busy past it is
     # reported abandoned (rc 1), mirroring the net CLI's discipline.
     drain_timeout_s: float = 30.0
+    # Flight recorder, same contract as NetConfig: anomaly dumps (slow
+    # request / deadline / breaker open / eviction) spool here;
+    # TPU_STENCIL_FLIGHTREC_DIR overrides; None disables the spool.
+    flightrec_dir: Optional[str] = "flightrec"
+    # Slow-request trigger threshold (seconds; 0 = off).
+    flight_latency_threshold_s: float = 0.0
 
     def __post_init__(self) -> None:
         if not self.host:
@@ -769,6 +791,12 @@ class FedConfig:
         if self.drain_timeout_s <= 0:
             raise ValueError(
                 f"drain_timeout_s must be > 0, got {self.drain_timeout_s}"
+            )
+        if self.flight_latency_threshold_s < 0:
+            raise ValueError(
+                f"flight_latency_threshold_s must be >= 0 (0 = no "
+                f"slow-request trigger), got "
+                f"{self.flight_latency_threshold_s}"
             )
 
     @property

@@ -152,6 +152,20 @@ class BreakerBoard:
     def record_failure(self, host_id: str) -> None:
         if self.get(host_id).record_failure():
             self._m_opened.inc()
+            # The breaker-open anomaly: dump the flight ring + emit
+            # the event. Attempt threads re-bind the request's trace
+            # context, so the open that a specific forward provoked is
+            # trace-scoped; an open with no context in scope dumps the
+            # recent ring (the lead-up).
+            from tpu_stencil.obs import context as _obs_ctx
+            from tpu_stencil.obs import flight as _obs_flight
+
+            ctx = _obs_ctx.current()
+            _obs_flight.trigger(
+                "breaker_open",
+                trace_id=ctx.trace_id if ctx else "",
+                tier="fed", host=host_id,
+            )
         self._refresh_gauge()
 
     def _refresh_gauge(self) -> None:

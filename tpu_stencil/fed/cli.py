@@ -106,6 +106,20 @@ def build_parser() -> argparse.ArgumentParser:
                         "bleed to zero within it, else that host is "
                         "reported abandoned and the process exits 1 "
                         "(default 30)")
+    p.add_argument("--flightrec-dir", dest="flightrec_dir",
+                   default="flightrec", metavar="DIR",
+                   help="flight-recorder spool: anomaly triggers (slow "
+                        "request, deadline, breaker open) dump the "
+                        "trace's spans as capped per-trace JSON files "
+                        "here; GET /debug/flightrec lists/fetches them; "
+                        "TPU_STENCIL_FLIGHTREC_DIR overrides; 'none' "
+                        "disables the spool (docs/OBSERVABILITY.md)")
+    p.add_argument("--flight-latency-threshold",
+                   dest="flight_latency_threshold_s", type=float,
+                   default=0.0, metavar="SECONDS",
+                   help="slow-request anomaly threshold: a 200 slower "
+                        "than this triggers an automatic flight-"
+                        "recorder dump (0 = off)")
     p.add_argument("--metrics-text", default=None, metavar="PATH",
                    help="after the drain, write the federation-wide "
                         "metrics (the /metrics exposition, member "
@@ -135,6 +149,9 @@ def main(argv=None) -> int:
             premium_tenants=tuple(ns.premium_tenants),
             premium_quota_factor=ns.premium_quota_factor,
             drain_timeout_s=ns.drain_timeout_s,
+            flightrec_dir=(None if ns.flightrec_dir == "none"
+                           else ns.flightrec_dir),
+            flight_latency_threshold_s=ns.flight_latency_threshold_s,
         )
     except ValueError as e:
         parser.error(str(e))
@@ -160,7 +177,8 @@ def main(argv=None) -> int:
         f"hedge={'on' if cfg.hedge else 'off'}, "
         f"tenant quota {cfg.tenant_quota}); "
         f"POST /v1/blur /admin/register /admin/drain, "
-        f"GET /healthz /metrics /statusz; SIGTERM drains",
+        f"GET /healthz /metrics /statusz /debug/trace/<id> "
+        f"/debug/flightrec; SIGTERM drains",
         flush=True,
     )
     # Timed waits (the net CLI's signal-liveness discipline).

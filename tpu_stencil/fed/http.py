@@ -54,8 +54,15 @@ from tpu_stencil.fed.router import (
     FedRouter,
     TenantQuotaExceeded,
 )
-from tpu_stencil.net.http import _Oversized, read_request_body
+from tpu_stencil.net.http import (
+    _Oversized,
+    read_request_body,
+    send_trace_pair,
+    traced_error_body,
+)
 from tpu_stencil.net.router import Draining, Overloaded
+from tpu_stencil.obs import context as _obs_ctx
+from tpu_stencil.obs import flight as _obs_flight
 from tpu_stencil.obs import span as _obs_span
 from tpu_stencil.resilience.errors import (
     DeadlineExceeded,
@@ -101,6 +108,10 @@ class _FedHandler(BaseHTTPRequestHandler):
     server_version = "tpu-stencil-fed/1"
     timeout = 120.0  # read-side guard, same as the net handler
 
+    # Request-scoped trace context, same discipline as the net handler
+    # (set by _blur, cleared at every do_* against keep-alive reuse).
+    _trace: Optional[_obs_ctx.TraceContext] = None
+
     def log_message(self, *args) -> None:
         pass
 
@@ -115,7 +126,10 @@ class _FedHandler(BaseHTTPRequestHandler):
         self.send_response(code)
         self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(body)))
-        for k, v in (headers or {}).items():
+        headers = headers or {}
+        if self._trace is not None:
+            send_trace_pair(self, self._trace, headers)
+        for k, v in headers.items():
             self.send_header(k, v)
         self.end_headers()
         self.wfile.write(body)
@@ -125,6 +139,18 @@ class _FedHandler(BaseHTTPRequestHandler):
         # Close after errors answered before the body was consumed —
         # the same keep-alive-coherence rule as the net handler.
         self.close_connection = True
+        if self._trace is not None:
+            # The net tier's typed JSON error body, one hop up — every
+            # federation rejection class (shed 503, quota 429,
+            # validation 400, deadline 504) greps to its trace from
+            # the body alone.
+            self._respond(
+                code,
+                traced_error_body(code, msg, self._trace.trace_id),
+                content_type="application/json",
+                headers={**(headers or {}), "Connection": "close"},
+            )
+            return
         self._respond(code, (msg.rstrip("\n") + "\n").encode(),
                       headers={**(headers or {}), "Connection": "close"})
 
@@ -140,6 +166,7 @@ class _FedHandler(BaseHTTPRequestHandler):
     # -- GET -----------------------------------------------------------
 
     def do_GET(self) -> None:  # noqa: N802
+        self._trace = None
         path = urlsplit(self.path).path
         if path == "/healthz":
             if self.fe.router.draining:
@@ -156,12 +183,40 @@ class _FedHandler(BaseHTTPRequestHandler):
                            sort_keys=True).encode(),
                 content_type="application/json",
             )
+        elif path.startswith("/debug/trace/"):
+            self._debug_trace(path[len("/debug/trace/"):])
+        elif path == "/debug/flightrec" or path.startswith(
+                "/debug/flightrec/"):
+            name = (path[len("/debug/flightrec/"):]
+                    if path != "/debug/flightrec" else None)
+            data = _obs_flight.spool_http_payload(
+                _obs_flight.effective_spool(self.fe.cfg.flightrec_dir),
+                name,
+            )
+            if data is None:
+                self._error(404, "no such flight-recorder dump")
+            else:
+                self._respond(200, data,
+                              content_type="application/json")
         else:
             self._error(404, f"no such endpoint: {path}")
+
+    def _debug_trace(self, trace_id: str) -> None:
+        if not _obs_ctx.valid_id(trace_id):
+            self._error(400, f"malformed trace id {trace_id!r}")
+            return
+        payload = self.fe.debug_trace(trace_id)
+        if payload["span_count"] == 0:
+            self._error(404, f"no spans recorded for trace {trace_id} "
+                             "on this federation or its members")
+            return
+        self._respond(200, json.dumps(payload, indent=2).encode(),
+                      content_type="application/json")
 
     # -- POST ----------------------------------------------------------
 
     def do_POST(self) -> None:  # noqa: N802
+        self._trace = None
         split = urlsplit(self.path)
         if split.path == "/v1/blur":
             self._blur(parse_qs(split.query))
@@ -212,8 +267,14 @@ class _FedHandler(BaseHTTPRequestHandler):
 
     def _blur(self, query: dict) -> None:
         fe = self.fe
+        # The OUTERMOST edge of the federation: adopt a tracing
+        # client's valid X-Trace-Id, mint otherwise. Bound for the
+        # handler's duration — the router reads it to stamp every
+        # forward attempt (each hedge leg gets its own span id under
+        # this one trace id).
+        ctx = self._trace = _obs_ctx.from_headers(self.headers)
         t0 = time.perf_counter()
-        with _obs_span("fed.request", "fed"):
+        with _obs_ctx.bind(ctx), _obs_span("fed.request", "fed"):
             try:
                 w = int(self._param(query, "X-Width", "w"))
                 h = int(self._param(query, "X-Height", "h"))
@@ -314,19 +375,40 @@ class _FedHandler(BaseHTTPRequestHandler):
                             {"Retry-After": str(RETRY_AFTER_SHED)})
                 return
             except DeadlineExceeded as e:
+                # The member burned the deadline one hop down: this
+                # process's black box is the record of the whole hop
+                # (the member's own dump covers its half).
+                _obs_flight.trigger(
+                    "deadline_exceeded", trace_id=ctx.trace_id,
+                    tier="fed", duration_s=time.perf_counter() - t0,
+                    detail=str(e),
+                )
                 self._error(504, str(e))
                 return
             except Exception as e:
                 self._error(500, f"{type(e).__name__}: {e}")
                 return
+            elapsed = time.perf_counter() - t0
             if status == 200:
                 fe.registry.histogram(
                     "request_latency_seconds"
-                ).observe(time.perf_counter() - t0)
+                ).observe(elapsed)
+                thr = fe.cfg.flight_latency_threshold_s
+                if thr and elapsed > thr:
+                    _obs_flight.trigger(
+                        "slow_request", trace_id=ctx.trace_id,
+                        tier="fed", duration_s=elapsed,
+                        threshold_s=thr, member=host_id,
+                    )
             out_headers = {
                 k.title(): v for k, v in rh.items()
                 if k.startswith("x-")
             }
+            # The member echoed the trace id with ITS span id; this
+            # edge answers with its own (the member hop stays visible
+            # in /debug/trace, not in the response headers).
+            out_headers[_obs_ctx.TRACE_HEADER] = ctx.trace_id
+            out_headers[_obs_ctx.SPAN_HEADER] = ctx.span_id
             out_headers["X-Fed-Member"] = host_id
             out_headers["X-Fed-Hedged"] = "1" if hedged else "0"
             if status != 200:
@@ -372,10 +454,15 @@ class FedFrontend:
         self._drain_report: Optional[Dict[str, bool]] = None
         self._t_start = time.monotonic()
         self.admin_drain_requested = threading.Event()
+        # The process-wide flight recorder, installed at start().
+        self.flight = None
 
     # -- lifecycle -----------------------------------------------------
 
     def start(self) -> "FedFrontend":
+        # The always-on flight recorder (obs.flight): idempotent per
+        # process, spool per FedConfig (env override wins).
+        self.flight = _obs_flight.install(spool_dir=self.cfg.flightrec_dir)
         for url in self.cfg.members:
             self.membership.register_seed(url)
         self.membership.start()
@@ -467,6 +554,57 @@ class FedFrontend:
 
     # -- scrape surfaces -----------------------------------------------
 
+    def debug_trace(self, trace_id: str) -> dict:
+        """The cross-process trace tree: this process's spans (the
+        flight ring + the live tracer) PLUS every live member's
+        ``/debug/trace/<id>`` answer, fanned concurrently like the
+        metrics fold — one lookup walks the whole federation, a wedged
+        member costs one timeout, a 404 member simply contributes
+        nothing."""
+        import concurrent.futures
+
+        local = _obs_flight.local_trace_spans(trace_id)
+        processes = []
+        if local:
+            processes.append({
+                "source": "fed",
+                "span_count": len(local),
+                "spans": local,
+                "tree": _obs_flight.build_tree(local),
+            })
+
+        def fetch(m) -> list:
+            with urllib.request.urlopen(
+                m.url + "/debug/trace/" + trace_id, timeout=5.0
+            ) as r:
+                doc = json.loads(r.read())
+            out = []
+            for p in doc.get("processes", []):
+                p = dict(p)
+                p["source"] = f"{m.host_id}:{p.get('source', 'net')}"
+                out.append(p)
+            return out
+
+        live = [m for m in self.membership.members()
+                if m.state != "evicted"]
+        if live:
+            with concurrent.futures.ThreadPoolExecutor(
+                max_workers=min(8, len(live)),
+                thread_name_prefix="tpu-stencil-fed-trace",
+            ) as pool:
+                futs = [pool.submit(fetch, m) for m in live]
+                for fut in futs:
+                    try:
+                        processes.extend(fut.result())
+                    except Exception:
+                        continue  # 404 / unreachable: nothing to add
+        return {
+            "schema_version": 1,
+            "trace_id": trace_id,
+            "span_count": sum(p["span_count"] for p in processes),
+            "processes": processes,
+        }
+
     def metrics_snapshot(self) -> dict:
         """The fed registry with every live member's counters folded
         in as ``fleet_<host>_<name>`` — the net tier's replica fold,
@@ -553,5 +691,10 @@ class FedFrontend:
                 "premium_tenants": list(self.cfg.premium_tenants),
                 "premium_quota_factor": self.cfg.premium_quota_factor,
                 "drain_timeout_s": self.cfg.drain_timeout_s,
+                "flightrec_dir": _obs_flight.effective_spool(
+                    self.cfg.flightrec_dir
+                ),
+                "flight_latency_threshold_s":
+                    self.cfg.flight_latency_threshold_s,
             },
         }

@@ -54,11 +54,20 @@ EVICTED = "evicted"
 _STATES = (HEALTHY, SUSPECT, DRAINING, EVICTED)
 
 
+def _netloc(url: str) -> str:
+    """The scheme-less, slash-less address — what the fold prefix (and
+    therefore collision detection) is actually keyed on."""
+    return re.sub(r"^https?://", "", url.rstrip("/"))
+
+
 def host_id_for(url: str) -> str:
     """The metric-safe member id for a URL: the netloc with every
     non-alphanumeric squashed to ``_`` (``http://127.0.0.1:8080`` →
     ``127_0_0_1_8080``) — usable verbatim inside a Prometheus metric
-    name (the ``fleet_<host>_`` exposition fold)."""
+    name (the ``fleet_<host>_`` exposition fold). The squash is lossy
+    (``host-1:80`` and ``host.1:80`` collide); ``Membership.register``
+    detects that and suffixes a URL hash so two distinct netlocs never
+    share a fold prefix."""
     netloc = re.sub(r"^https?://", "", url.rstrip("/"))
     return re.sub(r"[^0-9A-Za-z]", "_", netloc)
 
@@ -137,6 +146,25 @@ class Membership:
         now = time.monotonic()
         with self._lock:
             m = self._members.get(hid)
+            if m is not None and _netloc(m.url) != _netloc(url):
+                # Metric-name fold collision: two DISTINCT netlocs
+                # sanitize to the same host_id (e.g. ``host-1:80`` and
+                # ``host.1:80`` → ``host_1_80``), and sharing the id
+                # would silently merge their ``fleet_<host_id>_*``
+                # counters in the /metrics fold. Disambiguate with a
+                # stable netloc-hash suffix — detected, counted, never
+                # merged. Compared on NETLOC, not the full URL: the
+                # same host re-registering under a new scheme
+                # (http→https) is a re-registration (URL updated in
+                # place below), never a phantom second member.
+                import zlib
+
+                hid = (f"{hid}_"
+                       f"{zlib.crc32(_netloc(url).encode()) & 0xFFFF:04x}")
+                self.registry.counter(
+                    "host_id_collisions_total"
+                ).inc()
+                m = self._members.get(hid)
             if m is None:
                 m = Member(host_id=hid, url=url, registered_at=now)
                 self._members[hid] = m
@@ -198,6 +226,10 @@ class Membership:
         self._refresh_gauges()
         with _obs_span("fed.evict", "fed", host=host_id, reason=reason):
             pass  # zero-duration marker: the eviction moment
+        from tpu_stencil.obs import events as _obs_events
+
+        _obs_events.emit("fed.evict", tier="fed", verdict="evicted",
+                         host=host_id, reason=reason)
 
     # -- views ---------------------------------------------------------
 

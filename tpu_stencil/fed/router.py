@@ -77,6 +77,8 @@ from tpu_stencil.config import FedConfig
 from tpu_stencil.fed.breaker import BreakerBoard
 from tpu_stencil.fed.membership import Member, Membership
 from tpu_stencil.net.router import Draining, Overloaded
+from tpu_stencil.obs import context as _obs_ctx
+from tpu_stencil.obs import events as _obs_events
 from tpu_stencil.obs import span as _obs_span
 from tpu_stencil.resilience.errors import (
     DeadlineExceeded,
@@ -147,6 +149,17 @@ class _Attempt:
         self.router = router
         self.member = member
         self.body = body
+        # Trace propagation: attempts are constructed on the handler
+        # thread where the request's context is bound — each attempt
+        # (first, reroute, hedge leg) forwards the ONE trace id with
+        # its OWN freshly-minted span id, so the member's spans name
+        # which leg they served.
+        self._ctx = _obs_ctx.current()
+        if self._ctx is not None:
+            headers = dict(headers)
+            headers.update(_obs_ctx.headers_for(
+                self._ctx, span_id=_obs_ctx.new_span_id()
+            ))
         self.headers = headers
         self.is_hedge = is_hedge
         self.cancelled = False
@@ -227,6 +240,14 @@ class _Attempt:
             )
 
     def _run_into(self, results: "queue.Queue") -> None:
+        # Re-bind the request's context on THIS thread (contextvars do
+        # not cross thread starts): breaker transitions and spans
+        # below inherit the trace id; bind(None) guards against a
+        # stale context from any thread reuse.
+        with _obs_ctx.bind(self._ctx):
+            self._run_into_bound(results)
+
+    def _run_into_bound(self, results: "queue.Queue") -> None:
         r = self.router
         hid = self.member.host_id
         r._track_launch(hid)
@@ -259,6 +280,16 @@ class _Attempt:
         else:
             r.breakers.record_failure(hid)
             r.registry.counter(f"forward_{payload[0]}_total").inc()
+            # One event line per failed forward attempt: the verdict
+            # taxonomy name, the leg (hedge or primary), the host —
+            # grep the trace id, read the request's whole post-mortem.
+            _obs_events.emit(
+                "fed.forward",
+                trace_id=self._ctx.trace_id if self._ctx else "",
+                tier="fed", verdict=payload[0],
+                duration_s=self.elapsed, host=hid,
+                hedge=self.is_hedge,
+            )
         results.put((self.member, self, kind, payload))
 
 
@@ -311,8 +342,12 @@ class FedRouter:
 
     def begin_drain(self) -> None:
         with self._lock:
+            was = self._draining
             self._draining = True
         self.registry.gauge("draining").set(1)
+        if not was:  # tier-transition event, once per flip
+            _obs_events.emit("fed.drain_begin", tier="fed",
+                             verdict="draining")
 
     # -- admission (the PR-10 ladder, one hop up) ----------------------
 

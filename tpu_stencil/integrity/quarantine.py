@@ -107,11 +107,22 @@ class QuarantineBoard:
         self._m_quarantines.inc()
         self.registry.gauge(f"replica_quarantined_dev{idx}").set(1)
         self.registry.gauge("replicas_quarantined").set(n)
+        from tpu_stencil.obs import context as _obs_ctx
+        from tpu_stencil.obs import flight as _obs_flight
         from tpu_stencil.obs import span as _obs_span
 
         with _obs_span("integrity.quarantine", "integrity",
                        replica=idx, reason=reason):
             pass  # zero-duration marker: the quarantine moment
+        # The black box + event line of the transition: with a bound
+        # trace context (an operator POST, or the tripping request's
+        # witness thread) the dump is trace-scoped; without one it
+        # captures the recent ring — the lead-up to the trip.
+        ctx = _obs_ctx.current()
+        _obs_flight.trigger(
+            "quarantine", trace_id=ctx.trace_id if ctx else "",
+            tier="net", replica=idx, reason=reason,
+        )
         return True
 
     def release(self, idx: int, how: str) -> bool:

@@ -101,6 +101,22 @@ def build_parser() -> argparse.ArgumentParser:
                    help="background re-verify probe period for "
                         "quarantined replicas (default 1.0; 0 disables "
                         "the prober)")
+    p.add_argument("--flightrec-dir", dest="flightrec_dir",
+                   default="flightrec", metavar="DIR",
+                   help="flight-recorder spool: anomaly triggers (slow "
+                        "request, deadline, witness mismatch, "
+                        "quarantine) dump the trace's spans as capped "
+                        "per-trace JSON files here; GET /debug/flightrec "
+                        "lists/fetches them; TPU_STENCIL_FLIGHTREC_DIR "
+                        "overrides; 'none' disables the spool "
+                        "(docs/OBSERVABILITY.md)")
+    p.add_argument("--flight-latency-threshold",
+                   dest="flight_latency_threshold_s", type=float,
+                   default=0.0, metavar="SECONDS",
+                   help="slow-request anomaly threshold: a 200 slower "
+                        "than this triggers an automatic flight-recorder "
+                        "dump, so a p99 straggler leaves a black-box "
+                        "record (0 = off)")
     p.add_argument("--platform", default=None,
                    choices=["cpu", "tpu", "gpu"],
                    help="force the JAX platform before backend init")
@@ -179,6 +195,9 @@ def main(argv=None) -> int:
             quarantine_after=ns.quarantine_after,
             readmit_after=ns.readmit_after,
             probe_interval_s=ns.probe_interval_s,
+            flightrec_dir=(None if ns.flightrec_dir == "none"
+                           else ns.flightrec_dir),
+            flight_latency_threshold_s=ns.flight_latency_threshold_s,
         )
     except ValueError as e:
         parser.error(str(e))
@@ -204,8 +223,8 @@ def main(argv=None) -> int:
         f"(max_queue={cfg.max_queue}/replica, "
         f"shed>{cfg.max_inflight_mb:g}MB inflight, "
         f"warm={'on' if cfg.warm_fleet else 'off'}); "
-        f"POST /v1/blur, GET /healthz /metrics /statusz; "
-        f"SIGTERM drains",
+        f"POST /v1/blur, GET /healthz /metrics /statusz "
+        f"/debug/trace/<id> /debug/flightrec; SIGTERM drains",
         flush=True,
     )
     if ns.register:

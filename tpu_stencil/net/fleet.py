@@ -219,6 +219,16 @@ class ReplicaFleet:
             for i, ok in sorted(results.items()):
                 if not ok:
                     self._m_abandoned.inc()
+        from tpu_stencil.obs import events as _obs_events
+
+        # Tier-transition event: the drain verdict in one greppable
+        # line (which replicas bled clean vs were abandoned).
+        abandoned = sorted(i for i, ok in results.items() if not ok)
+        _obs_events.emit(
+            "net.drain_report", tier="net",
+            verdict="abandoned" if abandoned else "clean",
+            replicas=len(results), abandoned=abandoned,
+        )
         return results
 
     def restart(self, i: int, timeout_s: Optional[float] = None,

@@ -63,6 +63,8 @@ from tpu_stencil.integrity.quarantine import (
 )
 from tpu_stencil.net.fleet import ReplicaFleet
 from tpu_stencil.net.router import Draining, Overloaded, Router
+from tpu_stencil.obs import context as _obs_ctx
+from tpu_stencil.obs import flight as _obs_flight
 from tpu_stencil.obs import span as _obs_span
 from tpu_stencil.resilience.errors import DeadlineExceeded, WorkerCrashed
 from tpu_stencil.serve.engine import QueueFull, ServerClosed
@@ -101,6 +103,28 @@ def _fault_stall_s() -> float:
 class _Oversized(ValueError):
     """Body larger than the declared frame (→ 413; a malformed framing
     header is a plain ValueError → 400 — shrinking won't fix it)."""
+
+
+def traced_error_body(code: int, msg: str, trace_id: str) -> bytes:
+    """The typed JSON error body of a request-scoped rejection — the
+    trace id rides in the body next to the header echo, so a logged
+    body alone greps to its trace. One spelling for BOTH HTTP tiers
+    (the fed handler imports it), so the wire contract cannot drift."""
+    return json.dumps({
+        "error": msg.rstrip("\n"),
+        "status": code,
+        "trace_id": trace_id,
+    }).encode() + b"\n"
+
+
+def send_trace_pair(handler, trace, headers: Dict[str, str]) -> None:
+    """Echo the ``X-Trace-Id``/``X-Span-Id`` pair on a response being
+    assembled (skipping keys the caller already set) — shared by both
+    tiers' ``_respond``."""
+    if _obs_ctx.TRACE_HEADER not in headers:
+        handler.send_header(_obs_ctx.TRACE_HEADER, trace.trace_id)
+    if _obs_ctx.SPAN_HEADER not in headers:
+        handler.send_header(_obs_ctx.SPAN_HEADER, trace.span_id)
 
 
 def read_request_body(rfile, headers, limit: int) -> bytes:
@@ -184,6 +208,12 @@ class _Handler(BaseHTTPRequestHandler):
 
     # -- plumbing ------------------------------------------------------
 
+    # The request-scoped trace context (obs.context): set by _blur,
+    # cleared at the top of every do_* — handler instances persist per
+    # keep-alive connection, so a stale context must never leak onto
+    # the next request.
+    _trace: Optional[_obs_ctx.TraceContext] = None
+
     def log_message(self, *args) -> None:
         pass  # metrics, not stderr chatter, are the observability story
 
@@ -199,7 +229,13 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_response(code)
         self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(body)))
-        for k, v in (headers or {}).items():
+        headers = headers or {}
+        if self._trace is not None:
+            # Every response — 200 AND 4xx/5xx — echoes the trace pair,
+            # so a client correlates its failure to /debug/trace and
+            # the flight-recorder spool without parsing bodies.
+            send_trace_pair(self, self._trace, headers)
+        for k, v in headers.items():
             self.send_header(k, v)
         self.end_headers()
         self.wfile.write(body)
@@ -212,6 +248,16 @@ class _Handler(BaseHTTPRequestHandler):
         # bytes on a kept-alive connection would be parsed as the next
         # request line — garbage for the whole connection.
         self.close_connection = True
+        if self._trace is not None:
+            # Request-scoped errors answer the typed JSON body carrying
+            # the trace id next to the header echo.
+            self._respond(
+                code,
+                traced_error_body(code, msg, self._trace.trace_id),
+                content_type="application/json",
+                headers={**(headers or {}), "Connection": "close"},
+            )
+            return
         self._respond(code, (msg.rstrip("\n") + "\n").encode(),
                       headers={**(headers or {}), "Connection": "close"})
 
@@ -230,6 +276,7 @@ class _Handler(BaseHTTPRequestHandler):
     # -- GET -----------------------------------------------------------
 
     def do_GET(self) -> None:  # noqa: N802 (stdlib handler contract)
+        self._trace = None
         path = urlsplit(self.path).path
         if path == "/healthz":
             if self.fe.router.draining:
@@ -245,12 +292,40 @@ class _Handler(BaseHTTPRequestHandler):
                                  sort_keys=True)
             self._respond(200, payload.encode(),
                           content_type="application/json")
+        elif path.startswith("/debug/trace/"):
+            self._debug_trace(path[len("/debug/trace/"):])
+        elif path == "/debug/flightrec" or path.startswith(
+                "/debug/flightrec/"):
+            name = (path[len("/debug/flightrec/"):]
+                    if path != "/debug/flightrec" else None)
+            data = _obs_flight.spool_http_payload(
+                _obs_flight.effective_spool(self.fe.cfg.flightrec_dir),
+                name,
+            )
+            if data is None:
+                self._error(404, "no such flight-recorder dump")
+            else:
+                self._respond(200, data,
+                              content_type="application/json")
         else:
             self._error(404, f"no such endpoint: {path}")
+
+    def _debug_trace(self, trace_id: str) -> None:
+        if not _obs_ctx.valid_id(trace_id):
+            self._error(400, f"malformed trace id {trace_id!r}")
+            return
+        payload = self.fe.debug_trace(trace_id)
+        if payload["span_count"] == 0:
+            self._error(404, f"no spans recorded for trace {trace_id} "
+                             "(aged out of the ring, or never here)")
+            return
+        self._respond(200, json.dumps(payload, indent=2).encode(),
+                      content_type="application/json")
 
     # -- POST ----------------------------------------------------------
 
     def do_POST(self) -> None:  # noqa: N802
+        self._trace = None
         split = urlsplit(self.path)
         if split.path == "/v1/blur":
             self._blur(parse_qs(split.query))
@@ -386,8 +461,14 @@ class _Handler(BaseHTTPRequestHandler):
             fe.fault_accept
         ):
             return  # injected connection drop: no response at all
+        # Trace context: adopt a valid inbound X-Trace-Id (the fed hop,
+        # or a tracing client), mint otherwise — net is the outermost
+        # edge when unfederated. Bound for the handler's duration so
+        # every span below (and the serve engine's request records)
+        # stitches into one cross-process trace.
+        ctx = self._trace = _obs_ctx.from_headers(self.headers)
         t0 = time.perf_counter()
-        with _obs_span("net.request", "net"):
+        with _obs_ctx.bind(ctx), _obs_span("net.request", "net"):
             try:
                 w = int(self._param(query, "X-Width", "w"))
                 h = int(self._param(query, "X-Height", "h"))
@@ -499,11 +580,19 @@ class _Handler(BaseHTTPRequestHandler):
             try:
                 out = fut.result(timeout=wait)
             except DeadlineExceeded as e:
+                # (The serve engine already dumped this trace at its
+                # batch-formation expiry — one anomaly, one dump.)
                 self._error(504, str(e))
                 return
             except (TimeoutError, concurrent.futures.TimeoutError):
                 # (One name on 3.11+; two distinct classes before.)
                 fut.cancel()
+                _obs_flight.trigger(
+                    "deadline_exceeded", trace_id=ctx.trace_id,
+                    tier="net", duration_s=time.perf_counter() - t0,
+                    replica=idx,
+                    detail=f"still pending after {wait:g}s",
+                )
                 self._error(504,
                             f"request still pending after {wait:g}s")
                 return
@@ -514,9 +603,19 @@ class _Handler(BaseHTTPRequestHandler):
             except Exception as e:
                 self._error(500, f"{type(e).__name__}: {e}")
                 return
+            elapsed = time.perf_counter() - t0
             fe.registry.histogram("request_latency_seconds").observe(
-                time.perf_counter() - t0
+                elapsed
             )
+            thr = fe.cfg.flight_latency_threshold_s
+            if thr and elapsed > thr:
+                # The p99-straggler trigger: the request SUCCEEDED but
+                # anomalously slowly — dump its spans while they are
+                # still in the ring.
+                _obs_flight.trigger(
+                    "slow_request", trace_id=ctx.trace_id, tier="net",
+                    duration_s=elapsed, threshold_s=thr, replica=idx,
+                )
             payload = np.ascontiguousarray(out).tobytes()
             resp_headers = {
                 "X-Width": str(w), "X-Height": str(h),
@@ -572,6 +671,8 @@ class NetFrontend:
         # Set by POST /admin/drain (the SIGTERM-equivalent admin
         # path); the CLI main loop watches it next to the signal flag.
         self.admin_drain_requested = threading.Event()
+        # The process-wide flight recorder, installed at start().
+        self.flight = None
         # net.accept / net.body / corruption chaos sites, resolved once
         # at start().
         self.fault_accept = None
@@ -595,6 +696,10 @@ class NetFrontend:
     def start(self) -> "NetFrontend":
         from tpu_stencil.resilience import faults as _faults
 
+        # The always-on flight recorder: every span this process
+        # records from here on lands in the ring, and anomaly triggers
+        # dump into the spool (obs.flight; idempotent per process).
+        self.flight = _obs_flight.install(spool_dir=self.cfg.flightrec_dir)
         self.fault_accept = _faults.site("net.accept")
         self.fault_body = _faults.site("net.body")
         self.fault_corrupt_ingest = _faults.site("integrity.corrupt_ingest")
@@ -677,6 +782,25 @@ class NetFrontend:
 
     # -- scrape surfaces -----------------------------------------------
 
+    def debug_trace(self, trace_id: str) -> dict:
+        """One trace's spans from this process (the flight ring plus
+        the live tracer when ``--trace`` is on — the replicas are
+        in-process, so one ring covers net → router → serve). The
+        federation fans this lookup to its members for the
+        cross-process tree."""
+        spans = _obs_flight.local_trace_spans(trace_id)
+        return {
+            "schema_version": 1,
+            "trace_id": trace_id,
+            "span_count": len(spans),
+            "processes": [{
+                "source": "net",
+                "span_count": len(spans),
+                "spans": spans,
+                "tree": _obs_flight.build_tree(spans),
+            }] if spans else [],
+        }
+
     def metrics_snapshot(self) -> dict:
         """The net registry with every replica's counters folded in as
         ``fleet_<name>`` — ONE snapshot under ONE prefix, so the
@@ -731,5 +855,10 @@ class NetFrontend:
                 "witness_rate": self.cfg.witness_rate,
                 "quarantine_after": self.cfg.quarantine_after,
                 "readmit_after": self.cfg.readmit_after,
+                "flightrec_dir": _obs_flight.effective_spool(
+                    self.cfg.flightrec_dir
+                ),
+                "flight_latency_threshold_s":
+                    self.cfg.flight_latency_threshold_s,
             },
         }

@@ -110,10 +110,17 @@ class Router:
     def begin_drain(self) -> None:
         """Flip the admission gate (idempotent): every subsequent
         submit raises :class:`Draining`; in-flight requests are
-        untouched. The ``draining`` gauge makes the flip scrapeable."""
+        untouched. The ``draining`` gauge makes the flip scrapeable,
+        and the first flip emits a tier-transition event line."""
         with self._lock:
+            was = self._draining
             self._draining = True
         self.registry.gauge("draining").set(1)
+        if not was:
+            from tpu_stencil.obs import events as _obs_events
+
+            _obs_events.emit("net.drain_begin", tier="net",
+                             verdict="draining")
 
     # -- quarantine ----------------------------------------------------
 

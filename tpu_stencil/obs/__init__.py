@@ -32,6 +32,7 @@ from tpu_stencil.obs.tracing import (
     SpanRecord,
     Tracer,
     disable,
+    emit_span,
     enable,
     enabled,
     get_tracer,
@@ -43,8 +44,11 @@ from tpu_stencil.obs.tracing import (
 )
 from tpu_stencil.obs import (
     breakdown,
+    context,
+    events,
     export,
     exposition,
+    flight,
     introspect,
     sentry,
     tracing,
@@ -52,9 +56,12 @@ from tpu_stencil.obs import (
 
 
 def reset() -> None:
-    """Drop the tracer, the accumulated metrics, AND the introspection
-    records (tests) — one teardown for the whole obs subsystem."""
+    """Drop the tracer, the accumulated metrics, the flight recorder,
+    the event-stream override, AND the introspection records (tests) —
+    one teardown for the whole obs subsystem."""
     tracing.reset()
+    flight.reset()
+    events.reset()
     introspect.reset()
 
 
@@ -63,10 +70,14 @@ __all__ = [
     "SpanRecord",
     "Tracer",
     "breakdown",
+    "context",
     "disable",
+    "emit_span",
     "enable",
     "enabled",
+    "events",
     "export",
+    "flight",
     "exposition",
     "get_tracer",
     "introspect",

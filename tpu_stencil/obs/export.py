@@ -22,8 +22,14 @@ from typing import List, Optional
 from tpu_stencil.obs.tracing import Tracer
 
 
-def chrome_events(tracer: Tracer, pid: Optional[int] = None) -> List[dict]:
-    """This process's spans as Chrome trace events (metadata included)."""
+def chrome_events(tracer: Tracer, pid: Optional[int] = None,
+                  trace_id: Optional[str] = None) -> List[dict]:
+    """This process's spans as Chrome trace events (metadata included).
+    ``trace_id`` filters to one request's spans (the
+    :mod:`~tpu_stencil.obs.context` correlation id; batch-scope spans
+    carrying the id in their ``trace_ids`` arg match too)."""
+    from tpu_stencil.obs import flight as _flight
+
     if pid is None:
         pid = _process_index()
     events: List[dict] = [{
@@ -34,6 +40,8 @@ def chrome_events(tracer: Tracer, pid: Optional[int] = None) -> List[dict]:
     # so the main thread (first recorder) stays on top.
     tids: dict = {}
     for rec in tracer.spans():
+        if trace_id is not None and not _flight.matches(rec, trace_id):
+            continue
         tid = tids.get(rec.tid)
         if tid is None:
             tid = tids[rec.tid] = len(tids)
@@ -41,6 +49,10 @@ def chrome_events(tracer: Tracer, pid: Optional[int] = None) -> List[dict]:
                 "ph": "M", "name": "thread_name", "pid": pid, "tid": tid,
                 "args": {"name": rec.tname},
             })
+        args = dict(rec.args, depth=rec.depth)
+        if rec.trace_id:
+            args["trace_id"] = rec.trace_id
+            args["span_id"] = rec.span_id
         events.append({
             "name": rec.name,
             "cat": rec.cat or "tpu_stencil",
@@ -49,7 +61,7 @@ def chrome_events(tracer: Tracer, pid: Optional[int] = None) -> List[dict]:
             "dur": round(rec.seconds * 1e6, 3),
             "pid": pid,
             "tid": tid,
-            "args": dict(rec.args, depth=rec.depth),
+            "args": args,
         })
     return events
 
@@ -63,12 +75,14 @@ def _process_index() -> int:
         return 0
 
 
-def merged_events(tracer: Tracer) -> List[dict]:
+def merged_events(tracer: Tracer,
+                  trace_id: Optional[str] = None) -> List[dict]:
     """All processes' events, gathered to every process. Single-process:
-    just this tracer's."""
+    just this tracer's. ``trace_id`` filters per process before the
+    gather (a one-request trace ships one request's bytes)."""
     import jax
 
-    local = chrome_events(tracer)
+    local = chrome_events(tracer, trace_id=trace_id)
     if jax.process_count() == 1:
         return local
     import numpy as np
@@ -85,10 +99,13 @@ def merged_events(tracer: Tracer) -> List[dict]:
     return merged
 
 
-def write_chrome_trace(path: str, tracer: Tracer) -> Optional[str]:
+def write_chrome_trace(path: str, tracer: Tracer,
+                       trace_id: Optional[str] = None) -> Optional[str]:
     """Write the merged trace; process 0 writes (every process joins the
-    gather). Returns ``path`` on the writing process, None elsewhere."""
-    events = merged_events(tracer)
+    gather). Returns ``path`` on the writing process, None elsewhere.
+    ``trace_id`` writes one request's cross-thread view instead of the
+    whole run."""
+    events = merged_events(tracer, trace_id=trace_id)
     if _process_index() != 0:
         return None
     with open(path, "w") as fh:

@@ -40,6 +40,7 @@ import threading
 import time
 from typing import Dict, List, Optional
 
+from tpu_stencil.obs.context import current as _ctx_current
 from tpu_stencil.utils.timing import Timer
 
 
@@ -55,10 +56,26 @@ class SpanRecord:
     tname: str         # thread name at record time
     depth: int         # nesting depth on its thread at open time
     args: Dict
+    # Request correlation (obs.context): the bound trace context at
+    # close time, empty for spans outside any request scope.
+    trace_id: str = ""
+    span_id: str = ""
 
     @property
     def seconds(self) -> float:
         return self.t1 - self.t0
+
+
+# Per-thread nesting stack, shared by every sink (tracer and flight
+# recorder must agree on depth, so the stack cannot live on either).
+_stack_tls = threading.local()
+
+
+def _stack() -> list:
+    st = getattr(_stack_tls, "stack", None)
+    if st is None:
+        st = _stack_tls.stack = []
+    return st
 
 
 class Tracer:
@@ -67,14 +84,7 @@ class Tracer:
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._records: List[SpanRecord] = []
-        self._tls = threading.local()
         self.t_origin = time.perf_counter()
-
-    def _stack(self) -> list:
-        st = getattr(self._tls, "stack", None)
-        if st is None:
-            st = self._tls.stack = []
-        return st
 
     def record(self, rec: SpanRecord) -> None:
         with self._lock:
@@ -87,18 +97,21 @@ class Tracer:
 
 
 class Span:
-    """Context manager recording one span on ``tracer``. Exceptions
-    propagate; the span still closes (a failed phase is still time
-    spent)."""
+    """Context manager recording one span on the active sinks (the
+    installed :class:`Tracer` and/or the flight recorder — one
+    :class:`SpanRecord` reaches both). Exceptions propagate; the span
+    still closes (a failed phase is still time spent)."""
 
-    __slots__ = ("name", "cat", "args", "_tracer", "_t0", "_depth")
+    __slots__ = ("name", "cat", "args", "_tracer", "_flight",
+                 "_t0", "_depth")
 
-    def __init__(self, tracer: Tracer, name: str, cat: str, args: Dict):
+    def __init__(self, tracer, flight, name: str, cat: str, args: Dict):
         self._tracer = tracer
+        self._flight = flight
         self.name, self.cat, self.args = name, cat, args
 
     def __enter__(self) -> "Span":
-        stack = self._tracer._stack()
+        stack = _stack()
         self._depth = len(stack)
         stack.append(self.name)
         self._t0 = time.perf_counter()
@@ -113,13 +126,20 @@ class Span:
 
     def __exit__(self, *exc) -> None:
         t1 = time.perf_counter()
-        self._tracer._stack().pop()
+        _stack().pop()
         th = threading.current_thread()
-        self._tracer.record(SpanRecord(
+        ctx = _ctx_current()
+        rec = SpanRecord(
             name=self.name, cat=self.cat, t0=self._t0, t1=t1,
             tid=th.ident or 0, tname=th.name, depth=self._depth,
             args=self.args,
-        ))
+            trace_id=ctx.trace_id if ctx is not None else "",
+            span_id=ctx.span_id if ctx is not None else "",
+        )
+        if self._tracer is not None:
+            self._tracer.record(rec)
+        if self._flight is not None:
+            self._flight.record(rec)
 
 
 class _NullSpan:
@@ -146,6 +166,11 @@ class _NullSpan:
 
 _NULL = _NullSpan()
 _tracer: Optional[Tracer] = None
+# The flight-recorder sink (tpu_stencil.obs.flight installs itself via
+# _set_flight): unlike the tracer it RECORDS by default in the serving
+# tiers — span() consults both globals, and only when both are None
+# does the shared no-op path run.
+_flight = None
 # Created lazily: metrics.Registry lives under tpu_stencil.serve, whose
 # package __init__ imports the engine, which imports obs — an import-time
 # Registry here would close that cycle.
@@ -171,6 +196,41 @@ def enabled() -> bool:
 
 def get_tracer() -> Optional[Tracer]:
     return _tracer
+
+
+def _set_flight(recorder) -> None:
+    """Install (or clear) the flight-recorder sink — called only by
+    :mod:`tpu_stencil.obs.flight`."""
+    global _flight
+    _flight = recorder
+
+
+def sinks_active() -> bool:
+    """True when at least one span sink (tracer or flight recorder) is
+    installed — the guard for optional per-request record emission."""
+    return _tracer is not None or _flight is not None
+
+
+def emit_span(name: str, cat: str, t0: float, t1: float,
+              trace_id: str = "", span_id: str = "", **args) -> None:
+    """Record one already-closed span directly (no context manager):
+    the retire path uses this to file a per-request ``serve.request``
+    record with an EXPLICIT trace id — the worker thread has no bound
+    context, and a batch mixes requests from different traces. No-op
+    when no sink is installed."""
+    t, f = _tracer, _flight
+    if t is None and f is None:
+        return
+    th = threading.current_thread()
+    rec = SpanRecord(
+        name=name, cat=cat, t0=t0, t1=t1, tid=th.ident or 0,
+        tname=th.name, depth=0, args=args,
+        trace_id=trace_id, span_id=span_id,
+    )
+    if t is not None:
+        t.record(rec)
+    if f is not None:
+        f.record(rec)
 
 
 def registry():
@@ -201,33 +261,39 @@ def reset() -> None:
 @_contextlib.contextmanager
 def scratch_registry():
     """Divert the process-wide registry to a throwaway — and silence
-    the tracer — for the duration: measurement probes run frames
-    through the real engines (a ``--mesh-frames 0`` auto A/B streams
-    ~a dozen), and without the diversion their counters/gauges would
-    land in the run's own exposition and their spans would interleave
-    with the real run's ``--trace``/``--breakdown`` at the same frame
-    indices — report-what-ran, for both telemetry surfaces. The
-    previous registry (with all its accumulated state) and tracer are
+    the tracer AND the flight recorder — for the duration: measurement
+    probes run frames through the real engines (a ``--mesh-frames 0``
+    auto A/B streams ~a dozen), and without the diversion their
+    counters/gauges would land in the run's own exposition and their
+    spans would interleave with the real run's ``--trace``/
+    ``--breakdown`` (and the flight ring) at the same frame indices —
+    report-what-ran, for every telemetry surface. The previous
+    registry (with all its accumulated state), tracer and recorder are
     restored on exit."""
-    global _registry, _tracer
+    global _registry, _tracer, _flight
     from tpu_stencil.serve.metrics import Registry
 
-    prev_registry, prev_tracer = _registry, _tracer
+    prev_registry, prev_tracer, prev_flight = _registry, _tracer, _flight
     _registry = Registry()
     _tracer = None
+    _flight = None
     try:
         yield _registry
     finally:
         _registry = prev_registry
         _tracer = prev_tracer
+        _flight = prev_flight
 
 
 def span(name: str, cat: str = "", **args):
-    """A trace span when tracing is enabled, a shared no-op otherwise."""
+    """A recorded span when a sink is installed (the ``--trace``
+    tracer and/or the always-on flight recorder), a shared no-op
+    otherwise."""
     t = _tracer
-    if t is None:
+    f = _flight
+    if t is None and f is None:
         return _NULL
-    return Span(t, name, cat, args)
+    return Span(t, f, name, cat, args)
 
 
 class phase:

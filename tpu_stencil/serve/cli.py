@@ -124,6 +124,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="comma-separated channel counts to cycle "
                         "(1=grey, 3=rgb; default 3)")
     p.add_argument("--seed", type=int, default=0, help="loadgen seed")
+    p.add_argument("--per-request", dest="per_request",
+                   action="store_true",
+                   help="print one line per completed request with its "
+                        "latency and X-Trace-Id (the id every hop "
+                        "echoed and /debug/trace assembles); the "
+                        "summary always names the slowest trace")
     p.add_argument("--platform", default=None,
                    choices=["cpu", "tpu", "gpu"],
                    help="force the JAX platform before backend init")
@@ -310,6 +316,7 @@ def main(argv=None) -> int:
             shapes=shapes, channels=channels, seed=ns.seed,
             rate_fps=ns.rate_fps,
             verify=ns.verify, verify_filter=ns.filter_name,
+            per_request=ns.per_request,
         )
         if ns.http:
             # The network-tier target: same loops, same report schema,
@@ -339,12 +346,28 @@ def main(argv=None) -> int:
             prefix="tpu_stencil_net" if ns.http else "tpu_stencil_serve",
         )
     c = report["stats"]["counters"]
+    if ns.per_request and report.get("per_request"):
+        # The loadgen's per-request table: the X-Trace-Id column is
+        # the same id every hop echoed, so a straggler line greps
+        # straight to /debug/trace/<id> and its flightrec dump.
+        print(f"{'i':>4}  {'latency_ms':>10}  {'ok':>2}  X-Trace-Id")
+        for rec in report["per_request"]:
+            print(f"{rec['i']:>4}  {rec['latency_s'] * 1e3:>10.2f}  "
+                  f"{'y' if rec['ok'] else 'n':>2}  {rec['trace_id']}")
     print(
         f"served {report['completed']}/{report['requests']} requests "
         f"in {report['wall_seconds']:.3f}s "
         f"({report['throughput_rps']:.1f} req/s, {report['mode']}-loop"
         f"{', http' if ns.http else ''})"
     )
+    if report.get("slowest_trace_id"):
+        print(
+            f"slowest request: "
+            f"{report['slowest_latency_s'] * 1e3:.2f}ms "
+            f"trace {report['slowest_trace_id']} "
+            f"(GET /debug/trace/<id>; flightrec dump if it tripped a "
+            f"trigger)"
+        )
     if ns.http:
         print(
             f"latency p50={report['p50_s'] * 1e3:.2f}ms "

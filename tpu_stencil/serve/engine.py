@@ -47,8 +47,11 @@ import numpy as np
 from tpu_stencil.config import ServeConfig
 from tpu_stencil.integrity import checksum as _checksum
 from tpu_stencil.integrity import witness as _witness_mod
+from tpu_stencil.obs import context as _obs_ctx
+from tpu_stencil.obs import flight as _obs_flight
 from tpu_stencil.obs import introspect as _introspect
 from tpu_stencil.obs import span as _obs_span
+from tpu_stencil.obs import tracing as _obs_tracing
 from tpu_stencil.resilience import faults as _faults
 from tpu_stencil.resilience import retry as _retry
 from tpu_stencil.resilience.errors import DeadlineExceeded, WorkerCrashed
@@ -73,6 +76,14 @@ def _resolve(fut: "concurrent.futures.Future", value=None,
         return True
     except concurrent.futures.InvalidStateError:
         return False  # cancelled (or already resolved); drop silently
+
+
+def _batch_trace_ids(batch) -> tuple:
+    """The distinct trace ids riding in a batch (span-arg form): a
+    dispatch/drain span covers requests from several traces, and the
+    ``trace_ids`` arg is what lets ``/debug/trace`` and the flight
+    dumps claim the batch-scope spans for each of them."""
+    return tuple(sorted({r.trace_id for r in batch if r.trace_id}))
 
 
 class QueueFull(RuntimeError):
@@ -106,6 +117,12 @@ class Request:
     # separately and small requests never share a batch with (or wait
     # inside) a sharded dispatch.
     sharded: bool = False
+    # Request correlation (obs.context): the trace context bound on the
+    # submitting thread, carried so worker-side records (serve.request,
+    # batch trace_ids args, anomaly dumps) stitch into the caller's
+    # cross-process trace. Empty outside any request scope.
+    trace_id: str = ""
+    span_id: str = ""
 
 
 def _mask_valid(imgs, valid_h, valid_w):
@@ -536,12 +553,15 @@ class StencilServer:
             raise ValueError(f"deadline_s must be >= 0, got {deadline_s}")
         fut: concurrent.futures.Future = concurrent.futures.Future()
         now = time.perf_counter()
+        ctx = _obs_ctx.current()
         req = Request(
             req_id=next(self._ids), image=image, reps=int(reps),
             filter_name=fname, key=key, bucket_hw=bucket_hw, future=fut,
             t_submit=now,
             t_deadline=(now + deadline_s) if deadline_s else None,
             sharded=sharded,
+            trace_id=ctx.trace_id if ctx is not None else "",
+            span_id=ctx.span_id if ctx is not None else "",
         )
         with _obs_span("serve.enqueue", "serve", req_id=req.req_id):
             with self._cond:
@@ -744,7 +764,8 @@ class StencilServer:
         closure's state: (batch, out_dev, meta, t_start)."""
         with _obs_span("serve.execute", "serve", batch=len(batch),
                        reps=batch[0].reps,
-                       sharded=batch[0].sharded):
+                       sharded=batch[0].sharded,
+                       trace_ids=_batch_trace_ids(batch)):
             if batch[0].sharded:
                 return self._dispatch_sharded(batch)
             return self._dispatch_inner(batch)
@@ -895,7 +916,8 @@ class StencilServer:
     def _retire(self, batch, out_dev, meta, t0) -> None:
         """Block on one in-flight batch, crop per-request outputs, resolve
         futures, record latency + achieved-bandwidth metrics."""
-        with _obs_span("serve.drain", "serve", batch=len(batch)):
+        with _obs_span("serve.drain", "serve", batch=len(batch),
+                       trace_ids=_batch_trace_ids(batch)):
             if isinstance(meta, dict) and meta.get("sharded"):
                 self._retire_sharded(batch, out_dev, meta, t0)
             else:
@@ -921,6 +943,7 @@ class StencilServer:
             if self._fault_corrupt_result is not None and _checksum.fired(
                     self._fault_corrupt_result, r.req_id):
                 res = _checksum.corrupt_array(res)
+            self._record_request_span(r, t1)
             if not r.future.done() and _resolve(r.future, res):
                 self._m_completed.inc()
                 self._m_rlat.observe(t1 - r.t_submit)
@@ -961,6 +984,7 @@ class StencilServer:
             if self._fault_corrupt_result is not None and _checksum.fired(
                     self._fault_corrupt_result, r.req_id):
                 res = _checksum.corrupt_array(res)
+            self._record_request_span(r, t1)
             # A client may have cancelled its (still-pending) future; the
             # result is simply dropped — one cancellation must never
             # poison its batch-mates' results.
@@ -973,6 +997,21 @@ class StencilServer:
         # stretch the batch-mates' latency tail.
         for r, res in witness_queue:
             self._witness_one(r, res)
+
+    def _record_request_span(self, r: Request, t1: float) -> None:
+        """File the per-request ``serve.request`` record (submit →
+        retire) with the request's OWN trace id — the worker thread has
+        no bound context and a batch mixes traces, so the batch-scope
+        spans cannot carry this. Recorded BEFORE the future resolves:
+        a handler woken by the result may immediately dump the trace,
+        and the record must already be in the ring. No-op when no span
+        sink is installed (the disabled hot path)."""
+        if r.trace_id and _obs_tracing.sinks_active():
+            _obs_tracing.emit_span(
+                "serve.request", "serve", r.t_submit, t1,
+                trace_id=r.trace_id, span_id=r.span_id,
+                req_id=r.req_id, reps=r.reps,
+            )
 
     def _witness_one(self, r: Request, got: np.ndarray) -> None:
         """Re-execute one sampled request through the eager measured-
@@ -998,6 +1037,13 @@ class StencilServer:
         self._m_witness_total.inc()
         if not ok:
             self._m_witness_bad.inc()
+            # The black-box record of a silent-corruption catch: dump
+            # the request's spans + emit the structured event (no-op
+            # spool-wise unless a recorder is installed).
+            _obs_flight.trigger(
+                "witness_mismatch", trace_id=r.trace_id, tier="serve",
+                req_id=r.req_id, reps=r.reps,
+            )
         cb = self.on_witness
         if cb is not None:
             try:
@@ -1057,11 +1103,16 @@ class StencilServer:
                 # Typed, outside the lock: an expired request fails
                 # instead of occupying a batch slot.
                 self._m_deadline.inc()
+                waited = time.perf_counter() - r.t_submit
+                _obs_flight.trigger(
+                    "deadline_exceeded", trace_id=r.trace_id,
+                    tier="serve", duration_s=waited, req_id=r.req_id,
+                )
                 if not r.future.done() and _resolve(
                     r.future,
                     exc=DeadlineExceeded(
                         f"request {r.req_id} expired after waiting "
-                        f"{time.perf_counter() - r.t_submit:.3f}s"
+                        f"{waited:.3f}s"
                     ),
                 ):
                     self._m_failed.inc()

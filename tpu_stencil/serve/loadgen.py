@@ -34,6 +34,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from tpu_stencil.integrity import checksum as _checksum
+from tpu_stencil.obs import context as _obs_ctx
 from tpu_stencil.serve.engine import (
     QueueFull,
     ServerClosed,
@@ -125,10 +126,16 @@ class HttpTarget:
         h, w = image.shape[:2]
         channels = image.shape[2] if image.ndim == 3 else 1
         payload = image.tobytes()
+        # The CLIENT is the outermost tracing edge here: the bound
+        # context (loadgen's per-request mint, or an embedder's) rides
+        # the wire, so every hop of this request — and its flight-
+        # recorder dump, if an anomaly fires — greps to one id.
+        ctx = _obs_ctx.current() or _obs_ctx.fresh()
         headers = {
             "X-Width": str(w), "X-Height": str(h),
             "X-Reps": str(reps), "X-Channels": str(channels),
             "Content-Type": "application/octet-stream",
+            **_obs_ctx.headers_for(ctx),
         }
         if self.verify is not None:
             headers[_checksum.CRC_HEADER] = str(_checksum.crc32c(payload))
@@ -194,8 +201,15 @@ class HttpTarget:
         so :class:`QueueFull` surfaces from ``future.result()`` — the
         open loop treats both spellings as a shed."""
         img = np.array(image, copy=True)  # same buffer-reuse contract
-        return self._pool.submit(self._post, img, reps, filter_name,
-                                 deadline_s)
+        # Contextvars do not cross into the pool thread: capture the
+        # caller's trace context here and re-bind it around the POST.
+        ctx = _obs_ctx.current()
+
+        def task() -> np.ndarray:
+            with _obs_ctx.bind(ctx):
+                return self._post(img, reps, filter_name, deadline_s)
+
+        return self._pool.submit(task)
 
     def submit_retrying(self, image: np.ndarray, reps: int,
                         filter_name: Optional[str] = None,
@@ -211,14 +225,17 @@ class HttpTarget:
         from tpu_stencil.resilience import retry as _retry
 
         img = np.array(image, copy=True)
+        ctx = _obs_ctx.current()  # re-bound on the pool thread
 
         def task() -> np.ndarray:
-            return _retry.reoffer_call(
-                lambda: self._post(img, reps, filter_name, deadline_s),
-                policy=policy, give_up_after_s=give_up_after_s,
-                base_delay=0.005, max_delay=0.1,
-                label="net.submit",
-            )
+            with _obs_ctx.bind(ctx):
+                return _retry.reoffer_call(
+                    lambda: self._post(img, reps, filter_name,
+                                       deadline_s),
+                    policy=policy, give_up_after_s=give_up_after_s,
+                    base_delay=0.005, max_delay=0.1,
+                    label="net.submit",
+                )
 
         return self._pool.submit(task)
 
@@ -264,6 +281,7 @@ def run(
     rate_fps: Optional[float] = None,
     verify: Optional[str] = None,
     verify_filter: str = "gaussian",
+    per_request: bool = False,
 ) -> Dict:
     """Drive ``server`` with synthetic load; return the report dict.
 
@@ -285,6 +303,15 @@ def run(
     count ``verify_failures_total`` in the report; closed loops fail
     fast on the first one (zero tolerance), open loops count and keep
     offering.
+
+    Every request is minted its own ``X-Trace-Id``
+    (:mod:`tpu_stencil.obs.context` — loadgen is the outermost tracing
+    edge), so the report names the SLOWEST request's trace id
+    (``slowest_trace_id`` / ``slowest_latency_s``): a p99 straggler
+    greps straight to its ``/debug/trace`` tree and flight-recorder
+    dump. ``per_request=True`` additionally returns one
+    ``{i, trace_id, latency_s, ok}`` record per completed request
+    (the ``--per-request`` CLI table).
 
     ``rate_fps``: the open-loop fixed-frame-rate mode (``--rate-fps``)
     — one frame is *due* every ``1/rate_fps`` seconds regardless of
@@ -317,6 +344,17 @@ def run(
     images = synth_requests(requests, shapes, channels, seed)
     completed = 0
     completed_lock = threading.Lock()
+    # Per-request trace records ({i, trace_id, latency_s, ok}), always
+    # collected (bounded by `requests`): the report names the slowest
+    # trace even when the caller skipped the per-request table.
+    records: List[Dict] = []
+    records_lock = threading.Lock()
+
+    def _record(i: int, trace_id: str, latency_s: float,
+                ok: bool) -> None:
+        with records_lock:
+            records.append({"i": i, "trace_id": trace_id,
+                            "latency_s": latency_s, "ok": ok})
     verify0 = _verify_failure_counter().value
     goldens: Dict[int, Optional[np.ndarray]] = {}
     goldens_lock = threading.Lock()
@@ -360,13 +398,19 @@ def run(
                     # jitter, but never past the run deadline — a wedged
                     # server must not spin these workers forever while
                     # run() returns a plausible-looking partial report.
-                    fut = server.submit_retrying(
-                        images[i], reps,
-                        give_up_after_s=max(
-                            0.001, t_start + timeout - time.perf_counter()
-                        ),
-                    )
+                    ctx = _obs_ctx.fresh()
+                    t_req = time.perf_counter()
+                    with _obs_ctx.bind(ctx):
+                        fut = server.submit_retrying(
+                            images[i], reps,
+                            give_up_after_s=max(
+                                0.001,
+                                t_start + timeout - time.perf_counter()
+                            ),
+                        )
                     got = fut.result(timeout=timeout)
+                    _record(i, ctx.trace_id,
+                            time.perf_counter() - t_req, True)
                     if not _check_golden(i, got):
                         # Zero tolerance in the closed loop: one wrong
                         # result fails the run typed.
@@ -406,7 +450,21 @@ def run(
                 # The request index rides with the future: a shed
                 # submission must not shift later results onto the
                 # wrong golden.
-                futures.append((i, server.submit(images[i], reps)))
+                ctx = _obs_ctx.fresh()
+                t_req = time.perf_counter()
+                with _obs_ctx.bind(ctx):
+                    f = server.submit(images[i], reps)
+                f.add_done_callback(
+                    # Completion time captured AT completion (the
+                    # drain loop below reads results in submission
+                    # order, so its clock would inflate latencies).
+                    lambda fut, i=i, c=ctx, t=t_req: _record(
+                        i, c.trace_id, time.perf_counter() - t,
+                        fut.cancelled() is False
+                        and fut.exception() is None,
+                    )
+                )
+                futures.append((i, f))
             except QueueFull:
                 pass  # counted by the server; open loops shed, not wait
         offer_wall = time.perf_counter() - t_start
@@ -454,6 +512,17 @@ def run(
         ).value - honored0,
         "stats": stats,
     }
+    with records_lock:
+        done_recs = sorted(records, key=lambda r: r["i"])
+    ok_recs = [r for r in done_recs if r["ok"]] or done_recs
+    if ok_recs:
+        # Name the straggler: its trace id greps straight to the
+        # /debug/trace tree and any flight-recorder dump it triggered.
+        slowest = max(ok_recs, key=lambda r: r["latency_s"])
+        report["slowest_trace_id"] = slowest["trace_id"]
+        report["slowest_latency_s"] = slowest["latency_s"]
+    if per_request:
+        report["per_request"] = done_recs
     if verify is not None:
         report["verify"] = verify
         report["verify_failures_total"] = (

@@ -203,6 +203,16 @@ def build_parser() -> argparse.ArgumentParser:
              "histograms, stream_inflight_depth gauge) as "
              "Prometheus-style text to PATH ('-' = stdout)",
     )
+    p.add_argument(
+        "--flightrec-dir", dest="flightrec_dir", default=None,
+        metavar="DIR",
+        help="install the always-on flight recorder with this anomaly-"
+             "dump spool: witness mismatches and torn-staging checksum "
+             "failures dump the frame's spans (trace id analog "
+             "frame-<i>) as capped JSON files; "
+             "TPU_STENCIL_FLIGHTREC_DIR overrides "
+             "(docs/OBSERVABILITY.md)",
+    )
     return p
 
 
@@ -265,6 +275,10 @@ def main(argv=None) -> int:
         from tpu_stencil import obs
 
         obs.enable()
+    if ns.flightrec_dir:
+        from tpu_stencil.obs import flight as _flight
+
+        _flight.install(spool_dir=ns.flightrec_dir)
     try:
         from tpu_stencil.stream.engine import StreamFailure, run_stream
 

@@ -64,6 +64,9 @@ import numpy as np
 
 from tpu_stencil import obs
 from tpu_stencil.config import StreamConfig
+from tpu_stencil.obs import context as _obs_ctx
+from tpu_stencil.obs import flight as _obs_flight
+from tpu_stencil.obs import tracing as _obs_tracing
 from tpu_stencil.integrity import checksum as _checksum
 from tpu_stencil.integrity import witness as _witness_mod
 from tpu_stencil.resilience import deadline as _deadline
@@ -236,7 +239,8 @@ class _Pipeline(_StageControl):
 
 
 class _StageSpan:
-    __slots__ = ("_pl", "name", "frame_index", "_span", "_t0", "_attrs")
+    __slots__ = ("_pl", "name", "frame_index", "_span", "_t0", "_attrs",
+                 "_ctx_token")
 
     def __init__(self, pl: "_StageControl", name: str, frame_index: int,
                  t0: float = None, **attrs):
@@ -245,6 +249,15 @@ class _StageSpan:
         self._attrs = attrs
 
     def __enter__(self):
+        # The frame index is the stream's trace-id analog: binding
+        # ``frame-<i>`` for the span's duration stamps the record, so
+        # /debug-style lookups and flight dumps correlate a frame's
+        # read/h2d/compute/d2h/write exactly like a request's hops.
+        # Only when a span sink is live — the disabled path stays free.
+        self._ctx_token = (
+            _obs_ctx.push(_obs_ctx.frame_context(self.frame_index))
+            if _obs_tracing.sinks_active() else None
+        )
         self._span = obs.span(
             f"stream.{self.name}", "stream", frame=self.frame_index,
             **self._attrs
@@ -260,6 +273,8 @@ class _StageSpan:
     def __exit__(self, *exc) -> None:
         dt = time.perf_counter() - self._t0
         self._span.__exit__(*exc)
+        if self._ctx_token is not None:
+            _obs_ctx.pop(self._ctx_token)
         with self._pl._stage_lock:
             self._pl.stage_seconds[self.name] += dt
         obs.registry().histogram(
@@ -337,6 +352,9 @@ def _verify_staged(buf: np.ndarray, crc, idx: int) -> None:
         _checksum.verify(buf, crc, f"stream staging ring (frame {idx})")
     except _checksum.ChecksumMismatch:
         obs.registry().counter("integrity_ingest_failures_total").inc()
+        _obs_flight.trigger("checksum_mismatch",
+                            trace_id=f"frame-{idx}", tier="stream",
+                            frame=idx)
         raise
     obs.registry().counter("integrity_ingest_verified_total").inc()
 
@@ -355,6 +373,9 @@ def _witness_frame(cfg: StreamConfig, idx: int, wit_buf: np.ndarray,
     obs.registry().counter("integrity_witness_total").inc()
     if not np.array_equal(want, np.asarray(arr)):
         obs.registry().counter("integrity_witness_mismatch_total").inc()
+        _obs_flight.trigger("witness_mismatch",
+                            trace_id=f"frame-{idx}", tier="stream",
+                            frame=idx, reps=cfg.repetitions)
         raise _checksum.WitnessMismatch(
             f"stream frame {idx}",
             "frame withheld from the sink (two measured-equivalent "
