@@ -112,6 +112,7 @@ import json
 import os
 import subprocess
 import sys
+import threading
 import time
 
 import numpy as np
@@ -689,7 +690,7 @@ def _measure_serve_meshfan(platform: str) -> dict:
     }
 
 
-def _measure_net(platform: str) -> dict:
+def _measure_net(platform: str) -> list:
     """Network-tier capture (``TPU_STENCIL_BENCH_NET=1``): the whole
     HTTP edge measured end to end — frontend + router + replica fleet
     started in process on an ephemeral port, north-star frames POSTed
@@ -699,9 +700,24 @@ def _measure_net(platform: str) -> dict:
     (concurrency 4 by default — enough to exercise least-outstanding
     placement without turning the number into a queueing benchmark).
 
+    Returns a LIST of capture lines, the ``_net_wall_per_request``
+    headline LAST (the last-line-is-most-complete stdout contract):
+    the tail-latency SLO series ``_net_p50_ms`` / ``_net_p99_ms``
+    (client-observed per-request latency over the headline window —
+    each its own sentry series, gated from its first two captures),
+    then the headline carrying the integrity-overhead rider and the
+    coalesce-on-vs-off A/B rider (``coalesce_speedup`` /
+    ``coalesce_wins`` — the never-enable-a-loss evidence for the
+    ``--coalesce-window-us`` knob; the headline itself stays at the
+    production default, coalescing off, so the series is continuous
+    with prior rounds).
+
     Knobs: ``TPU_STENCIL_BENCH_NET_REQUESTS`` (default 8),
     ``TPU_STENCIL_BENCH_NET_REPLICAS`` (default min(2, devices)),
-    ``TPU_STENCIL_BENCH_NET_CONCURRENCY`` (default 4)."""
+    ``TPU_STENCIL_BENCH_NET_CONCURRENCY`` (default 4; raise it — the
+    concurrency sweep — to exercise the coalescing window),
+    ``TPU_STENCIL_BENCH_NET_COALESCE_US`` (default 2000, the A/B arm's
+    window)."""
     import concurrent.futures
     import urllib.request
 
@@ -717,27 +733,36 @@ def _measure_net(platform: str) -> dict:
         or min(2, n_dev)
     n_req = int(os.environ.get("TPU_STENCIL_BENCH_NET_REQUESTS", "8"))
     conc = int(os.environ.get("TPU_STENCIL_BENCH_NET_CONCURRENCY", "4"))
+    co_us = float(os.environ.get("TPU_STENCIL_BENCH_NET_COALESCE_US",
+                                 "2000"))
     rng = np.random.default_rng(0)
     img = rng.integers(0, 256, size=(H, W, C), dtype=np.uint8)
     body = img.tobytes()
     body_crc = str(_crc.crc32c(body))
     verify_failures = [0]
 
-    def measure_window(fe, send_crc: bool) -> float:
-        """One warmed timed window against ``fe``; with ``send_crc``
-        the client stamps X-Content-Crc32c and checks the response's
-        X-Result-Crc32c — the zero-tolerance verify rider."""
+    def measure_window(fe, send_crc: bool):
+        """One warmed timed window against ``fe``; returns (wall,
+        per-request latencies). With ``send_crc`` the client stamps
+        X-Content-Crc32c and checks the response's X-Result-Crc32c —
+        the zero-tolerance verify rider."""
+        lats = []
+        lats_lock = threading.Lock()
+
         def post():
             headers = {"X-Content-Crc32c": body_crc} if send_crc else {}
             req = urllib.request.Request(
                 fe.url + f"/v1/blur?w={W}&h={H}&reps={REPS}&channels={C}",
                 data=body, headers=headers, method="POST",
             )
+            t_req = time.perf_counter()
             with urllib.request.urlopen(req, timeout=CHILD_TIMEOUT) as r:
                 data = r.read()
                 if send_crc and not _crc.stamp_matches(
                         r.headers.get("X-Result-Crc32c"), data):
                     verify_failures[0] += 1
+            with lats_lock:
+                lats.append(time.perf_counter() - t_req)
 
         # Warm every replica DETERMINISTICALLY before the timed window:
         # one routed request seeds the fleet's warm-key dedup (so the
@@ -749,11 +774,12 @@ def _measure_net(platform: str) -> dict:
         post()
         for rep in fe.fleet.replicas:
             rep.submit(img, REPS).result(timeout=CHILD_TIMEOUT)
+        lats.clear()
         t0 = time.perf_counter()
         with concurrent.futures.ThreadPoolExecutor(conc) as pool:
             for f in [pool.submit(post) for _ in range(n_req)]:
                 f.result(timeout=CHILD_TIMEOUT)
-        return time.perf_counter() - t0
+        return time.perf_counter() - t0, sorted(lats)
 
     # The headline window runs the PRODUCTION config (integrity on,
     # default witness rate) with the client verifying every response.
@@ -763,7 +789,11 @@ def _measure_net(platform: str) -> dict:
         # Best-of-2 windows per arm: the A/B subtracts two small
         # numbers, so per-window scheduler noise would otherwise
         # dominate the overhead rider.
-        wall = min(measure_window(fe, send_crc=True) for _ in range(2))
+        (wall, lats), (wall2, lats2) = (
+            measure_window(fe, send_crc=True) for _ in range(2)
+        )
+        if wall2 < wall:
+            wall, lats = wall2, lats2
         snap = fe.metrics_snapshot()
     finally:
         fe.close()
@@ -776,28 +806,67 @@ def _measure_net(platform: str) -> dict:
                                    max_queue=max(16, n_req),
                                    integrity=False)).start()
     try:
-        wall_off = min(measure_window(fe_off, send_crc=False)
+        wall_off = min(measure_window(fe_off, send_crc=False)[0]
                        for _ in range(2))
     finally:
         fe_off.close()
+    # The coalesce A/B arm: the SAME production config plus the window.
+    # Measured, never assumed — the knob ships default-off and DEPLOY.md
+    # points operators at this rider before enabling it.
+    fe_co = NetFrontend(NetConfig(port=0, replicas=n_rep,
+                                  max_queue=max(16, n_req),
+                                  coalesce_window_us=co_us)).start()
+    try:
+        wall_co = min(measure_window(fe_co, send_crc=True)[0]
+                      for _ in range(2))
+        snap_co = fe_co.metrics_snapshot()
+    finally:
+        fe_co.close()
     per_req = wall / max(1, n_req)
     per_req_off = wall_off / max(1, n_req)
+    per_req_co = wall_co / max(1, n_req)
     overhead = (per_req - per_req_off) / per_req_off if per_req_off > 0 \
         else 0.0
+    co_speedup = per_req / per_req_co if per_req_co > 0 else 0.0
+    p50 = lats[len(lats) // 2] if lats else 0.0
+    p99 = lats[min(len(lats) - 1,
+                   int(round(0.99 * (len(lats) - 1))))] if lats else 0.0
     log(f"net x{n_rep} replicas: {per_req * 1e3:.1f} ms/request "
         f"({n_req} requests over HTTP, concurrency {conc}; "
+        f"p50 {p50 * 1e3:.1f} ms p99 {p99 * 1e3:.1f} ms; "
         f"integrity overhead {overhead * 100:+.1f}% vs off, bar <=3%; "
-        f"verify failures {verify_failures[0]})")
-    return {
-        "metric": f"{W}x{H}_rgb_{REPS}reps_net_wall_per_request",
-        "value": round(per_req, 6),
-        "unit": "s",
-        "vs_baseline": round(BASELINE_S / per_req, 2),
+        f"coalesce@{co_us:g}us {co_speedup:.2f}x "
+        f"({'wins' if co_speedup > 1 else 'loses'}, "
+        f"{snap_co['counters'].get('coalesced_batches_total', 0)} "
+        f"coalesced batches); verify failures {verify_failures[0]})")
+    common = {
         "backend": "net",
         "platform": platform,
         "replicas": n_rep,
         "requests": n_req,
         "concurrency": conc,
+        "shape": f"{W}x{H}",
+        "reps": REPS,
+        "filter": "gaussian",
+        "dtype": "uint8",
+        "schema_version": 1,
+    }
+    lines = []
+    # Tail-latency SLO series (client-observed): their own sentry
+    # series, so a p99 regression gates even when throughput holds.
+    for name, val in (("p50", p50), ("p99", p99)):
+        lines.append({
+            "metric": f"{W}x{H}_rgb_{REPS}reps_net_{name}_ms",
+            "value": round(val * 1e3, 4),
+            "unit": "ms",
+            "ts": round(time.monotonic(), 6),
+            **common,
+        })
+    lines.append({
+        "metric": f"{W}x{H}_rgb_{REPS}reps_net_wall_per_request",
+        "value": round(per_req, 6),
+        "unit": "s",
+        "vs_baseline": round(BASELINE_S / per_req, 2),
         "requests_per_second": round(n_req / wall, 3) if wall > 0 else 0.0,
         "responses_2xx_total": snap["counters"].get(
             "responses_2xx_total", 0
@@ -810,13 +879,22 @@ def _measure_net(platform: str) -> dict:
         "integrity_overhead": round(overhead, 4),
         "integrity_overhead_bar": 0.03,
         "integrity_overhead_ok": bool(overhead <= 0.03),
-        "shape": f"{W}x{H}",
-        "reps": REPS,
-        "filter": "gaussian",
-        "dtype": "uint8",
-        "schema_version": 1,
+        # Coalesce A/B rider (the never-enable-a-loss discipline): the
+        # same window re-measured with --coalesce-window-us armed.
+        "coalesce_window_us": co_us,
+        "coalesce_per_request": round(per_req_co, 6),
+        "coalesce_speedup": round(co_speedup, 4),
+        "coalesce_wins": bool(co_speedup > 1.0),
+        "coalesced_batches_total": snap_co["counters"].get(
+            "coalesced_batches_total", 0
+        ),
+        "coalesced_requests_total": snap_co["counters"].get(
+            "coalesced_requests_total", 0
+        ),
         "ts": round(time.monotonic(), 6),
-    }
+        **common,
+    })
+    return lines
 
 
 def _spawn_fed_member(platform: str, timeout_s: float = 120.0):
@@ -1126,11 +1204,14 @@ def child_main() -> int:
 
     if os.environ.get("TPU_STENCIL_BENCH_NET") == "1":
         try:
-            result = _measure_net(platform)
+            lines = _measure_net(platform)
         except Exception as e:
             log(f"net: FAILED {type(e).__name__}: {e}")
             return 1
-        print(json.dumps(result), flush=True)
+        # p50/p99 SLO series first, the wall_per_request headline LAST
+        # (the stdout contract: last line = most complete capture).
+        for line in lines:
+            print(json.dumps(line), flush=True)
         return 0
 
     if int(os.environ.get("TPU_STENCIL_BENCH_FED") or 0) > 0:
@@ -1489,10 +1570,12 @@ def main() -> int:
             _is_capture(line) for line in forwarded
         )
         if rc == 0 and lines:
-            if os.environ.get("TPU_STENCIL_BENCH_SCHEDULE"):
-                # Per-schedule headline mode: every line is its own
-                # sentry series — gate each independently, worst verdict
-                # wins the exit code.
+            if (os.environ.get("TPU_STENCIL_BENCH_SCHEDULE")
+                    or os.environ.get("TPU_STENCIL_BENCH_NET") == "1"):
+                # Multi-series modes (per-schedule headlines; the net
+                # capture's p50/p99 SLO lines + headline): every line is
+                # its own sentry series — gate each independently, worst
+                # verdict wins the exit code.
                 rcs = [_sentry_gate(l) for l in lines if _is_capture(l)]
                 return max(rcs) if rcs else 0
             final = _rows_roll_probe(lines[-1])

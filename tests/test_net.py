@@ -919,6 +919,400 @@ def test_cli_sigterm_graceful_drain_subprocess(rng):
         proc.stderr.close()
 
 
+# -- continuous batching at the edge + zero-copy ingest (ISSUE 14) ----
+
+
+@pytest.fixture(scope="module")
+def cfe():
+    """A coalescing tier: a 10s window that in practice never expires —
+    groups dispatch deterministically when FULL (max_batch=4) or when a
+    member's deadline falls inside the window, so these tests are
+    timing-flake-free: K = n*max_batch concurrent posts form exactly n
+    groups."""
+    frontend = _make_frontend(max_batch=4,
+                              coalesce_window_us=10_000_000.0)
+    yield frontend
+    frontend.close()
+
+
+def _post_many(url, imgs, reps, extra_headers=None, timeout_s=None):
+    """POST all frames concurrently; returns [(status, body, headers)]
+    in imgs order."""
+    results = [None] * len(imgs)
+
+    def work(i):
+        h, w = imgs[i].shape[:2]
+        channels = imgs[i].shape[2] if imgs[i].ndim == 3 else 1
+        headers = {"X-Width": str(w), "X-Height": str(h),
+                   "X-Reps": str(reps), "X-Channels": str(channels)}
+        if timeout_s is not None:
+            headers["X-Request-Timeout"] = repr(timeout_s)
+        if extra_headers:
+            headers.update(extra_headers[i])
+        req = urllib.request.Request(url + "/v1/blur",
+                                     data=imgs[i].tobytes(),
+                                     headers=headers, method="POST")
+        try:
+            with urllib.request.urlopen(req, timeout=300) as r:
+                results[i] = (r.status, r.read(), dict(r.headers))
+        except urllib.error.HTTPError as e:
+            results[i] = (e.code, e.read(), dict(e.headers))
+
+    threads = [threading.Thread(target=work, args=(i,))
+               for i in range(len(imgs))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=300)
+    return results
+
+
+def test_netconfig_coalesce_validation():
+    with pytest.raises(ValueError, match="coalesce_window_us"):
+        NetConfig(coalesce_window_us=-1.0)
+    assert NetConfig(coalesce_window_us=250.0).coalesce_window_s == \
+        pytest.approx(250e-6)
+    # The LIBRARY default is OFF: embedders (and every pre-existing
+    # test) keep one-request-one-launch unless they opt in; the net CLI
+    # is where the production default lives.
+    assert NetConfig().coalesce_window_us == 0.0
+    assert NetConfig().ingest_arena is True
+
+
+def test_net_cli_coalesce_flags():
+    from tpu_stencil.net import cli as net_cli
+
+    ns = net_cli.build_parser().parse_args([])
+    assert ns.coalesce_window_us == 300.0  # production default: armed
+    assert ns.ingest_arena is True
+    ns = net_cli.build_parser().parse_args(
+        ["--coalesce-window-us", "0", "--no-ingest-arena"]
+    )
+    assert ns.coalesce_window_us == 0.0
+    assert ns.ingest_arena is False
+
+
+def test_coalesced_group_bit_exact_fuzz(cfe, rng):
+    """K concurrent same-bucket requests with DISTINCT payloads through
+    a coalescing fleet: every response byte-identical to its solo
+    golden (grey/RGB x reps, zero-reps identity included), and the
+    /metrics counters prove the stacking (batches < requests)."""
+    for shape, reps in (((20, 30, 3), 3), ((17, 23), 2),
+                        ((20, 30, 3), 0)):
+        imgs = [rng.integers(0, 256, shape, dtype=np.uint8)
+                for _ in range(4)]
+        c0 = cfe.metrics_snapshot()["counters"]
+        results = _post_many(cfe.url, imgs, reps)
+        for img, (status, body, headers) in zip(imgs, results):
+            assert status == 200, body
+            np.testing.assert_array_equal(
+                np.frombuffer(body, np.uint8).reshape(img.shape),
+                _golden(img, reps),
+            )
+            assert int(headers["X-Replica"]) >= 0
+        c1 = cfe.metrics_snapshot()["counters"]
+        assert (c1["coalesced_requests_total"]
+                - c0.get("coalesced_requests_total", 0)) == 4
+        # One full group -> ONE stacked submit (deterministic: a group
+        # leaves the forming table only when full here).
+        assert (c1["coalesced_batches_total"]
+                - c0.get("coalesced_batches_total", 0)) == 1
+
+
+def test_coalesced_two_groups_race_across_replicas(cfe, rng):
+    """2 x max_batch concurrent same-key requests: two full groups race
+    through admission; whichever replicas they land on, every member is
+    exact and the group count is exactly 2."""
+    imgs = [rng.integers(0, 256, (12, 19, 3), dtype=np.uint8)
+            for _ in range(8)]
+    c0 = cfe.metrics_snapshot()["counters"]
+    results = _post_many(cfe.url, imgs, 2)
+    want = _golden(imgs[0], 2)  # per-image goldens below
+    for img, (status, body, _h) in zip(imgs, results):
+        assert status == 200, body
+        want = _golden(img, 2)
+        np.testing.assert_array_equal(
+            np.frombuffer(body, np.uint8).reshape(img.shape), want
+        )
+    c1 = cfe.metrics_snapshot()["counters"]
+    assert (c1["coalesced_batches_total"]
+            - c0.get("coalesced_batches_total", 0)) == 2
+    assert (c1["coalesced_requests_total"]
+            - c0.get("coalesced_requests_total", 0)) == 8
+
+
+def test_coalesce_deadline_inside_window_dispatches_early(cfe, rng):
+    """A member whose deadline falls inside the (10s) window must NOT
+    be silently stretched: it dispatches its group immediately and
+    completes typed — a 200 well before the window, never a 504 earned
+    by the router's own waiting."""
+    img = rng.integers(0, 256, (16, 16), dtype=np.uint8)
+    t0 = time.perf_counter()
+    status, body, _ = _post(cfe.url, img, 2, timeout_s=2.0)
+    elapsed = time.perf_counter() - t0
+    assert status == 200, body
+    np.testing.assert_array_equal(
+        np.frombuffer(body, np.uint8).reshape(img.shape),
+        _golden(img, 2),
+    )
+    assert elapsed < 8.0, (
+        f"deadline-in-window request waited {elapsed:.1f}s — the "
+        f"window stretched it"
+    )
+
+
+def test_coalesce_trace_id_per_member(cfe, rng):
+    """Group members keep their OWN trace identity: each response
+    echoes the X-Trace-Id its request carried, not a group-mate's."""
+    from tpu_stencil.obs import context as obs_ctx
+
+    imgs = [rng.integers(0, 256, (10, 14, 3), dtype=np.uint8)
+            for _ in range(4)]
+    tids = [obs_ctx.new_trace_id() for _ in imgs]
+    extra = [{obs_ctx.TRACE_HEADER: t, obs_ctx.SPAN_HEADER:
+              obs_ctx.new_span_id()} for t in tids]
+    results = _post_many(cfe.url, imgs, 1, extra_headers=extra)
+    for tid, (status, _body, headers) in zip(tids, results):
+        assert status == 200
+        assert headers[obs_ctx.TRACE_HEADER] == tid
+
+
+def test_coalesced_drain_flushes_forming_groups(rng):
+    """Admitted members of a still-forming group complete during a
+    drain (the accepted-requests-complete contract) instead of waiting
+    out a window nobody will extend."""
+    frontend = _make_frontend(replicas=1, max_batch=8,
+                              coalesce_window_us=30_000_000.0)
+    try:
+        img = rng.integers(0, 256, (8, 8), dtype=np.uint8)
+        result = {}
+
+        def post():
+            result["r"] = _post(frontend.url, img, 1)
+
+        t = threading.Thread(target=post)
+        t.start()
+        # Wait until the member is admitted (bytes reserved) and so
+        # sits in the forming group.
+        deadline = time.perf_counter() + 10
+        while time.perf_counter() < deadline:
+            g = frontend.metrics_snapshot()["gauges"]
+            if g.get("inflight_bytes", {}).get("value", 0) > 0:
+                break
+            time.sleep(0.05)
+        frontend.drain(timeout_s=30)
+        t.join(timeout=60)
+        status, body, _ = result["r"]
+        assert status == 200
+        np.testing.assert_array_equal(
+            np.frombuffer(body, np.uint8).reshape(img.shape),
+            _golden(img, 1),
+        )
+    finally:
+        frontend.close()
+
+
+def test_ingest_arena_reuses_and_never_cross_contaminates(fe, rng):
+    """Sequential + adjacent concurrent same-bucket requests with
+    distinct payloads: every response exact (a recycled staging buffer
+    must never bleed a previous request's pixels) and the arena
+    counters prove steady-state reuse."""
+    c0 = fe.metrics_snapshot()["counters"]
+    for _ in range(3):  # sequential: guaranteed buffer recycling
+        img = rng.integers(0, 256, (21, 29, 3), dtype=np.uint8)
+        status, body, _ = _post(fe.url, img, 2)
+        assert status == 200
+        np.testing.assert_array_equal(
+            np.frombuffer(body, np.uint8).reshape(img.shape),
+            _golden(img, 2),
+        )
+    imgs = [rng.integers(0, 256, (21, 29, 3), dtype=np.uint8)
+            for _ in range(4)]
+    for img, (status, body, _h) in zip(imgs,
+                                       _post_many(fe.url, imgs, 1)):
+        assert status == 200
+        np.testing.assert_array_equal(
+            np.frombuffer(body, np.uint8).reshape(img.shape),
+            _golden(img, 1),
+        )
+    c1 = fe.metrics_snapshot()["counters"]
+    assert (c1["arena_ingest_reuse_total"]
+            - c0.get("arena_ingest_reuse_total", 0)) >= 2
+
+
+def test_ingest_arena_overlong_body_400_on_bucket_exact_frame(fe, rng):
+    """An over-declared body on a BUCKET-EXACT frame (capacity ==
+    expected before the slop fix) must fail 400 exactly like the
+    buffered path — never be silently accepted with the excess left
+    unread on the socket."""
+    img = rng.integers(0, 256, (16, 16), dtype=np.uint8)  # 16 = an edge
+    req = urllib.request.Request(
+        fe.url + "/v1/blur?w=16&h=16&reps=1&channels=1",
+        data=img.tobytes() + b"xx", method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=60) as r:
+            status = r.status
+    except urllib.error.HTTPError as e:
+        status = e.code
+    assert status == 400
+
+
+def test_ingest_arena_off_still_exact(rng):
+    frontend = _make_frontend(replicas=1, ingest_arena=False)
+    try:
+        img = rng.integers(0, 256, (14, 22, 3), dtype=np.uint8)
+        status, body, _ = _post(frontend.url, img, 2)
+        assert status == 200
+        np.testing.assert_array_equal(
+            np.frombuffer(body, np.uint8).reshape(img.shape),
+            _golden(img, 2),
+        )
+        c = frontend.metrics_snapshot()["counters"]
+        assert "arena_ingest_reuse_total" not in c
+    finally:
+        frontend.close()
+
+
+def test_chunked_upload_into_arena_bit_exact(fe, rng):
+    """The chunked path readintos the same staging buffer (no bytes
+    accumulation) — still byte-exact through the de-chunker."""
+    img = rng.integers(0, 256, (33, 21, 3), dtype=np.uint8)
+    payload = img.tobytes()
+
+    def chunks():
+        for i in range(0, len(payload), 997):
+            yield payload[i:i + 997]
+
+    conn = http.client.HTTPConnection("127.0.0.1", fe.port, timeout=300)
+    try:
+        conn.request("POST", "/v1/blur?w=21&h=33&reps=2&channels=3",
+                     body=chunks(), encode_chunked=True,
+                     headers={"Transfer-Encoding": "chunked"})
+        resp = conn.getresponse()
+        body = resp.read()
+        assert resp.status == 200, body
+        np.testing.assert_array_equal(
+            np.frombuffer(body, np.uint8).reshape(img.shape),
+            _golden(img, 2),
+        )
+    finally:
+        conn.close()
+
+
+def test_retry_after_derived_from_queue_state(rng):
+    """The satellite bugfix: Retry-After is computed from the tier's
+    CURRENT coalescing delay + backlog, not a config constant — an idle
+    router answers the floor, a backlogged one a truthful larger
+    wait."""
+    from tpu_stencil.net import router as router_mod
+
+    frontend = _make_frontend(replicas=1,
+                              coalesce_window_us=2_000_000.0)
+    try:
+        r = frontend.router
+        assert r.retry_after_s() >= router_mod.RETRY_AFTER_SHED
+        idle = r.retry_after_s()
+        # Simulate a backlogged tier: slow observed service, deep
+        # outstanding, a fat coalescing delay.
+        for _ in range(8):
+            frontend.registry.histogram(
+                "request_latency_seconds"
+            ).observe(2.0)
+            r._m_coal_delay.observe(1.5)
+        r._outstanding[0] = 64
+        loaded = r.retry_after_s()
+        assert loaded > idle
+        assert loaded <= router_mod.RETRY_AFTER_CAP
+        r._outstanding[0] = 0
+        # queue_full floors at its own constant
+        assert r.retry_after_s(queue_full=True) >= \
+            router_mod.RETRY_AFTER_QUEUE_FULL
+    finally:
+        frontend.close()
+
+
+def test_http_loadgen_burst_coalesces(cfe):
+    """The bursty loadgen satellite drives real cross-request
+    coalescing end to end: bursts of max_batch same-shape requests form
+    full groups; every response is verified and the report carries
+    p50/p99 next to the burst knob."""
+    from tpu_stencil.serve import loadgen
+
+    c0 = cfe.metrics_snapshot()["counters"]
+    target = loadgen.HttpTarget(cfe.url)
+    try:
+        report = loadgen.run(
+            target, mode="open", requests=8, rate=10_000.0, burst=4,
+            reps=1, shapes=((12, 16), (18, 14)), channels=(1, 3),
+            seed=3, timeout=300,
+        )
+    finally:
+        target.close()
+    assert report["completed"] == 8
+    assert report["burst"] == 4
+    assert report["p50_s"] >= 0.0 and report["p99_s"] >= report["p50_s"]
+    c1 = cfe.metrics_snapshot()["counters"]
+    assert (c1["coalesced_requests_total"]
+            - c0.get("coalesced_requests_total", 0)) == 8
+    assert (c1["coalesced_batches_total"]
+            - c0.get("coalesced_batches_total", 0)) == 2
+
+
+def test_coalescing_beats_one_request_per_launch(rng):
+    """The acceptance criterion: under the bursty profile (8 concurrent
+    same-bucket clients, CPU backend, one replica), coalescing beats
+    one-request-per-launch on wall-per-request. The structural half is
+    deterministic — OFF fragments every burst into a first-arrival
+    singleton launch plus a stragglers launch (engine batches > bursts)
+    while ON stacks each burst into exactly ONE launch — and the timing
+    half asserts with a wide margin (measured ~5x on an idle CI box)."""
+    import concurrent.futures
+
+    img = rng.integers(0, 256, (48, 32, 3), dtype=np.uint8)
+
+    def measure(window_us, rounds=4, k=8):
+        frontend = _make_frontend(replicas=1, max_queue=64, max_batch=8,
+                                  coalesce_window_us=window_us)
+        try:
+            def post():
+                status, body, _ = _post(frontend.url, img, 5)
+                assert status == 200, body
+            post()  # warm the batch-1 bucket's compile
+            with concurrent.futures.ThreadPoolExecutor(k) as pool:
+                list(pool.map(lambda _: post(), range(k)))  # warm batch-8
+                # Best-of-2 timed windows: the A/B subtracts small
+                # numbers, so one descheduled window must not decide it.
+                walls = []
+                for _ in range(2):
+                    t0 = time.perf_counter()
+                    for _ in range(rounds):
+                        list(pool.map(lambda _: post(), range(k)))
+                    walls.append(time.perf_counter() - t0)
+            c = frontend.metrics_snapshot()["counters"]
+            return min(walls) / (rounds * k), c["fleet_batches_total"]
+        finally:
+            frontend.close()
+
+    per_req_off, batches_off = measure(0.0)
+    # A fat window is FREE here: every burst is exactly max_batch, so
+    # each group dispatches inline the moment its 8th member joins —
+    # the window only covers slow-delivery spread, it is never waited
+    # out (the warm singleton rides the deadline-free expiry once,
+    # outside the timed rounds).
+    per_req_on, batches_on = measure(100_000.0)
+    # Structural: ON stacked every burst (warm + rounds bursts + the
+    # two warm singles), OFF fragmented them into more launches.
+    assert batches_on < batches_off
+    # Timing: "measurably beats" with a wide flake margin under the
+    # ~5x observed headroom (best-of-2 windows per arm above).
+    assert per_req_off > per_req_on * 1.1, (
+        f"coalescing did not beat one-request-per-launch: "
+        f"off={per_req_off * 1e3:.2f}ms/req on={per_req_on * 1e3:.2f}"
+        f"ms/req (launches {batches_off} vs {batches_on})"
+    )
+
+
 # -- bench rider -------------------------------------------------------
 
 
@@ -942,3 +1336,11 @@ def test_bench_net_capture_subprocess():
     assert cap["value"] > 0
     assert cap["replicas"] >= 1
     assert cap["responses_2xx_total"] >= cap["requests"]
+    # The tail-latency SLO series ride ahead of the headline (last
+    # line stays the most complete capture), and the headline carries
+    # the measured coalesce-on-vs-off A/B rider.
+    mets = {json.loads(l)["metric"] for l in lines}
+    assert any(m.endswith("_net_p50_ms") for m in mets), mets
+    assert any(m.endswith("_net_p99_ms") for m in mets), mets
+    assert "coalesce_speedup" in cap and "coalesce_wins" in cap
+    assert cap["coalesced_requests_total"] >= 1

@@ -491,3 +491,166 @@ def test_serve_overlap_config_plumbed():
         assert srv.stats()["gauges"]["overlap_mode"]["value"] == 1
     finally:
         srv.close(timeout=5)
+
+
+# -- zero-copy arenas + group submit + bursty loadgen (ISSUE 14) -------
+
+
+def _mk_imgs(n, shape=(20, 30, 3), seed=11):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(0, 256, shape, dtype=np.uint8)
+            for _ in range(n)]
+
+
+def test_steady_state_zero_host_canvas_allocations():
+    """The acceptance criterion: past warmup, the request path performs
+    ZERO per-request host canvas allocations — the per-bucket canvas
+    ring absorbs every dispatch (arena_canvas_alloc_total flat,
+    arena_canvas_reuse_total growing)."""
+    cfg = ServeConfig(max_queue=64, max_batch=4, bucket_edges=(8, 16, 32))
+    with StencilServer(cfg) as server:
+        img = _mk_imgs(1)[0]
+        # Warmup: enough sequential dispatches to fill the ring.
+        for _ in range(cfg.pipeline_depth + 2):
+            server.submit(img, 2).result(timeout=300)
+        c0 = server.stats()["counters"]
+        for _ in range(6):
+            server.submit(img, 2).result(timeout=300)
+        c1 = server.stats()["counters"]
+        assert c1["arena_canvas_alloc_total"] == \
+            c0["arena_canvas_alloc_total"], "steady state allocated"
+        assert c1["arena_canvas_reuse_total"] > \
+            c0["arena_canvas_reuse_total"]
+
+
+def test_canvas_arena_reuse_is_bit_exact_across_dirty_buffers():
+    """A recycled (dirty) canvas must never bleed a previous batch's
+    pixels: distinct-payload requests through the same bucket stay
+    byte-identical to their goldens, including short batches whose pad
+    slots held a previous batch's frames."""
+    f = filters.get_filter("gaussian")
+    with StencilServer(ServeConfig(max_queue=64, max_batch=4,
+                                   bucket_edges=(8, 16, 32))) as server:
+        for seed in range(5):
+            imgs = _mk_imgs(3, seed=seed)  # 3 < max_batch: pad slot
+            futs = [server.submit(i, 3) for i in imgs]
+            for img, fut in zip(imgs, futs):
+                want = stencil.reference_stencil_numpy(img, f, 3)
+                np.testing.assert_array_equal(
+                    fut.result(timeout=300), want
+                )
+
+
+def test_submit_owned_skips_copy_and_fires_on_consumed():
+    consumed = []
+    with StencilServer(ServeConfig(max_queue=8,
+                                   bucket_edges=(8, 16, 32))) as server:
+        img = _mk_imgs(1)[0]
+        fut = server.submit(img, 1, owned=True,
+                            on_consumed=lambda: consumed.append(True))
+        out = fut.result(timeout=300)
+        assert consumed == [True]
+        f = filters.get_filter("gaussian")
+        np.testing.assert_array_equal(
+            out, stencil.reference_stencil_numpy(img, f, 1)
+        )
+        # Unowned + hook: the copy frees the buffer immediately.
+        consumed.clear()
+        fut = server.submit(img, 1,
+                            on_consumed=lambda: consumed.append(True))
+        assert consumed == [True]  # fired synchronously at submit
+        fut.result(timeout=300)
+
+
+def test_submit_group_one_stacked_batch_bit_exact():
+    """A coalesced group enters atomically and rides ONE dispatch: one
+    batches_total increment for K members, each future exact."""
+    import concurrent.futures
+    import time as _time
+
+    from tpu_stencil.serve.engine import GroupItem
+
+    f = filters.get_filter("gaussian")
+    with StencilServer(ServeConfig(max_queue=16, max_batch=4,
+                                   bucket_edges=(8, 16, 32))) as server:
+        # Warm the key so the timed group cannot straddle a compile.
+        warm = _mk_imgs(1)[0]
+        server.submit(warm, 2).result(timeout=300)
+        b0 = server.stats()["counters"]["batches_total"]
+        imgs = _mk_imgs(3, seed=7)
+        now = _time.perf_counter()
+        items = [GroupItem(image=i, future=concurrent.futures.Future(),
+                           t_submit=now) for i in imgs]
+        server.submit_group(items, 2)
+        for img, it in zip(imgs, items):
+            want = stencil.reference_stencil_numpy(img, f, 2)
+            np.testing.assert_array_equal(
+                it.future.result(timeout=300), want
+            )
+        assert server.stats()["counters"]["batches_total"] == b0 + 1
+
+
+def test_submit_group_all_or_nothing_backpressure():
+    import concurrent.futures
+    import time as _time
+
+    from tpu_stencil.serve.engine import GroupItem
+
+    server = StencilServer(ServeConfig(max_queue=2, max_batch=4,
+                                       bucket_edges=(8, 16, 32)),
+                           start=False)
+    try:
+        imgs = _mk_imgs(3)
+        now = _time.perf_counter()
+        items = [GroupItem(image=i, future=concurrent.futures.Future(),
+                           t_submit=now) for i in imgs]
+        with pytest.raises(QueueFull):
+            server.submit_group(items, 1)
+        # NO member entered: the parked queue is still empty.
+        assert server.stats()["gauges"]["queue_depth"]["value"] == 0
+        assert all(not it.future.done() for it in items)
+    finally:
+        server.close(timeout=5)
+
+
+def test_loadgen_burst_mode_report_and_validation():
+    with StencilServer(ServeConfig(max_queue=64, max_batch=8,
+                                   bucket_edges=(8, 16, 32))) as server:
+        report = loadgen.run(
+            server, mode="open", requests=12, rate=10_000.0, burst=4,
+            reps=1, shapes=((16, 12), (20, 18)), channels=(1, 3),
+            seed=5, timeout=300,
+        )
+        assert report["burst"] == 4
+        assert report["completed"] == 12
+        assert report["p99_s"] >= report["p50_s"] >= 0.0
+        with pytest.raises(ValueError, match="burst"):
+            loadgen.run(server, mode="open", requests=2, burst=0)
+        with pytest.raises(ValueError, match="open-loop"):
+            loadgen.run(server, mode="closed", requests=2, burst=2)
+
+
+def test_loadgen_burst_ticks_share_shapes():
+    # The same-shape-per-tick guarantee that makes bursts coalescible.
+    imgs = loadgen.synth_requests(8, ((16, 12), (20, 18)), (1, 3),
+                                  seed=0, group=4)
+    assert all(i.shape == (16, 12) for i in imgs[:4])
+    assert all(i.shape == (20, 18, 3) for i in imgs[4:])
+    # Distinct payloads within a tick (coalesced members must differ).
+    assert not np.array_equal(imgs[0], imgs[1])
+    # group=1 keeps the classic per-request cycling bit-for-bit.
+    a = loadgen.synth_requests(6, ((16, 12), (20, 18)), (1, 3), seed=0)
+    b = loadgen.synth_requests(6, ((16, 12), (20, 18)), (1, 3), seed=0,
+                               group=1)
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(x, y)
+
+
+def test_serve_cli_burst_flag():
+    from tpu_stencil.serve import cli as serve_cli
+
+    ns = serve_cli.build_parser().parse_args(["--burst", "4"])
+    assert ns.burst == 4
+    with pytest.raises(SystemExit):
+        serve_cli.main(["--burst", "2", "--mode", "closed",
+                        "--requests", "1"])

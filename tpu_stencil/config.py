@@ -549,6 +549,26 @@ class NetConfig:
     # exceeds it triggers an automatic flight-recorder dump, so a p99
     # straggler leaves a black-box record. 0 disables the trigger.
     flight_latency_threshold_s: float = 0.0
+    # Router-level continuous batching (docs/SERVING.md "Continuous
+    # batching at the edge"): admitted requests sharing a compatibility
+    # key — (filter, shape bucket, channels, reps) — are held up to
+    # this many microseconds so concurrent arrivals stack onto ONE
+    # replica submit (one compiled batch program, one H2D) instead of
+    # N. A full group (max_batch members) or an expired window
+    # dispatches immediately; a member whose deadline falls inside the
+    # window dispatches its group early, never silently stretched.
+    # 0 = off — one request, one submit, exactly the pre-coalescing
+    # behavior. The LIBRARY default is off (embedders and the test
+    # suite keep today's semantics unless they opt in); the net CLI
+    # defaults the flag to a few hundred µs, gated by the measured
+    # coalesce-on-vs-off bench rider.
+    coalesce_window_us: float = 0.0
+    # Zero-copy ingest (the stream engine's staging-ring discipline
+    # applied to HTTP): request bodies are read directly into pinned
+    # per-bucket staging buffers (recv_into, CRC in place, no
+    # bytes -> frombuffer -> defensive-copy chain). Off = every body is
+    # buffered through fresh bytes objects (the A/B arm).
+    ingest_arena: bool = True
 
     def __post_init__(self) -> None:
         if not self.host:
@@ -610,6 +630,11 @@ class NetConfig:
                 f"slow-request trigger), got "
                 f"{self.flight_latency_threshold_s}"
             )
+        if self.coalesce_window_us < 0:
+            raise ValueError(
+                f"coalesce_window_us must be >= 0 (0 = no request "
+                f"coalescing), got {self.coalesce_window_us}"
+            )
         # Jax-free (the filter bank is pure numpy): a typo'd --filter
         # must die as a usage error, not boot a tier that answers 500
         # to every request.
@@ -627,6 +652,10 @@ class NetConfig:
     @property
     def max_inflight_bytes(self) -> int:
         return int(self.max_inflight_mb * (1 << 20))
+
+    @property
+    def coalesce_window_s(self) -> float:
+        return self.coalesce_window_us / 1e6
 
     def serve_config(self, device_index: int) -> ServeConfig:
         """The per-replica engine config: one engine pinned to one

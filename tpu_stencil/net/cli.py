@@ -51,6 +51,25 @@ def build_parser() -> argparse.ArgumentParser:
                         "queue is full the request gets 429 (default 256)")
     p.add_argument("--max-batch", type=int, default=8,
                    help="per-replica micro-batch bound (default 8)")
+    p.add_argument("--coalesce-window-us", dest="coalesce_window_us",
+                   type=float, default=300.0, metavar="US",
+                   help="router-level continuous batching: concurrent "
+                        "requests sharing a (filter, bucket, channels, "
+                        "reps) key are held up to this many microseconds "
+                        "and stacked onto ONE replica submit — one "
+                        "compiled batch program and one H2D instead of "
+                        "N. A full group (max-batch) or a member whose "
+                        "deadline falls inside the window dispatches "
+                        "immediately. 0 = off (one request, one launch). "
+                        "Default 300; tune with the bench coalesce A/B "
+                        "rider (docs/DEPLOY.md)")
+    p.add_argument("--no-ingest-arena", dest="ingest_arena",
+                   action="store_false",
+                   help="disable zero-copy ingest (on by default: "
+                        "request bodies readinto pinned per-bucket "
+                        "staging buffers, CRC in place, no per-request "
+                        "host copies); off buffers every body through "
+                        "fresh bytes objects — the A/B arm")
     p.add_argument("--max-inflight-mb", type=float, default=256.0,
                    help="load-shed watermark: past this many MB of "
                         "tracked in-flight request+response bytes, new "
@@ -186,6 +205,8 @@ def main(argv=None) -> int:
             host=ns.host, port=ns.port, replicas=ns.replicas,
             filter_name=ns.filter_name, backend=ns.backend,
             max_queue=ns.max_queue, max_batch=ns.max_batch,
+            coalesce_window_us=ns.coalesce_window_us,
+            ingest_arena=ns.ingest_arena,
             max_inflight_mb=ns.max_inflight_mb,
             request_timeout_s=ns.request_timeout_s,
             drain_timeout_s=ns.drain_timeout_s,
@@ -222,6 +243,8 @@ def main(argv=None) -> int:
         f"net: serving on {fe.url} with {len(fe.fleet)} replica(s) "
         f"(max_queue={cfg.max_queue}/replica, "
         f"shed>{cfg.max_inflight_mb:g}MB inflight, "
+        f"coalesce={cfg.coalesce_window_us:g}us, "
+        f"arena={'on' if cfg.ingest_arena else 'off'}, "
         f"warm={'on' if cfg.warm_fleet else 'off'}); "
         f"POST /v1/blur, GET /healthz /metrics /statusz "
         f"/debug/trace/<id> /debug/flightrec; SIGTERM drains",

@@ -176,7 +176,11 @@ class ReplicaFleet:
             if j == chosen:
                 continue
             try:
-                rep.submit(zeros, reps, fname)
+                # owned=True: the zeros frame is never mutated after
+                # this loop, so every sibling can read the ONE buffer —
+                # a warm burst costs one allocation, not replicas-1
+                # defensive copies of a frame nobody looks at.
+                rep.submit(zeros, reps, fname, owned=True)
             except Exception:
                 continue  # full/closed/crashed sibling: skip, don't fail
             self._m_warm.inc()

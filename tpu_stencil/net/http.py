@@ -61,22 +61,29 @@ from tpu_stencil.integrity.quarantine import (
     QuarantineBoard,
     QuarantineProber,
 )
+from tpu_stencil.net.arena import StagingArena
 from tpu_stencil.net.fleet import ReplicaFleet
-from tpu_stencil.net.router import Draining, Overloaded, Router
+from tpu_stencil.net.router import (
+    RETRY_AFTER_QUEUE_FULL,
+    RETRY_AFTER_SHED,
+    Draining,
+    Overloaded,
+    Router,
+)
 from tpu_stencil.obs import context as _obs_ctx
 from tpu_stencil.obs import flight as _obs_flight
 from tpu_stencil.obs import span as _obs_span
 from tpu_stencil.resilience.errors import DeadlineExceeded, WorkerCrashed
+from tpu_stencil.serve import bucketing
 from tpu_stencil.serve.engine import QueueFull, ServerClosed
 from tpu_stencil.serve.metrics import Registry
 
 # /statusz + --stats-json payload schema. Bump on breaking changes.
 STATUS_SCHEMA_VERSION = 1
 
-# Retry-After hints (seconds): queue-full clears within a batch or two;
-# a shed watermark needs the in-flight backlog to drain.
-RETRY_AFTER_QUEUE_FULL = 1
-RETRY_AFTER_SHED = 2
+# (RETRY_AFTER_* floors live in net.router next to the derived
+# retry_after_s hint; re-imported here so the wire constants keep one
+# spelling for both HTTP tiers.)
 
 # Hard cap on how long a handler thread waits for an accepted request
 # with no explicit deadline — the never-hang discipline at the edge.
@@ -183,6 +190,71 @@ def read_request_body(rfile, headers, limit: int) -> bytes:
             f"({limit} bytes)"
         )
     return rfile.read(n)
+
+
+def _readinto_all(rfile, mv: memoryview) -> int:
+    """Fill ``mv`` from the stream (readinto loops until full or EOF);
+    returns bytes read."""
+    total = 0
+    while total < len(mv):
+        n = rfile.readinto(mv[total:])
+        if not n:
+            break
+        total += n
+    return total
+
+
+def read_request_body_into(rfile, headers, buf, limit: int) -> int:
+    """Zero-copy sibling of :func:`read_request_body`: the upload lands
+    DIRECTLY in ``buf`` (a staging-arena buffer of at least ``limit`` +
+    slop bytes) via ``readinto`` — no intermediate ``bytes`` objects on
+    either the Content-Length or the chunked path. Same framing
+    contract: a body past the declared frame size fails typed
+    (:class:`_Oversized` -> 413), a malformed frame is a ValueError
+    (-> 400). Returns the byte count actually read; the caller treats a
+    short body exactly like the buffered path does (400)."""
+    mv = memoryview(buf).cast("B")
+    cap = min(len(mv), limit + _MAX_EXTRA_BODY)
+    te = (headers.get("Transfer-Encoding") or "").lower()
+    if "chunked" in te:
+        total = 0
+        while True:
+            size_line = rfile.readline(1024)
+            if size_line and not size_line.endswith(b"\n"):
+                raise ValueError("chunk-size line exceeds 1024 bytes")
+            try:
+                size = int(size_line.split(b";")[0].strip() or b"0", 16)
+            except ValueError:
+                raise ValueError(
+                    f"malformed chunk-size line {size_line!r}"
+                ) from None
+            if size == 0:
+                while rfile.readline(1024).strip():
+                    pass
+                return total
+            if total + size > limit + _MAX_EXTRA_BODY:
+                raise _Oversized(
+                    f"chunked body exceeds declared frame size "
+                    f"({limit} bytes)"
+                )
+            got = _readinto_all(rfile, mv[total:total + size])
+            total += got
+            if got < size:
+                return total  # stream ended mid-chunk: short body, 400
+            rfile.read(2)  # chunk-terminating CRLF
+    try:
+        n = int(headers.get("Content-Length") or 0)
+    except ValueError:
+        raise ValueError(
+            f"malformed Content-Length "
+            f"{headers.get('Content-Length')!r}"
+        ) from None
+    if n > limit + _MAX_EXTRA_BODY:
+        raise _Oversized(
+            f"body of {n} bytes exceeds declared frame size "
+            f"({limit} bytes)"
+        )
+    return _readinto_all(rfile, mv[:min(n, cap)])
 
 
 class _NetHTTPServer(ThreadingHTTPServer):
@@ -519,59 +591,131 @@ class _Handler(BaseHTTPRequestHandler):
                 )
                 return
             expected = w * h * channels
-            try:
-                body = self._read_body(expected)
-            except _Oversized as e:
-                self._error(413, str(e))
-                return
-            except ValueError as e:
-                self._error(400, str(e))
-                return
-            if len(body) != expected:
-                self._error(
-                    400,
-                    f"body is {len(body)} bytes; {w}x{h}x{channels} "
-                    f"needs exactly {expected}",
+            # Zero-copy ingest (docs/SERVING.md "Continuous batching at
+            # the edge"): the body is readinto a pinned bucket-capacity
+            # staging buffer, the CRC runs over it in place, and the
+            # frame VIEW rides into the engine owned — released back to
+            # the arena when the engine consumed it (or the request
+            # failed first; release is idempotent).
+            lease = None
+            release = None
+            if fe.arena is not None:
+                bh, bw = bucketing.bucket_shape(
+                    h, w, fe.cfg.bucket_edges or bucketing.DEFAULT_EDGES
                 )
-                return
+                # +slop so an over-declared body still reads FULLY and
+                # fails the length check like the buffered path (a
+                # bucket-exact frame would otherwise leave the excess
+                # unread on a kept-alive socket); one capacity per
+                # bucket either way, so pooling is unaffected.
+                lease = fe.arena.lease(
+                    bh * bw * channels + _MAX_EXTRA_BODY
+                )
+                release = lease.release
+                try:
+                    got = read_request_body_into(
+                        self.rfile, self.headers, lease.array, expected
+                    )
+                except _Oversized as e:
+                    release()
+                    self._error(413, str(e))
+                    return
+                except ValueError as e:
+                    release()
+                    self._error(400, str(e))
+                    return
+                if got != expected:
+                    release()
+                    self._error(
+                        400,
+                        f"body is {got} bytes; {w}x{h}x{channels} "
+                        f"needs exactly {expected}",
+                    )
+                    return
+                flat = lease.view(expected)
+            else:
+                try:
+                    body = self._read_body(expected)
+                except _Oversized as e:
+                    self._error(413, str(e))
+                    return
+                except ValueError as e:
+                    self._error(400, str(e))
+                    return
+                if len(body) != expected:
+                    self._error(
+                        400,
+                        f"body is {len(body)} bytes; {w}x{h}x{channels} "
+                        f"needs exactly {expected}",
+                    )
+                    return
+                # A frombuffer view keeps the (immutable) bytes object
+                # alive — still no copy, just no buffer reuse either.
+                flat = np.frombuffer(body, np.uint8)
             # Chaos site: flip a bit in the ingested body AFTER the
             # framing checks, BEFORE checksum validation — the exact
             # corruption the X-Content-Crc32c hop exists to catch.
             if fe.fault_corrupt_ingest is not None and _checksum.fired(
                     fe.fault_corrupt_ingest):
-                body = _checksum.corrupt_bytes(body)
+                flat = _checksum.corrupt_array(flat)
             claim = self._param(query, _checksum.CRC_HEADER, "crc32c")
             if claim is not None and fe.cfg.integrity:
-                err = _checksum.claim_error(claim, body)
+                err = _checksum.claim_error(claim, flat)
                 if err is not None:
                     msg, mismatch = err
                     if mismatch:
                         fe.registry.counter(
                             "integrity_checksum_failures_total"
                         ).inc()
+                    if release is not None:
+                        release()
                     self._error(400, msg)
                     return
             shape = (h, w) if channels == 1 else (h, w, channels)
-            img = np.frombuffer(body, np.uint8).reshape(shape)
+            img = flat.reshape(shape)
             try:
+                # owned=True: both ingest paths guarantee the buffer is
+                # not reused before on_consumed (arena lease) or ever
+                # (immutable bytes base) — the engine skips its
+                # defensive copy.
                 fut, idx = fe.router.submit(
-                    img, reps, fname, deadline_s=deadline_s
+                    img, reps, fname, deadline_s=deadline_s,
+                    owned=True, on_consumed=release,
                 )
             except Draining as e:
-                self._error(503, str(e),
-                            {"Retry-After": str(RETRY_AFTER_SHED)})
+                if release is not None:
+                    release()
+                self._error(503, str(e), {
+                    "Retry-After": str(fe.router.retry_after_s())
+                })
                 return
             except Overloaded as e:
-                self._error(503, str(e),
-                            {"Retry-After": str(RETRY_AFTER_SHED)})
+                if release is not None:
+                    release()
+                self._error(503, str(e), {
+                    "Retry-After": str(fe.router.retry_after_s())
+                })
                 return
             except QueueFull as e:
-                self._error(429, str(e),
-                            {"Retry-After": str(RETRY_AFTER_QUEUE_FULL)})
+                if release is not None:
+                    release()
+                self._error(429, str(e), {
+                    "Retry-After": str(
+                        fe.router.retry_after_s(queue_full=True)
+                    )
+                })
                 return
             except ValueError as e:
+                if release is not None:
+                    release()
                 self._error(400, str(e))
                 return
+            if release is not None:
+                # Failure paths that never reach the engine's consume
+                # hook (deadline at batch formation, worker crash,
+                # placement failure inside a coalesced group) release
+                # via the future — idempotent next to on_consumed.
+                fut.add_done_callback(lambda _f: release())
             wait = (
                 deadline_s + 5.0 if deadline_s
                 else (fe.cfg.request_timeout_s + 5.0
@@ -590,19 +734,39 @@ class _Handler(BaseHTTPRequestHandler):
                 _obs_flight.trigger(
                     "deadline_exceeded", trace_id=ctx.trace_id,
                     tier="net", duration_s=time.perf_counter() - t0,
-                    replica=idx,
+                    replica=-1 if idx is None else idx,
                     detail=f"still pending after {wait:g}s",
                 )
                 self._error(504,
                             f"request still pending after {wait:g}s")
                 return
+            except QueueFull as e:
+                # A coalesced group's placement failure arrives through
+                # the future (every replica rejected the whole group) —
+                # the same typed 429 the synchronous path answers.
+                self._error(429, str(e), {
+                    "Retry-After": str(
+                        fe.router.retry_after_s(queue_full=True)
+                    )
+                })
+                return
+            except (Draining, Overloaded) as e:
+                self._error(503, str(e), {
+                    "Retry-After": str(fe.router.retry_after_s())
+                })
+                return
             except (ServerClosed, WorkerCrashed) as e:
-                self._error(503, f"{type(e).__name__}: {e}",
-                            {"Retry-After": str(RETRY_AFTER_SHED)})
+                self._error(503, f"{type(e).__name__}: {e}", {
+                    "Retry-After": str(fe.router.retry_after_s())
+                })
                 return
             except Exception as e:
                 self._error(500, f"{type(e).__name__}: {e}")
                 return
+            if idx is None:
+                # Coalesced: the router stamped the placed replica onto
+                # the future at group dispatch (before it resolved).
+                idx = getattr(fut, "replica_idx", -1)
             elapsed = time.perf_counter() - t0
             fe.registry.histogram("request_latency_seconds").observe(
                 elapsed
@@ -663,6 +827,10 @@ class NetFrontend:
         self.registry.histogram("request_latency_seconds")
         self.fleet = ReplicaFleet(cfg, registry=self.registry,
                                   start_workers=start_workers)
+        # Zero-copy ingest staging pools (None = the buffered A/B arm).
+        self.arena: Optional[StagingArena] = (
+            StagingArena(self.registry) if cfg.ingest_arena else None
+        )
         self.router: Optional[Router] = None
         self._httpd: Optional[_NetHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
@@ -709,6 +877,10 @@ class NetFrontend:
             self.fleet, self.registry,
             max_inflight_bytes=self.cfg.max_inflight_bytes,
             quarantine=self.quarantine,
+            coalesce_window_s=self.cfg.coalesce_window_s,
+            max_batch=self.cfg.max_batch,
+            bucket_edges=self.cfg.bucket_edges,
+            default_filter=self.cfg.filter_name,
         )
         if self.cfg.probe_interval_s > 0:
             self._prober = QuarantineProber(
@@ -764,6 +936,8 @@ class NetFrontend:
             self._prober = None
         if self.router is not None and not self.router.draining:
             self.drain()
+        if self.router is not None:
+            self.router.shutdown()  # stop the coalescer timer thread
         if self._httpd is not None:
             self._httpd.shutdown()
             self._httpd.server_close()
@@ -845,6 +1019,8 @@ class NetFrontend:
                 "replicas": self.cfg.replicas,
                 "max_queue": self.cfg.max_queue,
                 "max_batch": self.cfg.max_batch,
+                "coalesce_window_us": self.cfg.coalesce_window_us,
+                "ingest_arena": self.cfg.ingest_arena,
                 "max_inflight_mb": self.cfg.max_inflight_mb,
                 "request_timeout_s": self.cfg.request_timeout_s,
                 "drain_timeout_s": self.cfg.drain_timeout_s,

@@ -100,7 +100,8 @@ class Request:
     """One queued inference request (internal)."""
 
     req_id: int
-    image: np.ndarray          # uint8 (H, W) or (H, W, C)
+    image: Optional[np.ndarray]  # uint8 (H, W) or (H, W, C); None once
+    #                              consumed into a batch canvas
     reps: int
     filter_name: str
     key: tuple                 # executable-cache key (sans batch bucket)
@@ -123,6 +124,37 @@ class Request:
     # cross-process trace. Empty outside any request scope.
     trace_id: str = ""
     span_id: str = ""
+    # The TRUE frame shape, kept past consumption: once the worker has
+    # copied the pixels into the batch canvas it drops ``image`` (an
+    # owned staging buffer goes back to its arena), but retire still
+    # needs the crop geometry.
+    shape: Tuple[int, ...] = ()
+    # Zero-copy ownership (the HTTP ingest-arena contract): called
+    # exactly once, on the worker thread, the moment the engine is done
+    # reading ``image`` — the staging buffer may be reused after.
+    on_consumed: Optional[object] = None
+    # Witness input snapshot: the sampler picks at dispatch (the last
+    # moment the input still exists for owned requests) and the copy
+    # rides here until the retire-side re-execution.
+    witness_src: Optional[np.ndarray] = None
+
+
+@dataclasses.dataclass
+class GroupItem:
+    """One member of a router-coalesced group (:meth:`StencilServer.
+    submit_group`): the future/deadline/trace identity was fixed at
+    ADMISSION time on the HTTP handler thread; the engine only wraps it
+    into a :class:`Request`. ``t_deadline`` is an absolute
+    ``perf_counter`` instant — time spent forming the group counts
+    against the member's deadline, never silently stretches it."""
+
+    image: np.ndarray
+    future: concurrent.futures.Future
+    t_submit: float
+    t_deadline: Optional[float] = None
+    trace_id: str = ""
+    span_id: str = ""
+    on_consumed: Optional[object] = None
 
 
 def _mask_valid(imgs, valid_h, valid_w):
@@ -228,6 +260,65 @@ class _ExecutableCache:
         return len(self._entries)
 
 
+class _CanvasArena:
+    """Persistent per-bucket host canvases: the batch canvas (and its
+    valid-h/valid-w vectors) for one (batch-bucket, bucket_hw, channels)
+    key is a small RING of reusable buffers instead of a fresh
+    ``np.zeros`` per dispatch — steady-state serving performs ZERO
+    per-request host canvas allocations (the Casper thesis: the serving
+    tax is data movement and allocation, not compute; the stream
+    engine's staging-ring discipline applied to the batch path).
+
+    The ring holds ``pipeline_depth + 1`` slots per key: at most
+    ``pipeline_depth`` batches are dispatched-but-unretired at any
+    moment (the worker loop's retire-when-full bound), so by the time a
+    slot cycles back around its batch has retired — safe even where
+    ``jax.device_put`` aliases host memory (CPU) and the donated launch
+    ping-pongs through it.
+
+    Keys are client-controlled (reps-independent, but oversized shapes
+    pad to ever-larger buckets), so the key population is LRU-bounded
+    like the executable cache; eviction frees the ring's buffers with
+    it. Only the worker thread acquires, so no lock is needed —
+    counters are thread-safe for scrapers.
+    """
+
+    _KEY_CAP = 32
+
+    def __init__(self, registry: Registry, ring: int) -> None:
+        self._rings: "collections.OrderedDict" = collections.OrderedDict()
+        self._ring = max(2, int(ring))
+        self._reuse = registry.counter("arena_canvas_reuse_total")
+        self._alloc = registry.counter("arena_canvas_alloc_total")
+        self._evict = registry.counter("arena_canvas_evictions_total")
+
+    def acquire(self, shape: Tuple[int, ...]):
+        """The next ``(canvas, valid_h, valid_w)`` slot for a batch of
+        ``shape`` = (nb, bh, bw[, c]). A freshly allocated canvas is
+        zeroed; a REUSED one is dirty — the dispatch writes every real
+        slot's pixels and pad explicitly."""
+        entry = self._rings.get(shape)
+        if entry is None:
+            entry = self._rings[shape] = {"slots": [], "next": 0}
+            while len(self._rings) > self._KEY_CAP:
+                self._rings.popitem(last=False)
+                self._evict.inc()
+        else:
+            self._rings.move_to_end(shape)
+        slots = entry["slots"]
+        if len(slots) < self._ring:
+            nb = shape[0]
+            slot = (np.zeros(shape, np.uint8),
+                    np.zeros(nb, np.int32), np.zeros(nb, np.int32))
+            slots.append(slot)
+            self._alloc.inc()
+            return slot
+        slot = slots[entry["next"]]
+        entry["next"] = (entry["next"] + 1) % len(slots)
+        self._reuse.inc()
+        return slot
+
+
 class _MemorySampler:
     """Background device-memory telemetry for a long-running server:
     a daemon thread samples ``device.memory_stats()`` every
@@ -314,6 +405,11 @@ class StencilServer:
         self.registry = Registry()
         self._cache = _ExecutableCache(self.registry,
                                        self.cfg.max_executables)
+        # Persistent host-side batch canvases: ring depth pipeline+1 so
+        # a slot never cycles back before its batch retired (see
+        # _CanvasArena).
+        self._arena = _CanvasArena(self.registry,
+                                   self.cfg.pipeline_depth + 1)
         self._models: Dict[str, object] = {}
         self._edges = self.cfg.bucket_edges or bucketing.DEFAULT_EDGES
         self._pending: "collections.deque[Request]" = collections.deque()
@@ -491,6 +587,8 @@ class StencilServer:
     def submit(self, image: np.ndarray, reps: int,
                filter_name: Optional[str] = None,
                deadline_s: Optional[float] = None,
+               owned: bool = False,
+               on_consumed=None,
                ) -> "concurrent.futures.Future":
         """Enqueue one request; returns a Future resolving to the blurred
         uint8 array (same shape as ``image``). Raises :class:`QueueFull`
@@ -501,7 +599,16 @@ class StencilServer:
         ``cfg.request_timeout_s``; 0/None = none) bounds how long the
         request may wait: expired requests fail typed with
         :class:`~tpu_stencil.resilience.errors.DeadlineExceeded` at
-        batch formation instead of occupying a batch slot."""
+        batch formation instead of occupying a batch slot.
+
+        ``owned=True`` is the zero-copy ingest contract: the caller
+        guarantees the buffer is not mutated until the engine signals it
+        is done reading (``on_consumed``, called once on the worker
+        thread after the pixels were copied into the batch canvas), so
+        the defensive copy is skipped — the HTTP staging-arena path.
+        With ``owned=False`` (the default, every pre-existing caller)
+        the engine copies as before and fires ``on_consumed``, if any,
+        immediately after the copy."""
         image = np.asarray(image)  # no copy yet: validate + gate first
         if image.dtype != np.uint8:
             raise ValueError(f"image must be uint8, got {image.dtype}")
@@ -517,14 +624,7 @@ class StencilServer:
         # time — this one only decides whether the copy is worth making.
         with self._cond:
             self._gate_locked()
-        # Defensive copy: canvas assembly happens later on the worker
-        # thread, so a caller reusing its buffer (the frame-loop pattern)
-        # must not corrupt an already-queued request. Mirrors the model's
-        # __call__ copy discipline.
-        image = np.array(image, copy=True)
-        fname = filter_name or self.cfg.filter_name
         h, w = image.shape[:2]
-        channels = image.shape[2] if image.ndim == 3 else 1
         # Sharded routing: with a non-"off" overlap schedule, requests
         # at/above the size threshold run the spatially-sharded
         # shard_map path at their TRUE shape (the sharded runner's own
@@ -536,6 +636,21 @@ class StencilServer:
             self.cfg.overlap != "off"
             and h * w >= self.cfg.shard_min_pixels
         )
+        # The sharded path stages inputs through its own runner.put,
+        # which may alias host memory — owned buffers would be released
+        # while the mesh still reads them. Copy there.
+        if not owned or sharded:
+            # Defensive copy: canvas assembly happens later on the
+            # worker thread, so a caller reusing its buffer (the
+            # frame-loop pattern) must not corrupt an already-queued
+            # request. Mirrors the model's __call__ copy discipline.
+            image = np.array(image, copy=True)
+            if on_consumed is not None:
+                # The caller's buffer is free the moment the copy landed.
+                on_consumed()
+                on_consumed = None
+        fname = filter_name or self.cfg.filter_name
+        channels = image.shape[2] if image.ndim == 3 else 1
         if sharded:
             bucket_hw = (h, w)
             key = (fname, (h, w), channels, str(image.dtype),
@@ -562,6 +677,8 @@ class StencilServer:
             sharded=sharded,
             trace_id=ctx.trace_id if ctx is not None else "",
             span_id=ctx.span_id if ctx is not None else "",
+            shape=tuple(image.shape),
+            on_consumed=on_consumed,
         )
         with _obs_span("serve.enqueue", "serve", req_id=req.req_id):
             with self._cond:
@@ -593,6 +710,84 @@ class StencilServer:
             policy=policy, give_up_after_s=give_up_after_s,
             label="serve.submit",
         )
+
+    def submit_group(self, items: List[GroupItem], reps: int,
+                     filter_name: Optional[str] = None) -> None:
+        """Enqueue a router-coalesced group under ONE lock acquisition
+        — the continuous-batching primitive. All members enter the
+        pending queue atomically (the worker cannot observe a partial
+        group), so a same-key group of K <= max_batch rides one batch
+        formation, one compiled program, one H2D, instead of K.
+
+        Admission is all-or-nothing: if the queue cannot take the whole
+        group, :class:`QueueFull` raises and NO member entered (the
+        router re-offers the intact group to a sibling replica).
+        Members keep their admission-time futures, deadlines and trace
+        ids; validation failures raise :class:`ValueError` for the
+        whole group (the members were pre-validated at the HTTP edge,
+        so a failure here is a router bug, not client traffic).
+
+        Member images are OWNED (the coalescer holds staging leases /
+        immutable body views until ``on_consumed``) — no defensive
+        copies, the zero-copy contract of ``submit(owned=True)``."""
+        if reps < 0:
+            raise ValueError(f"reps must be >= 0, got {reps}")
+        fname = filter_name or self.cfg.filter_name
+        with self._cond:
+            self._gate_locked()
+        reqs: List[Request] = []
+        for it in items:
+            image = np.asarray(it.image)
+            if image.dtype != np.uint8 or image.ndim not in (2, 3):
+                raise ValueError(
+                    f"group member must be a uint8 (H, W[, C]) frame, "
+                    f"got {image.dtype} {image.shape}"
+                )
+            h, w = image.shape[:2]
+            channels = image.shape[2] if image.ndim == 3 else 1
+            on_consumed = it.on_consumed
+            sharded = (
+                self.cfg.overlap != "off"
+                and h * w >= self.cfg.shard_min_pixels
+            )
+            if sharded:
+                # Same aliasing guard as submit(owned=True): the mesh
+                # stages through runner.put, so keep the engine's copy.
+                image = np.array(image, copy=True)
+                if on_consumed is not None:
+                    on_consumed()
+                    on_consumed = None
+                bucket_hw = (h, w)
+                key = (fname, (h, w), channels, str(image.dtype),
+                       self.cfg.backend, int(reps), "sharded")
+            else:
+                bucket_hw = bucketing.bucket_shape(h, w, self._edges)
+                key = (fname, bucket_hw, channels, str(image.dtype),
+                       self.cfg.backend, int(reps))
+            reqs.append(Request(
+                req_id=-1, image=image, reps=int(reps),
+                filter_name=fname, key=key, bucket_hw=bucket_hw,
+                future=it.future, t_submit=it.t_submit,
+                t_deadline=it.t_deadline, sharded=sharded,
+                trace_id=it.trace_id, span_id=it.span_id,
+                shape=tuple(image.shape), on_consumed=on_consumed,
+            ))
+        with _obs_span("serve.enqueue_group", "serve", group=len(reqs)):
+            with self._cond:
+                self._gate_locked()  # authoritative: at append time
+                if len(self._pending) + len(reqs) > self.cfg.max_queue:
+                    self._m_rejected.inc(len(reqs))
+                    raise QueueFull(
+                        f"queue cannot take a group of {len(reqs)} "
+                        f"({len(self._pending)}/{self.cfg.max_queue} "
+                        f"pending); retry later"
+                    )
+                for r in reqs:
+                    r.req_id = next(self._ids)
+                    self._pending.append(r)
+                self._m_requests.inc(len(reqs))
+                self._m_depth.set(len(self._pending))
+                self._cond.notify()
 
     def _gate_locked(self) -> None:
         """Admission gate (caller holds the lock): raises
@@ -770,6 +965,22 @@ class StencilServer:
                 return self._dispatch_sharded(batch)
             return self._dispatch_inner(batch)
 
+    def _consume(self, r: Request) -> None:
+        """The engine is done reading ``r.image``: snapshot the witness
+        input if the sampler picks this request (the input must outlive
+        the staging buffer), release the buffer back to its owner, and
+        drop the reference. Worker-thread only."""
+        if self._witness is not None and self._witness.pick():
+            r.witness_src = np.array(r.image, copy=True)
+        cb = r.on_consumed
+        r.image = None
+        r.on_consumed = None
+        if cb is not None:
+            try:
+                cb()
+            except Exception:
+                pass  # a broken release hook must not kill the batch
+
     def _dispatch_sharded(self, batch: List[Request]):
         """The oversized-request path: each request runs the shard_map
         + overlap program at its TRUE shape over all local devices
@@ -805,6 +1016,7 @@ class StencilServer:
         for r in batch:
             dev = runner.put(r.image)
             outs.append(runner.run(dev, r.reps))
+            self._consume(r)  # sharded images are engine-owned copies
         self._m_sharded.inc(len(batch))
         self._m_sharded_batches.inc()
         self._m_real.inc(len(batch) * h * w)
@@ -829,14 +1041,25 @@ class StencilServer:
         )
         nb = bucketing.batch_bucket(len(batch), self.cfg.max_batch)
         shape = (nb, bh, bw) + ((channels,) if channels > 1 else ())
-        canvas = np.zeros(shape, np.uint8)
-        vh = np.zeros(nb, np.int32)
-        vw = np.zeros(nb, np.int32)
+        # Persistent canvas (zero steady-state host allocation): a
+        # reused slot is DIRTY, so every real slot writes its pixels AND
+        # re-zeroes its pad explicitly — the pad must be zero at rep 1
+        # (the masked step re-zeroes it only from rep boundaries on).
+        # Unused batch-pad slots only need vh=vw=0: their pixels never
+        # feed a real frame (vmap is per-frame) and are never cropped.
+        canvas, vh, vw = self._arena.acquire(shape)
         for i, r in enumerate(batch):
             h, w = r.image.shape[:2]
             canvas[i, :h, :w] = r.image
+            if h < bh:
+                canvas[i, h:] = 0
+            if w < bw:
+                canvas[i, :h, w:] = 0
             vh[i], vw[i] = h, w
-        true_shapes = [r.image.shape[:2] for r in batch]
+            self._consume(r)
+        vh[len(batch):] = 0
+        vw[len(batch):] = 0
+        true_shapes = [r.shape[:2] for r in batch]
         self._m_padded.inc(bucketing.waste_pixels(true_shapes, (bh, bw), nb))
         self._m_real.inc(sum(h * w for h, w in true_shapes))
         # Bucket batches run single-device: the whole canvas lands on
@@ -947,7 +1170,7 @@ class StencilServer:
             if not r.future.done() and _resolve(r.future, res):
                 self._m_completed.inc()
                 self._m_rlat.observe(t1 - r.t_submit)
-            if self._witness is not None and self._witness.pick():
+            if r.witness_src is not None:
                 witness_queue.append((r, res))
         for r, res in witness_queue:
             self._witness_one(r, res)
@@ -976,7 +1199,7 @@ class StencilServer:
             self._m_gbps.observe(gbps)
         witness_queue = []
         for i, r in enumerate(batch):
-            h, w = r.image.shape[:2]
+            h, w = r.shape[:2]  # image was consumed at dispatch
             res = out[i, :h, :w].copy()
             # Corrupt INSIDE the request's true pixels (the canvas
             # midpoint could land in the bucket pad, which the crop
@@ -991,10 +1214,11 @@ class StencilServer:
             if not r.future.done() and _resolve(r.future, res):
                 self._m_completed.inc()
                 self._m_rlat.observe(t1 - r.t_submit)
-            if self._witness is not None and self._witness.pick():
+            if r.witness_src is not None:
                 witness_queue.append((r, res))
         # Witness AFTER every future resolved: verification must never
-        # stretch the batch-mates' latency tail.
+        # stretch the batch-mates' latency tail. (The sampler picked at
+        # dispatch — the input snapshot outlives the staging buffer.)
         for r, res in witness_queue:
             self._witness_one(r, res)
 
@@ -1028,7 +1252,8 @@ class StencilServer:
             with _obs_span("integrity.witness", "integrity",
                            req_id=r.req_id, reps=r.reps):
                 want = _witness_mod.device_witness(
-                    r.image, r.filter_name, r.reps, self.cfg.boundary
+                    r.witness_src, r.filter_name, r.reps,
+                    self.cfg.boundary,
                 )
                 ok = bool(np.array_equal(want, np.asarray(got)))
         except Exception:

@@ -254,14 +254,19 @@ class HttpTarget:
 
 def synth_requests(
     n: int, shapes: Sequence[Tuple[int, int]], channels: Sequence[int],
-    seed: int,
+    seed: int, group: int = 1,
 ) -> List[np.ndarray]:
-    """n seeded random uint8 images cycling over shapes x channels."""
+    """n seeded random uint8 images cycling over shapes x channels.
+    ``group`` > 1 cycles per GROUP of that many consecutive requests
+    instead of per request — the bursty arrival mode's guarantee that
+    every request of one tick shares a shape (and so a coalescing
+    compatibility key); pixels stay distinct per request."""
     rng = np.random.default_rng(seed)
+    group = max(1, int(group))
     out = []
     for i in range(n):
-        h, w = shapes[i % len(shapes)]
-        ch = channels[i % len(channels)]
+        h, w = shapes[(i // group) % len(shapes)]
+        ch = channels[(i // group) % len(channels)]
         shape = (h, w) if ch == 1 else (h, w, ch)
         out.append(rng.integers(0, 256, size=shape, dtype=np.uint8))
     return out
@@ -282,6 +287,7 @@ def run(
     verify: Optional[str] = None,
     verify_filter: str = "gaussian",
     per_request: bool = False,
+    burst: int = 1,
 ) -> Dict:
     """Drive ``server`` with synthetic load; return the report dict.
 
@@ -322,11 +328,29 @@ def run(
     included) / ``achieved_fps`` (completions over the wall) to the
     report — achieved < requested means the pipe, not the source, is
     the bottleneck.
+
+    ``burst`` (``--burst N``): the bursty open-loop arrival mode — N
+    simultaneous SAME-shape requests per tick (distinct payloads), tick
+    gaps drawn from a seeded exponential (a Poisson arrival process at
+    the same mean rate) instead of a metronome. This is the client-side
+    shape that actually exercises cross-request coalescing at the
+    network edge: a metronome at modest rates never offers two
+    compatible requests inside one window. The report's p50/p99 sit
+    next to achieved fps as always. ``burst=1`` (default) is exactly
+    the pre-existing fixed-period open loop; burst > 1 requires an open
+    loop (``mode='open'`` or ``rate_fps``).
     """
     if rate_fps is not None:
         if not rate_fps > 0:
             raise ValueError(f"rate_fps must be > 0, got {rate_fps!r}")
         mode, rate = "open", float(rate_fps)
+    if burst < 1:
+        raise ValueError(f"burst must be >= 1, got {burst}")
+    if burst > 1 and mode != "open":
+        raise ValueError(
+            "burst is an open-loop arrival mode (use mode='open' or "
+            "rate_fps)"
+        )
     if mode not in ("closed", "open"):
         raise ValueError(f"mode must be closed|open, got {mode!r}")
     if verify not in VERIFY_MODES:
@@ -341,7 +365,8 @@ def run(
     honored0 = obs.registry().counter(
         "resilience_retry_after_honored_total"
     ).value
-    images = synth_requests(requests, shapes, channels, seed)
+    images = synth_requests(requests, shapes, channels, seed,
+                            group=burst)
     completed = 0
     completed_lock = threading.Lock()
     # Per-request trace records ({i, trace_id, latency_s, ok}), always
@@ -440,11 +465,24 @@ def run(
         period = 1.0 / rate if rate > 0 else 0.0
         futures = []
         offered = 0
+        # Bursty mode: ticks of `burst` back-to-back submissions, the
+        # NEXT tick due an exponentially distributed gap later (seeded:
+        # a run replays exactly). The mean inter-REQUEST period is
+        # unchanged — a tick of N requests earns an N-period mean gap —
+        # so `rate` keeps meaning requests/second across modes.
+        jrng = (np.random.default_rng(seed ^ 0xB5457)
+                if burst > 1 else None)
+        t_due = t_start
         for i in range(requests):
-            t_due = t_start + i * period
-            delay = t_due - time.perf_counter()
-            if delay > 0:
-                time.sleep(delay)
+            if i % burst == 0:
+                if i > 0:
+                    t_due += (
+                        jrng.exponential(period * burst)
+                        if jrng is not None else period * burst
+                    )
+                delay = t_due - time.perf_counter()
+                if delay > 0:
+                    time.sleep(delay)
             offered += 1
             try:
                 # The request index rides with the future: a shed
@@ -521,6 +559,8 @@ def run(
         slowest = max(ok_recs, key=lambda r: r["latency_s"])
         report["slowest_trace_id"] = slowest["trace_id"]
         report["slowest_latency_s"] = slowest["latency_s"]
+    if burst > 1:
+        report["burst"] = burst
     if per_request:
         report["per_request"] = done_recs
     if verify is not None:
@@ -536,7 +576,9 @@ def run(
         # one period added back — n offers over a bare (n-1)-period
         # wall would read ~n/(n-1) above requested on perfect pacing.
         report["requested_fps"] = float(rate_fps)
-        offer_window = offer_wall + period
+        # (Bursty runs: the n offers span n/burst ticks, so one whole
+        # tick gap is added back — same reasoning, coarser grain.)
+        offer_window = offer_wall + period * burst
         report["offered_fps"] = (
             offered / offer_window if offer_window > 0 else 0.0
         )
