@@ -60,6 +60,11 @@ excluded) and emits a versioned headline capture in seconds/frame with
 the pipeline depth folded into the metric name — its own perf-sentry
 series, gateable like the mesh captures
 (``TPU_STENCIL_BENCH_STREAM_FRAMES`` / ``_DEPTH`` tune the run).
+``TPU_STENCIL_BENCH_STREAM_SHARD=RxC`` instead spatially shards every
+in-flight frame over an RxC mesh (``--shard-frames``; one headline
+``..._stream_shard<R>x<C>_depth<k>_wall_per_frame`` as its own sentry
+series, with per-edge ``edge_exchange_us``/``edge_ici_gbps`` riders off
+the cached mesh program).
 ``TPU_STENCIL_BENCH_STREAM_MESH=N`` additionally fans the stream over N
 devices (``tpu_stencil.parallel.fanout``) and folds ``_meshfan<N>``
 into the metric name — the whole-mesh frames/s series, its own sentry
@@ -624,6 +629,128 @@ def _measure_stream(platform: str) -> dict:
     return line
 
 
+def _measure_stream_shard(platform: str, mesh_shape) -> dict:
+    """Spatially-sharded stream capture
+    (``TPU_STENCIL_BENCH_STREAM_SHARD=RxC``): run a synthetic
+    north-star-frame stream with every in-flight frame sharded over the
+    RxC mesh (``StreamConfig.shard_frames`` — the mesh-wide pipeline
+    lane of docs/STREAMING.md "Spatially sharded frames") and emit a
+    versioned headline in wall seconds per frame, the topology folded
+    into the metric name (``..._stream_shard<R>x<C>_depth<k>_wall_per_
+    frame`` — its own sentry series). A warm-up stream pays the mesh
+    compile; the cached runner then serves the headline AND the
+    per-edge exchange probes, whose measured latencies ride along as
+    ``edge_exchange_us``/``edge_ici_gbps`` (each edge's span divided by
+    its own modeled ghost bytes — the multichip capture's per-edge
+    discipline), so a weak-scaling regression names the slow link.
+
+    Knobs: ``TPU_STENCIL_BENCH_STREAM_FRAMES`` (default 16),
+    ``TPU_STENCIL_BENCH_STREAM_DEPTH`` (default 2),
+    ``TPU_STENCIL_BENCH_STREAM_OVERLAP`` (default edge)."""
+    import tempfile
+
+    import jax
+
+    from tpu_stencil.config import ImageType, StreamConfig
+    from tpu_stencil.models.blur import IteratedConv2D
+    from tpu_stencil.parallel import sharded as _sharded
+    from tpu_stencil.runtime import roofline as _roofline
+    from tpu_stencil.stream.engine import run_stream
+
+    r, c = mesh_shape
+    if len(jax.devices()) < r * c:
+        raise RuntimeError(
+            f"shard mesh {r}x{c} needs {r * c} devices, "
+            f"have {len(jax.devices())}"
+        )
+    n_frames = int(os.environ.get("TPU_STENCIL_BENCH_STREAM_FRAMES", "16"))
+    depth = int(os.environ.get("TPU_STENCIL_BENCH_STREAM_DEPTH", "2"))
+    overlap = os.environ.get("TPU_STENCIL_BENCH_STREAM_OVERLAP", "edge")
+    backend = os.environ.get(
+        "TPU_STENCIL_BENCH_BACKENDS", "auto"
+    ).split(",")[0]
+    rng = np.random.default_rng(0)
+    with tempfile.TemporaryDirectory(prefix="bench_shard_") as d:
+        clip = os.path.join(d, "clip.raw")
+        frame = rng.integers(0, 256, size=(H, W, C), dtype=np.uint8)
+        with open(clip, "wb") as f:
+            for _ in range(max(2, n_frames)):
+                f.write(frame.tobytes())
+
+        def cfg(frames):
+            return StreamConfig(
+                input=clip, width=W, height=H, repetitions=REPS,
+                image_type=ImageType.RGB, backend=backend,
+                output="null", frames=frames, pipeline_depth=depth,
+                shard_frames=(r, c), shard_min_pixels=1,
+                overlap=overlap,
+            )
+
+        # Warm-up: the mesh program lands in the SHARED runner cache,
+        # so the headline measures steady state and the per-edge
+        # probes below reuse the same runner (a hit, never a second
+        # compile).
+        run_stream(cfg(2))
+        res = run_stream(cfg(n_frames))
+    per_frame = res.wall_seconds / max(1, res.frames)
+    log(f"stream shard {r}x{c} depth={depth} [{res.backend}]: "
+        f"{res.frames_per_second:.2f} frames/s "
+        f"({per_frame * 1e3:.1f} ms/frame, {res.frames} frames)")
+    line = {
+        "metric": (
+            f"{W}x{H}_rgb_{REPS}reps_stream_shard{r}x{c}_depth{depth}"
+            f"_wall_per_frame"
+        ),
+        "value": round(per_frame, 6),
+        "unit": "s",
+        "vs_baseline": round(BASELINE_S / per_frame, 2),
+        "backend": res.backend,
+        "platform": platform,
+        "frames_per_second": round(res.frames_per_second, 3),
+        "n_frames": res.frames,
+        "pipeline_depth": depth,
+        "shard_frames": [r, c],
+        "n_devices": r * c,
+        "overlap": overlap,
+        "stage_seconds": {
+            k: round(v, 6) for k, v in sorted(res.stage_seconds.items())
+        },
+        "shape": f"{W}x{H}",
+        "reps": REPS,
+        "filter": "gaussian",
+        "dtype": "uint8",
+        "schema_version": 1,
+        "ts": round(time.monotonic(), 6),
+    }
+    # Per-edge exchange riders off the CACHED runner (the headline's
+    # own mesh program — shared_runner returns it as a hit).
+    model = IteratedConv2D("gaussian", backend=backend)
+    runner = _sharded.shared_runner(
+        model, (H, W), C, mesh_shape=(r, c), devices=jax.devices(),
+        overlap=overlap,
+    )
+    if runner is not None:
+        per_edge_model = _roofline.ici_ghost_bytes_per_edge(
+            runner.tile, C, max(1, model.halo), (r, c), mode="edge"
+        )
+        probe_img = runner.put(frame)  # probes never donate
+        edge_us, edge_gbps = {}, {}
+        for name, fn in runner.edge_probes().items():
+            jax.block_until_ready(fn(probe_img))  # compile fence
+            best = min(
+                _timed(lambda f=fn: jax.block_until_ready(f(probe_img)))
+                for _ in range(3)
+            )
+            edge_us[name] = round(best * 1e6, 2)
+            b = per_edge_model.get(name, 0.0)
+            if best > 0 and b > 0:
+                edge_gbps[name] = round(b / best / 1e9, 3)
+        if edge_us:
+            line["edge_exchange_us"] = edge_us
+            line["edge_ici_gbps"] = edge_gbps
+    return line
+
+
 def _measure_serve_meshfan(platform: str) -> dict:
     """Serve mesh-fan capture (``TPU_STENCIL_BENCH_SERVE_MESHFAN=1``):
     drive north-star-sized requests through the serving engine's
@@ -1183,6 +1310,17 @@ def child_main() -> int:
         }), flush=True)
         log(f"backend init failed: {type(e).__name__}: {e}")
         return 2
+
+    shard_env = os.environ.get("TPU_STENCIL_BENCH_STREAM_SHARD")
+    if shard_env:
+        try:
+            rr, _, cc = shard_env.lower().partition("x")
+            result = _measure_stream_shard(platform, (int(rr), int(cc)))
+        except Exception as e:
+            log(f"stream shard: FAILED {type(e).__name__}: {e}")
+            return 1
+        print(json.dumps(result), flush=True)
+        return 0
 
     if os.environ.get("TPU_STENCIL_BENCH_STREAM") == "1":
         try:

@@ -22,6 +22,15 @@ if "TPU_STENCIL_FLIGHTREC_DIR" not in os.environ:
     os.environ["TPU_STENCIL_FLIGHTREC_DIR"] = tempfile.mkdtemp(
         prefix="tpu-stencil-flightrec-"
     )
+
+# Autotune-cache redirect: auto verdicts measured inside tests (overlap
+# probes, the stream --mesh-frames/--shard-frames A/Bs) must never read
+# or pollute the developer's real ~/.cache verdict store. Tests that
+# assert warm/cold cache semantics monkeypatch this to their tmp_path.
+if "TPU_STENCIL_AUTOTUNE_CACHE" not in os.environ:
+    os.environ["TPU_STENCIL_AUTOTUNE_CACHE"] = os.path.join(
+        tempfile.mkdtemp(prefix="tpu-stencil-autotune-"), "autotune.json"
+    )
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (

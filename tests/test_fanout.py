@@ -462,8 +462,12 @@ def test_serve_sharded_and_small_never_share_a_batch():
 
 
 def test_serve_sharded_runner_cache_reuse_and_device_accounting():
+    from tpu_stencil.parallel import sharded as psharded
     from tpu_stencil.serve.engine import StencilServer
 
+    # The runner cache is process-SHARED (serve + stream, PR 15): start
+    # cold so the hit/miss assertions count THIS server's traffic.
+    psharded.clear_runner_cache()
     img = _serve_case(40, 36, 3, 7)
     n_dev = len(jax.devices())
     with StencilServer(ServeConfig(
@@ -493,6 +497,9 @@ def test_serve_unservable_geometry_falls_back_to_bucket_path():
     refusal cached so retries never re-pay the failed build."""
     from tpu_stencil.serve.engine import StencilServer
 
+    from tpu_stencil.parallel import sharded as psharded
+
+    psharded.clear_runner_cache()  # process-shared: cold for the counters
     # 2 x 300 with gaussian7 (halo 3): every mesh factorization of the
     # 8-device conftest platform tiles the 2-row axis below the halo.
     img = _serve_case(2, 300, 1, 8)
@@ -563,10 +570,14 @@ def test_serve_sharded_build_covered_by_compile_fault():
     mesh-program build (the largest compile in serve): one injected
     failure fails that request typed, the next one succeeds and is
     bit-exact."""
+    from tpu_stencil.parallel import sharded as psharded
     from tpu_stencil.resilience import faults
     from tpu_stencil.resilience.errors import InjectedFault
     from tpu_stencil.serve.engine import StencilServer
 
+    # Start the process-shared runner cache cold: a hit would skip the
+    # build this test needs the fault to cover.
+    psharded.clear_runner_cache()
     img = _serve_case(40, 36, 3, 9)
     faults.configure("compile:times=1")
     try:

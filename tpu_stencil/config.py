@@ -224,6 +224,34 @@ class StreamConfig:
     # where a frame computes). Host memory is O(N * ring), device
     # memory O(N * pipeline_depth) frames.
     mesh_frames: int = 1
+    # Spatially sharded frames (tpu_stencil.stream.sharded): each
+    # in-flight frame shards over an RxC device mesh through the SAME
+    # cached ShardedRunner mesh programs serve's oversized-request path
+    # compiles (one shared cache — stream and serve never compile the
+    # same mesh program twice), with the per-edge persistent exchange
+    # (--overlap, default edge) threaded through the rep loop and the
+    # H2D/D2H stages split per shard. The route for frames too big for
+    # one device's HBM — the stream-side analog of serve's sharded
+    # route. None = off; (0, 0) = auto (a measured single-vs-sharded
+    # A/B enables sharding only when strictly faster, or without a
+    # probe when the frame exceeds the per-device feasibility bound);
+    # explicit (R, C) fails loudly when fewer than R*C devices exist.
+    # Mutually exclusive per-frame with mesh_frames: a frame either
+    # fans (whole-frame data parallelism) or shards (spatial), never
+    # both.
+    shard_frames: Optional[Tuple[int, int]] = None
+    # Sharded-frame routing threshold (true pixels, H*W) — the serve
+    # discipline (ServeConfig.shard_min_pixels) applied to the stream:
+    # frames below it stay single-device even when --shard-frames is
+    # given (the per-device tiles would be too small for the exchange
+    # to pay for itself).
+    shard_min_pixels: int = 1 << 20
+    # Interior/border overlap schedule for the sharded-frame mesh
+    # program, same vocabulary as JobConfig.overlap. Default "edge":
+    # the per-edge persistent double-buffered exchange (edge_iterate)
+    # rides the rep-loop carry (degenerate tiles degrade to "off"
+    # in-runner, report-what-ran). Ignored without shard_frames.
+    overlap: str = "edge"
     checkpoint_every: int = 0  # frame-index checkpoint period (0 = off)
     progress_every: int = 0    # stderr frame-index heartbeat (0 = off)
     # Dispatch watchdog window (seconds) around the drain's compute
@@ -267,6 +295,36 @@ class StreamConfig:
             raise ValueError(
                 f"mesh_frames must be >= 0 (0 = auto, 1 = single-device, "
                 f"N = fan width), got {self.mesh_frames}"
+            )
+        if self.shard_frames is not None:
+            sf = tuple(self.shard_frames)
+            if len(sf) != 2 or any(
+                not isinstance(d, int) or d < 0 for d in sf
+            ) or (0 in sf and sf != (0, 0)):
+                raise ValueError(
+                    f"shard_frames must be (rows, cols) positive ints, or "
+                    f"(0, 0) for auto, got {self.shard_frames}"
+                )
+            object.__setattr__(self, "shard_frames", sf)
+            if self.mesh_frames != 1:
+                # A frame either fans (one device computes it whole) or
+                # shards (the mesh computes it together) — the two
+                # compositions are mutually exclusive per frame.
+                raise ValueError(
+                    "shard_frames and mesh_frames are mutually exclusive "
+                    "per-frame: a frame either fans whole onto one device "
+                    "(--mesh-frames) or spatially shards over the mesh "
+                    "(--shard-frames), never both"
+                )
+        if self.shard_min_pixels < 1:
+            raise ValueError(
+                f"shard_min_pixels must be >= 1, got "
+                f"{self.shard_min_pixels}"
+            )
+        if self.overlap not in OVERLAP_MODES:
+            raise ValueError(
+                f"unknown overlap mode {self.overlap!r}; expected one of "
+                f"{'|'.join(OVERLAP_MODES)}"
             )
         if self.ring_buffers is not None and (
             self.ring_buffers < self.pipeline_depth + 1

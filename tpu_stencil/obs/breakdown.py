@@ -209,8 +209,10 @@ def render_stream(tracer: Tracer, info: dict) -> str:
     next to the measured rate.
 
     ``info``: ``{frame_bytes, reps, backend, filter_name, h_img,
-    block_h, fuse, pipeline_depth, frames, wall_seconds}``. Renders
-    nothing when no stream spans were recorded."""
+    block_h, fuse, pipeline_depth, frames, wall_seconds}`` — plus, on a
+    spatially-sharded run, ``{shard_frames, w_img, channels, halo}``
+    (the per-shard stage model needs the tile geometry and the ICI
+    ghost term). Renders nothing when no stream spans were recorded."""
     by = {r["name"]: r for r in aggregate(tracer)}
     stages = [n for n in (
         "stream.read", "stream.h2d", "stream.compute", "stream.d2h",
@@ -220,13 +222,24 @@ def render_stream(tracer: Tracer, info: dict) -> str:
         return ""
     from tpu_stencil.runtime import roofline
 
-    model_stages = roofline.stream_stage_seconds(
-        info["frame_bytes"], info["reps"], info["backend"],
-        info["filter_name"], info["h_img"],
-        block_h=info.get("block_h"), fuse=info.get("fuse"),
-    )
+    shard = info.get("shard_frames")
+    if shard:
+        model_stages = roofline.sharded_stream_stage_seconds(
+            info["reps"], info["backend"],
+            info["filter_name"], info["h_img"], info["w_img"],
+            info.get("channels", 1), tuple(shard),
+            halo=info.get("halo") or 1,
+            block_h=info.get("block_h"), fuse=info.get("fuse"),
+        )
+    else:
+        model_stages = roofline.stream_stage_seconds(
+            info["frame_bytes"], info["reps"], info["backend"],
+            info["filter_name"], info["h_img"],
+            block_h=info.get("block_h"), fuse=info.get("fuse"),
+        )
     depth = info.get("pipeline_depth", 2)
     n_dev = info.get("n_devices", 1) or 1
+    n_frames = info.get("frames") or 0
     lines = [
         "",
         f"stream pipeline: depth={depth}  "
@@ -239,15 +252,23 @@ def render_stream(tracer: Tracer, info: dict) -> str:
     total = 0.0
     for n in stages:
         per = by[n]["seconds"] / by[n]["count"]
+        if shard and n_frames and n in ("stream.h2d", "stream.d2h"):
+            # Sharded runs split H2D/D2H per shard (one span per tile,
+            # n_dev per frame): a frame's cost is the SUM of its
+            # shards' fenced transfers, so per-frame normalizes by the
+            # frame count, not the span count.
+            per = by[n]["seconds"] / n_frames
         # On a mesh fan the per-device stages (h2d/compute/d2h) run in
         # n_dev concurrent lanes, so a frame's share of the mesh's
         # THROUGHPUT is per/n_dev — the bottleneck comparison must use
         # that, or a 4-lane compute stage would out-rank the
         # single-threaded writer it is actually 4x faster than. The
-        # serial read/write stages handle every frame on one thread.
+        # serial read/write stages handle every frame on one thread. A
+        # SHARDED mesh computes one frame at a time — no lane division.
         eff = (
             per / n_dev
-            if n in ("stream.h2d", "stream.compute", "stream.d2h")
+            if not shard
+            and n in ("stream.h2d", "stream.compute", "stream.d2h")
             else per
         )
         total += eff
@@ -261,7 +282,10 @@ def render_stream(tracer: Tracer, info: dict) -> str:
     # The measured bound follows the depth's law, like the header says:
     # overlapped stages are limited by the slowest one; depth 1 pays
     # the serial sum.
-    mesh_note = f" ({n_dev} lanes)" if n_dev > 1 else ""
+    mesh_note = (
+        f" ({shard[0]}x{shard[1]} shards)" if shard
+        else f" ({n_dev} lanes)" if n_dev > 1 else ""
+    )
     if depth > 1 and slowest[1] > 0:
         lines.append(
             f"pipeline bound{mesh_note}: {slowest[0]} -> "
@@ -272,18 +296,45 @@ def render_stream(tracer: Tracer, info: dict) -> str:
             f"pipeline bound{mesh_note}: sum(stages) -> "
             f"{1.0 / total:.2f} frames/s"
         )
-    fps_model = roofline.stream_frames_per_second(
-        info["frame_bytes"], info["reps"], info["backend"],
-        info["filter_name"], info["h_img"],
-        block_h=info.get("block_h"), fuse=info.get("fuse"),
-        pipeline_depth=depth,
-    )
     measured = ""
     if info.get("frames") and info.get("wall_seconds"):
         measured = (
             f"measured {info['frames'] / info['wall_seconds']:.2f} "
             f"frames/s vs "
         )
+    if shard:
+        # Spatially sharded frames: the modeled bound is the max-stage
+        # bound over per-TILE compute + per-rep ICI ghost traffic +
+        # per-shard PCIe transfers (one mesh, one frame at a time — no
+        # x-n_devices term; the speedup lives inside the stages).
+        fps_shard = roofline.sharded_stream_frames_per_second(
+            info["frame_bytes"], info["reps"], info["backend"],
+            info["filter_name"], info["h_img"], info["w_img"],
+            info.get("channels", 1), tuple(shard),
+            halo=info.get("halo") or 1,
+            block_h=info.get("block_h"), fuse=info.get("fuse"),
+            pipeline_depth=depth,
+        )
+        th, tw = roofline.shard_tile_shape(
+            info["h_img"], info["w_img"], tuple(shard)
+        )
+        ici = roofline.ici_ghost_bytes_per_rep(
+            (th, tw), info.get("channels", 1), info.get("halo") or 1,
+            tuple(shard), mode="edge",
+        )
+        lines.append(
+            f"{measured}modeled sharded bound {fps_shard:.2f} frames/s "
+            f"(tile {th}x{tw}/device, ICI ghost model "
+            f"{ici / 1e3:.3f} KB/rep/device; host read/write measured, "
+            f"not modeled)"
+        )
+        return "\n".join(lines) + "\n"
+    fps_model = roofline.stream_frames_per_second(
+        info["frame_bytes"], info["reps"], info["backend"],
+        info["filter_name"], info["h_img"],
+        block_h=info.get("block_h"), fuse=info.get("fuse"),
+        pipeline_depth=depth,
+    )
     per_dev_label = "per-device " if n_dev > 1 else "device-side "
     lines.append(
         f"{measured}modeled {per_dev_label}bound {fps_model:.2f} frames/s "

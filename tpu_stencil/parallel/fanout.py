@@ -477,7 +477,14 @@ def resolve_mesh_frames(cfg: StreamConfig, devices,
     enables fan-out ONLY when the mesh arm measured strictly faster —
     the same never-auto-enable-a-measured-loss discipline as the deep
     schedule and the edge overlap verdicts. Returns 1 or the fan
-    width."""
+    width.
+
+    The real probe's verdict persists in the autotune cache
+    (:func:`tpu_stencil.runtime.autotune.cached_stream_verdict`, keyed
+    on platform/frame-geometry/depth/device-count like
+    ``overlap_verdict``), so a warm cache re-decides with ZERO probe
+    frames; an injected ``measure`` (tests) bypasses the cache in both
+    directions."""
     n_avail = len(devices)
     if cfg.mesh_frames == 1:
         return 1
@@ -491,8 +498,35 @@ def resolve_mesh_frames(cfg: StreamConfig, devices,
     # auto (0): nothing to fan on one device; else measure.
     if n_avail < 2:
         return 1
+    from tpu_stencil.runtime import autotune
+
+    geometry = (cfg.height, cfg.width, cfg.channels)
+    topo = f"ndev{n_avail}"
+    token = autotune.stream_cfg_token(cfg)
+    if measure is None:
+        hit = autotune.cached_stream_verdict(
+            "fanout", geometry, cfg.repetitions, cfg.pipeline_depth,
+            topo, token,
+        )
+        if hit is not None and 1 <= int(hit["pick"]) <= n_avail:
+            pick = int(hit["pick"])
+            print(
+                f"stream: --mesh-frames auto verdict from warm cache -> "
+                f"{'fan-out ' + str(pick) if pick > 1 else 'single-device'}"
+                f" (zero probe frames)",
+                file=sys.stderr, flush=True,
+            )
+            return pick
     t_single, t_mesh = (measure or measure_fanout_ab)(cfg, devices)
     pick = n_avail if t_mesh < t_single else 1
+    if measure is None:
+        autotune.store_stream_verdict(
+            "fanout", geometry, cfg.repetitions, cfg.pipeline_depth,
+            topo,
+            {"pick": pick, "single_us": round(t_single * 1e6, 2),
+             "mesh_us": round(t_mesh * 1e6, 2)},
+            token,
+        )
     print(
         f"stream: --mesh-frames auto measured single={t_single:.3f}s "
         f"mesh[{n_avail}]={t_mesh:.3f}s -> "

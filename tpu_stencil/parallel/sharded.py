@@ -23,6 +23,8 @@ semantics at the true image edge.
 
 from __future__ import annotations
 
+import collections
+import threading
 from typing import Optional, Sequence, Tuple
 
 import numpy as np
@@ -1011,3 +1013,131 @@ class ShardedRunner:
     def fetch(self, out_dev: jax.Array) -> np.ndarray:
         """Gather to host and crop the pad region off."""
         return np.asarray(out_dev)[: self.h, : self.w]
+
+
+# -- the shared runner cache (serve + stream) -------------------------
+#
+# One process-wide LRU of compiled ShardedRunner mesh programs, keyed on
+# everything that determines the compiled program (plan taps, geometry,
+# backend/schedule/kernel-geometry knobs, boundary, overlap mode, mesh
+# shape, device ids). Serve's oversized-request route and the stream's
+# --shard-frames path both resolve runners HERE, so a geometry warmed by
+# one engine is a cache hit for the other — stream and serve never
+# compile the same mesh program twice. Deterministic geometry refusals
+# (per-device tile smaller than the halo) are cached as an UNSERVABLE
+# sentinel so a retried shape never re-pays the failed build; transient/
+# compile failures propagate uncached.
+
+# LRU cap: each runner holds one compiled mesh program for one true
+# (filter, H, W, channels) — oversized shapes are rare and huge, so the
+# population is small, but the key space is client-controlled (serve)
+# and must not grow unboundedly.
+RUNNER_CACHE_CAP = 8
+
+_UNSERVABLE = object()
+_runner_cache: "collections.OrderedDict" = collections.OrderedDict()
+_runner_cache_lock = threading.Lock()
+
+
+def _resolved_mesh_for_key(mesh_shape, devices, image_shape):
+    """Normalize (mesh_shape, devices) to what the runner will actually
+    build over: an explicit RxC takes the first R*C devices; None takes
+    every device under the perimeter-minimizing default grid. Keying on
+    the RESOLVED shape means a stream's explicit ``--shard-frames RxC``
+    and serve's default mesh share one cache entry whenever they
+    resolve to the same program."""
+    devices = list(devices) if devices is not None else jax.devices()
+    if mesh_shape is not None:
+        r, c = mesh_shape
+        if r * c > len(devices):
+            raise ValueError(
+                f"mesh shape {r}x{c} needs {r * c} devices, "
+                f"have {len(devices)}"
+            )
+        return (r, c), devices[: r * c]
+    shape = partition.grid_shape(len(devices), *image_shape)
+    return tuple(shape), devices
+
+
+def runner_key(model, image_shape, channels, mesh_shape, devices,
+               overlap: str):
+    """The cache identity of one compiled mesh program. Everything the
+    compiled artifact depends on is in here; two callers whose keys
+    match would compile byte-identical programs."""
+    plan = model.plan
+    taps = ";".join(",".join(str(v) for v in row) for row in plan.taps)
+    return (
+        plan.kind, str(plan.divisor), taps, bool(plan.xla_pair_add),
+        tuple(image_shape), channels,
+        getattr(model, "backend", "auto"),
+        getattr(model, "schedule", None),
+        getattr(model, "block_h", None),
+        getattr(model, "fuse", None),
+        getattr(model, "boundary", "zero"),
+        tuple(mesh_shape),
+        tuple(d.id for d in devices),
+        overlap,
+    )
+
+
+def shared_runner(model, image_shape, channels, mesh_shape=None,
+                  devices=None, overlap: str = "off", registry=None,
+                  build_wrapper=None) -> Optional["ShardedRunner"]:
+    """The cached :class:`ShardedRunner` for this program identity, or
+    None when the mesh CANNOT serve the geometry (a typed ValueError /
+    NotImplementedError from the build — e.g. a per-device tile smaller
+    than the filter halo; the refusal is cached so retries never re-pay
+    the failed build). ``registry`` (optional) counts
+    ``sharded_runner_{hits,misses,evictions}_total`` and
+    ``sharded_fallbacks_total`` under the caller's metric surface (each
+    engine keeps its own counters over the ONE shared population);
+    ``build_wrapper`` lets a caller wrap the cold build (serve's
+    ``serve.sharded_runner_build`` span + its ``compile`` fault site)
+    — it receives the zero-arg builder and must call it."""
+    rshape, rdevs = _resolved_mesh_for_key(mesh_shape, devices,
+                                           image_shape)
+    key = runner_key(model, image_shape, channels, rshape, rdevs, overlap)
+    with _runner_cache_lock:
+        hit = _runner_cache.get(key)
+        if hit is not None:
+            _runner_cache.move_to_end(key)
+    if hit is not None:
+        if registry is not None:
+            registry.counter("sharded_runner_hits_total").inc()
+        return None if hit is _UNSERVABLE else hit
+    if registry is not None:
+        registry.counter("sharded_runner_misses_total").inc()
+
+    def build():
+        return ShardedRunner(model, tuple(image_shape), channels,
+                             mesh_shape=rshape, devices=rdevs,
+                             overlap=overlap)
+
+    try:
+        runner = build_wrapper(build) if build_wrapper else build()
+    except (ValueError, NotImplementedError):
+        # Deterministic geometry refusal (transient/compile failures
+        # raise other types and propagate uncached).
+        runner = _UNSERVABLE
+        if registry is not None:
+            registry.counter("sharded_fallbacks_total").inc()
+    with _runner_cache_lock:
+        _runner_cache[key] = runner
+        _runner_cache.move_to_end(key)
+        while len(_runner_cache) > RUNNER_CACHE_CAP:
+            _runner_cache.popitem(last=False)
+            if registry is not None:
+                registry.counter("sharded_runner_evictions_total").inc()
+    return None if runner is _UNSERVABLE else runner
+
+
+def runner_cache_len() -> int:
+    with _runner_cache_lock:
+        return len(_runner_cache)
+
+
+def clear_runner_cache() -> None:
+    """Drop every cached runner (tests; a long-lived process never
+    needs this — the LRU cap bounds the population)."""
+    with _runner_cache_lock:
+        _runner_cache.clear()

@@ -76,10 +76,11 @@ def _key(plan: StencilPlan, shape: Tuple[int, int], channels: int) -> str:
 
 def _entry_jax_version(key: str) -> Optional[str]:
     """The jax version embedded in a cache key (``_key`` puts it second;
-    overlap keys prepend an extra segment). None for unparseable keys —
-    those are garbage and get evicted."""
+    overlap/stream-verdict keys prepend an extra kind segment). None for
+    unparseable keys — those are garbage and get evicted."""
     parts = key.split("|")
-    idx = 2 if parts and parts[0] == "overlap" else 1
+    idx = 2 if parts and parts[0] in ("overlap", "fanout",
+                                      "shardstream") else 1
     return parts[idx] if len(parts) > idx else None
 
 
@@ -637,6 +638,75 @@ def best_overlap(plan: StencilPlan, tile: Tuple[int, int], channels: int,
         )
         _store_cache(store)
     return mode
+
+
+# --- stream mesh-composition verdicts (--mesh-frames 0 /
+# --shard-frames 0) ------------------------------------------------------
+#
+# Both stream auto knobs decide by a measured A/B (single-device vs the
+# mesh composition; never-enable-a-measured-loss). The A/B streams real
+# probe frames through the real engines — frames of compute per arm —
+# so the verdict persists here exactly like overlap_verdict: keyed on
+# (platform, frame geometry, reps, pipeline depth, topology), a warm
+# cache pays ZERO probe frames on later invocations.
+
+def stream_cfg_token(cfg) -> str:
+    """The compute-identity segment of a stream verdict key: the A/B's
+    arms time the COMPILED step, so everything that changes it —
+    filter, backend request, forced schedule/geometry, boundary — must
+    split the cache key exactly like ``_key`` splits the backend
+    verdicts on plan taps. A verdict measured under one filter or
+    backend must never answer for another at the same geometry."""
+    return "|".join([
+        cfg.filter_name, cfg.backend, str(cfg.schedule),
+        str(cfg.block_h), str(cfg.fuse), cfg.boundary,
+        # The sharded arm's overlap schedule changes its compiled mesh
+        # program; single-device/fan arms ignore it (harmless split).
+        getattr(cfg, "overlap", "off"),
+    ])
+
+
+def _stream_verdict_key(kind: str, geometry: Tuple[int, int, int],
+                        reps: int, depth: int, topo: str,
+                        cfg_token: str = "") -> str:
+    import jax
+
+    h, w, channels = geometry
+    return "|".join([
+        kind, jax.default_backend(), jax.__version__,
+        f"{h}x{w}x{channels}", f"reps{reps}", f"depth{depth}", topo,
+        cfg_token,
+    ])
+
+
+def cached_stream_verdict(kind: str, geometry: Tuple[int, int, int],
+                          reps: int, depth: int, topo: str,
+                          cfg_token: str = "") -> Optional[dict]:
+    """The cached auto verdict for one stream mesh composition, or None
+    (cache miss / malformed entry). ``kind`` is ``"fanout"``
+    (``--mesh-frames 0``) or ``"shardstream"`` (``--shard-frames 0``);
+    ``topo`` pins the decided-over topology (``ndev8`` / ``mesh2x4``)
+    so a verdict never answers for a different device population, and
+    ``cfg_token`` (:func:`stream_cfg_token`) pins the compute identity
+    (filter/backend/schedule/geometry knobs/boundary)."""
+    hit = _load_cache().get(
+        _stream_verdict_key(kind, geometry, reps, depth, topo, cfg_token)
+    )
+    if isinstance(hit, dict) and "pick" in hit:
+        return hit
+    return None
+
+
+def store_stream_verdict(kind: str, geometry: Tuple[int, int, int],
+                         reps: int, depth: int, topo: str,
+                         entry: dict, cfg_token: str = "") -> None:
+    """Persist one measured stream-composition verdict (``entry`` must
+    carry ``pick`` plus whatever measured arms make it auditable —
+    the ``overlap_verdict`` discipline)."""
+    store = _load_cache()
+    store[_stream_verdict_key(kind, geometry, reps, depth, topo,
+                              cfg_token)] = entry
+    _store_cache(store)
 
 
 def best_config(

@@ -323,20 +323,33 @@ def restore_sharded(cfg: JobConfig, sharding) -> Optional[Tuple[int, "object"]]:
 
 
 class MeshCursorMismatch(ValueError):
-    """A ``--resume`` of a mesh-fan stream run under a different device
-    count than the one that wrote the checkpoint. The recorded
-    per-device frame cursors are round-robin-aligned to the writing
-    run's device count, so silently adopting them under another count
-    would misattribute frames to devices; the resume must fail typed,
-    naming both counts (the recorded one and the requested one)."""
+    """A ``--resume`` of a mesh-composed stream run under a different
+    mesh topology than the one that wrote the checkpoint — the fan
+    width (``--mesh-frames`` device count) or the spatial shard
+    topology (``--shard-frames RxC``). The recorded cursor/scatter
+    layout is aligned to the writing run's topology, so silently
+    adopting it under another one would misattribute frames to devices
+    (fan) or mis-scatter tiles (shard); the resume must fail typed,
+    naming both topologies (the recorded one and the requested one).
 
-    def __init__(self, recorded: int, requested: int, path: str) -> None:
-        super().__init__(
-            f"stream checkpoint at {path} was written by a "
-            f"{recorded}-device mesh-fan run but --resume is running on "
-            f"{requested} device(s); re-run with --mesh-frames "
-            f"{recorded} (or delete the checkpoint to start over)"
-        )
+    ``recorded``/``requested`` are device counts (ints) for the fan
+    guard, ``"RxC"`` strings for the spatial-shard guard."""
+
+    def __init__(self, recorded, requested, path: str) -> None:
+        if isinstance(recorded, str) or isinstance(requested, str):
+            super().__init__(
+                f"stream checkpoint at {path} records spatial shard "
+                f"topology {recorded} (--shard-frames) but --resume is "
+                f"running {requested}; re-run at the recorded topology "
+                f"(or delete the checkpoint to start over)"
+            )
+        else:
+            super().__init__(
+                f"stream checkpoint at {path} was written by a "
+                f"{recorded}-device mesh-fan run but --resume is running "
+                f"on {requested} device(s); re-run with --mesh-frames "
+                f"{recorded} (or delete the checkpoint to start over)"
+            )
         self.recorded = recorded
         self.requested = requested
 
@@ -371,7 +384,9 @@ def _stream_fingerprint(cfg) -> dict:
 
 def save_stream_progress(cfg, frames_done: int,
                          mesh_devices: int = 1,
-                         cursors: Optional[list] = None) -> None:
+                         cursors: Optional[list] = None,
+                         shard_frames: Optional[Tuple[int, int]] = None
+                         ) -> None:
     """Atomically record that frames [0, frames_done) are durably in
     the sink. No frame payload — unlike the rep checkpoints, a stream's
     completed frames already live in the output; progress is one
@@ -386,7 +401,13 @@ def save_stream_progress(cfg, frames_done: int,
     cursors — they are the diagnostic record of where the interrupted
     fan stood); what the resume contract enforces is the device count,
     which a different-count resume must refuse
-    (:class:`MeshCursorMismatch`)."""
+    (:class:`MeshCursorMismatch`).
+
+    Spatially-sharded runs (``--shard-frames``) record the RxC shard
+    topology instead — the scatter layout every staged tile of the
+    writing run followed. A resume under a different topology (or
+    under no topology at all) must refuse typed rather than silently
+    mis-scatter, the same contract as the fan's device count."""
     _checkpoint_fault(int(frames_done))
     path = _stream_paths(cfg)
     meta = dict(_stream_fingerprint(cfg), frames_done=int(frames_done))
@@ -394,19 +415,29 @@ def save_stream_progress(cfg, frames_done: int,
         meta["mesh_devices"] = int(mesh_devices)
         if cursors is not None:
             meta["device_cursors"] = [int(c) for c in cursors]
+    if shard_frames is not None:
+        meta["shard_frames"] = [int(d) for d in shard_frames]
     _write_meta(path, meta)
 
 
-def restore_stream_progress(cfg, mesh_devices: int = 1) -> Optional[int]:
+def _topology_str(shard) -> str:
+    return "single-device" if shard is None else f"{shard[0]}x{shard[1]}"
+
+
+def restore_stream_progress(cfg, mesh_devices: int = 1,
+                            shard_frames: Optional[Tuple[int, int]] = None
+                            ) -> Optional[int]:
     """Frames already completed by a matching prior run, or None. A
     fingerprint mismatch raises (resuming a different job's sink would
     silently mix outputs); a device-count mismatch against a mesh-fan
-    checkpoint raises typed (:class:`MeshCursorMismatch` — the recorded
-    per-device cursors are aligned to the writing run's round-robin, so
-    a different count must never silently adopt them); a sidecar that
-    fails its embedded CRC (or no longer parses) raises typed
-    (:class:`CorruptCheckpoint` naming the file) — a flipped bit in
-    ``frames_done`` would otherwise silently skip or rewrite frames."""
+    checkpoint — or a spatial-shard-topology mismatch against a
+    ``--shard-frames`` checkpoint — raises typed
+    (:class:`MeshCursorMismatch`: the recorded cursor/scatter layout is
+    aligned to the writing run's topology, so a different one must
+    never silently adopt it); a sidecar that fails its embedded CRC (or
+    no longer parses) raises typed (:class:`CorruptCheckpoint` naming
+    the file) — a flipped bit in ``frames_done`` would otherwise
+    silently skip or rewrite frames."""
     path = _stream_paths(cfg)
     if not os.path.exists(path):
         return None
@@ -420,6 +451,16 @@ def restore_stream_progress(cfg, mesh_devices: int = 1) -> Optional[int]:
     recorded = int(meta.get("mesh_devices", 1))
     if recorded != int(mesh_devices):
         raise MeshCursorMismatch(recorded, int(mesh_devices), path)
+    rec_shard = meta.get("shard_frames")
+    rec_shard = tuple(int(d) for d in rec_shard) if rec_shard else None
+    req_shard = tuple(int(d) for d in shard_frames) if shard_frames else None
+    if rec_shard != req_shard:
+        raise MeshCursorMismatch(
+            _topology_str(rec_shard),
+            (f"--shard-frames {_topology_str(req_shard)}"
+             if req_shard else "single-device"),
+            path,
+        )
     return int(meta["frames_done"])
 
 

@@ -10,9 +10,16 @@ traffic model cannot drift apart.
 
 from __future__ import annotations
 
-from typing import Tuple
+import os
+from typing import Optional, Tuple
 
 V5E_HBM_GBPS = 819.0  # v5e HBM peak bandwidth
+# v5e per-device HBM capacity (16 GiB) — the feasibility ceiling a
+# single in-flight streaming frame (plus its donated output and the
+# dispatch-ahead window's siblings) must fit under; past it the frame
+# can only stream via --shard-frames. TPU_STENCIL_DEVICE_HBM_BYTES
+# overrides (smaller lab parts, or tests pinning the bound).
+V5E_HBM_BYTES = 16 * (1 << 30)
 # v5e inter-chip interconnect: 4 links x 400 Gbps = 1600 Gbps aggregate
 # per chip (the public spec sheet's number) — the ceiling the sharded
 # path's ghost traffic rides.
@@ -187,6 +194,113 @@ def stream_frames_per_second(frame_bytes: int, reps: int, backend: str,
     .render_stream`)."""
     stages = stream_stage_seconds(
         frame_bytes, reps, backend, filter_name, h_img, block_h, fuse
+    )
+    bound = (
+        sum(stages.values()) if pipeline_depth <= 1
+        else max(stages.values())
+    )
+    return 1.0 / bound if bound > 0 else float("inf")
+
+
+def device_hbm_bytes() -> int:
+    """The per-device HBM feasibility budget:
+    ``TPU_STENCIL_DEVICE_HBM_BYTES`` when set, else the v5e part's
+    16 GiB."""
+    return int(os.environ.get("TPU_STENCIL_DEVICE_HBM_BYTES",
+                              V5E_HBM_BYTES))
+
+
+def hbm_frame_feasible(frame_bytes: int, pipeline_depth: int = 2,
+                       hbm_bytes: Optional[int] = None) -> bool:
+    """Whether ONE device can hold the streaming engine's steady-state
+    working set for this frame size: each of the ``pipeline_depth``
+    in-flight frames occupies an input buffer that donation turns into
+    its output (one resident canvas per window slot), plus one slot of
+    H2D staging headroom — ``(depth + 1) * frame_bytes`` against the
+    per-device budget (:func:`device_hbm_bytes`). False is the
+    feasibility refusal the spatially-sharded stream route
+    (``--shard-frames``) exists for: the per-device working set then
+    shrinks by the mesh factor (each device holds TILES, not frames),
+    and ``--shard-frames 0`` (auto) shards without paying a probe —
+    the single-device arm could not run at all."""
+    budget = hbm_bytes if hbm_bytes is not None else device_hbm_bytes()
+    return (pipeline_depth + 1) * frame_bytes <= budget
+
+
+def shard_tile_shape(h_img: int, w_img: int,
+                     mesh_shape: Tuple[int, int]) -> Tuple[int, int]:
+    """The padded per-device tile of a spatially sharded frame (the
+    partition module's ceil-divide grid, restated jax-free so the
+    roofline model needs no mesh)."""
+    r, c = mesh_shape
+    return -(-h_img // r), -(-w_img // c)
+
+
+def sharded_stream_stage_seconds(reps: int,
+                                 backend: str, filter_name: str,
+                                 h_img: int, w_img: int, channels: int,
+                                 mesh_shape: Tuple[int, int],
+                                 halo: int = 1,
+                                 block_h=None, fuse=None) -> dict:
+    """Modeled per-frame seconds of the spatially-sharded streaming
+    stages (``--shard-frames RxC``): ``h2d``/``d2h`` move the PADDED
+    frame across the host's shared PCIe complex one per-shard tile at a
+    time (the uploads are split per shard so frame i+1's tiles overlap
+    frame i's exchange-and-compute, but they still sum to the padded
+    frame on the one shared pipe — the per-shard PCIe term), and
+    ``compute`` runs ``reps`` repetitions of the per-device TILE
+    against the HBM roofline plus the per-rep ICI ghost traffic of the
+    per-edge exchange (:func:`ici_ghost_bytes_per_rep`, ``mode="edge"``
+    — the persistent per-edge pipeline the sharded stream threads
+    through the rep loop). All byte counts derive from the tile
+    geometry (``h_img``/``w_img``/``channels``), never a caller-
+    supplied frame size that could disagree with it. Host
+    ``read``/``write`` stay measured, never modeled."""
+    th, tw = shard_tile_shape(h_img, w_img, mesh_shape)
+    r, c = mesh_shape
+    tile_bytes = th * tw * channels
+    padded_bytes = tile_bytes * r * c
+    per_rep_tile = analytic_bytes_per_rep(
+        tile_bytes, backend, filter_name, th, block_h, fuse,
+        w_img=tw, channels=channels, reps=reps,
+    )
+    ici_per_rep = ici_ghost_bytes_per_rep(
+        (th, tw), channels, halo, mesh_shape, fuse=fuse or 1,
+        mode="edge",
+    )
+    return {
+        "h2d": padded_bytes / (V5E_PCIE_GBPS * 1e9),
+        "compute": reps * (
+            per_rep_tile / (V5E_HBM_GBPS * 1e9)
+            + ici_per_rep / (V5E_ICI_GBPS * 1e9)
+        ),
+        "d2h": padded_bytes / (V5E_PCIE_GBPS * 1e9),
+    }
+
+
+def sharded_stream_frames_per_second(frame_bytes: int, reps: int,
+                                     backend: str, filter_name: str,
+                                     h_img: int, w_img: int,
+                                     channels: int,
+                                     mesh_shape: Tuple[int, int],
+                                     halo: int = 1,
+                                     block_h=None, fuse=None,
+                                     pipeline_depth: int = 2) -> float:
+    """The modeled steady-state frames/s bound of the spatially-sharded
+    stream (:mod:`tpu_stencil.stream.sharded`): the max-stage bound of
+    :func:`sharded_stream_stage_seconds` at depth >= 2 (per-shard H2D
+    of frame i+1 overlaps frame i's exchange-and-compute), the serial
+    sum at depth 1. One mesh computes one frame at a time, so unlike
+    the fan-out there is no x-n_devices term — the speedup lives
+    inside the stages (tile-sized compute, mesh-wide exchange).
+    ``frame_bytes`` is accepted for signature parity with
+    :func:`stream_frames_per_second` (the breakdown passes one info
+    dict to both); the stage model derives every byte count from the
+    tile geometry."""
+    del frame_bytes
+    stages = sharded_stream_stage_seconds(
+        reps, backend, filter_name, h_img, w_img, channels,
+        mesh_shape, halo=halo, block_h=block_h, fuse=fuse,
     )
     bound = (
         sum(stages.values()) if pipeline_depth <= 1

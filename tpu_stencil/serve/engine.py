@@ -368,13 +368,6 @@ class _MemorySampler:
 # is 64 by default; 8x that covers realistic churn).
 _INTROSPECT_KEY_CAP = 512
 
-# LRU cap on cached ShardedRunners (the sharded-routing analog of the
-# bucket-executable cache): each runner holds a compiled mesh program
-# for one true (filter, H, W, channels) — oversized shapes are rare and
-# huge, so the population is small, but the key space is still
-# client-controlled and must not grow unboundedly.
-_SHARDED_RUNNER_CAP = 8
-
 _server_serials = itertools.count()
 
 _last_server_ref = None  # weakref to the most recently constructed server
@@ -481,11 +474,11 @@ class StencilServer:
         self._m_witness_total = m.counter("integrity_witness_total")
         self._m_witness_bad = m.counter("integrity_witness_mismatch_total")
         # Sharded routing (overlap != "off"): oversized requests run the
-        # shard_map path; the runner cache is the sharded analog of the
-        # bucket-executable cache.
-        self._sharded_runners: "collections.OrderedDict" = (
-            collections.OrderedDict()
-        )
+        # shard_map path; runners come from the PROCESS-SHARED cache in
+        # parallel/sharded.py (one population for serve AND the stream's
+        # --shard-frames route — a mesh program compiled by either
+        # engine is a hit for the other; this server's hit/miss counters
+        # land in its own registry).
         self._m_sharded = m.counter("sharded_requests_total")
         self._m_sharded_batches = m.counter("sharded_batches_total")
         self._m_qwait = m.histogram("queue_wait_seconds")
@@ -814,7 +807,11 @@ class StencilServer:
         snap = self.registry.snapshot()
         snap["executables_cached"] = len(self._cache)
         snap["introspected_executables"] = len(self._introspected)
-        snap["sharded_runners_cached"] = len(self._sharded_runners)
+        # The PROCESS-SHARED runner population (serve + stream share
+        # one cache — parallel/sharded.py).
+        from tpu_stencil.parallel import sharded as _sharded
+
+        snap["sharded_runners_cached"] = _sharded.runner_cache_len()
         return snap
 
     def introspection(self) -> List[dict]:
@@ -873,20 +870,19 @@ class StencilServer:
             )
         return model
 
-    # Cache sentinel: this shape's mesh build failed on a DETERMINISTIC
-    # geometry constraint — serve it on the bucket path, and never
-    # re-pay the failed build on the next same-shape request.
-    _SHARDED_UNSERVABLE = object()
-
     def _sharded_runner_for(self, filter_name: str, hw: Tuple[int, int],
                             channels: int):
         """The cached :class:`~tpu_stencil.parallel.sharded
         .ShardedRunner` for one true (filter, H, W, channels) — keyed
         WITHOUT reps (the runner's rep count is a traced argument, so
-        one compiled mesh program serves any reps), LRU-bounded like
-        the bucket-executable cache. Built over all local devices with
-        the server's overlap schedule (a 1-device process degrades to
-        the 1x1 mesh — still bit-exact, so routing never depends on
+        one compiled mesh program serves any reps), resolved through
+        the PROCESS-SHARED runner cache
+        (:func:`tpu_stencil.parallel.sharded.shared_runner` — one
+        LRU-bounded population serving this engine and the stream's
+        ``--shard-frames`` route, so the same mesh program is never
+        compiled twice in one process). Built over all local devices
+        with the server's overlap schedule (a 1-device process degrades
+        to the 1x1 mesh — still bit-exact, so routing never depends on
         device count).
 
         Returns None when the mesh CANNOT serve this geometry (e.g. an
@@ -896,42 +892,25 @@ class StencilServer:
         path, which serves every shape the pre-routing engine did. The
         verdict is cached so retries of the same shape never re-pay the
         failed build."""
-        key = (filter_name, hw, channels)
-        runner = self._sharded_runners.get(key)
-        if runner is not None:
-            self.registry.counter("sharded_runner_hits_total").inc()
-            self._sharded_runners.move_to_end(key)
-            return (
-                None if runner is self._SHARDED_UNSERVABLE else runner
-            )
-        self.registry.counter("sharded_runner_misses_total").inc()
         import jax
 
         from tpu_stencil.parallel import sharded as _sharded
 
-        with _obs_span("serve.sharded_runner_build", "serve",
-                       shape=hw, channels=channels):
-            # The largest compile in serve: the "compile" injection
-            # point must cover it like the bucket builders, or the
-            # chaos suite cannot exercise a failed mesh build.
-            if self._fault_compile is not None:
-                self._fault_compile()
-            try:
-                runner = _sharded.ShardedRunner(
-                    self._model_for(filter_name), hw, channels,
-                    devices=jax.devices(), overlap=self.cfg.overlap,
-                )
-            except (ValueError, NotImplementedError):
-                # Deterministic geometry refusal (transient/compile
-                # failures raise other types and propagate like any
-                # dispatch error — they are NOT cached).
-                runner = self._SHARDED_UNSERVABLE
-                self.registry.counter("sharded_fallbacks_total").inc()
-        self._sharded_runners[key] = runner
-        while len(self._sharded_runners) > _SHARDED_RUNNER_CAP:
-            self._sharded_runners.popitem(last=False)
-            self.registry.counter("sharded_runner_evictions_total").inc()
-        return None if runner is self._SHARDED_UNSERVABLE else runner
+        def wrapper(build):
+            with _obs_span("serve.sharded_runner_build", "serve",
+                           shape=hw, channels=channels):
+                # The largest compile in serve: the "compile" injection
+                # point must cover it like the bucket builders, or the
+                # chaos suite cannot exercise a failed mesh build.
+                if self._fault_compile is not None:
+                    self._fault_compile()
+                return build()
+
+        return _sharded.shared_runner(
+            self._model_for(filter_name), hw, channels,
+            devices=jax.devices(), overlap=self.cfg.overlap,
+            registry=self.registry, build_wrapper=wrapper,
+        )
 
     def _account_devices(self, n_devices: int, total_bytes: int,
                          n_requests: int, first: int = 0) -> None:

@@ -116,6 +116,39 @@ def build_parser() -> argparse.ArgumentParser:
              "--resume under a different count fails typed",
     )
     p.add_argument(
+        "--shard-frames", dest="shard_frames", default=None,
+        metavar="RxC",
+        help="spatially shard every in-flight frame over an RxC device "
+             "mesh (docs/STREAMING.md 'Spatially sharded frames') — the "
+             "route for frames too big for one device's HBM: the mesh "
+             "program is the SAME cached ShardedRunner serve's "
+             "oversized-request path compiles (one shared cache), with "
+             "the per-edge persistent exchange (--overlap, default "
+             "edge) threaded through the rep loop and H2D/D2H split "
+             "per shard. 0 = auto (a measured single-vs-sharded A/B, "
+             "cached; frames past the per-device HBM feasibility bound "
+             "shard without a probe). Frames below --shard-min-pixels "
+             "stay single-device (serve's routing discipline). "
+             "Mutually exclusive with --mesh-frames; bit-exact; "
+             "checkpoints record the topology, so --resume under a "
+             "different RxC fails typed",
+    )
+    p.add_argument(
+        "--shard-min-pixels", dest="shard_min_pixels", type=int,
+        default=1 << 20, metavar="PX",
+        help="sharded-frame routing threshold in true pixels (H*W), "
+             "the serve discipline: frames below it stay single-device "
+             "even under --shard-frames (default 1 Mpx)",
+    )
+    p.add_argument(
+        "--overlap", default="edge", choices=list(OVERLAP_MODES),
+        help="compute/communication overlap schedule of the "
+             "--shard-frames mesh program, same vocabulary as the run "
+             "CLI; default edge (per-edge persistent double-buffered "
+             "exchange in the rep-loop carry; degenerate tiles degrade "
+             "to off, report-what-ran). Ignored without --shard-frames",
+    )
+    p.add_argument(
         "--checkpoint-every", type=int, default=0, metavar="N",
         help="commit a frame-index checkpoint every N written frames "
              "(0 = off); needs a resumable sink (file or directory)",
@@ -216,9 +249,27 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
+def _parse_shard_frames(parser, value):
+    """``RxC`` -> (R, C); ``0`` -> (0, 0) (auto); None passes through.
+    Jax-free, like every CLI validation here."""
+    if value is None:
+        return None
+    if value == "0":
+        return (0, 0)
+    r, sep, c = value.lower().partition("x")
+    if not sep or not r.isdigit() or not c.isdigit() \
+            or int(r) < 1 or int(c) < 1:
+        parser.error(
+            f"--shard-frames must be RxC with positive integers, or 0 "
+            f"for auto, got {value!r}"
+        )
+    return (int(r), int(c))
+
+
 def main(argv=None) -> int:
     parser = build_parser()
     ns = parser.parse_args(argv)
+    shard_frames = _parse_shard_frames(parser, ns.shard_frames)
     try:
         cfg = StreamConfig(
             input=ns.input,
@@ -237,6 +288,9 @@ def main(argv=None) -> int:
             pipeline_depth=ns.pipeline_depth,
             ring_buffers=ns.ring_buffers,
             mesh_frames=ns.mesh_frames,
+            shard_frames=shard_frames,
+            shard_min_pixels=ns.shard_min_pixels,
+            overlap=ns.overlap,
             checkpoint_every=ns.checkpoint_every,
             progress_every=ns.progress_every,
             dispatch_timeout_s=ns.dispatch_timeout_s,
@@ -319,11 +373,15 @@ def main(argv=None) -> int:
         f"({result.frames_per_second:.2f} frames/s, "
         f"depth={result.pipeline_depth}, backend={result.backend}"
         + (f" schedule={result.schedule}" if result.schedule else "")
+        + (f" shard-frames={result.shard_frames[0]}x"
+           f"{result.shard_frames[1]}"
+           if result.shard_frames else "")
         + (f" mesh-frames={result.n_devices}dev"
-           if result.n_devices > 1 else "")
+           if result.n_devices > 1 and not result.shard_frames else "")
         + ")", file=report_out,
     )
-    if result.n_devices > 1 and result.per_device_frames:
+    if result.n_devices > 1 and not result.shard_frames \
+            and result.per_device_frames:
         print(
             "per-device frames: "
             + " ".join(f"dev{d}={c}"
@@ -349,6 +407,9 @@ def main(argv=None) -> int:
             "restarts": result.restarts,
             "n_devices": result.n_devices,
             "per_device_frames": result.per_device_frames,
+            "shard_frames": (
+                list(result.shard_frames) if result.shard_frames else None
+            ),
             "output": out_spec,
         }
         text = json.dumps(payload, indent=2, sort_keys=True)
@@ -370,6 +431,13 @@ def _report_observability(ns, cfg: StreamConfig, result, out) -> None:
         if wrote:
             print(f"wrote trace {wrote}", file=out)
     if ns.breakdown:
+        halo = None
+        if result.shard_frames:
+            # The ICI ghost model needs the filter halo; the filter
+            # bank is pure numpy, so this stays jax-free.
+            from tpu_stencil.filters import get_filter
+
+            halo = get_filter(cfg.filter_name).halo
         print(obs.breakdown.render_breakdown(tracer), end="", file=out)
         print(obs.breakdown.render_stream(tracer, {
             "frame_bytes": cfg.frame_bytes,
@@ -377,12 +445,16 @@ def _report_observability(ns, cfg: StreamConfig, result, out) -> None:
             "backend": result.backend,
             "filter_name": cfg.filter_name,
             "h_img": cfg.height,
+            "w_img": cfg.width,
+            "channels": cfg.channels,
             "block_h": cfg.block_h,
             "fuse": cfg.fuse,
             "pipeline_depth": result.pipeline_depth,
             "frames": result.frames,
             "wall_seconds": result.wall_seconds,
             "n_devices": result.n_devices,
+            "shard_frames": result.shard_frames,
+            "halo": halo,
         }), end="", file=out)
         print(obs.breakdown.render_resilience(obs.snapshot()),
               end="", file=out)

@@ -117,6 +117,11 @@ class StreamResult:
     # frames each device's lane completed this run.
     n_devices: int = 1
     per_device_frames: Optional[list] = None
+    # Spatially sharded frames (tpu_stencil.stream.sharded): the RxC
+    # topology each frame sharded over, or None (report-what-ran —
+    # --shard-frames 0 and the shard_min_pixels routing discipline
+    # resolve before this is set; n_devices is then R*C).
+    shard_frames: Optional[Tuple[int, int]] = None
 
 
 class _Abort(Exception):
@@ -472,10 +477,12 @@ def _drain(pl: _Pipeline, eng: dict) -> None:
         pl.fail(stage, max(idx, 0), e)
 
 
-def _writer(pl: _Pipeline, sink, done: list) -> None:
+def _writer(pl: _Pipeline, sink, done: list, save_progress=None) -> None:
     """Write results in order; commit the frame-index checkpoint and the
     progress heartbeat. ``done[0]`` tracks frames fully written. Retry
-    semantics: :func:`_make_write_frame`."""
+    semantics: :func:`_make_write_frame`. ``save_progress`` (optional)
+    overrides the checkpoint commit — the sharded-stream engine passes
+    a closure stamping the RxC shard topology into the sidecar."""
     cfg = pl.cfg
     idx = -1
     write_frame = _make_write_frame(cfg, sink)
@@ -498,7 +505,10 @@ def _writer(pl: _Pipeline, sink, done: list) -> None:
                 from tpu_stencil.runtime import checkpoint as ckpt
 
                 sink.flush()
-                ckpt.save_stream_progress(cfg, done[0])
+                if save_progress is not None:
+                    save_progress(done[0])
+                else:
+                    ckpt.save_stream_progress(cfg, done[0])
             if cfg.progress_every and done[0] % cfg.progress_every == 0:
                 print(f"stream: frame {done[0]}", file=sys.stderr, flush=True)
     except _Abort:
@@ -648,15 +658,29 @@ def run_stream(
     resolved ONCE per call — explicit N, or the measured auto A/B
     (:func:`tpu_stencil.parallel.fanout.resolve_mesh_frames`) — and
     every restart of this run re-fans at the same width, so the
-    checkpoint's per-device cursors stay aligned."""
+    checkpoint's per-device cursors stay aligned.
+
+    Spatially sharded frames (``cfg.shard_frames``): the RxC topology
+    is likewise resolved ONCE per call (explicit RxC above the
+    ``shard_min_pixels`` routing threshold, or the measured /
+    feasibility-forced auto verdict —
+    :func:`tpu_stencil.stream.sharded.resolve_shard_frames`) and every
+    restart re-shards at the SAME topology, so the checkpoint's
+    recorded scatter layout stays aligned."""
     restarts = 0
     n_mesh = None
+    shard = _UNRESOLVED
     while True:
         try:
+            if shard is _UNRESOLVED:
+                shard = _resolve_shard_frames(cfg, devices)
             if n_mesh is None:
-                n_mesh = _resolve_mesh_frames(cfg, devices)
+                n_mesh = (
+                    1 if shard is not None
+                    else _resolve_mesh_frames(cfg, devices)
+                )
             result = _run_stream_once(cfg, devices, resume, source, sink,
-                                      n_mesh=n_mesh)
+                                      n_mesh=n_mesh, shard=shard)
             result.restarts = restarts
             return result
         except StreamFailure as e:
@@ -689,7 +713,8 @@ def _finish_result(cfg: StreamConfig, resume: bool, t_start: float,
                    start_frame: int, frames: int, stage_seconds: Dict,
                    backend: str, schedule, out_spec: str,
                    n_devices: int = 1,
-                   per_device_frames: Optional[list] = None
+                   per_device_frames: Optional[list] = None,
+                   shard_frames: Optional[Tuple[int, int]] = None
                    ) -> StreamResult:
     """The shared run epilogue both engines (single-device and mesh
     fan-out) end in: sweep the progress sidecar of a completed run,
@@ -713,6 +738,7 @@ def _finish_result(cfg: StreamConfig, resume: bool, t_start: float,
         output=out_spec,
         n_devices=n_devices,
         per_device_frames=per_device_frames,
+        shard_frames=shard_frames,
     )
 
 
@@ -730,6 +756,47 @@ def _resolve_mesh_frames(cfg: StreamConfig, devices) -> int:
     return fanout.resolve_mesh_frames(cfg, devs)
 
 
+# Distinct from None: shard resolution CAN resolve to None (single
+# device), and the restart loop must not re-pay the probe for it.
+_UNRESOLVED = object()
+
+
+def _resolve_shard_frames(cfg: StreamConfig, devices
+                          ) -> Optional[Tuple[int, int]]:
+    """The RxC topology this run spatially shards over, or None: no jax
+    import at all without ``--shard-frames``; else the shard resolver's
+    verdict (explicit RxC under the routing threshold discipline, or
+    the measured / feasibility-forced auto A/B)."""
+    if cfg.shard_frames is None:
+        return None
+    import jax
+
+    from tpu_stencil.stream import sharded as shardstream
+
+    devs = devices if devices is not None else jax.devices()
+    return shardstream.resolve_shard_frames(cfg, devs)
+
+
+def _close_io(own_source, source, own_sink, sink, failed: bool) -> None:
+    """The mesh/shard-branch close discipline, in ONE place (the two
+    branches used to carry verbatim copies): closing the source can
+    race a reader parked in read() and the failure is already recorded
+    first-wins, so a close-time error must never mask it; a sink-close
+    error on an otherwise-clean run still raises (lost buffered frames
+    are a real failure)."""
+    if own_source:
+        try:
+            source.close()
+        except OSError:
+            pass
+    if own_sink and sink is not None:
+        try:
+            sink.close()
+        except OSError:
+            if not failed:
+                raise
+
+
 def _run_stream_once(
     cfg: StreamConfig,
     devices: Optional[list] = None,
@@ -737,12 +804,15 @@ def _run_stream_once(
     source: Optional[frames_io.FrameSource] = None,
     sink: Optional[frames_io.FrameSink] = None,
     n_mesh: int = 1,
+    shard: Optional[Tuple[int, int]] = None,
 ) -> StreamResult:
     """One pipeline lifetime (see :func:`run_stream`, which owns the
     engine-restart loop around this). ``n_mesh`` > 1 routes the frame
     loop through the mesh fan-out engine
-    (:mod:`tpu_stencil.parallel.fanout`) — resume/IO resolution, the
-    restart ladder, and result assembly stay shared here, so the two
+    (:mod:`tpu_stencil.parallel.fanout`); a resolved ``shard`` = (R, C)
+    routes it through the spatially-sharded engine
+    (:mod:`tpu_stencil.stream.sharded`) — resume/IO resolution, the
+    restart ladder, and result assembly stay shared here, so the three
     engines can never drift on those contracts."""
     import jax
 
@@ -755,16 +825,20 @@ def _run_stream_once(
                            block_h=cfg.block_h, fuse=cfg.fuse)
     if devices is None:
         devices = jax.devices()
-    devices = devices[:n_mesh]
-    # Report-what-ran for THIS run, on both paths — a single-device run
-    # after a mesh one must not keep exposing the stale fan width.
+    devices = devices[: shard[0] * shard[1]] if shard else devices[:n_mesh]
+    # Report-what-ran for THIS run, on every path — a single-device run
+    # after a mesh/sharded one must not keep exposing stale topology.
     obs.registry().gauge("stream_mesh_devices").set(n_mesh)
+    obs.registry().gauge("stream_shard_devices").set(
+        shard[0] * shard[1] if shard else 0
+    )
 
     start_frame = 0
     if resume:
         from tpu_stencil.runtime import checkpoint as ckpt
 
-        restored = ckpt.restore_stream_progress(cfg, mesh_devices=n_mesh)
+        restored = ckpt.restore_stream_progress(cfg, mesh_devices=n_mesh,
+                                                shard_frames=shard)
         if restored is not None:
             start_frame = restored
     elif cfg.checkpoint_every:
@@ -806,6 +880,25 @@ def _run_stream_once(
             source.close()
         raise
 
+    if shard is not None:
+        from tpu_stencil.stream import sharded as shardstream
+
+        failed = False
+        try:
+            sres = shardstream.run_shard_stream(
+                cfg, devices, shard, model, source, sink, start_frame
+            )
+        except BaseException:
+            failed = True
+            raise
+        finally:
+            _close_io(own_source, source, own_sink, sink, failed)
+        return _finish_result(
+            cfg, resume, t_start, start_frame, sres["frames"],
+            sres["stage_seconds"], sres["backend"], sres["schedule"],
+            out_spec, n_devices=sres["n_devices"], shard_frames=shard,
+        )
+
     if n_mesh > 1:
         from tpu_stencil.parallel import fanout
 
@@ -818,19 +911,7 @@ def _run_stream_once(
             failed = True
             raise
         finally:
-            # Same close discipline as the single-device path below: a
-            # close-time error must never mask a recorded failure.
-            if own_source:
-                try:
-                    source.close()
-                except OSError:
-                    pass
-            if own_sink and sink is not None:
-                try:
-                    sink.close()
-                except OSError:
-                    if not failed:
-                        raise
+            _close_io(own_source, source, own_sink, sink, failed)
         return _finish_result(
             cfg, resume, t_start, start_frame, mesh["frames"],
             mesh["stage_seconds"], mesh["backend"], mesh["schedule"],
@@ -865,21 +946,10 @@ def _run_stream_once(
         for t in threads:
             t.join(timeout=1.0)
         pl.zero_gauge()  # aborted frames never pass release_window
-        # Closing the source can race a reader still parked in read();
-        # the failure is already recorded (first-wins), so a close-time
-        # error must not mask it. The reader thread is a daemon either
-        # way.
-        if own_source:
-            try:
-                source.close()
-            except OSError:
-                pass
-        if own_sink and sink is not None:
-            try:
-                sink.close()
-            except OSError:
-                if pl.failure is None:
-                    raise
+        # The reader thread is a daemon either way; _close_io owns the
+        # close-time error-masking rules.
+        _close_io(own_source, source, own_sink, sink,
+                  pl.failure is not None)
 
     if pl.failure is not None:
         stage, frame_index, cause = pl.failure

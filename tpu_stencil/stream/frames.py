@@ -312,6 +312,75 @@ class NullSink(FrameSink):
         self.frames_written += 1
 
 
+class TileScatter:
+    """Shard-scatter staging views for the spatially-sharded stream
+    (:mod:`tpu_stencil.stream.sharded`): one reusable host staging tile
+    per mesh position, plus the precomputed copy plan that scatters a
+    flat frame buffer into them.
+
+    The tiles are the H2D unit — each is ``device_put`` onto its own
+    device, so uploads split per shard and frame ``i+1``'s tiles can
+    overlap frame ``i``'s exchange-and-compute. Pad regions (the grid's
+    ceil-divide overhang at the bottom/right image edge) are zeroed
+    ONCE at construction and never written again: the scatter only
+    copies the image-interior window of each tile, so steady state
+    allocates nothing and re-zeroes nothing (the staging-ring
+    discipline, per shard). Pure numpy — jax-free, like every container
+    here; the device placement lives with the engine.
+
+    ``specs``: one ``(rows, cols)`` pair of ``slice`` objects per tile,
+    each a window into the PADDED global canvas (the engine derives
+    them from the mesh sharding's index map, so the scatter layout can
+    never drift from what the compiled program expects)."""
+
+    def __init__(self, frame_shape, specs) -> None:
+        self.frame_shape = tuple(frame_shape)
+        h, w = self.frame_shape[:2]
+        trailing = self.frame_shape[2:]
+        self.specs = list(specs)
+        self.tiles: List[np.ndarray] = []
+        self._copies = []  # (tile_idx, tile_window, frame_window)
+        for i, (rows, cols) in enumerate(self.specs):
+            th = rows.stop - rows.start
+            tw = cols.stop - cols.start
+            self.tiles.append(np.zeros((th, tw) + trailing, np.uint8))
+            # The image-interior window of this tile (empty for tiles
+            # fully inside the pad overhang — nothing to copy, the
+            # zeros already there ARE the pad semantics).
+            r1 = min(rows.stop, h)
+            c1 = min(cols.stop, w)
+            if r1 > rows.start and c1 > cols.start:
+                self._copies.append((
+                    i,
+                    (slice(0, r1 - rows.start), slice(0, c1 - cols.start)),
+                    (slice(rows.start, r1), slice(cols.start, c1)),
+                ))
+
+    def scatter(self, buf: np.ndarray) -> List[np.ndarray]:
+        """Copy one flat frame buffer into the staging tiles and return
+        them (the same arrays every call — callers must consume each
+        tile, e.g. via a fenced H2D, before the next scatter)."""
+        frame = buf.reshape(self.frame_shape)
+        for i, tile_win, frame_win in self._copies:
+            self.tiles[i][tile_win] = frame[frame_win]
+        return self.tiles
+
+    def gather_into(self, out: np.ndarray, shards) -> np.ndarray:
+        """The D2H inverse: crop each per-shard result back into the
+        true-image window of ``out`` (pad rows/cols dropped). ``shards``
+        iterates ``(tile_index, array)`` in any order."""
+        h, w = self.frame_shape[:2]
+        for i, arr in shards:
+            rows, cols = self.specs[i]
+            r1 = min(rows.stop, h)
+            c1 = min(cols.stop, w)
+            if r1 > rows.start and c1 > cols.start:
+                out[rows.start:r1, cols.start:c1] = np.asarray(arr)[
+                    : r1 - rows.start, : c1 - cols.start
+                ]
+        return out
+
+
 def _is_dir_spec(spec: str) -> bool:
     return spec.endswith(os.sep) or os.path.isdir(spec)
 

@@ -1,0 +1,457 @@
+"""Spatially sharded frames inside the stream (``--shard-frames RxC``).
+
+The third composition of the engines: ``--mesh-frames`` (PR 9) fans
+WHOLE frames over per-device lanes — one device must still hold one
+frame — and serve's oversized-request route (PR 9) spatially shards one
+REQUEST over the mesh. This module composes the stream pipeline (PR 5)
+with that sharded route so each in-flight frame shards over the local
+mesh: the workload class the stack previously refused — a frame larger
+than one device's HBM — streams to completion, bit-exact (the
+reference's MPI variant exists for exactly this reason: one worker
+cannot hold the whole image).
+
+Shape of the machine (docs/STREAMING.md "Spatially sharded frames"):
+
+* **reader thread** — the single-device engine's, verbatim
+  (:func:`tpu_stencil.stream.engine._reader`): whole frames into the
+  staging ring, CRC'd at ingest, witness-sampled, window-gated.
+* **dispatch (main thread)** — scatters each staged frame into
+  reusable per-shard host tiles
+  (:class:`tpu_stencil.stream.frames.TileScatter` — pad regions zeroed
+  once, steady state copies only image-interior windows), re-verifies
+  the ring slot AND each staged tile against its CRC (ingest integrity
+  per shard), uploads each tile to its own device with a fenced
+  per-shard ``stream.h2d`` span (``dev=`` tagged — the H2D stage is
+  split per shard, so frame ``i+1``'s tile uploads overlap frame
+  ``i``'s exchange-and-compute), assembles the global sharded array
+  (``jax.make_array_from_single_device_arrays``) and launches the
+  cached mesh program.
+* **the mesh program** — a :class:`tpu_stencil.parallel.sharded
+  .ShardedRunner` resolved through the PROCESS-SHARED runner cache
+  (:func:`tpu_stencil.parallel.sharded.shared_runner`) under the same
+  ``shard_min_pixels`` routing discipline as serve's oversized-request
+  path — stream and serve never compile the same mesh program twice.
+  The default ``--overlap edge`` threads the per-edge persistent
+  double-buffered exchange (``edge_iterate``, arXiv:2508.13370's
+  partitioned/persistent pattern) through the rep-loop carry.
+* **drain thread** — fences compute in dispatch order (watchdogged),
+  copies each shard back with a per-shard ``stream.d2h`` span, crops
+  the pad off into the output frame.
+* **writer thread** — the single-device engine's, with the progress
+  sidecar committing the RxC shard topology
+  (:func:`tpu_stencil.runtime.checkpoint.save_stream_progress`), so a
+  ``--resume`` under a different topology fails typed
+  (:class:`~tpu_stencil.runtime.checkpoint.MeshCursorMismatch`)
+  instead of silently mis-scattering.
+
+``--shard-frames 0`` (auto) decides by a measured single-vs-sharded
+A/B (:func:`measure_shard_ab`) under the never-enable-a-measured-loss
+discipline — except when the frame exceeds the per-device HBM
+feasibility bound (:func:`tpu_stencil.runtime.roofline
+.hbm_frame_feasible`), where sharding is the only arm that can run and
+no probe is paid. Real-probe verdicts persist in the autotune cache
+(``cached_stream_verdict``), so a warm cache re-decides with zero
+probe frames.
+
+Failure semantics, fault sites, stage spans/clocks and the
+engine-restart ladder are the single-device engine's
+(:mod:`tpu_stencil.stream.engine` owns the restart loop; a restart
+re-shards at the SAME resolved topology, so the checkpoint's recorded
+RxC stays aligned). Every path is bit-exact against the golden model:
+sharding changes only WHERE a frame's pixels compute, never what.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import sys
+import time
+from typing import Callable, Optional, Tuple
+
+import numpy as np
+
+from tpu_stencil import obs
+from tpu_stencil.config import StreamConfig
+from tpu_stencil.integrity import checksum as _checksum
+from tpu_stencil.resilience import deadline as _deadline
+from tpu_stencil.resilience import faults as _faults
+from tpu_stencil.stream import frames as frames_io
+# Module-level by design, like parallel/fanout.py: stream.engine only
+# imports this module lazily inside run_stream, so there is no cycle,
+# and the engines share one _Abort/_StageSpan/StreamFailure vocabulary.
+from tpu_stencil.stream import engine as _sengine
+
+_EOF = _sengine._EOF
+
+# Frames per arm of the auto (--shard-frames 0) measured A/B probe.
+PROBE_FRAMES = 3
+
+
+def resolve_shard_frames(cfg: StreamConfig, devices,
+                         measure: Optional[Callable] = None
+                         ) -> Optional[Tuple[int, int]]:
+    """Resolve ``cfg.shard_frames`` to the RxC topology that actually
+    runs, or None (single-device — report-what-ran, like every auto
+    knob). The routing discipline is serve's oversized-request one: a
+    frame below ``shard_min_pixels`` stays single-device even under an
+    explicit RxC (the per-device tiles would be too small for the
+    exchange to pay for itself). An explicit RxC above the threshold is
+    honored (failing loudly when fewer than R*C devices exist, naming
+    both counts); ``(0, 0)`` (auto) shards WITHOUT a probe when the
+    frame exceeds the per-device HBM feasibility bound (the
+    single-device arm cannot run), else runs the measured A/B
+    (:func:`measure_shard_ab`, or the injected ``measure``) and enables
+    sharding only when strictly faster. Real-probe verdicts persist in
+    the autotune cache; injected measures bypass it in both
+    directions."""
+    if cfg.shard_frames is None:
+        return None
+    if cfg.width * cfg.height < cfg.shard_min_pixels:
+        print(
+            f"stream: --shard-frames: {cfg.width}x{cfg.height} frame is "
+            f"below the routing threshold ({cfg.shard_min_pixels} px) "
+            f"-> single-device",
+            file=sys.stderr, flush=True,
+        )
+        return None
+    n_avail = len(devices)
+    if cfg.shard_frames != (0, 0):
+        r, c = cfg.shard_frames
+        if r * c > n_avail:
+            raise ValueError(
+                f"--shard-frames {r}x{c} asks for {r * c} devices, "
+                f"have {n_avail}"
+            )
+        return (r, c)
+    # auto (0): nothing to shard over on one device.
+    if n_avail < 2:
+        return None
+    from tpu_stencil.parallel import partition
+    from tpu_stencil.runtime import autotune, roofline
+
+    mesh_shape = tuple(partition.grid_shape(
+        n_avail, cfg.height, cfg.width
+    ))
+    if not roofline.hbm_frame_feasible(cfg.frame_bytes,
+                                       cfg.pipeline_depth):
+        # The single-device arm cannot run at all: shard, no probe.
+        print(
+            f"stream: --shard-frames auto: frame working set exceeds "
+            f"the per-device HBM feasibility bound "
+            f"({roofline.device_hbm_bytes()} bytes) -> shard "
+            f"{mesh_shape[0]}x{mesh_shape[1]} (no probe — the "
+            f"single-device arm is infeasible)",
+            file=sys.stderr, flush=True,
+        )
+        return mesh_shape
+    geometry = (cfg.height, cfg.width, cfg.channels)
+    topo = f"mesh{mesh_shape[0]}x{mesh_shape[1]}"
+    token = autotune.stream_cfg_token(cfg)
+    if measure is None:
+        hit = autotune.cached_stream_verdict(
+            "shardstream", geometry, cfg.repetitions,
+            cfg.pipeline_depth, topo, token,
+        )
+        if hit is not None and (
+            hit["pick"] == 0
+            or (isinstance(hit["pick"], list) and len(hit["pick"]) == 2
+                and hit["pick"][0] * hit["pick"][1] <= n_avail)
+        ):
+            pick = (
+                None if hit["pick"] == 0 else tuple(hit["pick"])
+            )
+            print(
+                f"stream: --shard-frames auto verdict from warm cache "
+                f"-> {'shard ' + topo[4:] if pick else 'single-device'}"
+                f" (zero probe frames)",
+                file=sys.stderr, flush=True,
+            )
+            return pick
+    t_single, t_shard = (measure or measure_shard_ab)(
+        cfg, devices, mesh_shape
+    )
+    pick = mesh_shape if t_shard < t_single else None
+    if measure is None:
+        autotune.store_stream_verdict(
+            "shardstream", geometry, cfg.repetitions,
+            cfg.pipeline_depth, topo,
+            {"pick": list(pick) if pick else 0,
+             "single_us": round(t_single * 1e6, 2),
+             "shard_us": round(t_shard * 1e6, 2)},
+            token,
+        )
+    print(
+        f"stream: --shard-frames auto measured single={t_single:.3f}s "
+        f"shard[{mesh_shape[0]}x{mesh_shape[1]}]={t_shard:.3f}s -> "
+        f"{'shard ' + topo[4:] if pick else 'single-device'}",
+        file=sys.stderr, flush=True,
+    )
+    return pick
+
+
+def measure_shard_ab(cfg: StreamConfig, devices,
+                     mesh_shape: Tuple[int, int],
+                     frames: int = PROBE_FRAMES
+                     ) -> Tuple[float, float]:
+    """The measured single-vs-sharded A/B behind ``--shard-frames 0``
+    (auto): run a tiny synthetic stream (random frames, null sink) once
+    warm + once timed at ``cfg.pipeline_depth`` on one device and
+    spatially sharded over ``mesh_shape``. Returns ``(single_seconds,
+    shard_seconds)``. The probe pays ~2 compiles + ``4 * frames *
+    reps`` of compute — the documented cost of a measured verdict; its
+    counters/spans run under a scratch registry so they never inflate
+    the caller's own run (the :func:`~tpu_stencil.parallel.fanout
+    .measure_fanout_ab` discipline)."""
+    rng = np.random.default_rng(0)
+    frame = rng.integers(0, 256, cfg.frame_bytes, dtype=np.uint8)
+
+    class _Synth(frames_io.FrameSource):
+        def __init__(self, k: int) -> None:
+            self._left = k
+
+        def read_into(self, buf) -> bool:
+            if self._left <= 0:
+                return False
+            np.copyto(buf, frame)
+            self._left -= 1
+            return True
+
+    def one(shard) -> float:
+        pcfg = dataclasses.replace(
+            cfg, frames=frames, shard_frames=shard, shard_min_pixels=1,
+            output="null", checkpoint_every=0, progress_every=0,
+        )
+        _sengine.run_stream(pcfg, devices=list(devices),
+                            source=_Synth(frames),
+                            sink=frames_io.NullSink())  # warm: compiles land
+        t0 = time.perf_counter()
+        _sengine.run_stream(pcfg, devices=list(devices),
+                            source=_Synth(frames),
+                            sink=frames_io.NullSink())
+        return time.perf_counter() - t0
+
+    with obs.scratch_registry():
+        return one(None), one(tuple(mesh_shape))
+
+
+class _ShardPlumbing:
+    """The per-run device-side state of one sharded stream: the cached
+    runner, the scatter layout derived from the RUNNER'S OWN sharding
+    (the staging views can never drift from what the compiled program
+    expects), and the device list per tile."""
+
+    def __init__(self, cfg: StreamConfig, runner) -> None:
+        self.runner = runner
+        gshape = runner.padded_shape
+        if cfg.channels != 1:
+            gshape = gshape + (cfg.channels,)
+        self.global_shape = gshape
+        imap = runner.sharding.devices_indices_map(gshape)
+        self.tile_devices = list(runner.mesh.devices.flat)
+        specs = []
+        for d in self.tile_devices:
+            idx = imap[d]
+            rows = slice(*idx[0].indices(gshape[0])[:2])
+            cols = slice(*idx[1].indices(gshape[1])[:2])
+            specs.append((rows, cols))
+        self.scatter = frames_io.TileScatter(cfg.frame_shape, specs)
+        self.dev_to_tile = {
+            d.id: i for i, d in enumerate(self.tile_devices)
+        }
+
+
+def _dispatch(pl, cfg: StreamConfig, pb: _ShardPlumbing) -> None:
+    """The main-thread dispatch loop: warm the mesh program on a
+    zero-rep launch (the compile overlaps the reader's prefetch — the
+    ``prepare_engine`` discipline), then scatter + per-shard H2D +
+    launch each staged frame inside the depth-``k`` window."""
+    import jax
+
+    runner = pb.runner
+    idx, stage = -1, "compute"  # bootstrap failures are compile/compute
+    fault_h2d = _faults.site("h2d")
+    fault_compute = _faults.site("compute")
+    try:
+        # Warm-up: the mesh program's compile lands before the first
+        # real frame (reps is a traced scalar, so the zero-rep program
+        # IS the production program). The zeroed staging tiles are the
+        # canvas — nothing extra allocates.
+        arrays = [
+            jax.device_put(t, d)
+            for t, d in zip(pb.scatter.tiles, pb.tile_devices)
+        ]
+        warm = jax.make_array_from_single_device_arrays(
+            pb.global_shape, runner.sharding, arrays
+        )
+        jax.block_until_ready(runner.run(warm, 0))
+        while True:
+            item = pl.get(pl.filled_q)
+            if item is _EOF:
+                break
+            idx, bi, crc, wit = item
+            stage = "h2d"
+            if fault_h2d is not None:
+                fault_h2d(idx)
+            # The ring slot's H2D-boundary re-verification (the shared
+            # single-device discipline), then the per-shard one: each
+            # staged tile is CRC'd at scatter and re-verified right
+            # before ITS device's upload — ingest integrity per shard.
+            _sengine._verify_staged(pl.ring[bi], crc, idx)
+            tiles = pb.scatter.scatter(pl.ring[bi])
+            pl.free_q.put(bi)  # scatter consumed the ring slot
+            tile_crcs = (
+                [_checksum.crc32c(t) for t in tiles]
+                if cfg.verify_ingest else [None] * len(tiles)
+            )
+            arrays = []
+            for d, (tile, dev) in enumerate(
+                    zip(tiles, pb.tile_devices)):
+                _sengine._verify_staged(tile, tile_crcs[d], idx)
+                with pl.stage("h2d", idx, dev=d) as s:
+                    # Fenced per shard: the span holds only THIS
+                    # tile's PCIe copy; earlier frames keep computing
+                    # on the mesh — the overlap the depth-2 trace
+                    # shows (frame i+1 tile uploads inside frame i's
+                    # exchange-and-compute).
+                    arrays.append(s.fence(jax.device_put(tile, dev)))
+            img_dev = jax.make_array_from_single_device_arrays(
+                pb.global_shape, runner.sharding, arrays
+            )
+            stage = "compute"
+            if fault_compute is not None:
+                fault_compute(idx)
+            t_disp = time.perf_counter()
+            out = runner.run(img_dev, cfg.repetitions)  # async; donates
+            pl.put(pl.inflight_q, (idx, out, t_disp, wit))
+        pl.put(pl.inflight_q, _EOF)
+    except _sengine._Abort:
+        pass
+    except BaseException as e:
+        pl.fail(stage, max(idx, 0), e)
+
+
+def _drain(pl, cfg: StreamConfig, pb: _ShardPlumbing) -> None:
+    """Fence the mesh compute in dispatch order (watchdogged), copy
+    each shard back D2H (split per shard, ``dev=``-tagged spans), crop
+    the pad off, free the window slot, hand off to the writer."""
+    idx, stage = -1, "compute"
+    fault_d2h = _faults.site("d2h")
+    fault_corrupt = _faults.site("integrity.corrupt_result")
+    timeout_s = _deadline.resolve(cfg.dispatch_timeout_s)
+    try:
+        while True:
+            item = pl.get(pl.inflight_q)
+            if item is _EOF:
+                pl.put(pl.write_q, _EOF)
+                return
+            idx, out_dev, t_disp, wit = item
+            stage = "compute"
+            with pl.stage("compute", idx, t0=t_disp):
+                _deadline.fence(out_dev, timeout_s,
+                                f"stream.compute[frame={idx},shard]")
+            stage = "d2h"
+            frame = np.empty(cfg.frame_shape, np.uint8)
+            for shard in out_dev.addressable_shards:
+                d = pb.dev_to_tile[shard.device.id]
+                with pl.stage("d2h", idx, dev=d):
+                    if fault_d2h is not None:
+                        fault_d2h(idx)
+                    piece = np.asarray(shard.data)
+                pb.scatter.gather_into(frame, [(d, piece)])
+            if fault_corrupt is not None and _checksum.fired(
+                    fault_corrupt, idx):
+                _checksum.corrupt_array(frame)
+            pl.release_window()
+            pl.put(pl.write_q, (idx, frame, wit))
+    except _sengine._Abort:
+        pass
+    except BaseException as e:
+        pl.fail(stage, max(idx, 0), e)
+
+
+def run_shard_stream(cfg: StreamConfig, devices,
+                     shard: Tuple[int, int], model,
+                     source, sink, start_frame: int) -> dict:
+    """One sharded-stream pipeline lifetime over the ``shard`` = (R, C)
+    mesh (the spatial analog of :func:`tpu_stencil.parallel.fanout
+    .run_mesh_frames`). The caller (:func:`tpu_stencil.stream.engine
+    ._run_stream_once`) owns source/sink lifecycle, resume resolution
+    and result assembly; this returns ``{"frames", "stage_seconds",
+    "backend", "schedule", "n_devices"}`` or raises
+    :class:`~tpu_stencil.stream.engine.StreamFailure`. The mesh program
+    comes from the PROCESS-SHARED runner cache — a geometry serve
+    already compiled is a hit here, and vice versa."""
+    import threading
+
+    from tpu_stencil.parallel import sharded as _psharded
+
+    r, c = shard
+    devices = list(devices)
+    if r * c > len(devices):
+        raise ValueError(
+            f"--shard-frames {r}x{c} asks for {r * c} devices, "
+            f"have {len(devices)}"
+        )
+    runner = _psharded.shared_runner(
+        model, (cfg.height, cfg.width), cfg.channels,
+        mesh_shape=(r, c), devices=devices, overlap=cfg.overlap,
+        registry=obs.registry(),
+    )
+    if runner is None:
+        # Unlike serve there is no bucket path to fall back to mid-
+        # stream: an explicitly requested topology the mesh cannot
+        # serve fails loudly, naming the constraint.
+        raise ValueError(
+            f"--shard-frames {r}x{c} cannot serve a "
+            f"{cfg.height}x{cfg.width} frame: the per-device tile is "
+            f"smaller than the filter halo (or the boundary refuses "
+            f"padding); use a smaller mesh or a larger frame"
+        )
+    pb = _ShardPlumbing(cfg, runner)
+    pl = _sengine._Pipeline(cfg)
+    done = [start_frame]
+
+    def save_progress(frames_done: int) -> None:
+        from tpu_stencil.runtime import checkpoint as ckpt
+
+        ckpt.save_stream_progress(cfg, frames_done, shard_frames=shard)
+
+    threads = [
+        threading.Thread(
+            target=_sengine._reader, args=(pl, source, start_frame),
+            name="shardstream-reader", daemon=True,
+        ),
+        threading.Thread(
+            target=_drain, args=(pl, cfg, pb),
+            name="shardstream-drain", daemon=True,
+        ),
+        threading.Thread(
+            target=_sengine._writer, args=(pl, sink, done, save_progress),
+            name="shardstream-writer", daemon=True,
+        ),
+    ]
+    try:
+        for t in threads:
+            t.start()
+        _dispatch(pl, cfg, pb)
+        # Clean runs end via the sentinel cascade; failed runs via the
+        # stop flag. Like the single-device engine, never wait
+        # indefinitely on a reader parked in a blocking pipe read.
+        for t in threads:
+            while t.is_alive() and not pl.stop.is_set():
+                t.join(timeout=0.1)
+    finally:
+        pl.stop.set()
+        for t in threads:
+            t.join(timeout=1.0)
+        pl.zero_gauge()
+    if pl.failure is not None:
+        stage, frame_index, cause = pl.failure
+        raise _sengine.StreamFailure(stage, frame_index, cause) from cause
+    return {
+        "frames": done[0] - start_frame,
+        "stage_seconds": dict(pl.stage_seconds),
+        "backend": runner.backend,
+        "schedule": runner.schedule,
+        "n_devices": r * c,
+    }
