@@ -92,6 +92,15 @@ the zero-tolerance ``verify_failures`` rider) and re-measured with
 (<=3% acceptance bar) — the integrity layer's cost is sentry-visible
 from its first capture.
 
+Result-cache mode: ``TPU_STENCIL_BENCH_NET_CACHE=1`` measures the
+``--result-cache-mb`` layer: a repeated-frame window against a caching
+tier emits the ``..._net_cachehit_wall_per_request`` headline (its own
+sentry series — the hit path's whole cost: parse + digest + lookup +
+response), with an all-distinct-bodies cache-on-vs-off A/B at hit-rate
+0 as the advisory ``cache_overhead`` rider (<=3% bar) — what a cache
+costs the workload it cannot help, measured before anyone enables it
+(``TPU_STENCIL_BENCH_NET_CACHE_MB`` sizes the store, default 64).
+
 Federation mode: ``TPU_STENCIL_BENCH_FED=N`` spawns N member hosts as
 real ``tpu_stencil net`` subprocesses (CPU members by default — N
 accelerator-locked processes cannot share one chip;
@@ -1024,6 +1033,182 @@ def _measure_net(platform: str) -> list:
     return lines
 
 
+def _measure_net_cache(platform: str) -> list:
+    """Result-cache capture (``TPU_STENCIL_BENCH_NET_CACHE=1``): what
+    the ``--result-cache-mb`` layer buys and what it costs, measured
+    on the same in-process HTTP edge as :func:`_measure_net`.
+
+    Two windows:
+
+    * **Hit path** — one miss populates the store, then ``n_req``
+      identical client-verified POSTs; every response must answer
+      ``X-Cache: hit``. The per-request wall is the
+      ``..._net_cachehit_wall_per_request`` headline — its own sentry
+      series (a hit skips admission + dispatch entirely, so gating it
+      against the cold series would be meaningless).
+    * **Hit-rate-0 A/B** — ``n_req`` all-DISTINCT bodies against the
+      caching tier (store cleared via ``/admin/cache?action=clear``
+      between windows so every request really misses) vs the same
+      window with the cache off. The advisory ``cache_overhead`` rider
+      (<=3% bar, the integrity-overhead discipline) is the digest +
+      lookup + insert cost on the workload a cache cannot help — the
+      number an operator reads before enabling the knob on a
+      low-repeat fleet.
+
+    Knobs: the ``TPU_STENCIL_BENCH_NET_*`` set, plus
+    ``TPU_STENCIL_BENCH_NET_CACHE_MB`` (store budget, default 64)."""
+    import concurrent.futures
+    import urllib.request
+
+    import jax
+
+    from tpu_stencil.config import NetConfig
+    from tpu_stencil.net.http import NetFrontend
+
+    from tpu_stencil.integrity import checksum as _crc
+
+    n_dev = len(jax.devices())
+    n_rep = int(os.environ.get("TPU_STENCIL_BENCH_NET_REPLICAS", "0")) \
+        or min(2, n_dev)
+    n_req = int(os.environ.get("TPU_STENCIL_BENCH_NET_REQUESTS", "8"))
+    conc = int(os.environ.get("TPU_STENCIL_BENCH_NET_CONCURRENCY", "4"))
+    cache_mb = float(os.environ.get("TPU_STENCIL_BENCH_NET_CACHE_MB",
+                                    "64"))
+    rng = np.random.default_rng(0)
+    img = rng.integers(0, 256, size=(H, W, C), dtype=np.uint8)
+    hot = img.tobytes()
+    distinct = [
+        rng.integers(0, 256, size=(H, W, C), dtype=np.uint8).tobytes()
+        for _ in range(n_req)
+    ]
+    crc_of = {b: str(_crc.crc32c(b)) for b in [hot] + distinct}
+    verify_failures = [0]
+    xcache_misses_on_hot = [0]
+
+    def post(fe, body, expect_hit: bool):
+        req = urllib.request.Request(
+            fe.url + f"/v1/blur?w={W}&h={H}&reps={REPS}&channels={C}",
+            data=body, headers={"X-Content-Crc32c": crc_of[body]},
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=CHILD_TIMEOUT) as r:
+            data = r.read()
+            if not _crc.stamp_matches(
+                    r.headers.get("X-Result-Crc32c"), data):
+                verify_failures[0] += 1
+            if expect_hit and r.headers.get("X-Cache") != "hit":
+                xcache_misses_on_hot[0] += 1
+
+    def window(fe, bodies, expect_hit: bool) -> float:
+        t0 = time.perf_counter()
+        with concurrent.futures.ThreadPoolExecutor(conc) as pool:
+            for f in [pool.submit(post, fe, b, expect_hit)
+                      for b in bodies]:
+                f.result(timeout=CHILD_TIMEOUT)
+        return time.perf_counter() - t0
+
+    def warm(fe) -> None:
+        # The _measure_net warm discipline: one routed request seeds
+        # the warm-key dedup, then a direct submit per engine pins
+        # every compile outside the timed windows.
+        post(fe, hot, expect_hit=False)
+        for rep in fe.fleet.replicas:
+            rep.submit(img, REPS).result(timeout=CHILD_TIMEOUT)
+
+    def clear(fe) -> None:
+        with urllib.request.urlopen(
+                fe.url + "/admin/cache?action=clear",
+                timeout=CHILD_TIMEOUT):
+            pass
+
+    fe_on = NetFrontend(NetConfig(port=0, replicas=n_rep,
+                                  max_queue=max(16, n_req),
+                                  result_cache_mb=cache_mb)).start()
+    try:
+        warm(fe_on)
+        # Populate the hot key (the warm post already did, but a clear
+        # below must not be able to race it away), then best-of-2 hit
+        # windows — every request identical, every answer a hit.
+        post(fe_on, hot, expect_hit=False)
+        wall_hit = min(window(fe_on, [hot] * n_req, expect_hit=True)
+                       for _ in range(2))
+        # Hit-rate-0 arm on the SAME tier: distinct bodies, store
+        # cleared per window so the second window misses too.
+        walls = []
+        for _ in range(2):
+            clear(fe_on)
+            walls.append(window(fe_on, distinct, expect_hit=False))
+        wall_miss_on = min(walls)
+        snap = fe_on.metrics_snapshot()
+    finally:
+        fe_on.close()
+    fe_off = NetFrontend(NetConfig(port=0, replicas=n_rep,
+                                   max_queue=max(16, n_req))).start()
+    try:
+        warm(fe_off)
+        wall_miss_off = min(window(fe_off, distinct, expect_hit=False)
+                            for _ in range(2))
+    finally:
+        fe_off.close()
+    per_req_hit = wall_hit / max(1, n_req)
+    per_req_on = wall_miss_on / max(1, n_req)
+    per_req_off = wall_miss_off / max(1, n_req)
+    overhead = ((per_req_on - per_req_off) / per_req_off
+                if per_req_off > 0 else 0.0)
+    hit_speedup = per_req_off / per_req_hit if per_req_hit > 0 else 0.0
+    c = snap["counters"]
+    log(f"net cache x{n_rep} replicas @{cache_mb:g}MB: "
+        f"{per_req_hit * 1e3:.2f} ms/request on hits "
+        f"({hit_speedup:.1f}x vs cold {per_req_off * 1e3:.1f} ms); "
+        f"hit-rate-0 overhead {overhead * 100:+.1f}% vs cache-off, "
+        f"bar <=3%; hits {c.get('result_cache_hits_total', 0)}, "
+        f"misses {c.get('result_cache_misses_total', 0)}, "
+        f"collapsed {c.get('singleflight_collapsed_total', 0)}; "
+        f"non-hit answers in hit window {xcache_misses_on_hot[0]}; "
+        f"verify failures {verify_failures[0]}")
+    return [{
+        "metric": f"{W}x{H}_rgb_{REPS}reps_net_cachehit_wall_per_request",
+        "value": round(per_req_hit, 6),
+        "unit": "s",
+        "vs_baseline": round(BASELINE_S / per_req_hit, 2)
+        if per_req_hit > 0 else 0.0,
+        "requests_per_second": round(n_req / wall_hit, 3)
+        if wall_hit > 0 else 0.0,
+        "cache_mb": cache_mb,
+        "hit_speedup_vs_cold": round(hit_speedup, 2),
+        # Zero-tolerance riders: a hit that answers anything but
+        # X-Cache:hit, or any stamp mismatch, is a capture-visible
+        # failure of the bit-exactness contract.
+        "non_hit_answers": xcache_misses_on_hot[0],
+        "verify_failures": verify_failures[0],
+        "result_cache_hits_total": c.get("result_cache_hits_total", 0),
+        "result_cache_misses_total": c.get(
+            "result_cache_misses_total", 0
+        ),
+        "singleflight_collapsed_total": c.get(
+            "singleflight_collapsed_total", 0
+        ),
+        # The hit-rate-0 A/B rider (advisory, the integrity-overhead
+        # discipline): what the cache costs a workload with no repeats.
+        "cache_overhead": round(overhead, 4),
+        "cache_overhead_bar": 0.03,
+        "cache_overhead_ok": bool(overhead <= 0.03),
+        "cold_per_request": round(per_req_off, 6),
+        "miss_per_request": round(per_req_on, 6),
+        "backend": "net",
+        "platform": platform,
+        "replicas": n_rep,
+        "requests": n_req,
+        "concurrency": conc,
+        "shape": f"{W}x{H}",
+        "reps": REPS,
+        "filter": "gaussian",
+        "dtype": "uint8",
+        "schema_version": 1,
+        "ts": round(time.monotonic(), 6),
+    }]
+
+
 def _spawn_fed_member(platform: str, timeout_s: float = 120.0):
     """Start one ``tpu_stencil net`` member host as a real subprocess
     and wait (bounded by ``timeout_s``) for its bound-URL line.
@@ -1338,6 +1523,16 @@ def child_main() -> int:
             log(f"serve meshfan: FAILED {type(e).__name__}: {e}")
             return 1
         print(json.dumps(result), flush=True)
+        return 0
+
+    if os.environ.get("TPU_STENCIL_BENCH_NET_CACHE") == "1":
+        try:
+            lines = _measure_net_cache(platform)
+        except Exception as e:
+            log(f"net cache: FAILED {type(e).__name__}: {e}")
+            return 1
+        for line in lines:
+            print(json.dumps(line), flush=True)
         return 0
 
     if os.environ.get("TPU_STENCIL_BENCH_NET") == "1":

@@ -1,0 +1,719 @@
+"""Result-cache suite (ISSUE 16): content digests, the byte-budgeted
+LRU store, single-flight collapse, digest-affinity routing, and the
+cache's integrity fence.
+
+The contracts under test are docs/SERVING.md "Result cache and
+single-flight collapse" / "Digest-affinity routing":
+
+* a cache hit is BIT-IDENTICAL to cold compute (payload and
+  ``X-Result-Crc32c`` stamp), across shapes x filters x reps, and the
+  CRC claim is validated identically on the hit and miss paths;
+* N concurrent identical requests cost exactly ONE replica dispatch
+  (counter-asserted), and an expired follower 504s without cancelling
+  the leader;
+* a witness mismatch or quarantine on replica *i* synchronously drops
+  *i*'s entries — a poisoned result (real injected bit flips) is never
+  served from cache;
+* the fed tier rendezvous-hashes content digests so repeats land where
+  their cache entry lives, propagates the member's ``X-Cache`` verdict,
+  and deduplicates (and counts) fold collisions in ``/metrics``.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from tpu_stencil import filters, obs
+from tpu_stencil.cache import affinity
+from tpu_stencil.cache import digest as cdigest
+from tpu_stencil.cache.singleflight import SingleFlight
+from tpu_stencil.cache.store import ResultStore
+from tpu_stencil.config import FedConfig, NetConfig, ServeConfig
+from tpu_stencil.integrity import checksum
+from tpu_stencil.ops import stencil
+from tpu_stencil.resilience import faults
+from tpu_stencil.serve.metrics import Registry
+
+pytestmark = pytest.mark.filterwarnings("ignore::ResourceWarning")
+
+H, W, C, REPS = 32, 24, 3, 3
+EDGES = (8, 16, 32, 64)
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    faults.clear()
+    obs.reset()
+    yield
+    faults.clear()
+    obs.reset()
+
+
+def _golden(img, reps, filter_name="gaussian"):
+    return stencil.reference_stencil_numpy(
+        img, filters.get_filter(filter_name), reps
+    )
+
+
+def _wait_for(pred, timeout=30.0):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        if pred():
+            return True
+        time.sleep(0.05)
+    return pred()
+
+
+# -- digest + key (jax-free) --------------------------------------------
+
+def test_digest_and_crc_one_scan_equals_separate_passes():
+    # Multi-chunk body: the fused scan must agree with standalone
+    # BLAKE2b-160 and standalone CRC32C, chunk boundaries included.
+    rng = np.random.default_rng(0)
+    data = rng.integers(0, 256, (3 << 20) + 17, dtype=np.uint8).tobytes()
+    d, crc = cdigest.digest_and_crc(data)
+    assert d == hashlib.blake2b(data, digest_size=20).digest()
+    assert d == cdigest.content_digest(data)
+    assert crc == checksum.crc32c(data)
+    assert len(d) == cdigest.DIGEST_SIZE == 20
+    # ndarray views digest their logical bytes, no copy semantics leak.
+    arr = np.frombuffer(data, np.uint8)
+    assert cdigest.digest_and_crc(arr) == (d, crc)
+
+
+def test_request_key_total_over_every_knob():
+    d = cdigest.content_digest(b"frame")
+    base = cdigest.request_key(d, "gaussian", 3, 4, 5, 3, 0)
+    variants = {
+        cdigest.request_key(cdigest.content_digest(b"other"),
+                            "gaussian", 3, 4, 5, 3, 0),
+        cdigest.request_key(d, "box", 3, 4, 5, 3, 0),
+        cdigest.request_key(d, "gaussian", 4, 4, 5, 3, 0),
+        cdigest.request_key(d, "gaussian", 3, 5, 5, 3, 0),
+        cdigest.request_key(d, "gaussian", 3, 4, 6, 3, 0),
+        cdigest.request_key(d, "gaussian", 3, 4, 5, 1, 0),
+        cdigest.request_key(d, "gaussian", 3, 4, 5, 3, 1),
+    }
+    assert base not in variants and len(variants) == 7
+    assert base == cdigest.request_key(d, "gaussian", 3, 4, 5, 3, 0)
+
+
+# -- store (jax-free) ---------------------------------------------------
+
+def _k(i):
+    return ("key", i)
+
+
+def test_store_lru_eviction_under_byte_budget():
+    r = Registry()
+    st = ResultStore(r, capacity_bytes=100)
+    assert st.put(_k(1), b"a" * 40, None, 0, st.token())
+    assert st.put(_k(2), b"b" * 40, None, 0, st.token())
+    assert st.get(_k(1)).payload == b"a" * 40  # refresh: k2 is now LRU
+    assert st.put(_k(3), b"c" * 40, None, 0, st.token())
+    assert st.get(_k(2)) is None  # the cold entry went, not the hot one
+    assert st.get(_k(1)) is not None and st.get(_k(3)) is not None
+    c = r.snapshot()["counters"]
+    assert c["result_cache_evictions_total"] == 1
+    assert c["result_cache_insertions_total"] == 3
+    # A payload alone past the whole budget is refused, not admitted
+    # just to be immediately evicted.
+    assert not st.put(_k(9), b"z" * 101, None, 0, st.token())
+    assert (r.snapshot()["counters"]["result_cache_admission_refused_total"]
+            == 1)
+    stats = st.stats()
+    assert stats["entries"] == 2 and stats["bytes"] == 80
+    assert stats["capacity_bytes"] == 100
+    g = r.snapshot()["gauges"]
+    assert g["result_cache_bytes"]["value"] == 80.0
+    assert g["result_cache_entries"]["value"] == 2.0
+
+
+def test_store_epoch_fence_refuses_post_distrust_insert():
+    # The witness/admission race: the verdict lands between the token
+    # draw (pre-dispatch) and the put (post-compute) — the insert from
+    # the now-distrusted replica must be refused.
+    r = Registry()
+    st = ResultStore(r, 1000)
+    tok = st.token()
+    st.invalidate_replica(0, "witness_mismatch")
+    assert not st.put(_k(1), b"poison", None, 0, tok)
+    assert st.put(_k(2), b"fine", None, 1, tok)  # sibling unaffected
+    # A token drawn AFTER the distrust admits again (the next request's
+    # dispatch post-dates the verdict).
+    assert st.put(_k(1), b"clean", None, 0, st.token())
+    assert (r.snapshot()["counters"]["result_cache_admission_refused_total"]
+            == 1)
+
+
+def test_store_refuses_quarantined_producer():
+    bad = {0}
+    r = Registry()
+    st = ResultStore(r, 1000, quarantined=lambda i: i in bad)
+    assert not st.put(_k(1), b"x", None, 0, st.token())
+    assert st.put(_k(2), b"x", None, 1, st.token())
+    bad.clear()
+    assert st.put(_k(1), b"x", None, 0, st.token())
+    assert (r.snapshot()["counters"]["result_cache_admission_refused_total"]
+            == 1)
+
+
+def test_invalidate_replica_drops_only_its_entries_by_cause():
+    r = Registry()
+    st = ResultStore(r, 10_000)
+    st.put(_k(1), b"x" * 10, None, 0, st.token())
+    st.put(_k(2), b"y" * 10, None, 0, st.token())
+    st.put(_k(3), b"z" * 10, None, 1, st.token())
+    assert st.invalidate_replica(0, "witness_mismatch") == 2
+    assert st.get(_k(1)) is None and st.get(_k(2)) is None
+    assert st.get(_k(3)).payload == b"z" * 10
+    c = r.snapshot()["counters"]
+    assert c["cache_invalidations_total"] == 2
+    assert c["cache_invalidations_witness_mismatch_total"] == 2
+    assert c["cache_invalidations_quarantine_total"] == 0  # pre-created
+    assert st.clear() == 1
+    c = r.snapshot()["counters"]
+    assert c["cache_invalidations_clear_total"] == 1
+    assert c["cache_invalidations_total"] == 3
+    assert st.stats()["entries"] == 0
+
+
+def test_singleflight_collapse_resolve_and_fail():
+    r = Registry()
+    sf = SingleFlight(r)
+    lead, fut = sf.join(("k",))
+    assert lead and fut is None
+    f1 = sf.join(("k",))
+    f2 = sf.join(("k",))
+    assert not f1[0] and not f2[0]
+    assert sf.inflight() == 1
+    sf.resolve(("k",), 42)
+    assert f1[1].result(timeout=0) == 42
+    assert f2[1].result(timeout=0) == 42
+    assert sf.inflight() == 0
+    # Settled-key resolve/fail are no-ops, not KeyErrors (a cache-off
+    # code path or a double settle must be harmless).
+    sf.resolve(("k",), 1)
+    sf.fail(("k",), RuntimeError("late"))
+    # Leader failure propagates the typed exception to every follower.
+    assert sf.join(("e",))[0]
+    _, fol = sf.join(("e",))
+    sf.fail(("e",), ValueError("boom"))
+    with pytest.raises(ValueError, match="boom"):
+        fol.result(timeout=0)
+    c = r.snapshot()["counters"]
+    assert c["singleflight_leaders_total"] == 2
+    assert c["singleflight_collapsed_total"] == 3
+
+
+# -- rendezvous affinity (jax-free) -------------------------------------
+
+def test_rendezvous_order_deterministic_total_and_minimal_churn():
+    hosts = [f"host_{i}" for i in range(6)]
+    d = cdigest.content_digest(b"frame-1")
+    order = affinity.rendezvous_order(hosts, d)
+    assert sorted(order) == sorted(hosts)  # a permutation, total
+    assert order == affinity.rendezvous_order(hosts, d)
+    # Input order is irrelevant: every fed instance ranks identically.
+    assert order == affinity.rendezvous_order(list(reversed(hosts)), d)
+    # Different digests actually spread across members.
+    tops = {
+        affinity.rendezvous_order(
+            hosts, cdigest.content_digest(b"frame-%d" % i)
+        )[0]
+        for i in range(64)
+    }
+    assert len(tops) > 1
+    # Minimal churn: dropping one member moves ONLY the keys it owned —
+    # the relative order of the survivors is untouched.
+    gone = order[2]
+    rest = affinity.rendezvous_order([h for h in hosts if h != gone], d)
+    assert rest == [h for h in order if h != gone]
+
+
+# -- config / CLI (jax-free) --------------------------------------------
+
+def test_netconfig_result_cache_validation():
+    with pytest.raises(ValueError, match="result_cache_mb"):
+        NetConfig(result_cache_mb=-1.0)
+    assert NetConfig().result_cache_mb == 0.0  # default off
+    assert NetConfig(result_cache_mb=2.0).result_cache_bytes == 2 << 20
+
+
+def test_net_cli_rejects_negative_result_cache():
+    from tpu_stencil.net import cli as net_cli
+
+    with pytest.raises(SystemExit) as exc:
+        net_cli.main(["--result-cache-mb", "-3"])
+    assert exc.value.code == 2
+
+
+def test_fedconfig_digest_affinity_default_on():
+    assert FedConfig().digest_affinity is True
+    assert FedConfig(digest_affinity=False).digest_affinity is False
+
+
+# -- loadgen zipf keyspace (jax-free draw; HTTP report below) -----------
+
+def test_zipf_requests_deterministic_and_bounded():
+    from tpu_stencil.serve import loadgen
+
+    imgs, idx = loadgen.zipf_requests(50, ((8, 6),), (3,), seed=3,
+                                      s=1.2, keys=5)
+    imgs2, idx2 = loadgen.zipf_requests(50, ((8, 6),), (3,), seed=3,
+                                        s=1.2, keys=5)
+    assert idx == idx2
+    assert all(np.array_equal(a, b) for a, b in zip(imgs, imgs2))
+    assert len(imgs) == 50 and min(idx) >= 0 and max(idx) < 5
+    # Skew is real: a heavier exponent concentrates mass on rank 0.
+    _, uniform = loadgen.zipf_requests(400, ((8, 6),), (3,), seed=3,
+                                       s=0.0, keys=8)
+    _, skewed = loadgen.zipf_requests(400, ((8, 6),), (3,), seed=3,
+                                      s=2.5, keys=8)
+    assert skewed.count(0) > uniform.count(0)
+    with pytest.raises(ValueError, match="exponent"):
+        loadgen.zipf_requests(5, ((8, 6),), (3,), seed=0, s=-0.1)
+    with pytest.raises(ValueError, match="pool"):
+        loadgen.zipf_requests(5, ((8, 6),), (3,), seed=0, s=1.0, keys=0)
+
+
+def test_loadgen_zipf_hit_ratio_none_without_result_cache():
+    # The serve engine has no result cache: the report must say None
+    # (unknown), never fake a 0.0 hit ratio from absent counters.
+    from tpu_stencil.serve import loadgen
+    from tpu_stencil.serve.engine import StencilServer
+
+    with StencilServer(ServeConfig(max_queue=64,
+                                   bucket_edges=EDGES)) as s:
+        report = loadgen.run(s, requests=4, concurrency=2, reps=1,
+                             shapes=((10, 12),), channels=(1,), seed=2,
+                             zipf=1.0, zipf_keys=2)
+    assert report["completed"] == 4
+    assert report["zipf"] == 1.0 and report["zipf_keys"] == 2
+    assert 1 <= report["distinct_keys_offered"] <= 2
+    assert report["cache_hit_ratio"] is None
+
+
+# -- HTTP tier ----------------------------------------------------------
+
+def _net(**kw):
+    from tpu_stencil.net.http import NetFrontend
+
+    kw.setdefault("port", 0)
+    kw.setdefault("replicas", 2)
+    kw.setdefault("result_cache_mb", 8.0)
+    kw.setdefault("witness_rate", 0.0)
+    kw.setdefault("probe_interval_s", 0.0)
+    kw.setdefault("warm_fleet", False)
+    kw.setdefault("bucket_edges", EDGES)
+    return NetFrontend(NetConfig(**kw)).start()
+
+
+def _post(fe, img, reps, filter_name=None, extra_headers=None,
+          timeout=300):
+    h, w = img.shape[:2]
+    channels = img.shape[2] if img.ndim == 3 else 1
+    url = (fe.url + f"/v1/blur?w={w}&h={h}&reps={reps}"
+                    f"&channels={channels}")
+    if filter_name:
+        url += f"&filter={filter_name}"
+    req = urllib.request.Request(url, data=img.tobytes(), method="POST",
+                                 headers=extra_headers or {})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.read(), r.headers
+
+
+def _http_error(fe, img, reps, **kw):
+    try:
+        _post(fe, img, reps, **kw)
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+    raise AssertionError("expected an HTTP error")
+
+
+def _get_json(fe, path):
+    with urllib.request.urlopen(fe.url + path, timeout=60) as r:
+        return json.loads(r.read())
+
+
+def test_hit_bit_identical_to_cold_compute_fuzz():
+    # The acceptance criterion: hit == cold compute == NumPy golden,
+    # payload AND stamp, across grey/RGB x filter x reps (incl. the
+    # reps=0 identity).
+    rng = np.random.default_rng(11)
+    fe = _net()
+    try:
+        cases = [((16, 12), "gaussian", 2), ((16, 12, 3), "box", 1),
+                 ((24, 18, 3), "gaussian", 4), ((9, 13), "box", 0)]
+        for shape, fname, reps in cases:
+            img = rng.integers(0, 256, shape, dtype=np.uint8)
+            want = np.asarray(_golden(img, reps, fname)).tobytes()
+            out1, h1 = _post(fe, img, reps, filter_name=fname)
+            out2, h2 = _post(fe, img, reps, filter_name=fname)
+            assert h1["X-Cache"] == "miss" and h2["X-Cache"] == "hit"
+            assert out1 == want and out2 == want
+            stamp = str(checksum.crc32c(want))
+            assert h1[checksum.RESULT_HEADER] == stamp
+            assert h2[checksum.RESULT_HEADER] == stamp
+        snap = fe.metrics_snapshot()
+        assert snap["counters"]["result_cache_hits_total"] == len(cases)
+        assert snap["counters"]["result_cache_misses_total"] == len(cases)
+        assert (snap["counters"]["result_cache_insertions_total"]
+                == len(cases))
+    finally:
+        fe.close()
+
+
+def test_crc_claim_validated_identically_on_hit_and_miss():
+    rng = np.random.default_rng(5)
+    img = rng.integers(0, 256, (H, W, C), dtype=np.uint8)
+    body = img.tobytes()
+    claim = {checksum.CRC_HEADER: str(checksum.crc32c(body))}
+    fe = _net()
+    try:
+        out1, h1 = _post(fe, img, REPS, extra_headers=claim)
+        out2, h2 = _post(fe, img, REPS, extra_headers=claim)
+        assert h1["X-Cache"] == "miss" and h2["X-Cache"] == "hit"
+        assert out1 == out2 == _golden(img, REPS).tobytes()
+        # A wrong claim 400s BEFORE the (populated) cache can answer —
+        # the hit path validates exactly like the miss path did.
+        code, detail = _http_error(
+            fe, img, REPS, extra_headers={checksum.CRC_HEADER: "12345"})
+        assert code == 400 and "ChecksumMismatch" in detail
+        code, detail = _http_error(
+            fe, img, REPS,
+            extra_headers={checksum.CRC_HEADER: "not-a-crc"})
+        assert code == 400 and "malformed" in detail
+        snap = fe.metrics_snapshot()
+        assert snap["counters"]["integrity_checksum_failures_total"] == 1
+        assert snap["counters"]["result_cache_hits_total"] == 1
+    finally:
+        fe.close()
+
+
+def test_singleflight_one_dispatch_for_concurrent_identicals(
+        rng=None, monkeypatch=None):
+    rng = np.random.default_rng(9)
+    fe = _net(replicas=1)
+    try:
+        img = rng.integers(0, 256, (16, 12, 3), dtype=np.uint8)
+        want = _golden(img, REPS).tobytes()
+        rep0 = fe.fleet.replicas[0]
+        orig = rep0._dispatch
+
+        def slow(batch):
+            time.sleep(1.0)  # hold the flight open for the followers
+            return orig(batch)
+
+        rep0._dispatch = slow
+        results = []
+
+        def post_one():
+            results.append(_post(fe, img, REPS))
+
+        leader = threading.Thread(target=post_one)
+        leader.start()
+        # The flight is registered before the router dispatch: once
+        # inflight()==1 every identical arrival MUST collapse.
+        assert _wait_for(lambda: fe.cache.flights.inflight() == 1)
+        followers = [threading.Thread(target=post_one) for _ in range(4)]
+        for t in followers:
+            t.start()
+        leader.join()
+        for t in followers:
+            t.join()
+        assert len(results) == 5
+        xcs = sorted(h["X-Cache"] for _, h in results)
+        assert xcs == ["collapsed"] * 4 + ["miss"]
+        assert all(out == want for out, _ in results)
+        snap = fe.metrics_snapshot()
+        # Exactly ONE replica dispatch for the five identical requests.
+        assert snap["counters"]["fleet_completed_total"] == 1
+        assert snap["counters"]["singleflight_leaders_total"] == 1
+        assert snap["counters"]["singleflight_collapsed_total"] == 4
+        assert snap["counters"]["result_cache_insertions_total"] == 1
+    finally:
+        rep0._dispatch = orig
+        fe.close()
+
+
+def test_follower_deadline_expires_typed_without_cancelling_leader():
+    # A follower whose budget runs out 504s on ITS OWN clock; the
+    # leader (and its client) keep flying to a full 200.
+    rng = np.random.default_rng(13)
+    fe = _net(replicas=1)
+    rep0 = fe.fleet.replicas[0]
+    orig = rep0._dispatch
+    try:
+        img = rng.integers(0, 256, (16, 12, 3), dtype=np.uint8)
+        want = _golden(img, REPS).tobytes()
+
+        def slow(batch):
+            # Longer than the follower's deadline+grace wait (~5.2s),
+            # well under the leader's default budget.
+            time.sleep(6.5)
+            return orig(batch)
+
+        rep0._dispatch = slow
+        leader_out = {}
+
+        def leader():
+            out, h = _post(fe, img, REPS)
+            leader_out["body"], leader_out["xc"] = out, h["X-Cache"]
+
+        t = threading.Thread(target=leader)
+        t.start()
+        assert _wait_for(lambda: fe.cache.flights.inflight() == 1)
+        code, detail = _http_error(
+            fe, img, REPS,
+            extra_headers={"X-Request-Timeout": "0.2"})
+        assert code == 504  # the follower expired, typed
+        t.join()
+        assert leader_out["body"] == want and leader_out["xc"] == "miss"
+        snap = fe.metrics_snapshot()
+        assert snap["counters"]["fleet_completed_total"] == 1
+        assert snap["counters"]["singleflight_collapsed_total"] == 1
+    finally:
+        rep0._dispatch = orig
+        fe.close()
+
+
+@pytest.mark.chaos
+def test_witness_mismatch_evicts_poisoned_entries_before_any_hit():
+    # The poisoning acceptance scenario, with REAL bit flips: a replica
+    # corrupts one result (integrity.corrupt_result), the witness
+    # convicts it, and the cache drops (or refuses) the poisoned entry
+    # — the identical follow-up request is a MISS serving golden bytes,
+    # never a poisoned hit.
+    rng = np.random.default_rng(7)
+    img = rng.integers(0, 256, (H, W, C), dtype=np.uint8)
+    want = _golden(img, REPS).tobytes()
+    faults.configure("integrity.corrupt_result:times=1")
+    fe = _net(witness_rate=1.0, quarantine_after=3)
+    try:
+        out, h = _post(fe, img, REPS)
+        assert h["X-Cache"] == "miss"
+        assert out != want  # the corruption really went out cold
+
+        def convicted():
+            c = fe.metrics_snapshot()["counters"]
+            # Either the entry was admitted then synchronously dropped
+            # by the verdict, or the verdict beat the insert and the
+            # epoch fence refused it — both keep poison out.
+            return (c["cache_invalidations_witness_mismatch_total"] >= 1
+                    or c["result_cache_admission_refused_total"] >= 1)
+
+        assert _wait_for(convicted)
+        out2, h2 = _post(fe, img, REPS)
+        assert h2["X-Cache"] == "miss"  # the poisoned entry is NOT hit
+        assert out2 == want
+        with urllib.request.urlopen(fe.url + "/metrics",
+                                    timeout=60) as r:
+            text = r.read().decode()
+        assert "tpu_stencil_net_cache_invalidations_witness_mismatch_total" \
+            in text
+        assert "tpu_stencil_net_fleet_integrity_witness_mismatch_total" \
+            in text
+    finally:
+        fe.close()
+
+
+def test_quarantine_synchronously_empties_replica_entries():
+    rng = np.random.default_rng(3)
+    img = rng.integers(0, 256, (H, W, C), dtype=np.uint8)
+    want = _golden(img, REPS).tobytes()
+    fe = _net()
+    try:
+        out, h = _post(fe, img, REPS)
+        assert h["X-Cache"] == "miss" and h["X-Replica"] == "0"
+        assert _post(fe, img, REPS)[1]["X-Cache"] == "hit"
+        # Operator quarantine: replica 0's entries must be gone by the
+        # time the POST returns, not eventually.
+        req = urllib.request.Request(
+            fe.url + "/admin/quarantine?replica=0", data=b"",
+            method="POST")
+        with urllib.request.urlopen(req, timeout=120) as r:
+            assert json.loads(r.read())["quarantined"] is True
+        assert _get_json(fe, "/admin/cache?action=stats")["entries"] == 0
+        snap = fe.metrics_snapshot()
+        assert snap["counters"]["cache_invalidations_quarantine_total"] \
+            == 1
+        # The identical request recomputes on the sibling, bit-exact.
+        out2, h2 = _post(fe, img, REPS)
+        assert h2["X-Cache"] == "miss" and h2["X-Replica"] == "1"
+        assert out2 == want
+        # A quarantined replica's results are never admitted.
+        assert fe.cache.store.put(("x",), b"p", None, 0,
+                                  fe.cache.token()) is False
+        snap = fe.metrics_snapshot()
+        assert snap["counters"]["result_cache_admission_refused_total"] \
+            >= 1
+    finally:
+        fe.close()
+
+
+def test_admin_cache_stats_clear_roundtrip_and_404_when_off():
+    rng = np.random.default_rng(17)
+    img = rng.integers(0, 256, (16, 12, 3), dtype=np.uint8)
+    fe = _net(replicas=1)
+    try:
+        _post(fe, img, 1)
+        assert _post(fe, img, 1)[1]["X-Cache"] == "hit"
+        stats = _get_json(fe, "/admin/cache?action=stats")
+        assert stats["entries"] == 1 and stats["bytes"] == img.nbytes
+        cleared = _get_json(fe, "/admin/cache?action=clear")
+        assert cleared == {"action": "clear", "cleared": 1}
+        assert _post(fe, img, 1)[1]["X-Cache"] == "miss"
+        snap = fe.metrics_snapshot()
+        assert snap["counters"]["cache_invalidations_clear_total"] == 1
+        # Unknown action: usage error, not a crash.
+        try:
+            _get_json(fe, "/admin/cache?action=typo")
+            raise AssertionError("expected 400")
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+        # statusz carries the cache block and the config knob.
+        status = _get_json(fe, "/statusz")
+        assert status["config"]["result_cache_mb"] == 8.0
+        assert status["cache"]["entries"] == 1
+    finally:
+        fe.close()
+    fe_off = _net(replicas=1, result_cache_mb=0.0)
+    try:
+        try:
+            _get_json(fe_off, "/admin/cache")
+            raise AssertionError("expected 404")
+        except urllib.error.HTTPError as e:
+            assert e.code == 404  # "off" is distinguishable from "empty"
+        assert _get_json(fe_off, "/statusz")["cache"] is None
+        # Cache-off requests carry no X-Cache header at all.
+        out, h = _post(fe_off, img, 1)
+        assert h["X-Cache"] is None
+    finally:
+        fe_off.close()
+
+
+def test_loadgen_zipf_reports_cache_hit_ratio_over_http():
+    from tpu_stencil.serve import loadgen
+
+    fe = _net(replicas=1)
+    target = loadgen.HttpTarget(fe.url)
+    try:
+        report = loadgen.run(target, mode="closed", requests=10,
+                             concurrency=1, reps=1, shapes=((10, 12),),
+                             channels=(3,), seed=4, zipf=1.5,
+                             zipf_keys=2)
+    finally:
+        target.close()
+        fe.close()
+    assert report["completed"] == 10
+    assert report["zipf"] == 1.5 and report["zipf_keys"] == 2
+    distinct = report["distinct_keys_offered"]
+    assert 1 <= distinct <= 2
+    # Sequential closed loop over <=2 keys: every request past each
+    # key's first sighting is a hit, from the target's own registry.
+    assert report["cache_hit_ratio"] == (10 - distinct) / 10
+
+
+# -- federation tier ----------------------------------------------------
+
+def _fed_pair(**net_kw):
+    from tpu_stencil.fed.http import FedFrontend
+
+    net_kw.setdefault("result_cache_mb", 8.0)
+    m1 = _net(replicas=1, **net_kw)
+    m2 = _net(replicas=1, **net_kw)
+    fed = FedFrontend(FedConfig(port=0, members=(m1.url, m2.url),
+                                heartbeat_interval_s=0.1,
+                                hedge=False)).start()
+    assert _wait_for(lambda: sum(
+        1 for m in fed.membership.members() if m.state == "healthy"
+    ) == 2)
+    return fed, m1, m2
+
+
+def test_fed_digest_affinity_pins_repeats_and_propagates_xcache():
+    rng = np.random.default_rng(19)
+    img = rng.integers(0, 256, (24, 18, 3), dtype=np.uint8)
+    want = _golden(img, REPS).tobytes()
+    fed, m1, m2 = _fed_pair()
+    try:
+        def post(frame):
+            h, w = frame.shape[:2]
+            req = urllib.request.Request(
+                fed.url + f"/v1/blur?w={w}&h={h}&reps={REPS}&channels=3",
+                data=frame.tobytes(), method="POST")
+            with urllib.request.urlopen(req, timeout=300) as r:
+                return r.read(), r.headers
+
+        out1, h1 = post(img)
+        out2, h2 = post(img)
+        # Affinity: the identical frame lands on the SAME member, so
+        # the second post is that member's cache hit — and the member's
+        # X-Cache verdict survives the fed hop.
+        assert h1["X-Fed-Member"] == h2["X-Fed-Member"]
+        assert h1["X-Cache"] == "miss" and h2["X-Cache"] == "hit"
+        assert out1 == want and out2 == want
+        # A distinct frame is a miss wherever it lands.
+        img2 = rng.integers(0, 256, (24, 18, 3), dtype=np.uint8)
+        _, h3 = post(img2)
+        assert h3["X-Cache"] == "miss"
+        snap = fed.metrics_snapshot()
+        assert snap["counters"]["member_cache_hit_total"] == 1
+        assert snap["counters"]["member_cache_miss_total"] == 2
+        assert snap["counters"]["member_cache_collapsed_total"] == 0
+        assert snap["counters"]["affinity_routed_total"] >= 3
+        # The member result-cache counters fold into the fed scrape.
+        assert any(k.startswith("fleet_")
+                   and k.endswith("result_cache_hits_total")
+                   for k in snap["counters"])
+        with urllib.request.urlopen(fed.url + "/statusz",
+                                    timeout=60) as r:
+            status = json.loads(r.read())
+        assert status["config"]["digest_affinity"] is True
+    finally:
+        fed.close()
+        m1.close()
+        m2.close()
+
+
+def test_fed_fold_collision_deduped_and_counted():
+    from tpu_stencil.fed.http import FedFrontend
+
+    member = _net(replicas=1, result_cache_mb=0.0)
+    fed = FedFrontend(FedConfig(port=0, members=(member.url,),
+                                heartbeat_interval_s=0.1,
+                                hedge=False)).start()
+    try:
+        assert _wait_for(lambda: any(
+            m.state == "healthy" for m in fed.membership.members()
+        ))
+        # Materialize a member counter worth folding.
+        rng = np.random.default_rng(23)
+        img = rng.integers(0, 256, (10, 12, 3), dtype=np.uint8)
+        req = urllib.request.Request(
+            fed.url + "/v1/blur?w=12&h=10&reps=1&channels=3",
+            data=img.tobytes(), method="POST")
+        with urllib.request.urlopen(req, timeout=300) as r:
+            r.read()
+        host_id = fed.membership.members()[0].host_id
+        fk = f"fleet_{host_id}_requests_total"
+        # A fed-registry counter that literally shadows the fold target:
+        # the old code silently overwrote it with the member's value.
+        fed.registry.counter(fk).inc(7)
+        snap = fed.metrics_snapshot()
+        assert snap["counters"][fk] == 7  # first writer wins
+        assert snap["counters"]["fold_collisions_total"] >= 1
+        # Uncontested member counters still fold.
+        assert f"fleet_{host_id}_responses_2xx_total" in snap["counters"]
+    finally:
+        fed.close()
+        member.close()

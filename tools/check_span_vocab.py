@@ -15,6 +15,11 @@ closes the gap:
 * each must appear, backticked, in the "Span vocabulary" section of
   docs/OBSERVABILITY.md (a ``stream.*`` table entry covers every
   ``stream.<stage>`` literal);
+* every DOTTED name's tier prefix (the segment before the first ``.``)
+  must come from :data:`KNOWN_TIERS` — the span vocabulary is
+  partitioned by tier (``serve.*``, ``net.*``, ``cache.*``, ...), and
+  a typo'd or ad-hoc prefix (``cahce.lookup``) would otherwise pass as
+  long as someone documented the typo too;
 * a missing name fails the check (exit 1); a documented name with no
   remaining call site is reported as a warning (docs can legitimately
   list conditional names).
@@ -36,6 +41,16 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 SRC_DIR = os.path.join(REPO, "tpu_stencil")
 DOC = os.path.join(REPO, "docs", "OBSERVABILITY.md")
 SECTION = "## Span vocabulary"
+
+#: The tier partition of the span vocabulary: a dotted span name's
+#: first segment must be one of these (bare names — the driver phases
+#: like ``load``/``compile`` — are exempt). Extending the vocabulary
+#: with a new tier means adding it HERE plus its table rows in
+#: docs/OBSERVABILITY.md — two deliberate edits, no drive-by prefixes.
+KNOWN_TIERS = frozenset({
+    "serve", "sharded", "stream", "net", "fed", "cache",
+    "integrity", "resilience", "iterate",
+})
 
 _CALL_RE = re.compile(
     r"(?:\bobs\.span|\b_obs_span|\btracing\.span|\bobs\.phase"
@@ -110,6 +125,19 @@ def check() -> int:
             for doc in documented
         )
 
+    bad_tier = {
+        n: sites for n, sites in sorted(found.items())
+        if "." in n and n.split(".", 1)[0] not in KNOWN_TIERS
+    }
+    if bad_tier:
+        print("span-vocabulary drift: these span literals use a tier "
+              "prefix outside KNOWN_TIERS "
+              f"({', '.join(sorted(KNOWN_TIERS))}):", file=sys.stderr)
+        for name, sites in bad_tier.items():
+            print(f"  {name!r}  ({', '.join(sites[:3])}"
+                  f"{', ...' if len(sites) > 3 else ''})",
+                  file=sys.stderr)
+        return 1
     missing = {n: sites for n, sites in sorted(found.items())
                if not covered(n)}
     if missing:

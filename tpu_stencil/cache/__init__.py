@@ -1,0 +1,91 @@
+"""tpu_stencil.cache — content-addressed result caching at the edge.
+
+The request-level analog of the executable cache's never-re-pay rule:
+the serve tier never re-pays a compile, the net tier (with this
+subsystem armed via ``--result-cache-mb``) never re-pays a *launch*
+for bytes it has already blurred. Four pieces:
+
+* :mod:`~tpu_stencil.cache.digest` — BLAKE2b-160 content digest fused
+  into the existing CRC scan of the staging buffer; the full cache key.
+* :mod:`~tpu_stencil.cache.store` — byte-budgeted LRU of true result
+  bytes + integrity stamps, with synchronous replica-distrust
+  invalidation and epoch-fenced admission.
+* :mod:`~tpu_stencil.cache.singleflight` — concurrent identical
+  requests collapse onto one leader launch.
+* :mod:`~tpu_stencil.cache.affinity` — rendezvous hashing so the fed
+  tier concentrates repeated content where its cache entry lives.
+
+:class:`ResultCache` is the facade the net tier holds: store +
+single-flight behind one object, ``None`` when the cache is off.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Tuple
+
+from tpu_stencil.cache import affinity, digest, singleflight, store
+from tpu_stencil.cache.affinity import rendezvous_order
+from tpu_stencil.cache.digest import (
+    DIGEST_SIZE,
+    content_digest,
+    digest_and_crc,
+    request_key,
+)
+from tpu_stencil.cache.singleflight import SingleFlight
+from tpu_stencil.cache.store import Entry, ResultStore
+from tpu_stencil.serve.metrics import Registry
+
+__all__ = [
+    "DIGEST_SIZE", "Entry", "ResultCache", "ResultStore", "SingleFlight",
+    "affinity", "content_digest", "digest", "digest_and_crc",
+    "rendezvous_order", "request_key", "singleflight", "store",
+]
+
+
+class ResultCache:
+    """Store + single-flight behind the one handle the HTTP layer
+    threads around. The leader contract: draw :meth:`token` before
+    dispatch, then exactly one of :meth:`complete` (admits + resolves
+    followers) or :meth:`fail` (propagates typed, caches nothing)."""
+
+    def __init__(self, registry: Registry, capacity_bytes: int,
+                 quarantined: Optional[Callable[[int], bool]] = None)\
+            -> None:
+        self.store = ResultStore(registry, capacity_bytes,
+                                 quarantined=quarantined)
+        self.flights = SingleFlight(registry)
+
+    key = staticmethod(request_key)
+
+    def token(self) -> int:
+        return self.store.token()
+
+    def lookup(self, key: tuple) -> Optional[Entry]:
+        return self.store.get(key)
+
+    def join(self, key: tuple):
+        return self.flights.join(key)
+
+    def complete(self, key: tuple, payload: bytes, stamp: Optional[str],
+                 replica: int, token: int) -> bool:
+        """Leader success: admit (subject to the distrust fence) and
+        resolve every follower with the true bytes. Followers get the
+        result even when admission is refused — refusal is about the
+        STORE not trusting the replica going forward, while these
+        specific bytes already passed the same path a cache-off
+        response takes."""
+        admitted = self.store.put(key, payload, stamp, replica, token)
+        self.flights.resolve(key, (payload, stamp, replica))
+        return admitted
+
+    def fail(self, key: tuple, exc: BaseException) -> None:
+        self.flights.fail(key, exc)
+
+    def invalidate_replica(self, replica: int, cause: str) -> int:
+        return self.store.invalidate_replica(replica, cause)
+
+    def clear(self) -> int:
+        return self.store.clear()
+
+    def stats(self) -> dict:
+        return self.store.stats()

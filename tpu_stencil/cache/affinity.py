@@ -1,0 +1,39 @@
+"""Digest-affinity placement: rendezvous hashing over fleet members.
+
+A result cache at every member is only as good as the router's aim: if
+the fed tier sprays identical content round-robin, each member caches
+its own copy and the fleet-wide hit rate divides by N. Rendezvous
+(highest-random-weight) hashing fixes the aim — for a given content
+digest every fed instance independently ranks the SAME member first,
+so repeated content lands where its cache entry already lives.
+
+Rendezvous over consistent-ring hashing because membership here is
+small and churny: when a member drops out (breaker open, draining,
+scrape-dead) only the keys it owned move, everything else keeps its
+placement, and there is no ring state to rebuild — the ranking is a
+pure function of (digest, member id).
+
+The weight is BLAKE2b-64 over ``digest || host_id`` — the same hash
+family as the cache key, seeded per member, deterministic across
+processes (no PYTHONHASHSEED exposure like builtin ``hash``).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Iterable, List
+
+
+def _weight(digest: bytes, host_id: str) -> bytes:
+    return hashlib.blake2b(
+        digest + host_id.encode("utf-8", "surrogatepass"), digest_size=8
+    ).digest()
+
+
+def rendezvous_order(host_ids: Iterable[str], digest: bytes) -> List[str]:
+    """Member ids ranked by highest-random-weight for ``digest`` —
+    index 0 is the affinity home. Ties (only possible for duplicate
+    ids) break on the id itself, so the order is total and stable."""
+    return sorted(host_ids,
+                  key=lambda hid: (_weight(digest, hid), hid),
+                  reverse=True)

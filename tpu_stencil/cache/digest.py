@@ -1,0 +1,83 @@
+"""Content digests for the edge result cache.
+
+The net tier already scans every request body once (the CRC32C claim
+check from the integrity PR). A CRC is the right tool for detecting
+wire corruption but the wrong tool for content addressing: 32 bits
+collide under birthday pressure at cache scale, and a collision here
+is not a retry — it is the wrong pixels served with a 200. The cache
+keys on BLAKE2b-160 instead (20 bytes; collision-free for any
+realistic keyspace, and available in hashlib everywhere without a
+dependency).
+
+:func:`digest_and_crc` is the fusion point: ONE pass over the staging
+buffer feeds both the BLAKE2b state and the incremental CRC32C
+(``crc32c(chunk, value)`` extends a running checksum), so arming the
+cache does not add a second scan to the ingest path — the digest rides
+the scan the integrity claim check was already paying for.
+
+The full cache key is the digest PLUS every parameter that changes the
+result bytes: filter, reps, geometry (H, W, channels) and boundary.
+Two requests share a cache entry iff a cold compute would return
+bit-identical payloads for both.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Tuple
+
+from tpu_stencil.integrity import checksum as _checksum
+
+# BLAKE2b-160: 20-byte digests. Big enough that content collisions are
+# out of the failure model; small enough that a million-entry key index
+# stays tens of MB.
+DIGEST_SIZE = 20
+
+# Scan granularity. One chunk per MiB keeps the Python-level loop
+# overhead negligible against the C hash cores while bounding the
+# temporary memoryview slices.
+_CHUNK = 1 << 20
+
+
+def _flat_view(data) -> memoryview:
+    """A 1-D byte view of ``data`` (bytes / bytearray / memoryview /
+    contiguous ndarray) without copying."""
+    mv = memoryview(data)
+    if mv.ndim != 1 or mv.itemsize != 1:
+        mv = mv.cast("B")
+    return mv
+
+
+def content_digest(data) -> bytes:
+    """BLAKE2b-160 over ``data``. The fed tier uses this (it holds the
+    raw body bytes and does not need the CRC fused in)."""
+    mv = _flat_view(data)
+    h = hashlib.blake2b(digest_size=DIGEST_SIZE)
+    h.update(mv)
+    return h.digest()
+
+
+def digest_and_crc(data) -> Tuple[bytes, int]:
+    """One scan, both checks: returns ``(blake2b_160_digest, crc32c)``
+    over the same pass through the buffer. The net tier calls this on
+    the arena staging view so the cache key and the integrity claim
+    validation share a single read of the request body."""
+    mv = _flat_view(data)
+    h = hashlib.blake2b(digest_size=DIGEST_SIZE)
+    crc = 0
+    for off in range(0, len(mv), _CHUNK):
+        chunk = mv[off:off + _CHUNK]
+        h.update(chunk)
+        crc = _checksum.crc32c(chunk, crc)
+    return h.digest(), crc
+
+
+def request_key(digest: bytes, filter_name: str, reps: int, h: int,
+                w: int, channels: int, boundary: int) -> tuple:
+    """The full cache key: content digest plus every request parameter
+    that reaches the kernel. Hashable, cheap to compare, and total —
+    omitting any of these would alias distinct results."""
+    return (
+        digest, str(filter_name), int(reps), int(h), int(w),
+        int(channels), int(boundary),
+    )

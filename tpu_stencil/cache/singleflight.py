@@ -1,0 +1,85 @@
+"""Single-flight collapse: one launch per distinct in-flight key.
+
+The PR-14 coalescer merges *compatible* requests (same bucket) into one
+batched launch; this module merges *identical* requests (same full
+content key) into ONE launch total. The first arrival for a key is the
+leader — it runs the real admission + dispatch path. Every concurrent
+arrival with the same key is a follower: it parks on its own future and
+never touches the router, so N identical requests cost exactly one
+inflight-bytes reservation and one replica dispatch.
+
+Deadline and failure semantics, per the net tier's contracts:
+
+* Followers wait with their OWN deadline budget. An expired follower
+  fails ``DeadlineExceeded``-shaped (a 504 at the edge) WITHOUT
+  cancelling the leader — the leader's client and any patient
+  followers still get their bytes.
+* A leader failure propagates the typed exception to every follower
+  (each maps it through the same status ladder a direct request would
+  hit) and caches nothing.
+
+Each follower gets a distinct :class:`concurrent.futures.Future`, so a
+follower-side cancel/timeout affects only that follower; the leader
+resolves the flight once and the fan-out is a plain loop.
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent.futures import Future
+from typing import Dict, List, Optional, Tuple
+
+from tpu_stencil.obs import span as _obs_span
+from tpu_stencil.serve.metrics import Registry
+
+
+class SingleFlight:
+    """In-flight key table. ``join`` then exactly one of ``resolve`` /
+    ``fail`` from the leader; both are no-ops for unknown keys (a
+    leader that already settled, or a cache-off path)."""
+
+    def __init__(self, registry: Registry) -> None:
+        self._lock = threading.Lock()
+        self._flights: Dict[tuple, List[Future]] = {}
+        self._m_leaders = registry.counter("singleflight_leaders_total")
+        self._m_collapsed = registry.counter("singleflight_collapsed_total")
+
+    def join(self, key: tuple) -> Tuple[bool, Optional[Future]]:
+        """Returns ``(is_leader, follower_future)``. The leader gets
+        ``(True, None)`` and MUST eventually :meth:`resolve` or
+        :meth:`fail` the key; followers get ``(False, future)`` and
+        wait on it under their own deadline."""
+        with self._lock:
+            followers = self._flights.get(key)
+            if followers is None:
+                self._flights[key] = []
+                self._m_leaders.inc()
+                return True, None
+            fut: Future = Future()
+            followers.append(fut)
+            self._m_collapsed.inc()
+        with _obs_span("cache.collapse", "net"):
+            pass
+        return False, fut
+
+    def resolve(self, key: tuple, value) -> None:
+        """Leader success: hand ``value`` to every follower."""
+        for fut in self._pop(key):
+            if fut.set_running_or_notify_cancel():
+                fut.set_result(value)
+
+    def fail(self, key: tuple, exc: BaseException) -> None:
+        """Leader failure: propagate the typed exception to every
+        follower."""
+        for fut in self._pop(key):
+            if fut.set_running_or_notify_cancel():
+                fut.set_exception(exc)
+
+    def _pop(self, key: tuple) -> List[Future]:
+        with self._lock:
+            return self._flights.pop(key, [])
+
+    def inflight(self) -> int:
+        """How many keys currently have a leader in flight (tests)."""
+        with self._lock:
+            return len(self._flights)

@@ -627,6 +627,13 @@ class NetConfig:
     # bytes -> frombuffer -> defensive-copy chain). Off = every body is
     # buffered through fresh bytes objects (the A/B arm).
     ingest_arena: bool = True
+    # Content-addressed result cache (tpu_stencil.cache; docs/SERVING.md
+    # "Result cache"): this many MB of true result bytes keyed by
+    # (body BLAKE2b-160, filter, reps, geometry, boundary), with
+    # single-flight collapse of concurrent identical requests and
+    # synchronous invalidation on replica distrust. 0 = off (the
+    # default: caching is a traffic-shape bet the operator opts into).
+    result_cache_mb: float = 0.0
 
     def __post_init__(self) -> None:
         if not self.host:
@@ -693,6 +700,11 @@ class NetConfig:
                 f"coalesce_window_us must be >= 0 (0 = no request "
                 f"coalescing), got {self.coalesce_window_us}"
             )
+        if self.result_cache_mb < 0:
+            raise ValueError(
+                f"result_cache_mb must be >= 0 (0 = no result cache), "
+                f"got {self.result_cache_mb}"
+            )
         # Jax-free (the filter bank is pure numpy): a typo'd --filter
         # must die as a usage error, not boot a tier that answers 500
         # to every request.
@@ -714,6 +726,10 @@ class NetConfig:
     @property
     def coalesce_window_s(self) -> float:
         return self.coalesce_window_us / 1e6
+
+    @property
+    def result_cache_bytes(self) -> int:
+        return int(self.result_cache_mb * (1 << 20))
 
     def serve_config(self, device_index: int) -> ServeConfig:
         """The per-replica engine config: one engine pinned to one
@@ -805,6 +821,13 @@ class FedConfig:
     flightrec_dir: Optional[str] = "flightrec"
     # Slow-request trigger threshold (seconds; 0 = off).
     flight_latency_threshold_s: float = 0.0
+    # Digest-affinity placement (tpu_stencil.cache.affinity): healthy
+    # members are ranked by rendezvous hash of the request body's
+    # BLAKE2b-160 digest, so repeated content concentrates on the
+    # member whose result cache already holds it. Suspect members,
+    # breakers, drains and hedging behave exactly as before; off =
+    # pure least-outstanding placement.
+    digest_affinity: bool = True
 
     def __post_init__(self) -> None:
         if not self.host:

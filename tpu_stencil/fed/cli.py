@@ -74,6 +74,13 @@ def build_parser() -> argparse.ArgumentParser:
                    default=0.05, metavar="SECONDS",
                    help="hedge-trigger floor under the observed p99 "
                         "(default 0.05)")
+    p.add_argument("--no-digest-affinity", dest="digest_affinity",
+                   action="store_false",
+                   help="disable content-digest rendezvous placement "
+                        "(on by default: identical frames land on the "
+                        "same healthy member so its result cache sees "
+                        "the whole repeat stream; off = pure "
+                        "least-outstanding)")
     p.add_argument("--forward-timeout", dest="forward_timeout_s",
                    type=float, default=120.0, metavar="SECONDS",
                    help="per-attempt member socket timeout (default "
@@ -142,6 +149,7 @@ def main(argv=None) -> int:
             breaker_threshold=ns.breaker_threshold,
             breaker_cooldown_s=ns.breaker_cooldown_s,
             hedge=ns.hedge, hedge_min_s=ns.hedge_min_s,
+            digest_affinity=ns.digest_affinity,
             forward_timeout_s=ns.forward_timeout_s,
             reoffer_s=ns.reoffer_s,
             max_inflight_mb=ns.max_inflight_mb,
@@ -175,6 +183,7 @@ def main(argv=None) -> int:
         f"suspect/evict after {cfg.suspect_after}/{cfg.evict_after} "
         f"misses, breaker opens at {cfg.breaker_threshold}, "
         f"hedge={'on' if cfg.hedge else 'off'}, "
+        f"affinity={'on' if cfg.digest_affinity else 'off'}, "
         f"tenant quota {cfg.tenant_quota}); "
         f"POST /v1/blur /admin/register /admin/drain, "
         f"GET /healthz /metrics /statusz /debug/trace/<id> "

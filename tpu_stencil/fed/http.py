@@ -7,8 +7,12 @@ Endpoints (docs/SERVING.md "Federation tier" is the contract):
   legal); the frontend admits (drain gate 503 / federation byte-shed
   503 + Retry-After / per-tenant quota 429 + Retry-After, classes keyed
   on ``X-Tenant``), then the router forwards to a member host with
-  hedging and typed rerouting. Success responses carry
-  ``X-Fed-Member`` (which host computed) and ``X-Fed-Hedged``.
+  hedging and typed rerouting — placed by content-digest rendezvous
+  affinity when ``digest_affinity`` is on, so identical frames revisit
+  the same member's result cache. Success responses carry
+  ``X-Fed-Member`` (which host computed), ``X-Fed-Hedged``, and the
+  member's ``X-Cache`` verdict (hit/miss/collapsed) when its result
+  cache is enabled.
 * ``GET /healthz`` — 200 serving / 503 draining, same readiness
   contract as the net tier, one hop up.
 * ``GET /metrics`` — the fed registry rendered under
@@ -45,6 +49,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional
 from urllib.parse import parse_qs, urlsplit
 
+from tpu_stencil.cache import digest as _cache_digest
 from tpu_stencil.config import FedConfig
 from tpu_stencil.fed.breaker import BreakerBoard
 from tpu_stencil.integrity import checksum as _checksum
@@ -340,12 +345,19 @@ class _FedHandler(BaseHTTPRequestHandler):
                 v = self._param(query, name, qname)
                 if v is not None:
                     fwd[name] = v
+            # Digest-affinity placement: the fed computes the same
+            # BLAKE2b-160 content digest the member's result cache
+            # keys on, so the router can land identical frames on the
+            # SAME member — N member caches hold N keyspaces, not N
+            # copies of the hot set.
+            digest = (_cache_digest.content_digest(body)
+                      if fe.cfg.digest_affinity else None)
             # Request + response buffers both live for the hop's
             # lifetime: the honest in-flight footprint is 2x the frame.
             nbytes = 2 * expected
             try:
                 status, rh, data, host_id, hedged = fe.router.submit(
-                    body, fwd, nbytes, tenant=tenant
+                    body, fwd, nbytes, tenant=tenant, digest=digest
                 )
             except Draining as e:
                 self._error(503, str(e),
@@ -393,6 +405,17 @@ class _FedHandler(BaseHTTPRequestHandler):
                 fe.registry.histogram(
                     "request_latency_seconds"
                 ).observe(elapsed)
+                # The member's X-Cache verdict, observed at THIS tier:
+                # member_cache_hit_total / requests answered from a
+                # member's result cache is the federation's hit ratio
+                # — the number digest-affinity placement exists to
+                # move. (The header also passes through to the client
+                # via the x-* copy below.)
+                xc = rh.get("x-cache")
+                if xc in ("hit", "miss", "collapsed"):
+                    fe.registry.counter(
+                        f"member_cache_{xc}_total"
+                    ).inc()
                 thr = fe.cfg.flight_latency_threshold_s
                 if thr and elapsed > thr:
                     _obs_flight.trigger(
@@ -443,6 +466,12 @@ class FedFrontend:
         self.registry.histogram("request_latency_seconds")
         self.registry.counter("rejected_total")
         self.registry.counter("member_scrape_failures_total")
+        self.registry.counter("fold_collisions_total")
+        # The federation's view of member result caches (X-Cache on
+        # member 200s) — pre-created so a cold federation scrapes them
+        # at zero and dashboards can rate() from the start.
+        for xc in ("hit", "miss", "collapsed"):
+            self.registry.counter(f"member_cache_{xc}_total")
         self.membership = Membership(cfg, self.registry)
         self.breakers = BreakerBoard(
             cfg.breaker_threshold, cfg.breaker_cooldown_s, self.registry
@@ -650,7 +679,27 @@ class FedFrontend:
                     for k, v in sorted(
                         member.get("counters", {}).items()
                     ):
-                        snap["counters"][f"fleet_{m.host_id}_{k}"] = v
+                        fk = f"fleet_{m.host_id}_{k}"
+                        if fk in snap["counters"]:
+                            # Fold collision: a member counter whose
+                            # folded name is already taken (a fed
+                            # counter literally named fleet_<host>_<k>,
+                            # or two registrations of one host). The
+                            # old behavior silently overwrote — the
+                            # first writer's value vanished from the
+                            # scrape. First writer wins; the collision
+                            # is counted and re-snapshotted so THIS
+                            # scrape shows it.
+                            self.registry.counter(
+                                "fold_collisions_total"
+                            ).inc()
+                            snap["counters"][
+                                "fold_collisions_total"
+                            ] = self.registry.counter(
+                                "fold_collisions_total"
+                            ).value
+                            continue
+                        snap["counters"][fk] = v
         snap["members"] = len(live)
         return snap
 
@@ -684,6 +733,7 @@ class FedFrontend:
                 "breaker_cooldown_s": self.cfg.breaker_cooldown_s,
                 "hedge": self.cfg.hedge,
                 "hedge_min_s": self.cfg.hedge_min_s,
+                "digest_affinity": self.cfg.digest_affinity,
                 "forward_timeout_s": self.cfg.forward_timeout_s,
                 "reoffer_s": self.cfg.reoffer_s,
                 "max_inflight_mb": self.cfg.max_inflight_mb,

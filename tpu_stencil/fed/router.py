@@ -73,6 +73,7 @@ import time
 from typing import Callable, Dict, List, Optional, Tuple
 from urllib.parse import urlsplit
 
+from tpu_stencil.cache import affinity as _affinity
 from tpu_stencil.config import FedConfig
 from tpu_stencil.fed.breaker import BreakerBoard
 from tpu_stencil.fed.membership import Member, Membership
@@ -321,6 +322,7 @@ class FedRouter:
         self._m_hedges = m.counter("hedges_total")
         self._m_hedge_wins = m.counter("hedge_wins_total")
         m.counter("hedge_cancelled_total")
+        self._m_affinity = m.counter("affinity_routed_total")
         self._m_inflight = m.gauge("inflight_bytes")
         self._g_tenants = m.gauge("tenants_active")
         self._h_fwd = m.histogram("forward_latency_seconds")
@@ -435,12 +437,21 @@ class FedRouter:
         with self._lock:
             return dict(self._tenants)
 
-    def _candidates(self) -> List[Member]:
+    def _candidates(self, digest: Optional[bytes] = None) -> List[Member]:
         """Routable members in placement order: healthy before suspect
-        (membership's contract), least-outstanding first within each,
-        host_id as the tie-break. Breaker admission happens at launch
-        time (:meth:`_next_allowed`) so half-open probe slots are only
-        consumed by attempts that actually launch."""
+        (membership's contract). Within the healthy class a content
+        ``digest`` places by rendezvous hash — identical frames land on
+        the same member, so each member's result cache sees the whole
+        repeat stream for its share of the keyspace instead of 1/N of
+        it. Without a digest (affinity off, or nothing healthy) the
+        order is least-outstanding first, host_id as the tie-break —
+        and the suspect class always stays least-outstanding (affinity
+        must not pin traffic to a wobbling host). Breaker admission
+        happens at launch time (:meth:`_next_allowed`) so half-open
+        probe slots are only consumed by attempts that actually
+        launch; membership churn degrades affinity gracefully — a
+        rendezvous hash moves only the keys owned by the departed
+        member."""
         members = self.membership.routable()
         with self._lock:
             out = dict(self._host_outstanding)
@@ -450,7 +461,17 @@ class FedRouter:
         healthy = [m for m in members if m.state == "healthy"]
         suspect = [m for m in members if m.state != "healthy"]
         key = lambda m: (out.get(m.host_id, 0), m.host_id)  # noqa: E731
-        return sorted(healthy, key=key) + sorted(suspect, key=key)
+        if digest is not None and healthy:
+            rank = {
+                hid: i for i, hid in enumerate(_affinity.rendezvous_order(
+                    [m.host_id for m in healthy], digest
+                ))
+            }
+            healthy = sorted(healthy, key=lambda m: rank[m.host_id])
+            self._m_affinity.inc()
+        else:
+            healthy = sorted(healthy, key=key)
+        return healthy + sorted(suspect, key=key)
 
     def _next_allowed(self, it) -> Optional[Member]:
         for m in it:
@@ -469,10 +490,14 @@ class FedRouter:
 
     def submit(self, body: bytes, headers: Dict[str, str], nbytes: int,
                tenant: str = DEFAULT_TENANT,
+               digest: Optional[bytes] = None,
                ) -> Tuple[int, Dict[str, str], bytes, str, bool]:
         """Admit + forward one request; returns ``(status,
         response_headers, response_body, member_host_id, hedged)``.
-        Raises :class:`~tpu_stencil.net.router.Draining` /
+        ``digest`` (the request body's content digest, when the
+        frontend computed one) turns placement into rendezvous-hash
+        affinity so identical frames revisit the same member's result
+        cache. Raises :class:`~tpu_stencil.net.router.Draining` /
         :class:`~tpu_stencil.net.router.Overloaded` /
         :class:`TenantQuotaExceeded` /
         :class:`~tpu_stencil.serve.engine.QueueFull` /
@@ -490,7 +515,7 @@ class FedRouter:
 
                 try:
                     return _retry.reoffer_call(
-                        lambda: self._forward(body, headers),
+                        lambda: self._forward(body, headers, digest),
                         give_up_after_s=self.cfg.reoffer_s,
                         base_delay=0.01, max_delay=0.1,
                         label="fed.forward",
@@ -502,13 +527,14 @@ class FedRouter:
                     if te.__cause__ is not None:
                         raise te.__cause__ from None
                     raise
-            return self._forward(body, headers)
+            return self._forward(body, headers, digest)
         finally:
             release()
 
     def _forward(self, body: bytes, headers: Dict[str, str],
+                 digest: Optional[bytes] = None,
                  ) -> Tuple[int, Dict[str, str], bytes, str, bool]:
-        cands = self._candidates()
+        cands = self._candidates(digest)
         if not cands:
             raise HostUnavailable(
                 "no routable member host (every member is draining, "

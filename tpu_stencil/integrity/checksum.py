@@ -147,18 +147,20 @@ def parse_crc(value: str, where: str) -> int:
     return n
 
 
-def claim_error(claim: str, body: bytes):
+def claim_error(claim: str, body: bytes, computed: Optional[int] = None):
     """Validate a client ``X-Content-Crc32c`` claim against ``body`` —
     the ONE request-validation rule both HTTP edges (net and fed)
     apply, so their wire behavior can never drift. Returns None when
     the claim matches, else ``(error_text, is_mismatch)`` for the 400:
     ``is_mismatch`` distinguishes a real corruption (count it) from a
-    malformed header (a client bug, not a detection)."""
+    malformed header (a client bug, not a detection). ``computed``
+    supplies a CRC the caller already holds for these exact bytes (the
+    cache's fused digest+CRC scan) so the body is not read twice."""
     try:
         want = parse_crc(claim, CRC_HEADER)
     except ValueError as e:
         return f"bad request parameters: {e}", False
-    got = crc32c(body)
+    got = int(computed) if computed is not None else crc32c(body)
     if got != want:
         return (
             f"ChecksumMismatch: request body crc32c {got} != declared "

@@ -70,6 +70,18 @@ def build_parser() -> argparse.ArgumentParser:
                         "staging buffers, CRC in place, no per-request "
                         "host copies); off buffers every body through "
                         "fresh bytes objects — the A/B arm")
+    p.add_argument("--result-cache-mb", dest="result_cache_mb",
+                   type=float, default=0.0, metavar="MB",
+                   help="content-addressed result cache: this many MB "
+                        "of true result bytes keyed by (body BLAKE2b "
+                        "digest, filter, reps, geometry); a hit answers "
+                        "X-Cache: hit from the store without touching a "
+                        "replica, concurrent identical requests "
+                        "collapse onto one launch, and a witness "
+                        "mismatch or quarantine drops the suspect "
+                        "replica's entries. GET /admin/cache?action="
+                        "clear wipes it. 0 = off, the default "
+                        "(docs/SERVING.md 'Result cache')")
     p.add_argument("--max-inflight-mb", type=float, default=256.0,
                    help="load-shed watermark: past this many MB of "
                         "tracked in-flight request+response bytes, new "
@@ -207,6 +219,7 @@ def main(argv=None) -> int:
             max_queue=ns.max_queue, max_batch=ns.max_batch,
             coalesce_window_us=ns.coalesce_window_us,
             ingest_arena=ns.ingest_arena,
+            result_cache_mb=ns.result_cache_mb,
             max_inflight_mb=ns.max_inflight_mb,
             request_timeout_s=ns.request_timeout_s,
             drain_timeout_s=ns.drain_timeout_s,
@@ -245,6 +258,7 @@ def main(argv=None) -> int:
         f"shed>{cfg.max_inflight_mb:g}MB inflight, "
         f"coalesce={cfg.coalesce_window_us:g}us, "
         f"arena={'on' if cfg.ingest_arena else 'off'}, "
+        f"cache={cfg.result_cache_mb:g}MB, "
         f"warm={'on' if cfg.warm_fleet else 'off'}); "
         f"POST /v1/blur, GET /healthz /metrics /statusz "
         f"/debug/trace/<id> /debug/flightrec; SIGTERM drains",

@@ -30,6 +30,19 @@ Endpoints (docs/SERVING.md "Network tier" is the contract):
   federation's rolling whole-host drain drives it): flips healthz,
   stops admission, and signals the CLI loop to run the full drain
   sequence and exit with its usual rc discipline.
+* ``GET /admin/cache?action=clear|stats`` — operator control over the
+  result cache (``--result-cache-mb``; 404 when it is off): ``clear``
+  wipes every entry, ``stats`` reports sizes without touching one.
+
+With ``--result-cache-mb N`` the edge holds a content-addressed result
+cache in front of the router (:mod:`tpu_stencil.cache`): the request
+body's BLAKE2b-160 digest (fused into the same scan as the CRC claim
+check) plus filter/reps/geometry keys a byte-budgeted LRU of true
+result bytes. A hit answers ``X-Cache: hit`` with the stored payload
+and stamp, never touching admission; concurrent identical misses
+collapse onto one leader launch (``X-Cache: collapsed`` for the
+followers); a witness mismatch or quarantine on a replica synchronously
+drops every entry it produced.
 
 Chaos sites ``net.accept`` (drop/stall a connection before any
 response) and ``net.body`` (truncate a 200 mid-body, or stall) arm via
@@ -55,6 +68,8 @@ from urllib.parse import parse_qs, urlsplit
 
 import numpy as np
 
+from tpu_stencil.cache import ResultCache
+from tpu_stencil.cache import digest as _cache_digest
 from tpu_stencil.config import NetConfig
 from tpu_stencil.integrity import checksum as _checksum
 from tpu_stencil.integrity.quarantine import (
@@ -349,7 +364,8 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_GET(self) -> None:  # noqa: N802 (stdlib handler contract)
         self._trace = None
-        path = urlsplit(self.path).path
+        split = urlsplit(self.path)
+        path = split.path
         if path == "/healthz":
             if self.fe.router.draining:
                 self._error(503, "draining")
@@ -364,6 +380,8 @@ class _Handler(BaseHTTPRequestHandler):
                                  sort_keys=True)
             self._respond(200, payload.encode(),
                           content_type="application/json")
+        elif path == "/admin/cache":
+            self._admin_cache(parse_qs(split.query))
         elif path.startswith("/debug/trace/"):
             self._debug_trace(path[len("/debug/trace/"):])
         elif path == "/debug/flightrec" or path.startswith(
@@ -506,6 +524,33 @@ class _Handler(BaseHTTPRequestHandler):
                 and self.fe.quarantine.is_quarantined(idx)
             ),
         }).encode(), content_type="application/json")
+
+    def _admin_cache(self, query: dict) -> None:
+        """Operator control over the result cache (docs/DEPLOY.md
+        runbook): ``?action=clear`` wipes every entry (counted under
+        ``cache_invalidations_clear_total``), ``?action=stats`` (the
+        default) reports sizes without touching one. 404 when the tier
+        runs cache-off — a probe can tell "cleared" from "was never
+        caching"."""
+        fe = self.fe
+        if fe.cache is None:
+            self._error(
+                404, "result cache is not enabled (--result-cache-mb)"
+            )
+            return
+        action = query.get("action", ["stats"])[0]
+        if action == "clear":
+            cleared = fe.cache.clear()
+            self._respond(200, json.dumps(
+                {"action": "clear", "cleared": cleared}
+            ).encode(), content_type="application/json")
+        elif action == "stats":
+            self._respond(200, json.dumps(fe.cache.stats()).encode(),
+                          content_type="application/json")
+        else:
+            self._error(
+                400, f"action must be clear|stats, got {action!r}"
+            )
 
     def _restart(self, query: dict) -> None:
         # Consume any request body first: an unread body corrupts the
@@ -659,8 +704,17 @@ class _Handler(BaseHTTPRequestHandler):
                     fe.fault_corrupt_ingest):
                 flat = _checksum.corrupt_array(flat)
             claim = self._param(query, _checksum.CRC_HEADER, "crc32c")
+            digest = None
+            body_crc = None
+            if fe.cache is not None:
+                # One scan, two checks: the BLAKE2b-160 cache key and
+                # the CRC the integrity claim is validated against ride
+                # the same pass over the staging buffer — arming the
+                # cache never adds a second read of the body.
+                digest, body_crc = _cache_digest.digest_and_crc(flat)
             if claim is not None and fe.cfg.integrity:
-                err = _checksum.claim_error(claim, flat)
+                err = _checksum.claim_error(claim, flat,
+                                            computed=body_crc)
                 if err is not None:
                     msg, mismatch = err
                     if mismatch:
@@ -673,6 +727,107 @@ class _Handler(BaseHTTPRequestHandler):
                     return
             shape = (h, w) if channels == 1 else (h, w, channels)
             img = flat.reshape(shape)
+            wait = (
+                deadline_s + 5.0 if deadline_s
+                else (fe.cfg.request_timeout_s + 5.0
+                      if fe.cfg.request_timeout_s else _RESULT_TIMEOUT_S)
+            )
+            cache = fe.cache
+            ckey = None
+            token = 0
+            is_leader = True
+            fol_fut = None
+            if cache is not None:
+                # The full content key: body digest plus every knob
+                # that reaches the kernel. Boundary is always zero at
+                # this tier (validated above).
+                ckey = cache.key(digest, fname or fe.cfg.filter_name,
+                                 reps, h, w, channels, 0)
+                with _obs_span("cache.lookup", "net"):
+                    hit = cache.lookup(ckey)
+                if hit is not None:
+                    # Short-circuit BEFORE admission: no inflight-bytes
+                    # reservation, no replica dispatch — the stored
+                    # true bytes + stamp answer bit-identically to a
+                    # cold compute.
+                    if release is not None:
+                        release()
+                    fe.registry.histogram(
+                        "request_latency_seconds"
+                    ).observe(time.perf_counter() - t0)
+                    resp_headers = {
+                        "X-Width": str(w), "X-Height": str(h),
+                        "X-Channels": str(channels),
+                        "X-Reps": str(reps),
+                        "X-Replica": str(hit.replica),
+                        "X-Cache": "hit",
+                    }
+                    if hit.stamp is not None:
+                        resp_headers[_checksum.RESULT_HEADER] = hit.stamp
+                    self._send_result(fe, hit.payload, resp_headers)
+                    return
+                # Admission token BEFORE dispatch: any distrust of the
+                # producing replica from here on (a witness verdict can
+                # race this thread) refuses the later insert.
+                token = cache.token()
+                is_leader, fol_fut = cache.join(ckey)
+                if not is_leader and release is not None:
+                    # A follower's body is never dispatched — the
+                    # leader's launch produces the shared bytes.
+                    release()
+            if not is_leader:
+                try:
+                    payload, stamp, idx = fol_fut.result(timeout=wait)
+                except DeadlineExceeded as e:
+                    self._error(504, str(e))
+                    return
+                except (TimeoutError, concurrent.futures.TimeoutError):
+                    # THIS follower's budget expired; the leader and
+                    # any patient followers keep flying — cancel
+                    # nothing of theirs.
+                    self._error(
+                        504, f"request still pending after {wait:g}s"
+                    )
+                    return
+                except QueueFull as e:
+                    self._error(429, str(e), {
+                        "Retry-After": str(
+                            fe.router.retry_after_s(queue_full=True)
+                        )
+                    })
+                    return
+                except (Draining, Overloaded) as e:
+                    self._error(503, str(e), {
+                        "Retry-After": str(fe.router.retry_after_s())
+                    })
+                    return
+                except (ServerClosed, WorkerCrashed) as e:
+                    self._error(503, f"{type(e).__name__}: {e}", {
+                        "Retry-After": str(fe.router.retry_after_s())
+                    })
+                    return
+                except Exception as e:
+                    self._error(500, f"{type(e).__name__}: {e}")
+                    return
+                fe.registry.histogram(
+                    "request_latency_seconds"
+                ).observe(time.perf_counter() - t0)
+                resp_headers = {
+                    "X-Width": str(w), "X-Height": str(h),
+                    "X-Channels": str(channels), "X-Reps": str(reps),
+                    "X-Replica": str(idx), "X-Cache": "collapsed",
+                }
+                if stamp is not None:
+                    resp_headers[_checksum.RESULT_HEADER] = stamp
+                self._send_result(fe, payload, resp_headers)
+                return
+
+            def settle(e: BaseException) -> None:
+                # Leader failure: propagate the typed exception to
+                # every follower and cache nothing.
+                if cache is not None:
+                    cache.fail(ckey, e)
+
             try:
                 # owned=True: both ingest paths guarantee the buffer is
                 # not reused before on_consumed (arena lease) or ever
@@ -683,6 +838,7 @@ class _Handler(BaseHTTPRequestHandler):
                     owned=True, on_consumed=release,
                 )
             except Draining as e:
+                settle(e)
                 if release is not None:
                     release()
                 self._error(503, str(e), {
@@ -690,6 +846,7 @@ class _Handler(BaseHTTPRequestHandler):
                 })
                 return
             except Overloaded as e:
+                settle(e)
                 if release is not None:
                     release()
                 self._error(503, str(e), {
@@ -697,6 +854,7 @@ class _Handler(BaseHTTPRequestHandler):
                 })
                 return
             except QueueFull as e:
+                settle(e)
                 if release is not None:
                     release()
                 self._error(429, str(e), {
@@ -706,6 +864,7 @@ class _Handler(BaseHTTPRequestHandler):
                 })
                 return
             except ValueError as e:
+                settle(e)
                 if release is not None:
                     release()
                 self._error(400, str(e))
@@ -716,21 +875,18 @@ class _Handler(BaseHTTPRequestHandler):
                 # placement failure inside a coalesced group) release
                 # via the future — idempotent next to on_consumed.
                 fut.add_done_callback(lambda _f: release())
-            wait = (
-                deadline_s + 5.0 if deadline_s
-                else (fe.cfg.request_timeout_s + 5.0
-                      if fe.cfg.request_timeout_s else _RESULT_TIMEOUT_S)
-            )
             try:
                 out = fut.result(timeout=wait)
             except DeadlineExceeded as e:
                 # (The serve engine already dumped this trace at its
                 # batch-formation expiry — one anomaly, one dump.)
+                settle(e)
                 self._error(504, str(e))
                 return
-            except (TimeoutError, concurrent.futures.TimeoutError):
+            except (TimeoutError, concurrent.futures.TimeoutError) as e:
                 # (One name on 3.11+; two distinct classes before.)
                 fut.cancel()
+                settle(e)
                 _obs_flight.trigger(
                     "deadline_exceeded", trace_id=ctx.trace_id,
                     tier="net", duration_s=time.perf_counter() - t0,
@@ -744,6 +900,7 @@ class _Handler(BaseHTTPRequestHandler):
                 # A coalesced group's placement failure arrives through
                 # the future (every replica rejected the whole group) —
                 # the same typed 429 the synchronous path answers.
+                settle(e)
                 self._error(429, str(e), {
                     "Retry-After": str(
                         fe.router.retry_after_s(queue_full=True)
@@ -751,16 +908,19 @@ class _Handler(BaseHTTPRequestHandler):
                 })
                 return
             except (Draining, Overloaded) as e:
+                settle(e)
                 self._error(503, str(e), {
                     "Retry-After": str(fe.router.retry_after_s())
                 })
                 return
             except (ServerClosed, WorkerCrashed) as e:
+                settle(e)
                 self._error(503, f"{type(e).__name__}: {e}", {
                     "Retry-After": str(fe.router.retry_after_s())
                 })
                 return
             except Exception as e:
+                settle(e)
                 self._error(500, f"{type(e).__name__}: {e}")
                 return
             if idx is None:
@@ -786,26 +946,39 @@ class _Handler(BaseHTTPRequestHandler):
                 "X-Channels": str(channels), "X-Reps": str(reps),
                 "X-Replica": str(idx),
             }
+            stamp = None
             if fe.cfg.integrity:
                 # Stamp the TRUE result's CRC, then let the wire-
                 # corruption chaos site flip bits: a client (or the
                 # federation forward path) verifying the stamp catches
                 # exactly what the wire damaged.
-                resp_headers[_checksum.RESULT_HEADER] = str(
-                    _checksum.crc32c(payload)
-                )
-            if fe.fault_corrupt_body is not None and _checksum.fired(
-                    fe.fault_corrupt_body):
-                payload = _checksum.corrupt_bytes(payload)
-            if fe.fault_body is not None and self._body_fault(
-                fe.fault_body, payload
-            ):
-                return  # injected mid-body EOF: truncated 200 written
-            self._respond(
-                200, payload,
-                content_type="application/octet-stream",
-                headers=resp_headers,
-            )
+                stamp = str(_checksum.crc32c(payload))
+                resp_headers[_checksum.RESULT_HEADER] = stamp
+            if cache is not None:
+                # The store takes the pre-chaos-site bytes and the
+                # stamp just served (distrust-fenced by the token);
+                # followers resolve with the same triple.
+                cache.complete(ckey, payload, stamp, idx, token)
+                resp_headers["X-Cache"] = "miss"
+            self._send_result(fe, payload, resp_headers)
+
+    def _send_result(self, fe: "NetFrontend", payload: bytes,
+                     resp_headers: Dict[str, str]) -> None:
+        """The shared 200 tail for cold, hit, and collapsed responses:
+        wire-corruption and mid-body-EOF chaos sites fire on all three
+        alike, then the payload goes out."""
+        if fe.fault_corrupt_body is not None and _checksum.fired(
+                fe.fault_corrupt_body):
+            payload = _checksum.corrupt_bytes(payload)
+        if fe.fault_body is not None and self._body_fault(
+            fe.fault_body, payload
+        ):
+            return  # injected mid-body EOF: truncated 200 written
+        self._respond(
+            200, payload,
+            content_type="application/octet-stream",
+            headers=resp_headers,
+        )
 
 
 class NetFrontend:
@@ -858,6 +1031,14 @@ class NetFrontend:
             readmit_after=cfg.readmit_after,
         )
         self._prober: Optional[QuarantineProber] = None
+        # The content-addressed result cache (tpu_stencil.cache),
+        # default-off. Admission consults the quarantine board: a
+        # currently-quarantined replica's results never enter.
+        self.cache: Optional[ResultCache] = (
+            ResultCache(self.registry, cfg.result_cache_bytes,
+                        quarantined=self.quarantine.is_quarantined)
+            if cfg.result_cache_mb > 0 else None
+        )
 
     # -- lifecycle -----------------------------------------------------
 
@@ -881,6 +1062,7 @@ class NetFrontend:
             max_batch=self.cfg.max_batch,
             bucket_edges=self.cfg.bucket_edges,
             default_filter=self.cfg.filter_name,
+            cache=self.cache,
         )
         if self.cfg.probe_interval_s > 0:
             self._prober = QuarantineProber(
@@ -1006,6 +1188,7 @@ class NetFrontend:
                 str(k): v for k, v in self.router.outstanding().items()
             },
             "quarantine": self.quarantine.statusz(),
+            "cache": None if self.cache is None else self.cache.stats(),
             "drain_report": (
                 None if self._drain_report is None
                 else {str(k): v for k, v in self._drain_report.items()}
@@ -1021,6 +1204,7 @@ class NetFrontend:
                 "max_batch": self.cfg.max_batch,
                 "coalesce_window_us": self.cfg.coalesce_window_us,
                 "ingest_arena": self.cfg.ingest_arena,
+                "result_cache_mb": self.cfg.result_cache_mb,
                 "max_inflight_mb": self.cfg.max_inflight_mb,
                 "request_timeout_s": self.cfg.request_timeout_s,
                 "drain_timeout_s": self.cfg.drain_timeout_s,

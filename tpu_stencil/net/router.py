@@ -224,7 +224,8 @@ class Router:
                  coalesce_window_s: float = 0.0,
                  max_batch: int = 8,
                  bucket_edges: Optional[Tuple[int, ...]] = None,
-                 default_filter: str = "gaussian") -> None:
+                 default_filter: str = "gaussian",
+                 cache=None) -> None:
         self._fleet = fleet
         self.registry = registry
         self._lock = threading.Lock()
@@ -236,6 +237,11 @@ class Router:
         # of placement. The fleet's per-replica on_witness hooks feed
         # record_witness.
         self._quarantine = quarantine
+        # ResultCache (tpu_stencil.cache) or None: the store must never
+        # outlive distrust in a replica, so the router — the one place
+        # every verdict and quarantine transition passes through —
+        # drops a replica's entries the moment either lands.
+        self._cache = cache
         if quarantine is not None:
             fleet.set_witness_sink(self.record_witness)
         self._inflight_bytes = 0
@@ -302,15 +308,24 @@ class Router:
     def record_witness(self, idx: int, ok: bool) -> None:
         """One witness verdict from replica ``idx``'s engine (the
         fleet's on_witness hook lands here, on the replica's worker
-        thread)."""
+        thread). A mismatch SYNCHRONOUSLY invalidates every cached
+        result the replica produced — before the verdict even reaches
+        the board, so no later lookup can serve a poisoned hit from a
+        source this verdict just discredited."""
+        if not ok and self._cache is not None:
+            self._cache.invalidate_replica(idx, "witness_mismatch")
         if self._quarantine is not None:
             self._quarantine.record_witness(idx, ok)
 
     def quarantine_replica(self, idx: int, reason: str) -> bool:
         """Operator path (``POST /admin/quarantine``): out of placement
-        now; probes (or an explicit clear) bring it back."""
+        now; probes (or an explicit clear) bring it back. The replica's
+        cached results go with it — quarantine is distrust, and the
+        store never outlives distrust in its source."""
         if self._quarantine is None:
             return False
+        if self._cache is not None:
+            self._cache.invalidate_replica(idx, "quarantine")
         return self._quarantine.quarantine(idx, reason)
 
     def release_replica(self, idx: int) -> bool:

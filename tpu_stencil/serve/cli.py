@@ -64,6 +64,19 @@ def build_parser() -> argparse.ArgumentParser:
                         "next to achieved fps as always (default 1 = "
                         "the classic metronome; needs --mode open or "
                         "--rate-fps)")
+    p.add_argument("--zipf", type=float, default=None, metavar="S",
+                   help="keyspace mode: draw requests from a seeded "
+                        "pool of --zipf-keys DISTINCT frames under a "
+                        "Zipf(S) popularity law (S=0 uniform, S~1 "
+                        "web-traffic skew) instead of all-distinct "
+                        "frames — the repeat-heavy stream a "
+                        "--result-cache-mb tier serves; the report "
+                        "adds cache_hit_ratio from the target's own "
+                        "result_cache_* counters. Deterministic per "
+                        "--seed")
+    p.add_argument("--zipf-keys", type=int, default=16, metavar="K",
+                   help="distinct frames in the --zipf pool "
+                        "(default 16)")
     p.add_argument("--rate-fps", type=float, default=None, metavar="FPS",
                    help="open-loop fixed-frame-rate mode: one frame due "
                         "every 1/FPS seconds regardless of completions "
@@ -326,6 +339,10 @@ def main(argv=None) -> int:
         if ns.burst > 1 and ns.mode != "open" and ns.rate_fps is None:
             parser.error("--burst needs --mode open (or --rate-fps): "
                          "it is an open-loop arrival mode")
+        if ns.zipf is not None and ns.zipf < 0:
+            parser.error(f"--zipf must be >= 0, got {ns.zipf}")
+        if ns.zipf_keys < 1:
+            parser.error(f"--zipf-keys must be >= 1, got {ns.zipf_keys}")
         loadgen_kwargs = dict(
             mode=ns.mode, requests=ns.requests,
             concurrency=ns.concurrency, rate=ns.rate, reps=ns.reps,
@@ -333,6 +350,7 @@ def main(argv=None) -> int:
             rate_fps=ns.rate_fps, burst=ns.burst,
             verify=ns.verify, verify_filter=ns.filter_name,
             per_request=ns.per_request,
+            zipf=ns.zipf, zipf_keys=ns.zipf_keys,
         )
         if ns.http:
             # The network-tier target: same loops, same report schema,
@@ -407,6 +425,15 @@ def main(argv=None) -> int:
             f"{report['verify_failures_total']} failure(s) over "
             f"{report['completed']} completed"
         )
+    if "zipf" in report:
+        hr = report["cache_hit_ratio"]
+        print(
+            f"zipf keyspace: S={report['zipf']:g} over "
+            f"{report['zipf_keys']} key(s), "
+            f"{report['distinct_keys_offered']} distinct offered; "
+            f"cache_hit_ratio="
+            f"{'n/a (no result cache)' if hr is None else format(hr, '.3f')}"
+        )
     if "requested_fps" in report:
         print(
             f"frame rate: requested {report['requested_fps']:.2f} fps, "
@@ -435,6 +462,11 @@ def main(argv=None) -> int:
         if ns.burst > 1:
             # Bursty arrivals change what p50 means — own sentry series.
             load += f"b{ns.burst}"
+        if ns.zipf is not None:
+            # A repeat-heavy keyspace against a caching tier serves
+            # hits in microseconds — its p50 is a different quantity,
+            # so the zipf exponent is a sentry key field too.
+            load += f"z{ns.zipf:g}"
         # The network tier measures HTTP+routing on top of the engine,
         # so its p50 is its own sentry series — never compared against
         # the in-process numbers as a false regression.

@@ -272,6 +272,44 @@ def synth_requests(
     return out
 
 
+def zipf_requests(
+    n: int, shapes: Sequence[Tuple[int, int]], channels: Sequence[int],
+    seed: int, s: float, keys: int = 16,
+) -> Tuple[List[np.ndarray], List[int]]:
+    """``n`` requests drawn from a seeded pool of ``keys`` DISTINCT
+    frames under a Zipf(``s``) popularity law — the repeat-heavy
+    keyspace the result cache (``--result-cache-mb``) exists for. Key
+    rank ``k`` (1-based) is drawn with probability ``k^-s / H``: at
+    ``s=0`` every key is uniform (worst case for a cache), at ``s≈1``
+    a handful of keys dominate (the web-traffic shape).
+
+    The draw is a normalized power-law ``rng.choice`` over the finite
+    pool, NOT ``numpy.random.zipf`` (which is unbounded and whose
+    support would leak keys past the pool) — and it is fully seeded:
+    the same ``(n, shapes, channels, seed, s, keys)`` replays the
+    identical request sequence byte-for-byte, so a cache-hit-ratio
+    measurement is reproducible.
+
+    Returns ``(images, key_indices)``: the per-request frames (entries
+    are shared references into the pool — callers copy on submit) and
+    the drawn pool index per request, for hit-ratio accounting."""
+    if not s >= 0:
+        raise ValueError(f"zipf exponent must be >= 0, got {s!r}")
+    if keys < 1:
+        raise ValueError(f"zipf pool needs >= 1 key, got {keys}")
+    pool = synth_requests(keys, shapes, channels, seed)
+    ranks = np.arange(1, keys + 1, dtype=np.float64)
+    weights = ranks ** -float(s)
+    weights /= weights.sum()
+    # A distinct stream from the pool's pixels: reseeding with the
+    # same constant everywhere keeps the draw independent of pool
+    # size (growing `keys` must not reshuffle which request slots
+    # repeat).
+    drng = np.random.default_rng(seed ^ 0x21BF)
+    idx = drng.choice(keys, size=n, p=weights)
+    return [pool[j] for j in idx], [int(j) for j in idx]
+
+
 def run(
     server: StencilServer,
     mode: str = "closed",
@@ -288,6 +326,8 @@ def run(
     verify_filter: str = "gaussian",
     per_request: bool = False,
     burst: int = 1,
+    zipf: Optional[float] = None,
+    zipf_keys: int = 16,
 ) -> Dict:
     """Drive ``server`` with synthetic load; return the report dict.
 
@@ -339,6 +379,16 @@ def run(
     next to achieved fps as always. ``burst=1`` (default) is exactly
     the pre-existing fixed-period open loop; burst > 1 requires an open
     loop (``mode='open'`` or ``rate_fps``).
+
+    ``zipf`` (``--zipf S``): draw the request stream from a seeded pool
+    of ``zipf_keys`` distinct frames under a Zipf(S) popularity law
+    (:func:`zipf_requests`) instead of all-distinct frames — the
+    repeat-heavy keyspace the network tier's result cache serves. The
+    report gains ``zipf`` / ``zipf_keys`` / ``distinct_keys_offered``
+    and ``cache_hit_ratio`` (``result_cache_hits_total`` over hits +
+    misses from the target's own registry; ``None`` when the target
+    has no result cache). Deterministic: the same seed replays the
+    identical key sequence.
     """
     if rate_fps is not None:
         if not rate_fps > 0:
@@ -365,8 +415,13 @@ def run(
     honored0 = obs.registry().counter(
         "resilience_retry_after_honored_total"
     ).value
-    images = synth_requests(requests, shapes, channels, seed,
-                            group=burst)
+    zipf_idx: Optional[List[int]] = None
+    if zipf is not None:
+        images, zipf_idx = zipf_requests(requests, shapes, channels,
+                                         seed, zipf, zipf_keys)
+    else:
+        images = synth_requests(requests, shapes, channels, seed,
+                                group=burst)
     completed = 0
     completed_lock = threading.Lock()
     # Per-request trace records ({i, trace_id, latency_s, ok}), always
@@ -391,10 +446,14 @@ def run(
         mismatch."""
         if verify != "golden":
             return True
+        # Zipf streams repeat pool keys: memoize the golden per POOL
+        # key, not per request slot — K computations, not N.
+        gi = zipf_idx[i] if zipf_idx is not None else i
         with goldens_lock:
-            if i not in goldens:
-                goldens[i] = _golden_for(images[i], reps, verify_filter)
-            want = goldens[i]
+            if gi not in goldens:
+                goldens[gi] = _golden_for(images[i], reps,
+                                          verify_filter)
+            want = goldens[gi]
         if want is None or np.array_equal(np.asarray(got), want):
             return True
         _verify_failure_counter().inc()
@@ -561,6 +620,22 @@ def run(
         report["slowest_latency_s"] = slowest["latency_s"]
     if burst > 1:
         report["burst"] = burst
+    if zipf is not None:
+        report["zipf"] = float(zipf)
+        report["zipf_keys"] = int(zipf_keys)
+        report["distinct_keys_offered"] = len(set(zipf_idx))
+        # Hit ratio from the TARGET's own instruments, like every
+        # other report number — None means the target runs no result
+        # cache (the counters don't exist in its registry).
+        hits = stats["counters"].get("result_cache_hits_total")
+        misses = stats["counters"].get("result_cache_misses_total")
+        if hits is None or misses is None:
+            report["cache_hit_ratio"] = None
+        else:
+            total = hits + misses
+            report["cache_hit_ratio"] = (
+                hits / total if total > 0 else 0.0
+            )
     if per_request:
         report["per_request"] = done_recs
     if verify is not None:
