@@ -82,6 +82,8 @@ def test_histogram_empty_percentile_is_zero():
     assert h.percentile(50) == 0.0
     assert h.percentile(99) == 0.0
     snap = h.snapshot()
+    buckets = snap.pop("buckets")          # always present, all zero
+    assert buckets["+Inf"] == 0 and all(v == 0 for v in buckets.values())
     assert snap == {"count": 0, "sum": 0.0, "mean": 0.0, "p50": 0.0,
                     "p99": 0.0, "max": 0.0}
 

@@ -1,0 +1,677 @@
+"""Live telemetry plane (PR 17): time-series ring + sampler, SLO
+burn-rate engine, histogram buckets with trace exemplars, and the
+on-demand profiler endpoint.
+
+The contract under test is docs/OBSERVABILITY.md ("Time-series ring",
+"SLO burn-rate engine", "Histogram buckets and exemplars", "On-demand
+device profiler") + docs/DEPLOY.md "Reading the burn rate":
+
+* windowed counter deltas/rates and bucket-delta tail quantiles come
+  out of the ring exactly, and a counter minted mid-window still
+  deltas correctly from a zero baseline;
+* THE acceptance storm: under ``integrity.corrupt_result`` +
+  ``net.accept`` chaos with live traffic, the SLO engine flips
+  ``/healthz`` to ``degraded`` (200 — still routable), emits a
+  ``slo.breach`` event whose trace id names a flight dump in the
+  spool, and ``/debug/timeseries`` shows the 5xx spike;
+* a federation member killed -9 mid-scrape surfaces as an EXPLICIT
+  stale entry in the merged ``/debug/timeseries`` — well-formed JSON,
+  bounded time, never a hang — and as a ``fleet_*_scrape_age_seconds``
+  staleness gauge in the fold;
+* exemplars on ``/metrics`` bucket lines resolve via
+  ``/debug/trace/<id>`` to the exact request that landed them;
+* the sampler tick and the bucketed-histogram observe stay cheap
+  enough to leave always-on.
+"""
+
+import glob
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from io import StringIO
+
+import numpy as np
+import pytest
+
+from tpu_stencil import filters, obs
+from tpu_stencil.config import FedConfig, NetConfig
+from tpu_stencil.obs import context as octx
+from tpu_stencil.obs import events as oevents
+from tpu_stencil.obs import exposition
+from tpu_stencil.obs import flight as oflight
+from tpu_stencil.obs import prof as oprof
+from tpu_stencil.obs import slo as oslo
+from tpu_stencil.obs import timeseries as ots
+from tpu_stencil.ops import stencil
+from tpu_stencil.resilience import faults
+from tpu_stencil.serve.metrics import DEFAULT_BUCKETS, Histogram, Registry
+
+pytestmark = pytest.mark.filterwarnings("ignore::ResourceWarning")
+
+EDGES = (8, 16, 32, 64)
+REPS = 2
+
+
+@pytest.fixture(autouse=True)
+def _fresh_obs():
+    obs.reset()
+    yield
+    obs.reset()
+    faults.clear()
+
+
+def _golden(img, reps, name="gaussian"):
+    return stencil.reference_stencil_numpy(
+        img, filters.get_filter(name), reps
+    )
+
+
+def _post(url, img, reps, http_timeout=120.0):
+    h, w = img.shape[:2]
+    channels = img.shape[2] if img.ndim == 3 else 1
+    headers = {"X-Width": str(w), "X-Height": str(h),
+               "X-Reps": str(reps), "X-Channels": str(channels)}
+    req = urllib.request.Request(url + "/v1/blur", data=img.tobytes(),
+                                 headers=headers, method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=http_timeout) as r:
+            return r.status, r.read(), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, e.read(), dict(e.headers)
+
+
+def _get(url, path, http_timeout=60.0):
+    try:
+        with urllib.request.urlopen(url + path, timeout=http_timeout) as r:
+            return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def _post_raw(url, path, http_timeout=60.0):
+    req = urllib.request.Request(url + path, data=b"", method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=http_timeout) as r:
+            return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def _make_net(**overrides):
+    from tpu_stencil.net import NetFrontend
+
+    kw = dict(port=0, replicas=1, bucket_edges=EDGES, max_queue=64)
+    kw.update(overrides)
+    return NetFrontend(NetConfig(**kw)).start()
+
+
+# -- time-series ring ---------------------------------------------------
+
+
+def _snap(counters=None, gauges=None, histograms=None):
+    return {
+        "counters": counters or {},
+        "gauges": {k: {"value": v, "peak": v}
+                   for k, v in (gauges or {}).items()},
+        "histograms": histograms or {},
+    }
+
+
+def test_ring_window_deltas_rates_and_gauges():
+    ring = ots.TimeSeriesRing(interval_s=1.0)
+    for i in range(11):
+        ring.append(
+            _snap(counters={"requests_total": 10 * i},
+                  gauges={"queue_depth": i % 4}),
+            t_mono=100.0 + i, ts_unix=1000.0 + i,
+        )
+    out = ring.window(10.0)
+    assert out["schema_version"] == ots.SCHEMA_VERSION
+    assert out["samples"] == 11 and out["span_s"] == 10.0
+    c = out["counters"]["requests_total"]
+    assert c["delta"] == 100 and c["rate_per_s"] == pytest.approx(10.0)
+    g = out["gauges"]["queue_depth"]
+    assert g["min"] == 0 and g["max"] == 3 and g["last"] == 10 % 4
+    # A shorter window keeps one pre-window baseline sample, so the
+    # delta spans the full window, not window-minus-one-tick.
+    out5 = ring.window(5.0)
+    assert out5["counters"]["requests_total"]["delta"] == 60
+
+
+def test_ring_counter_minted_mid_window_baselines_at_zero():
+    ring = ots.TimeSeriesRing(interval_s=1.0)
+    ring.append(_snap(counters={}), t_mono=0.0, ts_unix=0.0)
+    ring.append(_snap(counters={"late_total": 7}), t_mono=1.0, ts_unix=1.0)
+    out = ring.window(60.0)
+    assert out["counters"]["late_total"]["delta"] == 7
+    assert ring.counter_delta("late_total", 60.0) == 7
+    assert ring.counter_delta(("absent_total", "late_total"), 60.0) == 7
+
+
+def test_ring_histogram_bucket_deltas_and_p99():
+    def hist(count, s, b_01, b_inf):
+        return {"request_latency_seconds": {
+            "count": count, "sum": s,
+            "buckets": {"0.1": b_01, "+Inf": b_inf},
+        }}
+
+    ring = ots.TimeSeriesRing(interval_s=1.0)
+    ring.append(_snap(histograms=hist(0, 0.0, 0, 0)), t_mono=0.0,
+                ts_unix=0.0)
+    ring.append(_snap(histograms=hist(100, 5.0, 99, 100)), t_mono=10.0,
+                ts_unix=10.0)
+    out = ring.window(60.0)
+    h = out["histograms"]["request_latency_seconds"]
+    assert h["count_delta"] == 100
+    assert h["rate_per_s"] == pytest.approx(10.0)
+    assert h["mean_s"] == pytest.approx(0.05)
+    # 99/100 within 0.1s: the 0.99 rank lands in the 0.1 bucket.
+    assert h["p99_est_s"] == pytest.approx(0.1)
+    deltas = ring.bucket_deltas("request_latency_seconds", 60.0)
+    assert deltas == {"0.1": 99, "+Inf": 100}
+    assert ring.bucket_deltas("absent", 60.0) is None
+
+
+def test_quantile_inf_bucket_reports_largest_finite_bound():
+    # Everything slower than the last finite boundary: the estimate
+    # floors at that boundary (honest direction for alerting).
+    q = ots.quantile_from_bucket_deltas({"0.5": 0, "+Inf": 10}, 0.99)
+    assert q == 0.5
+    assert ots.quantile_from_bucket_deltas({}, 0.99) == 0.0
+    assert ots.quantile_from_bucket_deltas({"+Inf": 0}, 0.99) == 0.0
+
+
+def test_sampler_swallows_snapshot_and_callback_failures():
+    calls = {"n": 0, "cb": 0}
+
+    def snap_fn():
+        calls["n"] += 1
+        if calls["n"] == 2:
+            raise RuntimeError("scrape blew up")
+        return _snap(counters={"x_total": calls["n"]})
+
+    s = ots.Sampler(snap_fn, interval_s=0.01)
+
+    def bad_cb(ring):
+        calls["cb"] += 1
+        raise ValueError("SLO hook blew up")
+
+    s.on_sample.append(bad_cb)
+    s.sample_once()
+    s.sample_once()  # snapshot raises: no sample, no callback, no crash
+    s.sample_once()
+    assert len(s.ring) == 2 and calls["cb"] == 2
+
+
+# -- histogram buckets + exemplars --------------------------------------
+
+
+def test_histogram_buckets_cumulative_with_exemplar():
+    h = Histogram(cap=64)
+    ctx = octx.fresh()
+    with octx.bind(ctx):
+        h.observe(0.003)   # lands in le=0.005
+    h.observe(100.0)       # +Inf only, no context bound -> no exemplar
+    snap = h.snapshot()
+    b = snap["buckets"]
+    assert b["0.001"] == 0 and b["0.005"] == 1 and b["+Inf"] == 2
+    # Cumulative: every boundary >= 0.005 already counts the first obs.
+    assert b["30.0"] == 1
+    ex = snap["exemplars"]
+    assert ex == {"0.005": {"trace_id": ctx.trace_id, "value": 0.003}}
+
+
+def test_exposition_round_trips_buckets_and_exemplars():
+    reg = Registry()
+    reg.counter("requests_total").inc(3)
+    h = reg.histogram("request_latency_seconds")
+    with octx.bind(octx.fresh()):
+        h.observe(0.02)
+    snap = reg.snapshot()
+    text = exposition.render_text(snap, prefix="tpu_stencil_net")
+    assert ("# TYPE tpu_stencil_net_request_latency_seconds histogram"
+            in text)
+    assert 'request_latency_seconds_bucket{le="+Inf"} 1' in text
+    assert ' # {trace_id="' in text
+    assert exposition.parse_text(text, prefix="tpu_stencil_net") == snap
+
+
+# -- SLO engine ---------------------------------------------------------
+
+
+def _err_objective(budget=0.05):
+    return oslo.Objective(
+        name="error_ratio", kind="error_ratio",
+        bad=("responses_5xx_total",),
+        total=("responses_2xx_total", "responses_5xx_total"),
+        budget=budget,
+    )
+
+
+def _feed(ring, t, ok, bad):
+    ring.append(_snap(counters={"responses_2xx_total": ok,
+                                "responses_5xx_total": bad}),
+                t_mono=t, ts_unix=t)
+
+
+def test_slo_engine_breach_fires_event_and_recovers():
+    buf = StringIO()
+    oevents.set_stream(buf)
+    reg = Registry()
+    ring = ots.TimeSeriesRing(interval_s=1.0)
+    eng = oslo.SloEngine([_err_objective()], reg, tier="net",
+                         fast_window_s=10.0, slow_window_s=30.0)
+    # Clean traffic: no burn, not degraded.
+    _feed(ring, 0.0, 0, 0)
+    _feed(ring, 1.0, 100, 0)
+    eng.evaluate(ring)
+    assert not eng.degraded()
+    # 100 bad / 250 total vs a 5% budget: burn 8 >= fast 6 AND slow 3.
+    _feed(ring, 2.0, 150, 100)
+    eng.evaluate(ring)
+    assert eng.degraded()
+    assert reg.snapshot()["counters"]["slo_breaches_total"] == 1
+    gauges = reg.snapshot()["gauges"]
+    assert gauges["degraded"]["value"] == 1
+    assert gauges["slo_error_ratio_fast_burn_rate"]["value"] >= 6.0
+    events = [json.loads(line) for line in buf.getvalue().splitlines()]
+    breach = [e for e in events if e["event"] == "slo.breach"]
+    assert breach and breach[0]["objective"] == "error_ratio"
+    assert breach[0]["verdict"] == "degraded"
+    st = eng.statusz()
+    assert st["degraded"] and st["objectives"]["error_ratio"]["breached"]
+    # Hysteresis: stays breached while fast burn >= 1.0, even though
+    # the enter thresholds are no longer met.
+    for t in range(3, 9):
+        _feed(ring, float(t), 150 + 100 * t, 100 + 8 * t)
+    eng.evaluate(ring)
+    assert eng.degraded()
+    # Recovery: a clean fast window drops fast burn under 1.0 (the
+    # bad counter holds flat — counters are monotonic).
+    for t in range(9, 25):
+        _feed(ring, float(t), 1500 + 500 * t, 148)
+    eng.evaluate(ring)
+    assert not eng.degraded()
+    events = [json.loads(line) for line in buf.getvalue().splitlines()]
+    assert any(e["event"] == "slo.recover" for e in events)
+    assert reg.snapshot()["gauges"]["degraded"]["value"] == 0
+
+
+def test_slo_latency_objective_counts_bucket_tail():
+    obj = oslo.Objective(name="latency_p99", kind="latency",
+                         histogram="request_latency_seconds",
+                         threshold_s=0.1, budget=0.01)
+    ring = ots.TimeSeriesRing(interval_s=1.0)
+
+    def hist(b_01, b_inf):
+        return {"request_latency_seconds": {
+            "count": b_inf, "sum": 0.0,
+            "buckets": {"0.1": b_01, "+Inf": b_inf},
+        }}
+
+    ring.append(_snap(histograms=hist(0, 0)), t_mono=0.0, ts_unix=0.0)
+    ring.append(_snap(histograms=hist(95, 100)), t_mono=10.0,
+                ts_unix=10.0)
+    # 5% slower than 0.1s against a 1% budget: burn 5.
+    assert obj.burn(ring, 60.0) == pytest.approx(5.0)
+    # Zero traffic burns nothing (no divide, no false page).
+    empty = ots.TimeSeriesRing(interval_s=1.0)
+    assert obj.burn(empty, 60.0) == 0.0
+
+
+def test_default_net_objectives_follow_config_knobs():
+    cfg = NetConfig(slo_error_budget=0.02, slo_latency_p99_s=0.0)
+    objs = oslo.default_net_objectives(cfg)
+    assert [o.name for o in objs] == ["error_ratio", "witness_mismatch"]
+    assert objs[0].budget == 0.02
+    cfg = NetConfig(slo_latency_p99_s=0.25)
+    names = [o.name for o in oslo.default_net_objectives(cfg)]
+    assert "latency_p99" in names
+
+
+# -- profiler spool -----------------------------------------------------
+
+
+def test_prof_spool_read_refuses_escape(tmp_path):
+    spool = tmp_path / "profspool"
+    run = spool / "prof-1"
+    run.mkdir(parents=True)
+    (run / "trace.json").write_bytes(b"{}")
+    (tmp_path / "secret.txt").write_bytes(b"nope")
+    assert oprof.spool_read(str(spool), "prof-1/trace.json") == b"{}"
+    assert oprof.spool_read(str(spool), "../secret.txt") is None
+    assert oprof.spool_read(str(spool), "/etc/hostname") is None
+    assert oprof.spool_read(None, "prof-1/trace.json") is None
+    idx = oprof.spool_list(str(spool))
+    assert idx["schema_version"] == 1 and idx["spool_cap"] == oprof.SPOOL_CAP
+    assert [r["run"] for r in idx["runs"]] == ["prof-1"]
+
+
+# -- net tier integration -----------------------------------------------
+
+
+def test_net_timeseries_exemplar_and_prof_endpoints(rng, tmp_path):
+    fe = _make_net(sample_interval_s=0.05,
+                   prof_dir=str(tmp_path / "profspool"))
+    try:
+        img = rng.integers(0, 256, (12, 10), dtype=np.uint8)
+        status, body, headers = _post(fe.url, img, REPS)
+        assert status == 200
+        tid = headers["X-Trace-Id"]
+        body_golden = _golden(img, REPS).tobytes()
+        assert body == body_golden
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            status, raw = _get(fe.url, "/debug/timeseries?window=60")
+            assert status == 200
+            doc = json.loads(raw)
+            if doc["counters"].get("responses_2xx_total",
+                                   {}).get("delta", 0) >= 1:
+                break
+            time.sleep(0.05)
+        assert doc["schema_version"] == 1 and doc["source"] == "net"
+        assert doc["counters"]["responses_2xx_total"]["rate_per_s"] > 0
+        assert "request_latency_seconds" in doc["histograms"]
+        assert doc["slo"] is not None and not doc["slo"]["degraded"]
+        # Malformed / non-positive windows are a typed 400.
+        assert _get(fe.url, "/debug/timeseries?window=bogus")[0] == 400
+        assert _get(fe.url, "/debug/timeseries?window=-5")[0] == 400
+        # The scrape carries bucket lines; the latency histogram's
+        # exemplar is THIS request's trace id, and it resolves live.
+        status, metrics = _get(fe.url, "/metrics")
+        text = metrics.decode()
+        assert status == 200 and "_bucket{le=" in text
+        exline = [ln for ln in text.splitlines()
+                  if "request_latency_seconds_bucket" in ln
+                  and f'# {{trace_id="{tid}"}}' in ln]
+        assert exline, "request's exemplar missing from /metrics"
+        status, spans = _get(fe.url, f"/debug/trace/{tid}")
+        assert status == 200 and json.loads(spans)["trace_id"] == tid
+        assert "flightrec_dropped_total 0" in text
+        # Profiler: a capture either works end-to-end or 404s typed.
+        status, raw = _post_raw(fe.url, "/debug/prof?seconds=0.05")
+        if oprof.available()[0]:
+            assert status == 200
+            run = json.loads(raw)
+            assert run["files"], "capture produced no trace files"
+            path = run["files"][0]["path"]
+            assert _get(fe.url, f"/debug/prof/{path}")[0] == 200
+            idx = json.loads(_get(fe.url, "/debug/prof")[1])
+            assert idx["available"] and idx["runs"]
+        else:
+            assert status == 404
+        # One capture at a time: a held lock means a typed 409.
+        assert oprof._capture_lock.acquire(blocking=False)
+        try:
+            if oprof.available()[0]:
+                status, raw = _post_raw(fe.url, "/debug/prof?seconds=0.05")
+                assert status == 409
+        finally:
+            oprof._capture_lock.release()
+        assert _post_raw(fe.url, "/debug/prof?seconds=bogus")[0] == 400
+        st = json.loads(_get(fe.url, "/statusz")[1])
+        assert st["slo"]["degraded"] is False
+        assert st["timeseries"]["samples"] >= 1
+        assert st["flightrec_dropped_total"] == 0
+    finally:
+        fe.close()
+
+
+def test_net_timeseries_404_when_sampler_off(rng):
+    fe = _make_net(sample_interval_s=0.0)
+    try:
+        status, raw = _get(fe.url, "/debug/timeseries")
+        assert status == 404
+        assert b"sampler" in raw
+        # healthz untouched: no sampler means no SLO engine either.
+        assert _get(fe.url, "/healthz")[1] == b"ok\n"
+    finally:
+        fe.close()
+
+
+def test_flightrec_drop_counter_counts_spool_cap(tmp_path, monkeypatch):
+    monkeypatch.setenv("TPU_STENCIL_FLIGHTREC_DIR", str(tmp_path))
+    buf = StringIO()
+    oevents.set_stream(buf)
+    rec = oflight.install(capacity=64, spool_dir=str(tmp_path))
+    assert oflight.dropped_total() == 0
+    for i in range(oflight.SPOOL_CAP + 3):
+        rec.dump("slow_request", trace_id=f"t{i}", tier="net")
+    assert oflight.dropped_total() == 3
+    assert len(glob.glob(str(tmp_path / "*.json"))) == oflight.SPOOL_CAP
+    drops = [json.loads(line) for line in buf.getvalue().splitlines()
+             if json.loads(line)["event"] == "flightrec.spool_drop"]
+    assert len(drops) == 1  # one line at first drop, not one per file
+    assert drops[0]["verdict"] == "capped"
+
+
+# -- THE acceptance storm -----------------------------------------------
+
+
+@pytest.mark.chaos
+def test_fault_storm_flips_healthz_degraded_with_linked_evidence(
+        rng, tmp_path, monkeypatch):
+    """ISSUE 17 acceptance: integrity.corrupt_result + net.accept chaos
+    under live load -> the SLO engine flips /healthz to 'degraded'
+    (200, still routable), the breach event's trace id names a flight
+    dump in the spool, and /debug/timeseries shows the 5xx spike."""
+    monkeypatch.setenv("TPU_STENCIL_FLIGHTREC_DIR", str(tmp_path))
+    buf = StringIO()
+    oevents.set_stream(buf)
+    # Every result corrupted: the witness (rate 1.0) convicts the only
+    # replica, and once it is quarantined every request 503s
+    # unroutable — the sustained 5xx ratio the SLO engine exists to
+    # catch. Plus a bounded burst of dropped connections at accept.
+    # warm_fleet off: a sibling warm would race the corruption budget.
+    faults.configure("integrity.corrupt_result:times=0:p=1.0,"
+                     "net.accept:p=0.3:times=3")
+    fe = _make_net(sample_interval_s=0.05, slo_error_budget=0.05,
+                   slo_fast_window_s=2.0, slo_slow_window_s=4.0,
+                   witness_rate=1.0, warm_fleet=False,
+                   quarantine_after=1)
+    try:
+        img = rng.integers(0, 256, (12, 10), dtype=np.uint8)
+        statuses = []
+        stop = threading.Event()
+
+        def load():
+            # Sustained storm traffic: keeps the burn windows fed
+            # while the sampler ticks (net.accept drops are caught —
+            # a dropped connection is part of the storm).
+            while not stop.is_set():
+                try:
+                    statuses.append(_post(fe.url, img, REPS,
+                                          http_timeout=60.0)[0])
+                except (OSError, urllib.error.URLError):
+                    statuses.append(None)
+                time.sleep(0.02)
+
+        loader = threading.Thread(target=load, daemon=True)
+        loader.start()
+        deadline = time.monotonic() + 30.0
+        health = b""
+        saw_degraded = False
+        try:
+            # Run the storm until BOTH signals land: healthz degraded
+            # (the witness-mismatch objective burns the instant the
+            # first conviction folds) and the quarantined-unroutable
+            # 5xx spike (once the only replica is out of routing).
+            while time.monotonic() < deadline:
+                try:
+                    status, health = _get(fe.url, "/healthz")
+                except (OSError, urllib.error.URLError):
+                    status = None
+                if health == b"degraded\n":
+                    assert status == 200  # degraded is ROUTABLE, not 503
+                    saw_degraded = True
+                if saw_degraded and any(
+                        s is not None and s >= 500 for s in statuses):
+                    break
+                time.sleep(0.1)
+        finally:
+            stop.set()
+            loader.join(timeout=60)
+        assert any(s is not None and s >= 500 for s in statuses), statuses
+        assert saw_degraded, (health, buf.getvalue()[-2000:])
+        events = [json.loads(line)
+                  for line in buf.getvalue().splitlines()]
+        breaches = [e for e in events if e["event"] == "slo.breach"]
+        assert breaches, [e["event"] for e in events]
+        breach = breaches[0]
+        assert breach["verdict"] == "degraded" and breach["tier"] == "net"
+        assert breach["trace_id"], "breach must link a traced request"
+        # The breach triggered a flight dump carrying that trace id.
+        dumps = glob.glob(str(tmp_path / "*-slo_burn-*.json"))
+        assert dumps, os.listdir(str(tmp_path))
+        dumped = [json.loads(open(p).read()) for p in dumps]
+        assert any(d["trace_id"] == breach["trace_id"] for d in dumped)
+        # The spike is visible as windowed rates, not just totals.
+        doc = json.loads(_get(fe.url, "/debug/timeseries?window=30")[1])
+        assert doc["counters"]["responses_5xx_total"]["delta"] >= 1
+        assert doc["slo"]["degraded"] is True
+        st = json.loads(_get(fe.url, "/statusz")[1])
+        assert st["slo"]["degraded"] is True
+        burned = [o for o in st["slo"]["objectives"].values()
+                  if o["breached"]]
+        assert burned and all(o["fast_burn"] >= 1.0 for o in burned)
+    finally:
+        fe.close()
+
+
+# -- federation: merge with a member killed mid-scrape ------------------
+
+
+def _spawn_member(extra=()):
+    repo = os.path.join(os.path.dirname(__file__), os.pardir)
+    argv = [sys.executable, "-m", "tpu_stencil", "net", "--port", "0",
+            "--replicas", "1", "--platform", "cpu",
+            "--drain-timeout", "60"] + list(extra)
+    proc = subprocess.Popen(
+        argv, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True, cwd=repo,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+    )
+    line = proc.stdout.readline()
+    assert "net: serving on http://" in line, (
+        line, proc.stderr.read()[-2000:]
+    )
+    return proc, line.split()[3]
+
+
+def _reap(proc):
+    if proc.poll() is None:
+        proc.kill()
+        proc.wait(timeout=30)
+    proc.stdout.close()
+    proc.stderr.close()
+
+
+@pytest.mark.chaos
+def test_fed_timeseries_merge_survives_kill9_member(rng):
+    """Satellite: a member killed -9 mid-scrape under load surfaces as
+    an explicit stale entry in the merged /debug/timeseries — the
+    payload stays well-formed, the fan-out stays bounded (never a
+    hang), and the fold stamps the staleness gauge."""
+    from tpu_stencil.fed import FedFrontend, host_id_for
+
+    p1, url1 = _spawn_member(extra=("--sample-interval", "0.2"))
+    p2, url2 = _spawn_member(extra=("--sample-interval", "0.2"))
+    fed = None
+    stop = threading.Event()
+    try:
+        fed = FedFrontend(FedConfig(
+            port=0, members=(url1, url2), heartbeat_interval_s=10.0,
+            sample_interval_s=0.1, breaker_threshold=2,
+        )).start()
+        img = rng.integers(0, 256, (12, 10), dtype=np.uint8)
+        status, body, _ = _post(fed.url, img, REPS)
+        assert status == 200 and body == _golden(img, REPS).tobytes()
+
+        def load():
+            while not stop.is_set():
+                try:
+                    _post(fed.url, img, REPS, http_timeout=30.0)
+                except Exception:
+                    pass
+
+        t = threading.Thread(target=load, daemon=True)
+        t.start()
+        # A healthy merge first: both members answer, neither stale.
+        doc = json.loads(_get(fed.url, "/debug/timeseries?window=60",
+                              http_timeout=30.0)[1])
+        id1, id2 = host_id_for(url1), host_id_for(url2)
+        assert doc["source"] == "fed" and set(doc["members"]) == {id1, id2}
+        assert not doc["members"][id1]["stale"]
+        assert not doc["members"][id2]["stale"]
+        assert doc["members"][id1]["schema_version"] == 1
+        # Kill -9 one member mid-load, then merge again.
+        os.kill(p2.pid, signal.SIGKILL)
+        p2.wait(timeout=30)
+        t0 = time.monotonic()
+        status, raw = _get(fed.url, "/debug/timeseries?window=60",
+                           http_timeout=30.0)
+        elapsed = time.monotonic() - t0
+        assert status == 200 and elapsed < 15.0, elapsed
+        doc = json.loads(raw)  # well-formed despite the dead member
+        assert set(doc["members"]) == {id1, id2}
+        live, dead = doc["members"][id1], doc["members"][id2]
+        assert not live["stale"] and live["counters"]
+        assert dead["stale"] and "error" in dead
+        assert dead["scrape_age_s"] >= 0 or dead["scrape_age_s"] == -1.0
+        # The fold stamps per-member staleness gauges on /metrics.
+        snap = fed.metrics_snapshot()
+        age_live = snap["gauges"][f"fleet_{id1}_scrape_age_seconds"]
+        age_dead = snap["gauges"][f"fleet_{id2}_scrape_age_seconds"]
+        assert age_live["value"] >= 0.0
+        assert age_dead["value"] >= 0.0 or age_dead["value"] == -1.0
+        assert snap["counters"]["member_scrape_failures_total"] >= 1
+        text = exposition.render_text(snap, prefix="tpu_stencil_fed")
+        assert f"fleet_{id1}_scrape_age_seconds" in text
+    finally:
+        stop.set()
+        if fed is not None:
+            fed.close()
+        _reap(p1)
+        _reap(p2)
+
+
+# -- overhead -----------------------------------------------------------
+
+
+@pytest.mark.timing
+def test_histogram_and_sampler_overhead_bounded():
+    """The telemetry plane must be cheap enough to leave on: a bucketed
+    observe (with a trace context bound, recorder installed — the
+    worst case) stays in single-digit microseconds, and a sampler tick
+    over a realistically-sized registry stays well under a millisecond
+    — negligible at the 1 s default interval."""
+    oflight.install(capacity=256, spool_dir=None)
+    reg = Registry()
+    for i in range(100):
+        reg.counter(f"c{i}_total").inc(i)
+    for i in range(8):
+        reg.gauge(f"g{i}").set(i)
+    hists = [reg.histogram(f"h{i}_seconds") for i in range(5)]
+    n = 20000
+    with octx.bind(octx.fresh()):
+        t0 = time.perf_counter()
+        for i in range(n):
+            hists[0].observe(0.001 * (i % 40))
+        per_observe = (time.perf_counter() - t0) / n
+    assert per_observe < 20e-6, f"observe cost {per_observe * 1e6:.1f}us"
+    sampler = ots.Sampler(reg.snapshot, interval_s=1.0)
+    ticks = 50
+    t0 = time.perf_counter()
+    for _ in range(ticks):
+        sampler.sample_once()
+    per_tick = (time.perf_counter() - t0) / ticks
+    assert per_tick < 5e-3, f"sampler tick {per_tick * 1e3:.2f}ms"
+    out = sampler.ring.window(60.0)
+    assert out["counters"]["c99_total"]["delta"] == 0
+    assert out["histograms"]["h0_seconds"]["count_delta"] == 0

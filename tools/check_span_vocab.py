@@ -1,6 +1,9 @@
 #!/usr/bin/env python3
-"""Span-vocabulary drift check: every ``obs.span("...")`` literal in
-the tree must appear in the span table in docs/OBSERVABILITY.md.
+"""Span- and metric-vocabulary drift check: every ``obs.span("...")``
+literal in the tree must appear in the span table in
+docs/OBSERVABILITY.md, and every registry metric literal
+(``.counter("...")`` / ``.gauge("...")`` / ``.histogram("...")``) must
+appear in its "Metric vocabulary" table.
 
 The span vocabulary is an API — ``/debug/trace`` consumers, the flight
 recorder's dumps, and the Chrome-trace tooling all key on span names —
@@ -23,6 +26,14 @@ closes the gap:
 * a missing name fails the check (exit 1); a documented name with no
   remaining call site is reported as a warning (docs can legitimately
   list conditional names).
+
+The metric pass applies the same machinery to registry metric names:
+every first-string-literal of ``.counter(`` / ``.gauge(`` /
+``.histogram(`` under ``tpu_stencil/`` (f-string placeholders again
+normalize to ``*``) must appear — backticked, first column — in the
+"Metric vocabulary" table. Metrics have no tier partition (names like
+``responses_2xx_total`` are flat by design), but the same no-drift
+rule holds: a new counter literal without its table row fails CI.
 
 Wired into tier-1 via tests/test_tracectx.py, and runnable standalone:
 
@@ -58,6 +69,16 @@ _CALL_RE = re.compile(
     r"(?:f?\"(?P<dq>[^\"]+)\"|f?'(?P<sq>[^']+)')"
 )
 
+METRIC_SECTION = "## Metric vocabulary"
+
+# Any registry factory call: `registry.counter("x")`, `.gauge(f"...")`,
+# `self.registry.histogram(...)` — the receiver does not matter, the
+# method name + first string literal do.
+_METRIC_CALL_RE = re.compile(
+    r"\.(?:counter|gauge|histogram)\(\s*"
+    r"(?:f?\"(?P<dq>[^\"]+)\"|f?'(?P<sq>[^']+)')"
+)
+
 
 def _normalize(name: str) -> str:
     """F-string placeholders become ``*`` so one doc entry covers a
@@ -65,10 +86,11 @@ def _normalize(name: str) -> str:
     return re.sub(r"\{[^}]*\}", "*", name)
 
 
-def collect_span_literals(src_dir: str = SRC_DIR) -> Dict[str, List[str]]:
-    """``{span_name: [file:line, ...]}`` for every span/phase literal
-    under ``src_dir``. Whole-file scan, not per-line: the call's
-    string argument routinely sits on the line after the ``(``."""
+def _collect_literals(pattern: "re.Pattern",
+                      src_dir: str) -> Dict[str, List[str]]:
+    """``{name: [file:line, ...]}`` for every first-string-literal of
+    ``pattern`` under ``src_dir``. Whole-file scan, not per-line: the
+    call's string argument routinely sits on the line after the ``(``."""
     found: Dict[str, List[str]] = {}
     for dirpath, _dirs, files in os.walk(src_dir):
         for fname in sorted(files):
@@ -78,36 +100,52 @@ def collect_span_literals(src_dir: str = SRC_DIR) -> Dict[str, List[str]]:
             with open(path, encoding="utf-8") as fh:
                 text = fh.read()
             rel = os.path.relpath(path, REPO)
-            for m in _CALL_RE.finditer(text):
+            for m in pattern.finditer(text):
                 name = _normalize(m.group("dq") or m.group("sq"))
                 lineno = text.count("\n", 0, m.start()) + 1
                 found.setdefault(name, []).append(f"{rel}:{lineno}")
     return found
 
 
-def documented_spans(doc_path: str = DOC) -> Set[str]:
-    """The first-column backticked names of the "Span vocabulary"
+def collect_span_literals(src_dir: str = SRC_DIR) -> Dict[str, List[str]]:
+    return _collect_literals(_CALL_RE, src_dir)
+
+
+def collect_metric_literals(src_dir: str = SRC_DIR) -> Dict[str, List[str]]:
+    return _collect_literals(_METRIC_CALL_RE, src_dir)
+
+
+def _documented(section: str, doc_path: str) -> Set[str]:
+    """The first-column backticked names of one vocabulary section's
     table rows (prose backticks in the section don't count — only
     table entries are the vocabulary)."""
     with open(doc_path, encoding="utf-8") as fh:
         text = fh.read()
-    start = text.find(SECTION)
+    start = text.find(section)
     if start < 0:
         raise SystemExit(
-            f"check_span_vocab: no {SECTION!r} section in {doc_path}"
+            f"check_span_vocab: no {section!r} section in {doc_path}"
         )
-    end = text.find("\n## ", start + len(SECTION))
-    section = text[start:end if end > 0 else len(text)]
+    end = text.find("\n## ", start + len(section))
+    chunk = text[start:end if end > 0 else len(text)]
     names: Set[str] = set()
-    for line in section.splitlines():
+    for line in chunk.splitlines():
         m = re.match(r"\|\s*`([^`\s]+)`\s*\|", line)
         if m:
             names.add(m.group(1))
     if not names:
         raise SystemExit(
-            f"check_span_vocab: {SECTION!r} section has no table rows"
+            f"check_span_vocab: {section!r} section has no table rows"
         )
     return names
+
+
+def documented_spans(doc_path: str = DOC) -> Set[str]:
+    return _documented(SECTION, doc_path)
+
+
+def documented_metrics(doc_path: str = DOC) -> Set[str]:
+    return _documented(METRIC_SECTION, doc_path)
 
 
 def check() -> int:
@@ -164,6 +202,45 @@ def check() -> int:
               file=sys.stderr)
     print(f"span vocabulary OK: {len(found)} span literal(s) all "
           f"documented ({len(documented)} table entries)")
+
+    # --- metric pass: same no-drift rule, no tier partition ---------
+    m_found = collect_metric_literals()
+    m_documented = documented_metrics()
+
+    def m_covered(name: str) -> bool:
+        if name in m_documented:
+            return True
+        return any(
+            "*" in doc and fnmatchcase(name, doc.replace("[", "[[]"))
+            for doc in m_documented
+        )
+
+    m_missing = {n: sites for n, sites in sorted(m_found.items())
+                 if not m_covered(n)}
+    if m_missing:
+        print("metric-vocabulary drift: these .counter()/.gauge()/"
+              ".histogram() literals are NOT in the metric table in "
+              "docs/OBSERVABILITY.md ('Metric vocabulary'):",
+              file=sys.stderr)
+        for name, sites in m_missing.items():
+            print(f"  {name!r}  ({', '.join(sites[:3])}"
+                  f"{', ...' if len(sites) > 3 else ''})",
+                  file=sys.stderr)
+        return 1
+    m_stale = sorted(
+        doc for doc in m_documented
+        if "*" not in doc and doc not in m_found
+        and not any(fnmatchcase(doc, f.replace("[", "[[]"))
+                    for f in m_found if "*" in f)
+    )
+    if m_stale:
+        # Warning only: folded/synthesized names (fleet_*,
+        # flightrec_dropped_total) have no factory call site.
+        print("check_span_vocab: documented metric with no literal "
+              f"call site (synthesized or stale?): {', '.join(m_stale)}",
+              file=sys.stderr)
+    print(f"metric vocabulary OK: {len(m_found)} metric literal(s) all "
+          f"documented ({len(m_documented)} table entries)")
     return 0
 
 

@@ -634,6 +634,28 @@ class NetConfig:
     # synchronous invalidation on replica distrust. 0 = off (the
     # default: caching is a traffic-shape bet the operator opts into).
     result_cache_mb: float = 0.0
+    # Live telemetry plane (tpu_stencil.obs.timeseries / .slo;
+    # docs/OBSERVABILITY.md "Time series"): a sampler thread snapshots
+    # the registry every sample_interval_s into a bounded ring serving
+    # GET /debug/timeseries. 0 disables the sampler (and with it the
+    # SLO engine, which evaluates on sampler ticks).
+    sample_interval_s: float = 1.0
+    # SLO burn-rate engine: the error budget (allowed bad fraction) of
+    # the stock error-ratio objective. 0 disables the engine; a breach
+    # flips /healthz to "degraded" (200 — still routable), emits an
+    # slo.breach event and triggers a flight dump.
+    slo_error_budget: float = 0.05
+    slo_fast_window_s: float = 60.0
+    slo_slow_window_s: float = 300.0
+    slo_fast_burn: float = 6.0
+    slo_slow_burn: float = 3.0
+    # Optional latency objective: fraction of requests slower than this
+    # threshold burns a 1% budget (0 = objective off).
+    slo_latency_p99_s: float = 0.0
+    # On-demand device profiler (POST /debug/prof?seconds=N): capture
+    # directories spool here (capped, oldest pruned). None disables the
+    # endpoint (404), as does an unavailable jax profiler.
+    prof_dir: Optional[str] = "profspool"
 
     def __post_init__(self) -> None:
         if not self.host:
@@ -705,6 +727,7 @@ class NetConfig:
                 f"result_cache_mb must be >= 0 (0 = no result cache), "
                 f"got {self.result_cache_mb}"
             )
+        _validate_telemetry(self)
         # Jax-free (the filter bank is pure numpy): a typo'd --filter
         # must die as a usage error, not boot a tier that answers 500
         # to every request.
@@ -828,6 +851,18 @@ class FedConfig:
     # breakers, drains and hedging behave exactly as before; off =
     # pure least-outstanding placement.
     digest_affinity: bool = True
+    # Live telemetry plane, same contract as NetConfig: local-registry
+    # sampler (0 = off, which also disables the SLO engine) feeding
+    # GET /debug/timeseries (the fed endpoint additionally fans the
+    # query to live members and merges), and the SLO error budget
+    # (0 = engine off) for the fed tier's own response mix.
+    sample_interval_s: float = 1.0
+    slo_error_budget: float = 0.05
+    slo_fast_window_s: float = 60.0
+    slo_slow_window_s: float = 300.0
+    slo_fast_burn: float = 6.0
+    slo_slow_burn: float = 3.0
+    slo_latency_p99_s: float = 0.0
 
     def __post_init__(self) -> None:
         if not self.host:
@@ -908,10 +943,46 @@ class FedConfig:
                 f"slow-request trigger), got "
                 f"{self.flight_latency_threshold_s}"
             )
+        _validate_telemetry(self)
 
     @property
     def max_inflight_bytes(self) -> int:
         return int(self.max_inflight_mb * (1 << 20))
+
+
+def _validate_telemetry(cfg) -> None:
+    """Shared validation for the NetConfig/FedConfig telemetry knobs
+    (both tiers carry the identical sampler + SLO field set)."""
+    if cfg.sample_interval_s < 0:
+        raise ValueError(
+            f"sample_interval_s must be >= 0 (0 = sampler off), got "
+            f"{cfg.sample_interval_s}"
+        )
+    if not 0.0 <= cfg.slo_error_budget <= 1.0:
+        raise ValueError(
+            f"slo_error_budget must be in [0, 1] (0 = SLO engine off), "
+            f"got {cfg.slo_error_budget}"
+        )
+    if cfg.slo_fast_window_s <= 0 or cfg.slo_slow_window_s <= 0:
+        raise ValueError(
+            f"slo windows must be > 0, got fast={cfg.slo_fast_window_s} "
+            f"slow={cfg.slo_slow_window_s}"
+        )
+    if cfg.slo_slow_window_s < cfg.slo_fast_window_s:
+        raise ValueError(
+            f"slo_slow_window_s must be >= slo_fast_window_s "
+            f"({cfg.slo_fast_window_s}), got {cfg.slo_slow_window_s}"
+        )
+    if cfg.slo_fast_burn <= 0 or cfg.slo_slow_burn <= 0:
+        raise ValueError(
+            f"slo burn thresholds must be > 0, got "
+            f"fast={cfg.slo_fast_burn} slow={cfg.slo_slow_burn}"
+        )
+    if cfg.slo_latency_p99_s < 0:
+        raise ValueError(
+            f"slo_latency_p99_s must be >= 0 (0 = no latency "
+            f"objective), got {cfg.slo_latency_p99_s}"
+        )
 
 
 def build_parser() -> argparse.ArgumentParser:

@@ -127,6 +127,18 @@ def build_parser() -> argparse.ArgumentParser:
                    help="slow-request anomaly threshold: a 200 slower "
                         "than this triggers an automatic flight-"
                         "recorder dump (0 = off)")
+    p.add_argument("--sample-interval", dest="sample_interval_s",
+                   type=float, default=1.0, metavar="SECONDS",
+                   help="time-series sampler period over the LOCAL fed "
+                        "registry (GET /debug/timeseries fans the "
+                        "member query on demand); the SLO engine "
+                        "evaluates on its ticks (0 disables both; "
+                        "default 1.0)")
+    p.add_argument("--slo-error-budget", dest="slo_error_budget",
+                   type=float, default=0.05, metavar="FRACTION",
+                   help="SLO error budget for the fed tier's own "
+                        "response mix; a sustained burn flips /healthz "
+                        "to 'degraded' (0 disables; default 0.05)")
     p.add_argument("--metrics-text", default=None, metavar="PATH",
                    help="after the drain, write the federation-wide "
                         "metrics (the /metrics exposition, member "
@@ -160,6 +172,8 @@ def main(argv=None) -> int:
             flightrec_dir=(None if ns.flightrec_dir == "none"
                            else ns.flightrec_dir),
             flight_latency_threshold_s=ns.flight_latency_threshold_s,
+            sample_interval_s=ns.sample_interval_s,
+            slo_error_budget=ns.slo_error_budget,
         )
     except ValueError as e:
         parser.error(str(e))
@@ -187,7 +201,7 @@ def main(argv=None) -> int:
         f"tenant quota {cfg.tenant_quota}); "
         f"POST /v1/blur /admin/register /admin/drain, "
         f"GET /healthz /metrics /statusz /debug/trace/<id> "
-        f"/debug/flightrec; SIGTERM drains",
+        f"/debug/flightrec /debug/timeseries; SIGTERM drains",
         flush=True,
     )
     # Timed waits (the net CLI's signal-liveness discipline).

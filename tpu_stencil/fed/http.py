@@ -13,8 +13,13 @@ Endpoints (docs/SERVING.md "Federation tier" is the contract):
   ``X-Fed-Member`` (which host computed), ``X-Fed-Hedged``, and the
   member's ``X-Cache`` verdict (hit/miss/collapsed) when its result
   cache is enabled.
-* ``GET /healthz`` — 200 serving / 503 draining, same readiness
+* ``GET /healthz`` — 200 serving (``degraded`` body when the SLO
+  burn-rate engine holds a breach) / 503 draining, same readiness
   contract as the net tier, one hop up.
+* ``GET /debug/timeseries[?window=s]`` — the local sampler's windowed
+  deltas/rates plus every live member's ``/debug/timeseries`` answer,
+  fanned concurrently and merged (a failed member surfaces as an
+  explicit ``stale`` entry with its scrape age).
 * ``GET /metrics`` — the fed registry rendered under
   ``tpu_stencil_fed``, with every live member's ``/metrics`` scrape
   folded in as ``fleet_<host>_<name>`` (counters) — one scrape walks
@@ -61,6 +66,7 @@ from tpu_stencil.fed.router import (
 )
 from tpu_stencil.net.http import (
     _Oversized,
+    _parse_window,
     read_request_body,
     send_trace_pair,
     traced_error_body,
@@ -68,7 +74,9 @@ from tpu_stencil.net.http import (
 from tpu_stencil.net.router import Draining, Overloaded
 from tpu_stencil.obs import context as _obs_ctx
 from tpu_stencil.obs import flight as _obs_flight
+from tpu_stencil.obs import slo as _obs_slo
 from tpu_stencil.obs import span as _obs_span
+from tpu_stencil.obs import timeseries as _obs_ts
 from tpu_stencil.resilience.errors import (
     DeadlineExceeded,
     HostUnavailable,
@@ -176,6 +184,10 @@ class _FedHandler(BaseHTTPRequestHandler):
         if path == "/healthz":
             if self.fe.router.draining:
                 self._error(503, "draining")
+            elif self.fe.slo is not None and self.fe.slo.degraded():
+                # Same contract as the net tier: degraded is 200
+                # (routable) but visibly unhealthy.
+                self._respond(200, b"degraded\n")
             else:
                 self._respond(200, b"ok\n")
         elif path == "/metrics":
@@ -188,6 +200,15 @@ class _FedHandler(BaseHTTPRequestHandler):
                            sort_keys=True).encode(),
                 content_type="application/json",
             )
+        elif path == "/debug/timeseries":
+            self._debug_timeseries(parse_qs(urlsplit(self.path).query))
+        elif path == "/debug/prof" or path.startswith("/debug/prof/"):
+            # The federation tier is deliberately jax-free: the
+            # profiler endpoint exists but is 404-clean, pointing the
+            # operator at the member endpoints.
+            self._error(404, "no device profiler on the federation "
+                             "tier (jax-free); POST /debug/prof on a "
+                             "member")
         elif path.startswith("/debug/trace/"):
             self._debug_trace(path[len("/debug/trace/"):])
         elif path == "/debug/flightrec" or path.startswith(
@@ -205,6 +226,21 @@ class _FedHandler(BaseHTTPRequestHandler):
                               content_type="application/json")
         else:
             self._error(404, f"no such endpoint: {path}")
+
+    def _debug_timeseries(self, query: dict) -> None:
+        if self.fe.sampler is None:
+            self._error(404, "time-series sampler is off "
+                             "(--sample-interval 0)")
+            return
+        window_s = _parse_window(query)
+        if window_s is None:
+            self._error(400, "window must be a positive number of "
+                             "seconds")
+            return
+        payload = self.fe.debug_timeseries(window_s)
+        self._respond(200, json.dumps(payload, indent=2,
+                                      sort_keys=True).encode(),
+                      content_type="application/json")
 
     def _debug_trace(self, trace_id: str) -> None:
         if not _obs_ctx.valid_id(trace_id):
@@ -229,6 +265,11 @@ class _FedHandler(BaseHTTPRequestHandler):
             self._register(parse_qs(split.query))
         elif split.path == "/admin/drain":
             self._drain(parse_qs(split.query))
+        elif split.path == "/debug/prof":
+            self._consume_body()
+            self._error(404, "no device profiler on the federation "
+                             "tier (jax-free); POST /debug/prof on a "
+                             "member")
         else:
             self._error(404, f"no such endpoint: {split.path}")
 
@@ -485,8 +526,25 @@ class FedFrontend:
         self.admin_drain_requested = threading.Event()
         # The process-wide flight recorder, installed at start().
         self.flight = None
+        # Live telemetry plane: the sampler ticks over the LOCAL
+        # registry only (a member scrape per second would hammer the
+        # fleet); /debug/timeseries fans the member query on demand.
+        self.sampler: Optional[_obs_ts.Sampler] = None
+        self.slo: Optional[_obs_slo.SloEngine] = None
+        # Monotonic stamp of the last successful scrape per member
+        # host, feeding the fleet_<host>_scrape_age_seconds gauges: a
+        # stale fold is distinguishable from a live one, and a skipped
+        # member is an explicit staleness gauge, never silently absent.
+        self._last_scrape_ok: Dict[str, float] = {}
 
     # -- lifecycle -----------------------------------------------------
+
+    def _local_snapshot(self) -> dict:
+        snap = self.registry.snapshot()
+        snap["counters"]["flightrec_dropped_total"] = (
+            _obs_flight.dropped_total()
+        )
+        return snap
 
     def start(self) -> "FedFrontend":
         # The always-on flight recorder (obs.flight): idempotent per
@@ -496,6 +554,21 @@ class FedFrontend:
             self.membership.register_seed(url)
         self.membership.start()
         self.router.start()
+        if self.cfg.sample_interval_s > 0:
+            self.sampler = _obs_ts.Sampler(
+                self._local_snapshot, self.cfg.sample_interval_s
+            )
+            if self.cfg.slo_error_budget > 0:
+                self.slo = _obs_slo.SloEngine(
+                    _obs_slo.default_fed_objectives(self.cfg),
+                    self.registry, tier="fed",
+                    fast_window_s=self.cfg.slo_fast_window_s,
+                    slow_window_s=self.cfg.slo_slow_window_s,
+                    fast_burn=self.cfg.slo_fast_burn,
+                    slow_burn=self.cfg.slo_slow_burn,
+                )
+                self.sampler.on_sample.append(self.slo.evaluate)
+            self.sampler.start()
         self._httpd = _FedHTTPServer((self.cfg.host, self.cfg.port), self)
         self._thread = threading.Thread(
             target=self._httpd.serve_forever,
@@ -562,6 +635,8 @@ class FedFrontend:
         }
 
     def close(self) -> None:
+        if self.sampler is not None:
+            self.sampler.stop()
         if self.router is not None and not self.router.draining:
             self.drain()
         self.membership.close()
@@ -644,7 +719,7 @@ class FedFrontend:
         on one lost host."""
         import concurrent.futures
 
-        snap = self.registry.snapshot()
+        snap = self._local_snapshot()
         from tpu_stencil.obs import exposition
 
         def scrape(m) -> dict:
@@ -664,6 +739,9 @@ class FedFrontend:
                 for m, fut in futs:
                     try:
                         member = fut.result()
+                        self._last_scrape_ok[m.host_id] = (
+                            time.monotonic()
+                        )
                     except Exception:
                         self.registry.counter(
                             "member_scrape_failures_total"
@@ -700,6 +778,16 @@ class FedFrontend:
                             ).value
                             continue
                         snap["counters"][fk] = v
+        # EVERY live member gets a scrape-age stamp — a member whose
+        # scrape just failed (or never succeeded: age -1.0) shows up
+        # as explicit staleness, never as silent absence from the fold.
+        now = time.monotonic()
+        for m in live:
+            last = self._last_scrape_ok.get(m.host_id)
+            age = round(now - last, 3) if last is not None else -1.0
+            snap["gauges"][f"fleet_{m.host_id}_scrape_age_seconds"] = {
+                "value": age, "peak": age,
+            }
         snap["members"] = len(live)
         return snap
 
@@ -708,6 +796,66 @@ class FedFrontend:
 
         return exposition.render_text(self.metrics_snapshot(),
                                       prefix="tpu_stencil_fed")
+
+    def debug_timeseries(self, window_s: float) -> dict:
+        """The fed ``GET /debug/timeseries`` body: the local sampler's
+        windowed view plus every live member's ``/debug/timeseries``
+        answer, fanned concurrently with the same bounded-timeout
+        discipline as the metrics fold. A member that fails mid-scrape
+        surfaces as an explicit ``stale`` entry (with its last-good
+        scrape age), never as silent absence — and one dead member
+        costs one timeout, not a hang."""
+        import concurrent.futures
+
+        assert self.sampler is not None, "sampler is off"
+        local = self.sampler.ring.window(window_s)
+        local["source"] = "fed"
+        local["slo"] = None if self.slo is None else self.slo.statusz()
+
+        def fetch(m) -> dict:
+            url = f"{m.url}/debug/timeseries?window={window_s:g}"
+            with urllib.request.urlopen(url, timeout=5.0) as r:
+                return json.loads(r.read())
+
+        members: Dict[str, dict] = {}
+        live = [m for m in self.membership.members()
+                if m.state != "evicted"]
+        if live:
+            with concurrent.futures.ThreadPoolExecutor(
+                max_workers=min(8, len(live)),
+                thread_name_prefix="tpu-stencil-fed-ts",
+            ) as pool:
+                futs = [(m, pool.submit(fetch, m)) for m in live]
+                now = time.monotonic()
+                for m, fut in futs:
+                    try:
+                        doc = fut.result()
+                        self._last_scrape_ok[m.host_id] = (
+                            time.monotonic()
+                        )
+                        doc["stale"] = False
+                        doc["scrape_age_s"] = 0.0
+                        members[m.host_id] = doc
+                    except Exception as e:
+                        self.registry.counter(
+                            "member_scrape_failures_total"
+                        ).inc()
+                        last = self._last_scrape_ok.get(m.host_id)
+                        members[m.host_id] = {
+                            "stale": True,
+                            "error": f"{type(e).__name__}: {e}",
+                            "scrape_age_s": (
+                                round(now - last, 3)
+                                if last is not None else -1.0
+                            ),
+                        }
+        return {
+            "schema_version": _obs_ts.SCHEMA_VERSION,
+            "window_s": float(window_s),
+            "source": "fed",
+            "fed": local,
+            "members": members,
+        }
 
     def statusz(self) -> dict:
         return {
@@ -720,6 +868,12 @@ class FedFrontend:
             "outstanding": self.router.outstanding(),
             "tenants": self.router.tenants(),
             "drain_report": self._drain_report,
+            "slo": None if self.slo is None else self.slo.statusz(),
+            "timeseries": None if self.sampler is None else {
+                "interval_s": self.sampler.interval_s,
+                "samples": len(self.sampler.ring),
+            },
+            "flightrec_dropped_total": _obs_flight.dropped_total(),
             # The same merged snapshot /metrics renders; loadgen's
             # HttpTarget.stats() reads this key, so --http against a
             # federation works unchanged.
@@ -746,5 +900,7 @@ class FedFrontend:
                 ),
                 "flight_latency_threshold_s":
                     self.cfg.flight_latency_threshold_s,
+                "sample_interval_s": self.cfg.sample_interval_s,
+                "slo_error_budget": self.cfg.slo_error_budget,
             },
         }

@@ -148,6 +148,31 @@ def build_parser() -> argparse.ArgumentParser:
                         "than this triggers an automatic flight-recorder "
                         "dump, so a p99 straggler leaves a black-box "
                         "record (0 = off)")
+    p.add_argument("--sample-interval", dest="sample_interval_s",
+                   type=float, default=1.0, metavar="SECONDS",
+                   help="time-series sampler period: a daemon thread "
+                        "snapshots the merged registry into a bounded "
+                        "ring serving GET /debug/timeseries; the SLO "
+                        "engine evaluates on its ticks (0 disables "
+                        "both; default 1.0)")
+    p.add_argument("--slo-error-budget", dest="slo_error_budget",
+                   type=float, default=0.05, metavar="FRACTION",
+                   help="SLO error budget (allowed bad fraction) for "
+                        "the stock burn-rate objectives; a sustained "
+                        "fast+slow window burn flips /healthz to "
+                        "'degraded', emits an slo.breach event and "
+                        "triggers a flight dump (0 disables the "
+                        "engine; default 0.05)")
+    p.add_argument("--slo-latency-p99", dest="slo_latency_p99_s",
+                   type=float, default=0.0, metavar="SECONDS",
+                   help="optional latency objective: requests slower "
+                        "than this burn a 1%% budget (0 = off)")
+    p.add_argument("--prof-dir", dest="prof_dir", default="profspool",
+                   metavar="DIR",
+                   help="on-demand profiler spool: POST /debug/prof"
+                        "?seconds=N runs a bounded jax.profiler "
+                        "capture into DIR (capped, oldest pruned); "
+                        "'none' disables the endpoint")
     p.add_argument("--platform", default=None,
                    choices=["cpu", "tpu", "gpu"],
                    help="force the JAX platform before backend init")
@@ -232,6 +257,10 @@ def main(argv=None) -> int:
             flightrec_dir=(None if ns.flightrec_dir == "none"
                            else ns.flightrec_dir),
             flight_latency_threshold_s=ns.flight_latency_threshold_s,
+            sample_interval_s=ns.sample_interval_s,
+            slo_error_budget=ns.slo_error_budget,
+            slo_latency_p99_s=ns.slo_latency_p99_s,
+            prof_dir=(None if ns.prof_dir == "none" else ns.prof_dir),
         )
     except ValueError as e:
         parser.error(str(e))
@@ -260,8 +289,9 @@ def main(argv=None) -> int:
         f"arena={'on' if cfg.ingest_arena else 'off'}, "
         f"cache={cfg.result_cache_mb:g}MB, "
         f"warm={'on' if cfg.warm_fleet else 'off'}); "
-        f"POST /v1/blur, GET /healthz /metrics /statusz "
-        f"/debug/trace/<id> /debug/flightrec; SIGTERM drains",
+        f"POST /v1/blur /debug/prof, GET /healthz /metrics /statusz "
+        f"/debug/trace/<id> /debug/flightrec /debug/timeseries; "
+        f"SIGTERM drains",
         flush=True,
     )
     if ns.register:

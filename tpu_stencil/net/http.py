@@ -14,9 +14,10 @@ Endpoints (docs/SERVING.md "Network tier" is the contract):
   413 oversized body, 429 + ``Retry-After`` when every replica queue
   is full, 503 + ``Retry-After`` when shedding or draining, 504 when
   the deadline expired (``DeadlineExceeded``), 500 anything else.
-* ``GET /healthz`` — 200 ``ok`` serving / 503 ``draining`` after
-  SIGTERM. The readiness probe: a load balancer stops routing here the
-  moment the drain begins.
+* ``GET /healthz`` — 200 ``ok`` serving / 200 ``degraded`` when the
+  SLO burn-rate engine holds a breach (still routable, visibly
+  unhealthy) / 503 ``draining`` after SIGTERM. The readiness probe: a
+  load balancer stops routing here the moment the drain begins.
 * ``GET /metrics`` — Prometheus-style text exposition (the PR-2
   renderer, prefix ``tpu_stencil_net``): the net registry (router +
   fleet + per-request HTTP metrics) with every replica's counters
@@ -33,6 +34,14 @@ Endpoints (docs/SERVING.md "Network tier" is the contract):
 * ``GET /admin/cache?action=clear|stats`` — operator control over the
   result cache (``--result-cache-mb``; 404 when it is off): ``clear``
   wipes every entry, ``stats`` reports sizes without touching one.
+* ``GET /debug/timeseries[?window=s]`` — windowed counter deltas and
+  per-second rates from the in-process sampler ring
+  (:mod:`tpu_stencil.obs.timeseries`; versioned JSON; 404 typed when
+  the sampler is off).
+* ``POST /debug/prof?seconds=N`` — one bounded ``jax.profiler``
+  capture into a capped spool (404-clean when profiling is
+  unavailable; 409 while one runs); ``GET /debug/prof`` lists
+  captures, ``GET /debug/prof/<path>`` fetches a trace file.
 
 With ``--result-cache-mb N`` the edge holds a content-addressed result
 cache in front of the router (:mod:`tpu_stencil.cache`): the request
@@ -87,7 +96,10 @@ from tpu_stencil.net.router import (
 )
 from tpu_stencil.obs import context as _obs_ctx
 from tpu_stencil.obs import flight as _obs_flight
+from tpu_stencil.obs import prof as _obs_prof
+from tpu_stencil.obs import slo as _obs_slo
 from tpu_stencil.obs import span as _obs_span
+from tpu_stencil.obs import timeseries as _obs_ts
 from tpu_stencil.resilience.errors import DeadlineExceeded, WorkerCrashed
 from tpu_stencil.serve import bucketing
 from tpu_stencil.serve.engine import QueueFull, ServerClosed
@@ -107,6 +119,23 @@ _RESULT_TIMEOUT_S = 600.0
 # Upload bound: a request body may not exceed the declared frame bytes
 # (chunked uploads have no Content-Length to sanity-check up front).
 _MAX_EXTRA_BODY = 2
+
+# Default /debug/timeseries window when ?window= is absent.
+DEFAULT_TS_WINDOW_S = 60.0
+
+
+def _parse_window(query: dict) -> Optional[float]:
+    """``?window=<seconds>`` -> float, :data:`DEFAULT_TS_WINDOW_S` when
+    absent, ``None`` (the caller's 400) when malformed/non-positive.
+    Shared by the net and fed handlers."""
+    raw = query.get("window", [None])[0]
+    if raw is None:
+        return DEFAULT_TS_WINDOW_S
+    try:
+        w = float(raw)
+    except ValueError:
+        return None
+    return w if w > 0 else None
 
 # How long an armed net.accept/net.body rule with raise=TimeoutError
 # stalls the handler (the chaos stand-in for a wedged host; the default
@@ -369,6 +398,11 @@ class _Handler(BaseHTTPRequestHandler):
         if path == "/healthz":
             if self.fe.router.draining:
                 self._error(503, "draining")
+            elif self.fe.slo is not None and self.fe.slo.degraded():
+                # Degraded ≠ draining: still 200 (routable — shedding
+                # a whole host on a burn-rate breach would amplify the
+                # incident), but visibly unhealthy to probes.
+                self._respond(200, b"degraded\n")
             else:
                 self._respond(200, b"ok\n")
         elif path == "/metrics":
@@ -382,6 +416,10 @@ class _Handler(BaseHTTPRequestHandler):
                           content_type="application/json")
         elif path == "/admin/cache":
             self._admin_cache(parse_qs(split.query))
+        elif path == "/debug/timeseries":
+            self._debug_timeseries(parse_qs(split.query))
+        elif path == "/debug/prof" or path.startswith("/debug/prof/"):
+            self._debug_prof_get(path)
         elif path.startswith("/debug/trace/"):
             self._debug_trace(path[len("/debug/trace/"):])
         elif path == "/debug/flightrec" or path.startswith(
@@ -412,6 +450,65 @@ class _Handler(BaseHTTPRequestHandler):
         self._respond(200, json.dumps(payload, indent=2).encode(),
                       content_type="application/json")
 
+    def _debug_timeseries(self, query: dict) -> None:
+        if self.fe.sampler is None:
+            self._error(404, "time-series sampler is off "
+                             "(--sample-interval 0)")
+            return
+        window_s = _parse_window(query)
+        if window_s is None:
+            self._error(400, "window must be a positive number of "
+                             "seconds")
+            return
+        payload = self.fe.timeseries_payload(window_s)
+        self._respond(200, json.dumps(payload, indent=2,
+                                      sort_keys=True).encode(),
+                      content_type="application/json")
+
+    def _debug_prof_get(self, path: str) -> None:
+        spool = self.fe.cfg.prof_dir
+        if spool is None:
+            self._error(404, "profiler spool is off (--prof-dir none)")
+            return
+        if path == "/debug/prof":
+            payload = _obs_prof.spool_list(spool)
+            self._respond(200, json.dumps(payload, indent=2,
+                                          sort_keys=True).encode(),
+                          content_type="application/json")
+            return
+        data = _obs_prof.spool_read(spool, path[len("/debug/prof/"):])
+        if data is None:
+            self._error(404, "no such profiler capture file")
+            return
+        self._respond(200, data,
+                      content_type="application/octet-stream")
+
+    def _debug_prof_post(self, query: dict) -> None:
+        spool = self.fe.cfg.prof_dir
+        if spool is None:
+            self._error(404, "profiler spool is off (--prof-dir none)")
+            return
+        ok, reason = _obs_prof.available()
+        if not ok:
+            self._error(404, reason)
+            return
+        try:
+            seconds = float(query.get("seconds", ["1.0"])[0])
+        except ValueError:
+            self._error(400, "seconds must be a number")
+            return
+        try:
+            result = _obs_prof.capture(seconds, spool)
+        except RuntimeError as e:
+            if str(e) == "busy":
+                self._error(409, "a profiler capture is already running")
+            else:
+                self._error(404, str(e))
+            return
+        self._respond(200, json.dumps(result, indent=2,
+                                      sort_keys=True).encode(),
+                      content_type="application/json")
+
     # -- POST ----------------------------------------------------------
 
     def do_POST(self) -> None:  # noqa: N802
@@ -425,6 +522,8 @@ class _Handler(BaseHTTPRequestHandler):
             self._admin_drain()
         elif split.path == "/admin/quarantine":
             self._quarantine(parse_qs(split.query))
+        elif split.path == "/debug/prof":
+            self._debug_prof_post(parse_qs(split.query))
         else:
             self._error(404, f"no such endpoint: {split.path}")
 
@@ -1039,6 +1138,11 @@ class NetFrontend:
                         quarantined=self.quarantine.is_quarantined)
             if cfg.result_cache_mb > 0 else None
         )
+        # The live telemetry plane (obs.timeseries / obs.slo), built at
+        # start(): the sampler snapshots the merged registry on a fixed
+        # interval and the SLO engine evaluates on its ticks.
+        self.sampler: Optional[_obs_ts.Sampler] = None
+        self.slo: Optional[_obs_slo.SloEngine] = None
 
     # -- lifecycle -----------------------------------------------------
 
@@ -1069,6 +1173,21 @@ class NetFrontend:
                 self.fleet, self.quarantine, self.cfg.filter_name,
                 self.cfg.probe_interval_s, self.registry,
             ).start()
+        if self.cfg.sample_interval_s > 0:
+            self.sampler = _obs_ts.Sampler(
+                self.metrics_snapshot, self.cfg.sample_interval_s
+            )
+            if self.cfg.slo_error_budget > 0:
+                self.slo = _obs_slo.SloEngine(
+                    _obs_slo.default_net_objectives(self.cfg),
+                    self.registry, tier="net",
+                    fast_window_s=self.cfg.slo_fast_window_s,
+                    slow_window_s=self.cfg.slo_slow_window_s,
+                    fast_burn=self.cfg.slo_fast_burn,
+                    slow_burn=self.cfg.slo_slow_burn,
+                )
+                self.sampler.on_sample.append(self.slo.evaluate)
+            self.sampler.start()
         self._httpd = _NetHTTPServer((self.cfg.host, self.cfg.port), self)
         self._thread = threading.Thread(
             target=self._httpd.serve_forever,
@@ -1113,6 +1232,8 @@ class NetFrontend:
 
     def close(self) -> None:
         """Stop the listener (drains first if nobody did)."""
+        if self.sampler is not None:
+            self.sampler.stop()
         if self._prober is not None:
             self._prober.stop()
             self._prober = None
@@ -1166,6 +1287,12 @@ class NetFrontend:
         snap = self.registry.snapshot()
         for k, v in sorted(self.fleet.merged_counters().items()):
             snap["counters"][f"fleet_{k}"] = v
+        # "No silent caps": dumps the flight spool pruned past its cap
+        # are a counter here (and on /statusz via the merged view), not
+        # an invisible loss.
+        snap["counters"]["flightrec_dropped_total"] = (
+            _obs_flight.dropped_total()
+        )
         snap["replicas"] = len(self.fleet)
         return snap
 
@@ -1175,6 +1302,16 @@ class NetFrontend:
         return exposition.render_text(
             self.metrics_snapshot(), prefix="tpu_stencil_net"
         )
+
+    def timeseries_payload(self, window_s: float) -> dict:
+        """The ``GET /debug/timeseries`` body: windowed deltas/rates
+        from the sampler's ring, stamped with the source tier and the
+        SLO engine's live view (when enabled)."""
+        assert self.sampler is not None, "sampler is off"
+        payload = self.sampler.ring.window(window_s)
+        payload["source"] = "net"
+        payload["slo"] = None if self.slo is None else self.slo.statusz()
+        return payload
 
     def statusz(self) -> dict:
         assert self.router is not None, "not started"
@@ -1189,6 +1326,12 @@ class NetFrontend:
             },
             "quarantine": self.quarantine.statusz(),
             "cache": None if self.cache is None else self.cache.stats(),
+            "slo": None if self.slo is None else self.slo.statusz(),
+            "timeseries": None if self.sampler is None else {
+                "interval_s": self.sampler.interval_s,
+                "samples": len(self.sampler.ring),
+            },
+            "flightrec_dropped_total": _obs_flight.dropped_total(),
             "drain_report": (
                 None if self._drain_report is None
                 else {str(k): v for k, v in self._drain_report.items()}
@@ -1220,5 +1363,8 @@ class NetFrontend:
                 ),
                 "flight_latency_threshold_s":
                     self.cfg.flight_latency_threshold_s,
+                "sample_interval_s": self.cfg.sample_interval_s,
+                "slo_error_budget": self.cfg.slo_error_budget,
+                "prof_dir": self.cfg.prof_dir,
             },
         }

@@ -19,6 +19,14 @@ docs/OBSERVABILITY.md). Three pieces:
   and ``device.memory_stats()`` telemetry, all degrade-to-unavailable.
 * :mod:`~tpu_stencil.obs.sentry` — the perf-regression sentry: JSONL
   capture history + baseline gate (``python -m tpu_stencil perf``).
+* :mod:`~tpu_stencil.obs.timeseries` — in-process time series: a
+  sampler thread snapshots the registry into a bounded ring; the
+  ``/debug/timeseries`` endpoints serve windowed deltas/rates.
+* :mod:`~tpu_stencil.obs.slo` — declarative objectives with
+  fast/slow burn-rate alerting; a breach flips ``/healthz`` to
+  ``degraded``, emits an event and triggers a flight dump.
+* :mod:`~tpu_stencil.obs.prof` — bounded on-demand ``jax.profiler``
+  captures behind ``POST /debug/prof`` (404-clean without jax).
 
 >>> from tpu_stencil import obs
 >>> obs.enable()
@@ -50,7 +58,10 @@ from tpu_stencil.obs import (
     exposition,
     flight,
     introspect,
+    prof,
     sentry,
+    slo,
+    timeseries,
     tracing,
 )
 
@@ -82,11 +93,14 @@ __all__ = [
     "get_tracer",
     "introspect",
     "phase",
+    "prof",
     "registry",
     "reset",
     "scratch_registry",
     "sentry",
+    "slo",
     "snapshot",
     "span",
+    "timeseries",
     "tracing",
 ]

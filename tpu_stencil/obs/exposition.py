@@ -8,14 +8,17 @@ One render path for every registry in the repo: the serve engine's
 
 * counters  -> ``<prefix>_<name> <int>``
 * gauges    -> value plus the high-water mark as ``{stat="peak"}``
-* histograms-> a summary: ``{quantile="0.5"|"0.99"}`` samples plus
-  ``_count``/``_sum``/``_mean``/``_max`` series
+* histograms-> OpenMetrics-style ``_bucket{le="..."}`` cumulative
+  series (with a ``# {trace_id="..."} <value>`` exemplar suffix on
+  buckets that have one), ``{quantile="0.5"|"0.99"}`` reservoir
+  samples, plus ``_count``/``_sum``/``_mean``/``_max`` series
 * bare scalars (e.g. ``executables_cached``) -> an untyped gauge
 
 :func:`parse_text` is the exact inverse — ``parse_text(render_text(s))
 == s`` for any snapshot (floats are emitted with ``repr``, which
-round-trips exactly in Python) — so tests can assert no metric is
-dropped, and downstream tooling has a reference parser.
+round-trips exactly in Python; bucket label strings pass through
+verbatim) — so tests can assert no metric is dropped, and downstream
+tooling (the federation's member-scrape fold) has a reference parser.
 """
 
 from __future__ import annotations
@@ -29,6 +32,15 @@ _SAMPLE_RE = re.compile(
     r'^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)'
     r'(?:\{(?P<labels>[^}]*)\})?\s+(?P<value>\S+)$'
 )
+# The exemplar suffix of a bucket line (OpenMetrics shape, trace_id
+# label only): `... # {trace_id="<hex>"} <value>`.
+_EXEMPLAR_RE = re.compile(
+    r'^\{trace_id="(?P<tid>[^"]*)"\}\s+(?P<value>\S+)$'
+)
+
+
+def _le_sort_key(le: str) -> float:
+    return float("inf") if le == "+Inf" else float(le)
 
 
 def _num(v) -> str:
@@ -60,7 +72,19 @@ def render_text(snapshot: dict, prefix: str = "tpu_stencil",
             f'{prefix}_{name}{{stat="peak"}} {_num(g["peak"])}',
         ])
     for name, h in sorted(snapshot.get("histograms", {}).items()):
-        lines = [
+        buckets = h.get("buckets")
+        exemplars = h.get("exemplars", {})
+        lines = []
+        if buckets is not None:
+            for le in sorted(buckets, key=_le_sort_key):
+                line = (f'{prefix}_{name}_bucket{{le="{le}"}} '
+                        f'{_num(buckets[le])}')
+                ex = exemplars.get(le)
+                if ex:
+                    line += (f' # {{trace_id="{ex["trace_id"]}"}} '
+                             f'{_num(ex["value"])}')
+                lines.append(line)
+        lines += [
             f'{prefix}_{name}{{quantile="{q}"}} {_num(h[key])}'
             for q, key in _QUANTILES
         ]
@@ -68,7 +92,12 @@ def render_text(snapshot: dict, prefix: str = "tpu_stencil",
             f"{prefix}_{name}_{field} {_num(h[field])}"
             for field in _HIST_FIELDS
         ]
-        emit("summary", name, lines)
+        # Bucketed histograms (every Registry histogram since the
+        # fixed-bucket change) expose the OpenMetrics `histogram` kind;
+        # bucketless dicts (older member payloads crossing the fed
+        # fold) stay `summary`.
+        emit("histogram" if buckets is not None else "summary",
+             name, lines)
     for name, v in sorted(snapshot.items()):
         if name in ("counters", "gauges", "histograms"):
             continue
@@ -117,6 +146,15 @@ def parse_text(text: str, prefix: str = "tpu_stencil") -> dict:
             continue
         if line.startswith("#"):
             continue
+        # Peel a bucket exemplar suffix off before the full-line sample
+        # match (OpenMetrics: `<sample> # {trace_id="..."} <value>`).
+        exemplar = None
+        if " # " in line:
+            line, _, ex_part = line.partition(" # ")
+            em = _EXEMPLAR_RE.match(ex_part.strip())
+            if not em:
+                raise ValueError(f"unparseable exemplar: {ex_part!r}")
+            exemplar = {"trace_id": em["tid"], "value": value(em["value"])}
         m = _SAMPLE_RE.match(line)
         if not m:
             raise ValueError(f"unparseable exposition line: {line!r}")
@@ -135,13 +173,19 @@ def parse_text(text: str, prefix: str = "tpu_stencil") -> dict:
         elif kind == "gauge":
             g = snap["gauges"].setdefault(base, {})
             g["peak" if labels and "peak" in labels else "value"] = val
-        elif kind == "summary":
+        elif kind in ("summary", "histogram"):
             h = snap["histograms"].setdefault(base, {})
-            if labels:
-                q = dict(
-                    (kv.split("=")[0], kv.split("=")[1].strip('"'))
-                    for kv in labels.split(",")
-                )["quantile"]
+            labmap = dict(
+                (kv.split("=")[0], kv.split("=")[1].strip('"'))
+                for kv in labels.split(",")
+            ) if labels else {}
+            if field == "bucket" and "le" in labmap:
+                le = labmap["le"]
+                h.setdefault("buckets", {})[le] = val
+                if exemplar is not None:
+                    h.setdefault("exemplars", {})[le] = exemplar
+            elif labels:
+                q = labmap["quantile"]
                 h[{"0.5": "p50", "0.99": "p99"}[q]] = val
             else:
                 h[field] = val

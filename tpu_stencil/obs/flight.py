@@ -61,6 +61,20 @@ _SAFE_FILE_CHARS = frozenset(
     "abcdefghijklmnopqrstuvwxyzABCDEFGHIJKLMNOPQRSTUVWXYZ0123456789._-"
 )
 
+# "No silent caps": dumps pruned past SPOOL_CAP are counted, surfaced
+# (``flightrec_dropped_total`` on /statusz and /metrics), and the FIRST
+# drop emits one event line — after that the counter carries the story
+# without turning the event log into a drop firehose.
+_drop_lock = threading.Lock()
+_dropped_total = 0
+_drop_event_emitted = False
+
+
+def dropped_total() -> int:
+    """Dump files this process has pruned out of the spool cap."""
+    with _drop_lock:
+        return _dropped_total
+
 
 def resolve_spool(configured: Optional[str]) -> Optional[str]:
     """The effective spool directory: the env override wins (tests and
@@ -234,15 +248,25 @@ def _prune_spool(spool: str) -> None:
     """Keep the spool at :data:`SPOOL_CAP` dumps, oldest pruned first
     (the timestamped names sort chronologically, so lexical order is
     age order — no fragile mtime dependence)."""
+    global _dropped_total, _drop_event_emitted
     try:
         names = sorted(n for n in os.listdir(spool) if n.endswith(".json"))
     except OSError:
         return
+    removed = 0
     for n in names[:-SPOOL_CAP] if len(names) > SPOOL_CAP else ():
         try:
             os.remove(os.path.join(spool, n))
+            removed += 1
         except OSError:
             pass
+    if removed:
+        with _drop_lock:
+            _dropped_total += removed
+            first, _drop_event_emitted = not _drop_event_emitted, True
+        if first:
+            _events.emit("flightrec.spool_drop", verdict="capped",
+                         spool_cap=SPOOL_CAP, dropped=removed)
 
 
 # -- the process-wide recorder ----------------------------------------
@@ -271,9 +295,12 @@ def get() -> Optional[FlightRecorder]:
 
 def reset() -> None:
     """Drop the recorder (tests) — span() falls back to tracer-only."""
-    global _recorder
+    global _recorder, _dropped_total, _drop_event_emitted
     _recorder = None
     _tracing._set_flight(None)
+    with _drop_lock:
+        _dropped_total = 0
+        _drop_event_emitted = False
 
 
 def trigger(name: str, trace_id: str = "", tier: str = "",

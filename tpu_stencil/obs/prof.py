@@ -1,0 +1,145 @@
+"""On-demand device profiler: bounded ``jax.profiler`` captures.
+
+``POST /debug/prof?seconds=N`` on a serving tier runs one bounded
+profiler capture on the live process and spools the resulting trace
+files (perfetto/xplane) for fetch via ``GET /debug/prof/<path>``. The
+whole module is defensive by construction:
+
+* **404-clean when unavailable** — jax may be absent (the fed tier is
+  deliberately jax-free) or built without profiler support; callers
+  ask :func:`available` first and surface a typed 404, never a 500.
+* **bounded** — capture duration clamps to [0.05 s, 30 s]; one capture
+  at a time (a second request gets a busy error -> HTTP 409); the
+  spool keeps at most :data:`SPOOL_CAP` capture directories, oldest
+  pruned first (same "no unbounded anything" rule as the flight
+  recorder's spool).
+* **path-safe** — :func:`spool_read` refuses any path that escapes the
+  spool root, so the fetch endpoint cannot be walked out of its
+  directory.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import threading
+import time
+from typing import List, Optional, Tuple
+
+#: Max capture directories kept in the spool.
+SPOOL_CAP = 8
+
+#: Capture duration clamp (seconds).
+MIN_SECONDS = 0.05
+MAX_SECONDS = 30.0
+
+_capture_lock = threading.Lock()
+
+
+def available() -> Tuple[bool, str]:
+    """(usable, reason). Probes for an importable ``jax.profiler``
+    with the trace API — cheap, import-only, no side effects."""
+    try:
+        import jax.profiler as _p  # noqa: F401
+    except Exception as e:  # ImportError or any init-time failure
+        return False, f"jax profiler unavailable: {type(e).__name__}"
+    if not hasattr(_p, "start_trace") or not hasattr(_p, "stop_trace"):
+        return False, "jax.profiler lacks start_trace/stop_trace"
+    return True, ""
+
+
+def _prune_spool(spool_dir: str) -> None:
+    try:
+        names = sorted(
+            n for n in os.listdir(spool_dir)
+            if os.path.isdir(os.path.join(spool_dir, n))
+        )
+    except OSError:
+        return
+    for n in names[:-SPOOL_CAP] if len(names) > SPOOL_CAP else ():
+        shutil.rmtree(os.path.join(spool_dir, n), ignore_errors=True)
+
+
+def _walk_files(root: str) -> List[dict]:
+    out = []
+    for dirpath, _dirs, files in os.walk(root):
+        for f in sorted(files):
+            p = os.path.join(dirpath, f)
+            try:
+                size = os.path.getsize(p)
+            except OSError:
+                size = 0
+            out.append({
+                "path": os.path.relpath(p, os.path.dirname(root)),
+                "bytes": size,
+            })
+    return out
+
+
+def capture(seconds: float, spool_dir: str) -> dict:
+    """Run one bounded profiler capture into a fresh spool subdir.
+
+    Returns ``{"run": name, "seconds": s, "files": [{path, bytes}]}``.
+    Raises ``RuntimeError("busy")`` if a capture is already running and
+    ``RuntimeError(reason)`` when the profiler is unavailable — the
+    HTTP layer maps those to 409 / 404."""
+    ok, reason = available()
+    if not ok:
+        raise RuntimeError(reason)
+    seconds = min(MAX_SECONDS, max(MIN_SECONDS, float(seconds)))
+    if not _capture_lock.acquire(blocking=False):
+        raise RuntimeError("busy")
+    try:
+        import jax.profiler as _p
+        run = f"prof-{int(time.time() * 1e3)}"
+        run_dir = os.path.join(spool_dir, run)
+        os.makedirs(run_dir, exist_ok=True)
+        _p.start_trace(run_dir)
+        try:
+            time.sleep(seconds)
+        finally:
+            _p.stop_trace()
+        _prune_spool(spool_dir)
+        return {
+            "run": run,
+            "seconds": seconds,
+            "files": _walk_files(run_dir),
+        }
+    finally:
+        _capture_lock.release()
+
+
+def spool_list(spool_dir: Optional[str]) -> dict:
+    """The ``GET /debug/prof`` index payload."""
+    ok, reason = available()
+    runs = []
+    if spool_dir and os.path.isdir(spool_dir):
+        for n in sorted(os.listdir(spool_dir)):
+            d = os.path.join(spool_dir, n)
+            if os.path.isdir(d):
+                runs.append({"run": n, "files": _walk_files(d)})
+    return {
+        "schema_version": 1,
+        "available": ok,
+        "reason": reason,
+        "spool_cap": SPOOL_CAP,
+        "runs": runs,
+    }
+
+
+def spool_read(spool_dir: Optional[str], rel: str) -> Optional[bytes]:
+    """Fetch one spooled file by its index-relative path; ``None`` on
+    a miss or any path that escapes the spool root."""
+    if not spool_dir:
+        return None
+    root = os.path.realpath(spool_dir)
+    path = os.path.realpath(os.path.join(root, rel))
+    if path != root and not path.startswith(root + os.sep):
+        return None
+    if not os.path.isfile(path):
+        return None
+    try:
+        with open(path, "rb") as fh:
+            return fh.read()
+    except OSError:
+        return None

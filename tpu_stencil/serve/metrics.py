@@ -13,13 +13,41 @@ observations each new sample evicts a pseudo-randomly chosen slot
 (seeded ``random.Random``), so percentile queries stay O(cap log cap)
 and memory stays bounded no matter how long the server runs — the same
 never-unbounded discipline as the request queue.
+
+Every histogram also maintains fixed cumulative buckets
+(:data:`DEFAULT_BUCKETS`, ``le``-keyed like OpenMetrics) next to the
+reservoir: bucket counts subtract cleanly between two snapshots, so the
+time-series sampler (:mod:`tpu_stencil.obs.timeseries`) can compute
+*windowed* tail quantiles and the SLO engine can count
+slower-than-threshold requests over a sliding window — reservoirs can
+do neither. When an observation lands while a trace context is bound
+(:mod:`tpu_stencil.obs.context`), the bucket keeps the latest
+``(trace_id, value)`` pair as its **exemplar**: the ``/metrics``
+exposition attaches it to the bucket line, so a populated tail bucket
+links straight to ``/debug/trace/<id>``.
 """
 
 from __future__ import annotations
 
 import random
 import threading
-from typing import Dict, List
+from bisect import bisect_left
+from typing import Dict, List, Optional, Tuple
+
+from tpu_stencil.obs.context import current as _ctx_current
+
+#: Default cumulative bucket boundaries (seconds for the latency
+#: histograms; generic log-spaced bounds otherwise — the ``+Inf``
+#: bucket makes them total either way). Chosen to straddle the serve
+#: tiers' latency range: sub-ms cache hits to multi-second cold
+#: compiles.
+DEFAULT_BUCKETS: Tuple[float, ...] = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+    0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+)
+
+#: The label value of the catch-all bucket (OpenMetrics spelling).
+INF_LE = "+Inf"
 
 
 class Counter:
@@ -73,7 +101,8 @@ class Histogram:
     observation sequence). ``count``/``sum`` stay exact regardless.
     """
 
-    def __init__(self, cap: int = 8192) -> None:
+    def __init__(self, cap: int = 8192,
+                 buckets: Tuple[float, ...] = DEFAULT_BUCKETS) -> None:
         self._lock = threading.Lock()
         self._cap = cap
         self._values: List[float] = []
@@ -81,14 +110,38 @@ class Histogram:
         self._sum = 0.0
         self._max = 0.0
         self._rng = random.Random(0)
+        self._buckets: Tuple[float, ...] = tuple(
+            sorted({float(b) for b in buckets})
+        )
+        # The bucket label strings, computed once (repr round-trips
+        # floats exactly, so snapshot keys survive the exposition's
+        # parse round-trip verbatim); the final slot is +Inf.
+        self._les: Tuple[str, ...] = tuple(
+            repr(b) for b in self._buckets
+        ) + (INF_LE,)
+        self._bucket_counts: List[int] = [0] * len(self._les)
+        # Per-bucket exemplar: the LATEST (trace_id, value) that landed
+        # in the bucket while a trace context was bound — last writer
+        # wins, so a tail bucket always names a recent straggler.
+        self._exemplars: Dict[int, Tuple[str, float]] = {}
 
     def observe(self, v: float) -> None:
         v = float(v)
+        # The bound trace context (if any) is the exemplar source; read
+        # outside the lock — one contextvar get, no allocation.
+        ctx = _ctx_current()
+        tid = ctx.trace_id if ctx is not None else ""
+        # Cumulative le semantics: the first boundary >= v owns the
+        # observation (inclusive upper bound, like OpenMetrics).
+        idx = bisect_left(self._buckets, v)
         with self._lock:
             self._count += 1
             self._sum += v
             if v > self._max:
                 self._max = v
+            self._bucket_counts[idx] += 1
+            if tid:
+                self._exemplars[idx] = (tid, v)
             if len(self._values) < self._cap:
                 self._values.append(v)
             else:
@@ -133,14 +186,31 @@ class Histogram:
         with self._lock:
             count, total, mx = self._count, self._sum, self._max
             vals = sorted(self._values)
-        return {
+            per_bucket = list(self._bucket_counts)
+            exemplars = dict(self._exemplars)
+        cum = 0
+        buckets: Dict[str, int] = {}
+        for le, n in zip(self._les, per_bucket):
+            cum += n
+            buckets[le] = cum
+        snap = {
             "count": count,
             "sum": total,
             "mean": (total / count) if count else 0.0,
             "p50": self._nearest_rank(vals, 50) if vals else 0.0,
             "p99": self._nearest_rank(vals, 99) if vals else 0.0,
             "max": mx,
+            "buckets": buckets,
         }
+        if exemplars:
+            # Keyed by bucket le; absent entirely when no traced
+            # observation has landed yet (the exposition renders —
+            # and its parser rebuilds — exactly what is here).
+            snap["exemplars"] = {
+                self._les[i]: {"trace_id": t, "value": v}
+                for i, (t, v) in sorted(exemplars.items())
+            }
+        return snap
 
 
 class Registry:
@@ -160,9 +230,12 @@ class Registry:
         with self._lock:
             return self._gauges.setdefault(name, Gauge())
 
-    def histogram(self, name: str, cap: int = 8192) -> Histogram:
+    def histogram(self, name: str, cap: int = 8192,
+                  buckets: Optional[Tuple[float, ...]] = None) -> Histogram:
         with self._lock:
-            return self._histograms.setdefault(name, Histogram(cap))
+            return self._histograms.setdefault(
+                name, Histogram(cap, buckets or DEFAULT_BUCKETS)
+            )
 
     def snapshot(self) -> dict:
         """The ``serve.stats()`` schema: plain JSON-serializable dict."""

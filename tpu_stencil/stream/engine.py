@@ -141,6 +141,11 @@ class _StageControl:
         self.failure: Optional[Tuple[str, int, BaseException]] = None
         self._stage_lock = threading.Lock()
         self.stage_seconds: Dict[str, float] = {s: 0.0 for s in _STAGES}
+        # Run start, for the live per-stage utilization gauges
+        # (stream_<stage>_busy_fraction = busy seconds / wall seconds
+        # so far): the time-series sampler turns these into the
+        # per-stage utilization trends the roofline comparison reads.
+        self.t_start = time.perf_counter()
 
     def fail(self, stage: str, frame_index: int, exc: BaseException) -> None:
         with self._fail_lock:
@@ -282,9 +287,17 @@ class _StageSpan:
             _obs_ctx.pop(self._ctx_token)
         with self._pl._stage_lock:
             self._pl.stage_seconds[self.name] += dt
+            busy = self._pl.stage_seconds[self.name]
         obs.registry().histogram(
             f"stream_{self.name}_seconds"
         ).observe(dt)
+        # Live stage utilization: busy-fraction of the run's wall clock
+        # so far (1.0 = the stage IS the pipeline's bottleneck).
+        wall = time.perf_counter() - self._pl.t_start
+        if wall > 0:
+            obs.registry().gauge(
+                f"stream_{self.name}_busy_fraction"
+            ).set(min(1.0, busy / wall))
 
 
 def _io_policy(cfg: StreamConfig) -> _retry.RetryPolicy:
