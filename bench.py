@@ -879,11 +879,20 @@ def _measure_net(platform: str) -> list:
 
     def measure_window(fe, send_crc: bool):
         """One warmed timed window against ``fe``; returns (wall,
-        per-request latencies). With ``send_crc`` the client stamps
-        X-Content-Crc32c and checks the response's X-Result-Crc32c —
-        the zero-tolerance verify rider."""
+        per-request latencies, device-seconds spent in the window).
+        With ``send_crc`` the client stamps X-Content-Crc32c and
+        checks the response's X-Result-Crc32c — the zero-tolerance
+        verify rider."""
         lats = []
         lats_lock = threading.Lock()
+
+        def dev_seconds():
+            # The engines' cost ledger fold: goodput + overhead is
+            # every second a replica's dispatch thread spent on device
+            # batches (docs/OBSERVABILITY.md 'Cost attribution').
+            c = fe.metrics_snapshot()["counters"]
+            return (c.get("fleet_goodput_device_seconds_total", 0.0)
+                    + c.get("fleet_overhead_device_seconds_total", 0.0))
 
         def post():
             headers = {"X-Content-Crc32c": body_crc} if send_crc else {}
@@ -911,11 +920,13 @@ def _measure_net(platform: str) -> list:
         for rep in fe.fleet.replicas:
             rep.submit(img, REPS).result(timeout=CHILD_TIMEOUT)
         lats.clear()
+        dev0 = dev_seconds()
         t0 = time.perf_counter()
         with concurrent.futures.ThreadPoolExecutor(conc) as pool:
             for f in [pool.submit(post) for _ in range(n_req)]:
                 f.result(timeout=CHILD_TIMEOUT)
-        return time.perf_counter() - t0, sorted(lats)
+        wall = time.perf_counter() - t0
+        return wall, sorted(lats), dev_seconds() - dev0
 
     # The headline window runs the PRODUCTION config (integrity on,
     # default witness rate) with the client verifying every response.
@@ -925,11 +936,11 @@ def _measure_net(platform: str) -> list:
         # Best-of-2 windows per arm: the A/B subtracts two small
         # numbers, so per-window scheduler noise would otherwise
         # dominate the overhead rider.
-        (wall, lats), (wall2, lats2) = (
+        (wall, lats, dev_s), (wall2, lats2, dev_s2) = (
             measure_window(fe, send_crc=True) for _ in range(2)
         )
         if wall2 < wall:
-            wall, lats = wall2, lats2
+            wall, lats, dev_s = wall2, lats2, dev_s2
         snap = fe.metrics_snapshot()
     finally:
         fe.close()
@@ -1027,6 +1038,13 @@ def _measure_net(platform: str) -> list:
         "coalesced_requests_total": snap_co["counters"].get(
             "coalesced_requests_total", 0
         ),
+        # Capacity rider: device-seconds spent inside the headline
+        # window over the replicas' wall budget — how busy the fleet
+        # actually was while posting the headline number (the same
+        # goodput+overhead fold GET /debug/capacity reads live).
+        "device_seconds": round(dev_s, 6),
+        "device_utilization": round(dev_s / (wall * n_rep), 4)
+        if wall > 0 else 0.0,
         "ts": round(time.monotonic(), 6),
         **common,
     })

@@ -1,0 +1,530 @@
+"""Cost-attribution and capacity plane (PR 18): per-request resource
+ledgers, tenant metering, and the saturation/headroom endpoints.
+
+The contract under test is docs/OBSERVABILITY.md ("Cost attribution
+and capacity") + docs/DEPLOY.md ("Reading headroom"):
+
+* the ledger accumulates per-tier spend under its contextvar binding
+  and sanitizes hostile tenant names before they reach metric names;
+* every 200 echoes ``X-Cost-Device-Us`` / ``X-Cost-Queue-Us`` /
+  ``X-Cost-Source`` and folds into ``/debug/tenants`` (a cache hit
+  answers ``source: cache`` with zero device spend and a recorded
+  saving);
+* ``/statusz`` surfaces the raw Retry-After intermediate terms and
+  ``/debug/capacity`` inverts them into utilization/headroom;
+* THE acceptance equation: under mixed load (hot tenant, coalescing
+  on, result cache on, witness sampling on) the per-tenant ledger
+  device-seconds plus accounted overhead matches the engines' total
+  measured batch-dispatch wall within 5%;
+* the fed ``/debug/tenants`` / ``/debug/capacity`` merges survive a
+  member killed -9 — live members fresh, the dead one an explicit
+  stale entry — and the merged tenant totals agree with the client's
+  own 200 count (a hedged/rerouted request never double-counts).
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from tpu_stencil import filters, obs
+from tpu_stencil.config import FedConfig, NetConfig
+from tpu_stencil.obs import ledger as oledger
+from tpu_stencil.ops import stencil
+from tpu_stencil.resilience import faults
+from tpu_stencil.serve.metrics import Registry
+
+pytestmark = pytest.mark.filterwarnings("ignore::ResourceWarning")
+
+EDGES = (8, 16, 32, 64)
+REPS = 2
+
+
+@pytest.fixture(autouse=True)
+def _fresh_obs():
+    obs.reset()
+    yield
+    obs.reset()
+    faults.clear()
+
+
+def _golden(img, reps, name="gaussian"):
+    return stencil.reference_stencil_numpy(
+        img, filters.get_filter(name), reps
+    )
+
+
+def _post(url, img, reps, tenant=None, http_timeout=120.0):
+    h, w = img.shape[:2]
+    channels = img.shape[2] if img.ndim == 3 else 1
+    headers = {"X-Width": str(w), "X-Height": str(h),
+               "X-Reps": str(reps), "X-Channels": str(channels)}
+    if tenant is not None:
+        headers[oledger.TENANT_HEADER] = tenant
+    req = urllib.request.Request(url + "/v1/blur", data=img.tobytes(),
+                                 headers=headers, method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=http_timeout) as r:
+            return r.status, r.read(), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, e.read(), dict(e.headers)
+
+
+def _get(url, path, http_timeout=60.0):
+    try:
+        with urllib.request.urlopen(url + path, timeout=http_timeout) as r:
+            return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def _make_net(**overrides):
+    from tpu_stencil.net import NetFrontend
+
+    kw = dict(port=0, replicas=1, bucket_edges=EDGES, max_queue=64)
+    kw.update(overrides)
+    return NetFrontend(NetConfig(**kw)).start()
+
+
+# -- ledger + sanitization units ----------------------------------------
+
+
+def test_sanitize_tenant_guards_the_wire():
+    assert oledger.sanitize_tenant("team-a") == "team_a"
+    assert oledger.sanitize_tenant("a.b.c") == "a_b_c"
+    assert oledger.sanitize_tenant("ok_123") == "ok_123"
+    # Hostile/invalid values collapse to the default, never into
+    # metric names: spaces, emptiness, non-strings, oversize.
+    assert oledger.sanitize_tenant("two words") == oledger.DEFAULT_TENANT
+    assert oledger.sanitize_tenant("") == oledger.DEFAULT_TENANT
+    assert oledger.sanitize_tenant(None) == oledger.DEFAULT_TENANT
+    assert oledger.sanitize_tenant(42) == oledger.DEFAULT_TENANT
+    assert oledger.sanitize_tenant("x" * 65) == oledger.DEFAULT_TENANT
+    assert oledger.sanitize_tenant("a/b{c}") == oledger.DEFAULT_TENANT
+
+
+def test_ledger_accumulates_and_reads_back_us():
+    led = oledger.RequestLedger("t1")
+    led.add_queue(0.010)
+    led.add_coalesce(0.002)
+    led.add_ingest(0.001)
+    led.add_device(0.5, h2d_bytes=1000, d2h_bytes=2000)
+    led.add_device(0.25, h2d_bytes=500)
+    led.add_device(-1.0)  # negative spend never subtracts
+    snap = led.snapshot()
+    assert snap["device_s"] == pytest.approx(0.75)
+    assert snap["h2d_bytes"] == 1500 and snap["d2h_bytes"] == 2000
+    assert led.device_us == 750000
+    # Queue-Us is engine queue wait PLUS the coalesce-window hold.
+    assert led.queue_us == 12000
+    assert snap["source"] == "compute" and snap["kind"] == "request"
+
+
+def test_ledger_contextvar_binding_and_explicit_clear():
+    assert oledger.current() is None
+    led = oledger.RequestLedger("t")
+    with oledger.bind(led):
+        assert oledger.current() is led
+        # bind(None) explicitly clears — a warm submit on a handler
+        # thread must not charge the client's ledger.
+        with oledger.bind(None):
+            assert oledger.current() is None
+        assert oledger.current() is led
+    assert oledger.current() is None
+    tok = oledger.push(led)
+    assert oledger.current() is led
+    oledger.pop(tok)
+    assert oledger.current() is None
+
+
+def test_tenant_meter_records_rejects_and_ratios():
+    reg = Registry()
+    meter = oledger.TenantMeter(reg)
+    led = oledger.RequestLedger("alpha")
+    led.add_device(0.5)
+    led.add_queue(0.1)
+    meter.record(led, bytes_in=100, bytes_out=300)
+    hit = oledger.RequestLedger("alpha")
+    hit.set_source("cache")
+    hit.saved_device_s = 0.5
+    meter.record(hit, bytes_in=100, bytes_out=300)
+    meter.reject("alpha", 429)
+    meter.reject("alpha", 503)
+    row = meter.snapshot()["alpha"]
+    assert row["requests"] == 2 and row["offered"] == 4
+    assert row["device_seconds"] == pytest.approx(0.5)
+    assert row["queue_seconds"] == pytest.approx(0.1)
+    assert row["bytes_in"] == 200 and row["bytes_out"] == 600
+    assert row["cache_hits"] == 1 and row["cache_hit_ratio"] == 0.5
+    assert row["saved_device_seconds"] == pytest.approx(0.5)
+    assert row["rejected_429"] == 1 and row["shed_503"] == 1
+    c = reg.snapshot()["counters"]
+    assert c["tenant_alpha_requests_total"] == 2
+    assert c["tenant_alpha_device_seconds_total"] == pytest.approx(0.5)
+
+
+def test_tenant_meter_cardinality_caps_into_overflow():
+    reg = Registry()
+    meter = oledger.TenantMeter(reg)
+    for i in range(oledger.TENANT_CAP + 5):
+        led = oledger.RequestLedger(f"t{i:03d}")
+        led.add_device(0.001)
+        meter.record(led, bytes_in=1, bytes_out=1)
+    rows = meter.snapshot()
+    assert len(rows) == oledger.TENANT_CAP + 1  # cap + the overflow row
+    assert rows[oledger.OVERFLOW_TENANT]["requests"] == 5
+    c = reg.snapshot()["counters"]
+    # Past the cap the METRIC folds into the overflow bucket too —
+    # the registry must never mint unbounded tenant names.
+    assert c[f"tenant_{oledger.OVERFLOW_TENANT}_requests_total"] == 5
+    minted = [k for k in c if k.startswith("tenant_")
+              and k.endswith("_requests_total")]
+    assert len(minted) == oledger.TENANT_CAP + 1
+
+
+# -- loadgen rollup ------------------------------------------------------
+
+
+def test_loadgen_cost_rollup_reads_cost_headers():
+    from tpu_stencil.serve.loadgen import HttpTarget
+
+    t = HttpTarget("http://127.0.0.1:1", tenant="smoke")
+    t._tally_cost({"X-Cost-Device-Us": "1500",
+                   "X-Cost-Queue-Us": "250",
+                   "X-Cost-Source": "compute"})
+    t._tally_cost({"X-Cost-Device-Us": "0",
+                   "X-Cost-Source": "cache"})
+    t._tally_cost({})                              # old tier: no headers
+    t._tally_cost({"X-Cost-Device-Us": "bogus"})   # malformed: dropped
+    snap = t.cost_snapshot()
+    assert snap["tenant"] == "smoke" and snap["responses"] == 2
+    assert snap["device_us"] == 1500 and snap["queue_us"] == 250
+    assert snap["device_seconds"] == pytest.approx(0.0015)
+    assert snap["by_source"] == {"compute": 1, "cache": 1}
+
+
+# -- net tier integration ------------------------------------------------
+
+
+def test_net_cost_headers_tenants_and_capacity(rng):
+    fe = _make_net(sample_interval_s=0.05)
+    try:
+        img = rng.integers(0, 256, (12, 10), dtype=np.uint8)
+        status, body, headers = _post(fe.url, img, REPS,
+                                      tenant="team-a")
+        assert status == 200 and body == _golden(img, REPS).tobytes()
+        assert int(headers["X-Cost-Device-Us"]) > 0
+        assert int(headers["X-Cost-Queue-Us"]) >= 0
+        assert headers["X-Cost-Source"] == "compute"
+        # An unparseable tenant meters under the default, not a 4xx —
+        # cost attribution is additive, never an admission gate.
+        status, _, _ = _post(fe.url, img, REPS, tenant="two words")
+        assert status == 200
+        # /statusz surfaces the raw Retry-After intermediate terms.
+        st = json.loads(_get(fe.url, "/statusz")[1])
+        terms = st["retry_after"]
+        assert {"backlog", "slots", "coalesce_window_s",
+                "coalesce_delay_p50_s", "mean_request_latency_s",
+                "service_rate_rps", "cap_s"} <= set(terms)
+        assert terms["slots"] >= 1 and terms["backlog"] == 0
+        assert terms["service_rate_rps"] > 0
+        # /debug/tenants: the sanitized row with real spend.
+        doc = json.loads(_get(fe.url, "/debug/tenants")[1])
+        assert doc["schema_version"] == 1 and doc["source"] == "net"
+        row = doc["tenants"]["team_a"]
+        assert row["requests"] == 1 and row["device_seconds"] > 0
+        assert row["bytes_in"] == img.nbytes
+        assert row["bytes_out"] == img.nbytes
+        assert doc["tenants"][oledger.DEFAULT_TENANT]["requests"] == 1
+        c = fe.metrics_snapshot()["counters"]
+        assert c["tenant_team_a_requests_total"] == 1
+        assert c["tenant_team_a_device_seconds_total"] > 0
+        # /debug/capacity: versioned, static terms always present.
+        doc = json.loads(_get(fe.url, "/debug/capacity?window=60")[1])
+        assert doc["schema_version"] == 1 and doc["source"] == "net"
+        assert doc["retry_after"]["slots"] == terms["slots"]
+        assert 0.0 <= doc["utilization"]["slot_fraction"] <= 1.0
+        assert doc["per_replica"]
+        for rep in doc["per_replica"].values():
+            assert 0.0 <= rep["busy_fraction"] <= 1.0
+        assert doc["bandwidth"]["roofline_gbps"] > 0
+        assert doc["service_rate_rps"] > 0
+        assert _get(fe.url, "/debug/capacity?window=bogus")[0] == 400
+        assert _get(fe.url, "/debug/capacity?window=-5")[0] == 400
+        # With the sampler on, the windowed terms fill in once the
+        # tick lands the served traffic.
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            doc = json.loads(
+                _get(fe.url, "/debug/capacity?window=60")[1]
+            )
+            if doc["achieved_rps"]:
+                break
+            time.sleep(0.05)
+        assert doc["achieved_rps"] > 0
+        assert doc["headroom_rps"] is not None
+        assert doc["headroom_rps"] <= doc["service_rate_rps"]
+        assert doc["bandwidth"]["achieved_gbps"] is not None
+        assert doc["bandwidth"]["roofline_fraction"] is not None
+    finally:
+        fe.close()
+
+
+def test_net_cache_hit_answers_source_cache_with_saving(rng):
+    fe = _make_net(result_cache_mb=8)
+    try:
+        img = rng.integers(0, 256, (12, 10), dtype=np.uint8)
+        status, body, h1 = _post(fe.url, img, REPS, tenant="hot")
+        assert status == 200 and h1["X-Cost-Source"] == "compute"
+        cold_us = int(h1["X-Cost-Device-Us"])
+        assert cold_us > 0
+        status, body2, h2 = _post(fe.url, img, REPS, tenant="hot")
+        assert status == 200 and body2 == body
+        assert h2["X-Cache"] == "hit"
+        assert h2["X-Cost-Source"] == "cache"
+        # A hit spends NO device time; the saving is what the stored
+        # entry cost to compute when it was admitted.
+        assert int(h2["X-Cost-Device-Us"]) == 0
+        row = json.loads(
+            _get(fe.url, "/debug/tenants")[1]
+        )["tenants"]["hot"]
+        assert row["requests"] == 2 and row["cache_hits"] == 1
+        assert row["cache_hit_ratio"] == 0.5
+        assert row["saved_device_seconds"] == pytest.approx(
+            cold_us / 1e6, rel=0.01
+        )
+        c = fe.metrics_snapshot()["counters"]
+        assert c["result_cache_saved_device_seconds_total"] > 0
+    finally:
+        fe.close()
+
+
+# -- THE acceptance equation --------------------------------------------
+
+
+@pytest.mark.chaos
+def test_conservation_mixed_load_within_5pct(rng):
+    """ISSUE 18 acceptance: hot tenant + coalescing + result cache +
+    witness sampling, then the books must balance — every engine's
+    measured batch-dispatch wall equals goodput + (overhead minus the
+    witness re-execution that never rode a batch), and the tenant
+    meters hold exactly the goodput side."""
+    fe = _make_net(result_cache_mb=8, coalesce_window_us=2000.0,
+                   witness_rate=0.5)
+    try:
+        hot = rng.integers(0, 256, (12, 10), dtype=np.uint8)
+        imgs = [rng.integers(0, 256, (10 + 2 * i, 10), dtype=np.uint8)
+                for i in range(4)]
+        errs = []
+
+        def drive(tenant, frames):
+            for f in frames:
+                try:
+                    status, _, _ = _post(fe.url, f, REPS, tenant=tenant)
+                    assert status == 200, status
+                except Exception as e:  # pragma: no cover - diagnostic
+                    errs.append(e)
+
+        threads = [
+            threading.Thread(target=drive, args=("hot", [hot] * 8)),
+            threading.Thread(target=drive, args=("hot", [hot] * 4)),
+            threading.Thread(target=drive, args=("anon", imgs)),
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert not errs, errs
+
+        # Metering lands AFTER the 200 hits the wire, so the client
+        # threads can finish a beat before the handler threads meter —
+        # give the meters a moment to settle to the full request count.
+        want = 12 + len(imgs)
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            got = sum(row["requests"]
+                      for row in fe.tenants.snapshot().values())
+            if got >= want:
+                break
+            time.sleep(0.02)
+
+        batch_wall = goodput = overhead = witness = 0.0
+        for rep in fe.fleet.replicas:
+            snap = rep.registry.snapshot()
+            batch_wall += snap["histograms"][
+                "batch_latency_seconds"]["sum"]
+            c = snap["counters"]
+            goodput += c.get("goodput_device_seconds_total", 0.0)
+            overhead += c.get("overhead_device_seconds_total", 0.0)
+            witness += c.get("witness_device_seconds_total", 0.0)
+        net_c = fe.registry.snapshot()["counters"]
+        cancelled = net_c.get(
+            "cancelled_response_device_seconds_total", 0.0
+        )
+        tenant_dev = sum(
+            row["device_seconds"]
+            for row in fe.tenants.snapshot().values()
+        )
+        assert batch_wall > 0 and witness > 0  # the mix really mixed
+        # Every batch's wall lands in exactly one bucket: goodput or
+        # non-witness overhead (witness re-execution is overhead that
+        # never rode a batch dispatch, so it subtracts out here).
+        accounted = goodput + (overhead - witness)
+        assert accounted == pytest.approx(batch_wall, rel=0.05), (
+            accounted, batch_wall, goodput, overhead, witness
+        )
+        # ...and the tenant meters hold the goodput side: every
+        # successfully answered request's share, nothing else.
+        assert tenant_dev + cancelled == pytest.approx(
+            goodput, rel=0.05
+        ), (tenant_dev, cancelled, goodput)
+        # The hot tenant's bill dwarfs the background's — per-tenant
+        # attribution separates the spenders.
+        rows = fe.tenants.snapshot()
+        assert rows["hot"]["device_seconds"] > 0
+        assert rows["hot"]["requests"] == 12
+        assert rows["anon"]["requests"] == len(imgs)
+    finally:
+        fe.close()
+
+
+# -- federation: merge + kill -9 ----------------------------------------
+
+
+def _spawn_member(extra=()):
+    repo = os.path.join(os.path.dirname(__file__), os.pardir)
+    argv = [sys.executable, "-m", "tpu_stencil", "net", "--port", "0",
+            "--replicas", "1", "--platform", "cpu",
+            "--drain-timeout", "60"] + list(extra)
+    proc = subprocess.Popen(
+        argv, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True, cwd=repo,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+    )
+    line = proc.stdout.readline()
+    assert "net: serving on http://" in line, (
+        line, proc.stderr.read()[-2000:]
+    )
+    return proc, line.split()[3]
+
+
+def _reap(proc):
+    if proc.poll() is None:
+        proc.kill()
+        proc.wait(timeout=30)
+    proc.stdout.close()
+    proc.stderr.close()
+
+
+@pytest.mark.chaos
+def test_fed_tenants_and_capacity_merge_survive_kill9(rng):
+    """Satellite: the fed /debug/tenants and /debug/capacity merges
+    under kill -9 — the live member stays fresh, the dead one is an
+    explicit stale entry (scrape-failure counters tick), and the
+    merged tenant totals equal the client's own 200 count: a request
+    rerouted or hedged across members never double-counts. Two layers
+    enforce that: a member only meters after it successfully WROTE
+    the 200, and the fed subtracts hedge losers whose small 200 still
+    landed in socket buffers before cancel() could stop the write
+    (the ``hedge_discards`` reconciliation)."""
+    from tpu_stencil.fed import FedFrontend, host_id_for
+
+    p1, url1 = _spawn_member(extra=("--sample-interval", "0.2"))
+    p2, url2 = _spawn_member(extra=("--sample-interval", "0.2"))
+    fed = None
+    try:
+        fed = FedFrontend(FedConfig(
+            port=0, members=(url1, url2), heartbeat_interval_s=10.0,
+            sample_interval_s=0.1, breaker_threshold=2,
+        )).start()
+        img = rng.integers(0, 256, (12, 10), dtype=np.uint8)
+        ok = 0
+        for _ in range(6):
+            status, body, headers = _post(fed.url, img, REPS,
+                                          tenant="hot")
+            assert status == 200
+            assert body == _golden(img, REPS).tobytes()
+            # The member's cost headers pass through the fed hop.
+            assert "X-Cost-Source" in headers
+            ok += 1
+        id1, id2 = host_id_for(url1), host_id_for(url2)
+        doc = json.loads(_get(fed.url, "/debug/tenants",
+                              http_timeout=30.0)[1])
+        assert doc["schema_version"] == 1 and doc["source"] == "fed"
+        assert set(doc["members"]) == {id1, id2}
+        assert not doc["members"][id1]["stale"]
+        assert not doc["members"][id2]["stale"]
+        # The merge agrees with the members' own meters AND with the
+        # client's own count of successful answers.
+        member_sum = sum(
+            m["tenants"].get("hot", {}).get("requests", 0)
+            for m in doc["members"].values()
+        )
+        disc = doc["hedge_discards"].get("hot", {}).get("requests", 0)
+        # Raw member meters may include hedge losers whose 200 the fed
+        # discarded; the reconciled merge matches the client exactly.
+        assert member_sum == ok + disc
+        assert doc["tenants"]["hot"]["requests"] == ok
+        live_before = doc["members"][id1]["tenants"].get(
+            "hot", {}).get("requests", 0)
+        live_disc_before = fed.router.hedge_discards({id1}).get(
+            "hot", {}).get("requests", 0)
+        assert doc["tenants"]["hot"]["device_seconds"] > 0
+        # The fed-local quota view rides along.
+        assert doc["fed"]["hot"]["admitted_total"] == ok
+        assert doc["fed"]["hot"]["quota"] >= 1
+        assert doc["fed"]["hot"]["outstanding"] == 0
+
+        # Kill -9 one member mid-fleet; traffic must keep flowing and
+        # the merges must answer well-formed and bounded.
+        os.kill(p2.pid, signal.SIGKILL)
+        p2.wait(timeout=30)
+        for _ in range(2):
+            status, _, _ = _post(fed.url, img, REPS, tenant="hot",
+                                 http_timeout=60.0)
+            assert status == 200
+            ok += 1
+        t0 = time.monotonic()
+        status, raw = _get(fed.url, "/debug/tenants",
+                           http_timeout=30.0)
+        assert status == 200 and time.monotonic() - t0 < 15.0
+        doc = json.loads(raw)
+        dead = doc["members"][id2]
+        assert dead["stale"] and "error" in dead
+        assert not doc["members"][id1]["stale"]
+        # Only live members feed the merge; the survivor holds every
+        # 200 the dead member did not successfully write, minus any
+        # hedge losers the fed discarded on the survivor itself.
+        live_hot = doc["members"][id1]["tenants"]["hot"]["requests"]
+        live_disc = doc["hedge_discards"].get(
+            "hot", {}).get("requests", 0)
+        assert doc["tenants"]["hot"]["requests"] == live_hot - live_disc
+        # The two post-kill 200s landed ONCE each on the survivor —
+        # rerouting/hedging across the dead member never double-bills
+        # (a hedge to the corpse fails at connect, so it can't mint a
+        # discarded 200; compare reconciled counts on both sides).
+        assert live_hot - live_disc == live_before - live_disc_before + 2
+        # The capacity merge: one fresh member summed, the dead one
+        # an explicit stale entry, never a hang.
+        doc = json.loads(_get(fed.url, "/debug/capacity?window=60",
+                              http_timeout=30.0)[1])
+        assert doc["schema_version"] == 1 and doc["source"] == "fed"
+        assert doc["members_live"] == 2 and doc["members_fresh"] == 1
+        assert doc["members"][id2]["stale"]
+        assert doc["headroom_rps"] is not None
+        assert doc["utilization"]["max_member_slot_fraction"] is not None
+        snap = fed.metrics_snapshot()
+        assert snap["counters"]["member_scrape_failures_total"] >= 2
+    finally:
+        if fed is not None:
+            fed.close()
+        _reap(p1)
+        _reap(p2)

@@ -67,14 +67,16 @@ class ResultCache:
         return self.flights.join(key)
 
     def complete(self, key: tuple, payload: bytes, stamp: Optional[str],
-                 replica: int, token: int) -> bool:
+                 replica: int, token: int, device_us: int = 0) -> bool:
         """Leader success: admit (subject to the distrust fence) and
         resolve every follower with the true bytes. Followers get the
         result even when admission is refused — refusal is about the
         STORE not trusting the replica going forward, while these
         specific bytes already passed the same path a cache-off
-        response takes."""
-        admitted = self.store.put(key, payload, stamp, replica, token)
+        response takes. ``device_us`` is what the leader's compute
+        cost — stored so a later hit can report its avoided spend."""
+        admitted = self.store.put(key, payload, stamp, replica, token,
+                                  device_us=device_us)
         self.flights.resolve(key, (payload, stamp, replica))
         return admitted
 

@@ -48,16 +48,19 @@ _CAUSES = ("witness_mismatch", "quarantine", "clear")
 
 class Entry:
     """One cached result: payload bytes, the integrity stamp that was
-    served with the cold response (None when integrity is off), and the
-    producing replica index."""
+    served with the cold response (None when integrity is off), the
+    producing replica index, and what the entry cost to compute
+    (device microseconds — a later hit reports this as its avoided
+    spend in the cost ledger)."""
 
-    __slots__ = ("payload", "stamp", "replica")
+    __slots__ = ("payload", "stamp", "replica", "device_us")
 
     def __init__(self, payload: bytes, stamp: Optional[str],
-                 replica: int) -> None:
+                 replica: int, device_us: int = 0) -> None:
         self.payload = payload
         self.stamp = stamp
         self.replica = replica
+        self.device_us = int(device_us)
 
 
 class ResultStore:
@@ -122,7 +125,7 @@ class ResultStore:
             return ent
 
     def put(self, key: tuple, payload: bytes, stamp: Optional[str],
-            replica: int, token: int) -> bool:
+            replica: int, token: int, device_us: int = 0) -> bool:
         """Admit one result. Returns False (counted) when the producer
         is distrusted — currently quarantined, or invalidated since
         ``token`` was drawn — or when the payload alone exceeds the
@@ -145,7 +148,8 @@ class ResultStore:
                 old = self._entries.pop(key, None)
                 if old is not None:
                     self._drop_locked(key, old)
-                self._entries[key] = Entry(payload, stamp, replica)
+                self._entries[key] = Entry(payload, stamp, replica,
+                                           device_us)
                 self._by_replica.setdefault(replica, set()).add(key)
                 self._bytes += nbytes
                 self._m_inserts.inc()

@@ -201,7 +201,8 @@ def main(argv=None) -> int:
         f"tenant quota {cfg.tenant_quota}); "
         f"POST /v1/blur /admin/register /admin/drain, "
         f"GET /healthz /metrics /statusz /debug/trace/<id> "
-        f"/debug/flightrec /debug/timeseries; SIGTERM drains",
+        f"/debug/flightrec /debug/timeseries /debug/capacity "
+        f"/debug/tenants; SIGTERM drains",
         flush=True,
     )
     # Timed waits (the net CLI's signal-liveness discipline).

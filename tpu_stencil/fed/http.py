@@ -104,6 +104,10 @@ _FORWARD_HEADERS = (
     # which re-validates it — the fed edge's own validation (below) does
     # not spend the member's trust.
     ("X-Content-Crc32c", "crc32c"),
+    # The tenant rides to the member so its cost ledger meters the SAME
+    # identity the fed quota machinery admitted — /debug/tenants at
+    # both tiers agrees on who spent what.
+    ("X-Tenant", "tenant"),
 )
 
 
@@ -202,6 +206,15 @@ class _FedHandler(BaseHTTPRequestHandler):
             )
         elif path == "/debug/timeseries":
             self._debug_timeseries(parse_qs(urlsplit(self.path).query))
+        elif path == "/debug/capacity":
+            self._debug_capacity(parse_qs(urlsplit(self.path).query))
+        elif path == "/debug/tenants":
+            self._respond(
+                200,
+                json.dumps(self.fe.debug_tenants(), indent=2,
+                           sort_keys=True).encode(),
+                content_type="application/json",
+            )
         elif path == "/debug/prof" or path.startswith("/debug/prof/"):
             # The federation tier is deliberately jax-free: the
             # profiler endpoint exists but is 404-clean, pointing the
@@ -238,6 +251,17 @@ class _FedHandler(BaseHTTPRequestHandler):
                              "seconds")
             return
         payload = self.fe.debug_timeseries(window_s)
+        self._respond(200, json.dumps(payload, indent=2,
+                                      sort_keys=True).encode(),
+                      content_type="application/json")
+
+    def _debug_capacity(self, query: dict) -> None:
+        window_s = _parse_window(query)
+        if window_s is None:
+            self._error(400, "window must be a positive number of "
+                             "seconds")
+            return
+        payload = self.fe.debug_capacity(window_s)
         self._respond(200, json.dumps(payload, indent=2,
                                       sort_keys=True).encode(),
                       content_type="application/json")
@@ -854,6 +878,141 @@ class FedFrontend:
             "window_s": float(window_s),
             "source": "fed",
             "fed": local,
+            "members": members,
+        }
+
+    def _fan_members(self, path: str, prefix: str) -> Dict[str, dict]:
+        """Fan one GET to every live member with the
+        ``/debug/timeseries`` staleness discipline: a fresh answer is
+        stamped ``stale=False``/``scrape_age_s=0``; a failed member
+        surfaces as an explicit ``stale`` entry carrying its last-good
+        scrape age and the scrape-failure counter ticks — never silent
+        absence, never a hang (one dead member costs one timeout)."""
+        import concurrent.futures
+
+        def fetch(m) -> dict:
+            with urllib.request.urlopen(m.url + path, timeout=5.0) as r:
+                return json.loads(r.read())
+
+        members: Dict[str, dict] = {}
+        live = [m for m in self.membership.members()
+                if m.state != "evicted"]
+        if live:
+            with concurrent.futures.ThreadPoolExecutor(
+                max_workers=min(8, len(live)),
+                thread_name_prefix=prefix,
+            ) as pool:
+                futs = [(m, pool.submit(fetch, m)) for m in live]
+                now = time.monotonic()
+                for m, fut in futs:
+                    try:
+                        doc = fut.result()
+                        self._last_scrape_ok[m.host_id] = (
+                            time.monotonic()
+                        )
+                        doc["stale"] = False
+                        doc["scrape_age_s"] = 0.0
+                        members[m.host_id] = doc
+                    except Exception as e:
+                        self.registry.counter(
+                            "member_scrape_failures_total"
+                        ).inc()
+                        last = self._last_scrape_ok.get(m.host_id)
+                        members[m.host_id] = {
+                            "stale": True,
+                            "error": f"{type(e).__name__}: {e}",
+                            "scrape_age_s": (
+                                round(now - last, 3)
+                                if last is not None else -1.0
+                            ),
+                        }
+        return members
+
+    def debug_tenants(self) -> dict:
+        """The fed ``GET /debug/tenants`` body: every live member's
+        metering table fanned + merged (numeric fields summed across
+        fresh members — a stale member contributes its staleness entry,
+        never phantom numbers), next to the fed-local quota view. A
+        hedged request only ever counts once in the merge: the losing
+        member's write failed, so its meter never recorded the
+        request."""
+        members = self._fan_members("/debug/tenants",
+                                    "tpu-stencil-fed-tenants")
+        merged: Dict[str, dict] = {}
+        fresh_ids = set()
+        for hid, doc in members.items():
+            if doc.get("stale"):
+                continue
+            fresh_ids.add(hid)
+            for tenant, row in doc.get("tenants", {}).items():
+                agg = merged.setdefault(tenant, {})
+                for k, v in row.items():
+                    if isinstance(v, (int, float)):
+                        agg[k] = agg.get(k, 0) + v
+        # Reconcile hedge losers: a cancelled attempt whose 200 was
+        # already written got billed by its member, but nobody received
+        # it — subtract those (only for members actually folded) so
+        # the merged totals count every delivered answer exactly once.
+        discards = self.router.hedge_discards(fresh_ids)
+        for tenant, d in discards.items():
+            row = merged.get(tenant)
+            if row is None:
+                continue
+            row["requests"] = max(
+                0, row.get("requests", 0) - d["requests"]
+            )
+            row["device_seconds"] = max(
+                0.0, row.get("device_seconds", 0.0)
+                - d["device_seconds"]
+            )
+        for row in merged.values():
+            # Ratios do not sum — recompute from the merged counts.
+            req = row.get("requests", 0)
+            row["cache_hit_ratio"] = (
+                row.get("cache_hits", 0) / req if req else 0.0
+            )
+        return {
+            "schema_version": 1,
+            "source": "fed",
+            "fed": self.router.tenant_stats(),
+            "tenants": merged,
+            "hedge_discards": discards,
+            "members": members,
+        }
+
+    def debug_capacity(self, window_s: float) -> dict:
+        """The fed ``GET /debug/capacity`` body: every live member's
+        capacity answer fanned + merged. Headroom SUMS across fresh
+        members (rps the federation can still absorb); utilization
+        reports the hottest member (the saturation bottleneck);
+        time-to-saturation is the earliest projected across members.
+        Stale members are excluded from the aggregates and carried as
+        explicit staleness entries."""
+        members = self._fan_members(
+            f"/debug/capacity?window={window_s:g}",
+            "tpu-stencil-fed-capacity",
+        )
+        fresh = [doc for doc in members.values()
+                 if not doc.get("stale")]
+        headrooms = [doc["headroom_rps"] for doc in fresh
+                     if doc.get("headroom_rps") is not None]
+        utils = [doc["utilization"]["slot_fraction"] for doc in fresh
+                 if doc.get("utilization")]
+        sat = [doc["time_to_saturation_s"] for doc in fresh
+               if doc.get("time_to_saturation_s") is not None]
+        return {
+            "schema_version": 1,
+            "source": "fed",
+            "window_s": float(window_s),
+            "members_live": len(members),
+            "members_fresh": len(fresh),
+            "headroom_rps": sum(headrooms) if headrooms else None,
+            "utilization": {
+                "max_member_slot_fraction": max(utils) if utils
+                else None,
+            },
+            "time_to_saturation_s": min(sat) if sat else None,
+            "outstanding": self.router.outstanding(),
             "members": members,
         }
 

@@ -80,6 +80,7 @@ from tpu_stencil.fed.membership import Member, Membership
 from tpu_stencil.net.router import Draining, Overloaded
 from tpu_stencil.obs import context as _obs_ctx
 from tpu_stencil.obs import events as _obs_events
+from tpu_stencil.obs import ledger as _obs_ledger
 from tpu_stencil.obs import span as _obs_span
 from tpu_stencil.resilience.errors import (
     DeadlineExceeded,
@@ -267,6 +268,24 @@ class _Attempt:
             # release any half-open probe slot, record nothing.
             r.breakers.get(hid).release_probe()
             r.registry.counter("hedge_cancelled_total").inc()
+            r.registry.counter("hedge_cancelled_seconds_total").inc(
+                self.elapsed
+            )
+            if kind == "resp" and payload[0] == 200:
+                # The loser's 200 was fully written before the cancel
+                # landed: the member's meter billed a response nobody
+                # received. Note it (with the member's own cost stamp)
+                # so the fed /debug/tenants merge can reconcile — the
+                # no-double-count guarantee for hedged requests.
+                rh = payload[1]
+                tenant = _obs_ledger.sanitize_tenant(
+                    self.headers.get("X-Tenant")
+                )
+                try:
+                    dev_us = int(rh.get("x-cost-device-us") or 0)
+                except ValueError:
+                    dev_us = 0
+                r._note_hedge_discard(hid, tenant, dev_us)
         elif kind == "resp":
             status = payload[0]
             if status >= 500 and status not in (503, 504):
@@ -322,6 +341,19 @@ class FedRouter:
         self._m_hedges = m.counter("hedges_total")
         self._m_hedge_wins = m.counter("hedge_wins_total")
         m.counter("hedge_cancelled_total")
+        # Wall time burned by cancelled hedge losers — non-goodput
+        # spend the cost plane keeps visible (the member's own
+        # cancelled_response_* counters carry the device-time half).
+        m.counter("hedge_cancelled_seconds_total")
+        # Cumulative per-tenant admission ledger (bounded like the
+        # obs.ledger tenant table) — the quota machinery has enforced
+        # blind until now; /debug/tenants reads this back.
+        self._tenant_admitted: Dict[str, int] = {}
+        self._tenant_rejected: Dict[str, int] = {}
+        # (host_id, tenant) -> the hedge-loser 200s that member wrote
+        # but the race discarded; /debug/tenants subtracts them.
+        self._hedge_discards: Dict[tuple, dict] = {}
+        m.counter("hedge_discarded_200_total")
         self._m_affinity = m.counter("affinity_routed_total")
         self._m_inflight = m.gauge("inflight_bytes")
         self._g_tenants = m.gauge("tenants_active")
@@ -385,6 +417,10 @@ class FedRouter:
             if cur >= quota:
                 self._m_tenant_rej.inc()
                 self._m_rejected.inc()
+                key = self._stat_key_locked(tenant)
+                self._tenant_rejected[key] = (
+                    self._tenant_rejected.get(key, 0) + 1
+                )
                 raise TenantQuotaExceeded(
                     f"tenant {tenant!r} is at its quota of {quota} "
                     f"outstanding requests "
@@ -393,6 +429,10 @@ class FedRouter:
                     f"are unaffected"
                 )
             self._tenants[tenant] = cur + 1
+            key = self._stat_key_locked(tenant)
+            self._tenant_admitted[key] = (
+                self._tenant_admitted.get(key, 0) + 1
+            )
             self._inflight_bytes += nbytes
             inflight, ntenants = self._inflight_bytes, len(self._tenants)
         self._m_inflight.set(inflight)
@@ -426,6 +466,35 @@ class FedRouter:
             depth = self._host_outstanding[host_id]
         self.registry.gauge(f"member_outstanding_{host_id}").set(depth)
 
+    def _note_hedge_discard(self, host_id: str, tenant: str,
+                            device_us: int) -> None:
+        """A cancelled hedge loser whose 200 was fully written: the
+        member billed it, nobody received it. Keyed by host so the
+        merge only reconciles against members it actually folded."""
+        with self._lock:
+            row = self._hedge_discards.setdefault(
+                (host_id, tenant), {"requests": 0, "device_seconds": 0.0}
+            )
+            row["requests"] += 1
+            row["device_seconds"] += device_us / 1e6
+        self.registry.counter("hedge_discarded_200_total").inc()
+
+    def hedge_discards(self, host_ids=None) -> Dict[str, dict]:
+        """Per-tenant discarded-hedge-200 totals, restricted to
+        ``host_ids`` when given (the merge passes its fresh set)."""
+        with self._lock:
+            items = list(self._hedge_discards.items())
+        out: Dict[str, dict] = {}
+        for (hid, tenant), row in items:
+            if host_ids is not None and hid not in host_ids:
+                continue
+            agg = out.setdefault(
+                tenant, {"requests": 0, "device_seconds": 0.0}
+            )
+            agg["requests"] += row["requests"]
+            agg["device_seconds"] += row["device_seconds"]
+        return out
+
     def outstanding(self) -> Dict[str, int]:
         with self._lock:
             return dict(self._host_outstanding)
@@ -436,6 +505,53 @@ class FedRouter:
         concurrency, not tenant cardinality)."""
         with self._lock:
             return dict(self._tenants)
+
+    def _stat_key_locked(self, tenant: str) -> str:
+        """The cumulative-stats key for a wire tenant name: sanitized,
+        and folded into the overflow bucket past the cardinality cap
+        (the obs.ledger discipline — a hostile client must not mint
+        unbounded table rows)."""
+        t = _obs_ledger.sanitize_tenant(tenant)
+        if (t not in self._tenant_admitted
+                and t not in self._tenant_rejected
+                and len(self._tenant_admitted)
+                + len(self._tenant_rejected) >= _obs_ledger.TENANT_CAP):
+            return _obs_ledger.OVERFLOW_TENANT
+        return t
+
+    def tenant_stats(self) -> Dict[str, dict]:
+        """The fed-local half of ``/debug/tenants``: per tenant, live
+        quota utilization (outstanding / quota for its class) plus the
+        cumulative admitted/quota-rejected counts since start."""
+        with self._lock:
+            raw_outstanding = dict(self._tenants)
+            admitted = dict(self._tenant_admitted)
+            rejected = dict(self._tenant_rejected)
+        # Outstanding is keyed on the raw wire name (the quota table's
+        # vocabulary); fold through the same sanitizer so one tenant is
+        # one row here.
+        outstanding: Dict[str, int] = {}
+        for t, v in raw_outstanding.items():
+            key = _obs_ledger.sanitize_tenant(t)
+            outstanding[key] = outstanding.get(key, 0) + v
+        premium_set = {_obs_ledger.sanitize_tenant(p)
+                       for p in self._premium}
+        out: Dict[str, dict] = {}
+        for t in sorted(set(outstanding) | set(admitted) | set(rejected)):
+            premium = t in premium_set
+            quota = self.cfg.tenant_quota * (
+                self.cfg.premium_quota_factor if premium else 1
+            )
+            cur = outstanding.get(t, 0)
+            out[t] = {
+                "outstanding": cur,
+                "quota": quota,
+                "quota_utilization": (cur / quota) if quota else None,
+                "premium": premium,
+                "admitted_total": admitted.get(t, 0),
+                "quota_rejected_total": rejected.get(t, 0),
+            }
+        return out
 
     def _candidates(self, digest: Optional[bytes] = None) -> List[Member]:
         """Routable members in placement order: healthy before suspect

@@ -290,8 +290,8 @@ def main(argv=None) -> int:
         f"cache={cfg.result_cache_mb:g}MB, "
         f"warm={'on' if cfg.warm_fleet else 'off'}); "
         f"POST /v1/blur /debug/prof, GET /healthz /metrics /statusz "
-        f"/debug/trace/<id> /debug/flightrec /debug/timeseries; "
-        f"SIGTERM drains",
+        f"/debug/trace/<id> /debug/flightrec /debug/timeseries "
+        f"/debug/capacity /debug/tenants; SIGTERM drains",
         flush=True,
     )
     if ns.register:

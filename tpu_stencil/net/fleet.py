@@ -43,6 +43,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from tpu_stencil.config import NetConfig
+from tpu_stencil.obs import ledger as _obs_ledger
 from tpu_stencil.obs import span as _obs_span
 from tpu_stencil.serve import bucketing
 from tpu_stencil.serve.engine import StencilServer
@@ -172,19 +173,26 @@ class ReplicaFleet:
                 self._warmed.popitem(last=False)
         zeros = np.zeros(image.shape, np.uint8)
         n = 0
-        for j, rep in enumerate(list(self.replicas)):
-            if j == chosen:
-                continue
-            try:
-                # owned=True: the zeros frame is never mutated after
-                # this loop, so every sibling can read the ONE buffer —
-                # a warm burst costs one allocation, not replicas-1
-                # defensive copies of a frame nobody looks at.
-                rep.submit(zeros, reps, fname, owned=True)
-            except Exception:
-                continue  # full/closed/crashed sibling: skip, don't fail
-            self._m_warm.inc()
-            n += 1
+        # Warm submits fire on the HTTP handler thread, where the
+        # CLIENT's cost ledger is bound — rebind a warm-kind ledger so
+        # the sibling's device share lands in overhead, never on the
+        # tenant that happened to trigger the warm.
+        with _obs_ledger.bind(
+                _obs_ledger.RequestLedger(tenant="_warm", kind="warm")):
+            for j, rep in enumerate(list(self.replicas)):
+                if j == chosen:
+                    continue
+                try:
+                    # owned=True: the zeros frame is never mutated after
+                    # this loop, so every sibling can read the ONE
+                    # buffer — a warm burst costs one allocation, not
+                    # replicas-1 defensive copies of a frame nobody
+                    # looks at.
+                    rep.submit(zeros, reps, fname, owned=True)
+                except Exception:
+                    continue  # full/closed/crashed sibling: skip
+                self._m_warm.inc()
+                n += 1
         return n
 
     # -- drain / restart -----------------------------------------------

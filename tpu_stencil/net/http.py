@@ -96,6 +96,7 @@ from tpu_stencil.net.router import (
 )
 from tpu_stencil.obs import context as _obs_ctx
 from tpu_stencil.obs import flight as _obs_flight
+from tpu_stencil.obs import ledger as _obs_ledger
 from tpu_stencil.obs import prof as _obs_prof
 from tpu_stencil.obs import slo as _obs_slo
 from tpu_stencil.obs import span as _obs_span
@@ -329,6 +330,10 @@ class _Handler(BaseHTTPRequestHandler):
     # keep-alive connection, so a stale context must never leak onto
     # the next request.
     _trace: Optional[_obs_ctx.TraceContext] = None
+    # The request's metered tenant (sanitized X-Tenant): set by _blur
+    # with the same keep-alive hygiene, so a 429/503 answered later on
+    # the connection never bills the previous request's tenant.
+    _tenant: Optional[str] = None
 
     def log_message(self, *args) -> None:
         pass  # metrics, not stderr chatter, are the observability story
@@ -364,6 +369,11 @@ class _Handler(BaseHTTPRequestHandler):
         # bytes on a kept-alive connection would be parsed as the next
         # request line — garbage for the whole connection.
         self.close_connection = True
+        if self._tenant is not None and code in (429, 503):
+            # The abuse view's two columns: a shed/backpressured
+            # request cost no device time, but the tenant meter still
+            # counts WHO was told to back off.
+            self.fe.tenants.reject(self._tenant, code)
         if self._trace is not None:
             # Request-scoped errors answer the typed JSON body carrying
             # the trace id next to the header echo.
@@ -393,6 +403,7 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_GET(self) -> None:  # noqa: N802 (stdlib handler contract)
         self._trace = None
+        self._tenant = None
         split = urlsplit(self.path)
         path = split.path
         if path == "/healthz":
@@ -418,6 +429,15 @@ class _Handler(BaseHTTPRequestHandler):
             self._admin_cache(parse_qs(split.query))
         elif path == "/debug/timeseries":
             self._debug_timeseries(parse_qs(split.query))
+        elif path == "/debug/capacity":
+            self._debug_capacity(parse_qs(split.query))
+        elif path == "/debug/tenants":
+            self._respond(
+                200,
+                json.dumps(self.fe.tenants_payload(), indent=2,
+                           sort_keys=True).encode(),
+                content_type="application/json",
+            )
         elif path == "/debug/prof" or path.startswith("/debug/prof/"):
             self._debug_prof_get(path)
         elif path.startswith("/debug/trace/"):
@@ -461,6 +481,17 @@ class _Handler(BaseHTTPRequestHandler):
                              "seconds")
             return
         payload = self.fe.timeseries_payload(window_s)
+        self._respond(200, json.dumps(payload, indent=2,
+                                      sort_keys=True).encode(),
+                      content_type="application/json")
+
+    def _debug_capacity(self, query: dict) -> None:
+        window_s = _parse_window(query)
+        if window_s is None:
+            self._error(400, "window must be a positive number of "
+                             "seconds")
+            return
+        payload = self.fe.capacity_payload(window_s)
         self._respond(200, json.dumps(payload, indent=2,
                                       sort_keys=True).encode(),
                       content_type="application/json")
@@ -513,6 +544,7 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_POST(self) -> None:  # noqa: N802
         self._trace = None
+        self._tenant = None
         split = urlsplit(self.path)
         if split.path == "/v1/blur":
             self._blur(parse_qs(split.query))
@@ -683,8 +715,18 @@ class _Handler(BaseHTTPRequestHandler):
         # every span below (and the serve engine's request records)
         # stitches into one cross-process trace.
         ctx = self._trace = _obs_ctx.from_headers(self.headers)
+        # The cost ledger (obs.ledger): bound next to the trace context
+        # so the router's coalescer and the engine's retire fence credit
+        # THIS request's spend with no call-site plumbing. Tenant comes
+        # off the wire (X-Tenant, forwarded by the fed hop) — sanitized
+        # before it can reach a metric name.
+        tenant = self._tenant = _obs_ledger.sanitize_tenant(
+            self._param(query, _obs_ledger.TENANT_HEADER, "tenant")
+        )
+        led = _obs_ledger.RequestLedger(tenant)
         t0 = time.perf_counter()
-        with _obs_ctx.bind(ctx), _obs_span("net.request", "net"):
+        with _obs_ctx.bind(ctx), _obs_ledger.bind(led), \
+                _obs_span("net.request", "net"):
             try:
                 w = int(self._param(query, "X-Width", "w"))
                 h = int(self._param(query, "X-Height", "h"))
@@ -743,6 +785,7 @@ class _Handler(BaseHTTPRequestHandler):
             # failed first; release is idempotent).
             lease = None
             release = None
+            t_ing = time.perf_counter()
             if fe.arena is not None:
                 bh, bw = bucketing.bucket_shape(
                     h, w, fe.cfg.bucket_edges or bucketing.DEFAULT_EDGES
@@ -826,6 +869,8 @@ class _Handler(BaseHTTPRequestHandler):
                     return
             shape = (h, w) if channels == 1 else (h, w, channels)
             img = flat.reshape(shape)
+            # Ingest spend: arena lease + body read + CRC/digest scan.
+            led.add_ingest(time.perf_counter() - t_ing)
             wait = (
                 deadline_s + 5.0 if deadline_s
                 else (fe.cfg.request_timeout_s + 5.0
@@ -854,6 +899,15 @@ class _Handler(BaseHTTPRequestHandler):
                     fe.registry.histogram(
                         "request_latency_seconds"
                     ).observe(time.perf_counter() - t0)
+                    led.set_source("cache")
+                    # The hit's avoided spend: what the stored entry
+                    # cost its producer to compute.
+                    saved = hit.device_us / 1e6
+                    led.saved_device_s = saved
+                    if saved > 0:
+                        fe.registry.counter(
+                            "result_cache_saved_device_seconds_total"
+                        ).inc(saved)
                     resp_headers = {
                         "X-Width": str(w), "X-Height": str(h),
                         "X-Channels": str(channels),
@@ -863,7 +917,8 @@ class _Handler(BaseHTTPRequestHandler):
                     }
                     if hit.stamp is not None:
                         resp_headers[_checksum.RESULT_HEADER] = hit.stamp
-                    self._send_result(fe, hit.payload, resp_headers)
+                    self._send_result(fe, hit.payload, resp_headers,
+                                      ledger=led, bytes_in=expected)
                     return
                 # Admission token BEFORE dispatch: any distrust of the
                 # producing replica from here on (a witness verdict can
@@ -911,6 +966,9 @@ class _Handler(BaseHTTPRequestHandler):
                 fe.registry.histogram(
                     "request_latency_seconds"
                 ).observe(time.perf_counter() - t0)
+                # The single-flight follower rode the leader's compute:
+                # its own device spend is zero by construction.
+                led.set_source("coalesced")
                 resp_headers = {
                     "X-Width": str(w), "X-Height": str(h),
                     "X-Channels": str(channels), "X-Reps": str(reps),
@@ -918,7 +976,8 @@ class _Handler(BaseHTTPRequestHandler):
                 }
                 if stamp is not None:
                     resp_headers[_checksum.RESULT_HEADER] = stamp
-                self._send_result(fe, payload, resp_headers)
+                self._send_result(fe, payload, resp_headers,
+                                  ledger=led, bytes_in=expected)
                 return
 
             def settle(e: BaseException) -> None:
@@ -1056,16 +1115,32 @@ class _Handler(BaseHTTPRequestHandler):
             if cache is not None:
                 # The store takes the pre-chaos-site bytes and the
                 # stamp just served (distrust-fenced by the token);
-                # followers resolve with the same triple.
-                cache.complete(ckey, payload, stamp, idx, token)
+                # followers resolve with the same triple. The entry
+                # remembers its compute cost so a later hit can report
+                # its avoided spend.
+                cache.complete(ckey, payload, stamp, idx, token,
+                               device_us=led.device_us)
                 resp_headers["X-Cache"] = "miss"
-            self._send_result(fe, payload, resp_headers)
+            self._send_result(fe, payload, resp_headers,
+                              ledger=led, bytes_in=expected)
 
     def _send_result(self, fe: "NetFrontend", payload: bytes,
-                     resp_headers: Dict[str, str]) -> None:
+                     resp_headers: Dict[str, str],
+                     ledger: Optional[_obs_ledger.RequestLedger] = None,
+                     bytes_in: int = 0) -> None:
         """The shared 200 tail for cold, hit, and collapsed responses:
         wire-corruption and mid-body-EOF chaos sites fire on all three
-        alike, then the payload goes out."""
+        alike, then the payload goes out — stamped with the request's
+        cost headers. The tenant is metered only AFTER the write
+        succeeded: a hedge loser whose fed-side socket already closed
+        fails the write here, lands in the cancelled-spend counters
+        instead, and is exactly how a hedged request that ran on two
+        members never double-counts in tenant totals."""
+        if ledger is not None:
+            resp_headers = dict(resp_headers)
+            resp_headers["X-Cost-Device-Us"] = str(ledger.device_us)
+            resp_headers["X-Cost-Queue-Us"] = str(ledger.queue_us)
+            resp_headers["X-Cost-Source"] = ledger.source
         if fe.fault_corrupt_body is not None and _checksum.fired(
                 fe.fault_corrupt_body):
             payload = _checksum.corrupt_bytes(payload)
@@ -1073,11 +1148,26 @@ class _Handler(BaseHTTPRequestHandler):
             fe.fault_body, payload
         ):
             return  # injected mid-body EOF: truncated 200 written
-        self._respond(
-            200, payload,
-            content_type="application/octet-stream",
-            headers=resp_headers,
-        )
+        try:
+            self._respond(
+                200, payload,
+                content_type="application/octet-stream",
+                headers=resp_headers,
+            )
+        except OSError:
+            # The client vanished before the 200 landed — the hedge
+            # loser's signature. Its device spend really happened
+            # (conservation keeps it), but no answer was delivered, so
+            # it meters as cancelled, not as tenant goodput.
+            self.close_connection = True
+            fe.registry.counter("cancelled_responses_total").inc()
+            if ledger is not None and ledger.device_s > 0:
+                fe.registry.counter(
+                    "cancelled_response_device_seconds_total"
+                ).inc(ledger.device_s)
+            return
+        if ledger is not None:
+            fe.tenants.record(ledger, bytes_in, len(payload))
 
 
 class NetFrontend:
@@ -1143,6 +1233,9 @@ class NetFrontend:
         # interval and the SLO engine evaluates on its ticks.
         self.sampler: Optional[_obs_ts.Sampler] = None
         self.slo: Optional[_obs_slo.SloEngine] = None
+        # The per-tenant billing/abuse table (obs.ledger) behind
+        # GET /debug/tenants and the tenant_* registry family.
+        self.tenants = _obs_ledger.TenantMeter(self.registry)
 
     # -- lifecycle -----------------------------------------------------
 
@@ -1313,6 +1406,106 @@ class NetFrontend:
         payload["slo"] = None if self.slo is None else self.slo.statusz()
         return payload
 
+    def tenants_payload(self) -> dict:
+        """The ``GET /debug/tenants`` body: the metering table plus the
+        source tier stamp the fed merge keys on."""
+        return {
+            "schema_version": 1,
+            "source": "net",
+            "tenants": self.tenants.snapshot(),
+        }
+
+    def capacity_payload(self, window_s: float) -> dict:
+        """The ``GET /debug/capacity`` body: the Retry-After math run
+        FORWARD — instead of "how long should a rejected client wait",
+        "how much more load fits". Static terms (backlog, slots, busy
+        fractions) always answer; windowed terms (achieved rps, arrival
+        trend, bandwidth-vs-roofline) need the sampler ring and degrade
+        to None when it is off — absent, never fabricated."""
+        assert self.router is not None, "not started"
+        from tpu_stencil.runtime.roofline import V5E_PCIE_GBPS
+
+        terms = self.router.retry_terms()
+        outstanding = self.router.outstanding()
+        max_batch = max(1, self.cfg.max_batch)
+        per_replica = {
+            str(k): {
+                "outstanding": v,
+                "busy_fraction": min(1.0, v / max_batch),
+            }
+            for k, v in outstanding.items()
+        }
+        payload = {
+            "schema_version": 1,
+            "source": "net",
+            "window_s": float(window_s),
+            "retry_after": terms,
+            "utilization": {
+                "slot_fraction": min(
+                    1.0, terms["backlog"] / terms["slots"]
+                ),
+                "busy_replicas": sum(
+                    1 for v in outstanding.values() if v > 0
+                ),
+            },
+            "per_replica": per_replica,
+            "service_rate_rps": terms["service_rate_rps"],
+            "achieved_rps": None,
+            "headroom_rps": None,
+            "time_to_saturation_s": None,
+            "bandwidth": {
+                "achieved_gbps": None,
+                "roofline_gbps": V5E_PCIE_GBPS,
+                "roofline_fraction": None,
+            },
+            "stale": False,
+        }
+        if self.sampler is None:
+            return payload
+        win = self.sampler.ring.window(window_s)
+        lat = win["histograms"].get("request_latency_seconds")
+        if lat is None or win["span_s"] <= 0:
+            return payload
+        achieved = lat["rate_per_s"]
+        payload["achieved_rps"] = achieved
+        svc = terms["service_rate_rps"]
+        if svc is not None:
+            payload["headroom_rps"] = max(0.0, svc - achieved)
+            # Arrival trend: the recent half-window's rate against the
+            # full window's — a positive slope projects when the
+            # headroom runs out at the current ramp.
+            half = self.sampler.ring.window(window_s / 2.0)
+            hlat = half["histograms"].get("request_latency_seconds")
+            if hlat is not None and half["span_s"] > 0:
+                slope = (hlat["rate_per_s"] - achieved) / max(
+                    window_s / 2.0, 1e-9
+                )
+                if payload["headroom_rps"] <= 0:
+                    payload["time_to_saturation_s"] = 0.0
+                elif slope > 0:
+                    payload["time_to_saturation_s"] = (
+                        payload["headroom_rps"] / slope
+                    )
+        # Achieved-vs-roofline GB/s from the ledger aggregates: bytes
+        # moved across the host<->device hop per second of device time
+        # actually spent in the window.
+        ctr = win["counters"]
+        moved = (ctr.get("fleet_h2d_bytes_total", {}).get("delta", 0)
+                 + ctr.get("fleet_d2h_bytes_total", {}).get("delta", 0))
+        spent = (
+            ctr.get("fleet_goodput_device_seconds_total",
+                    {}).get("delta", 0.0)
+            + ctr.get("fleet_overhead_device_seconds_total",
+                      {}).get("delta", 0.0)
+        )
+        if moved > 0 and spent > 0:
+            gbps = moved / spent / 1e9
+            payload["bandwidth"]["achieved_gbps"] = gbps
+            payload["bandwidth"]["roofline_fraction"] = (
+                gbps / V5E_PCIE_GBPS
+            )
+        return payload
+
     def statusz(self) -> dict:
         assert self.router is not None, "not started"
         return {
@@ -1332,6 +1525,10 @@ class NetFrontend:
                 "samples": len(self.sampler.ring),
             },
             "flightrec_dropped_total": _obs_flight.dropped_total(),
+            # The Retry-After derivation's named terms (satellite
+            # bugfix): the opaque integer a backpressured client sees
+            # is auditable against the state that produced it.
+            "retry_after": self.router.retry_terms(),
             "drain_report": (
                 None if self._drain_report is None
                 else {str(k): v for k, v in self._drain_report.items()}

@@ -55,6 +55,7 @@ import numpy as np
 
 from tpu_stencil.net.fleet import ReplicaFleet
 from tpu_stencil.obs import context as _obs_ctx
+from tpu_stencil.obs import ledger as _obs_ledger
 from tpu_stencil.obs import span as _obs_span
 from tpu_stencil.resilience.errors import WorkerCrashed
 from tpu_stencil.serve import bucketing
@@ -502,6 +503,7 @@ class Router:
             trace_id=ctx.trace_id if ctx is not None else "",
             span_id=ctx.span_id if ctx is not None else "",
             on_consumed=on_consumed,
+            ledger=_obs_ledger.current(),
         )
         self._coalescer.offer(key, item, int(reps), fname,
                               tuple(image.shape))
@@ -530,6 +532,8 @@ class Router:
             now = time.perf_counter()
             for m in members:
                 self._m_coal_delay.observe(now - m.t_submit)
+                if m.ledger is not None:
+                    m.ledger.add_coalesce(now - m.t_submit)
             self._m_coal_size.observe(len(members))
             with self._lock:
                 order = sorted(
@@ -634,6 +638,28 @@ class Router:
 
     # -- backpressure hints --------------------------------------------
 
+    def retry_terms(self) -> dict:
+        """The Retry-After derivation's intermediate terms, named — the
+        auditable form behind both :meth:`retry_after_s` and the
+        ``/statusz`` ``retry_after`` block (an operator can check the
+        opaque integer against the state that produced it), and the raw
+        material ``/debug/capacity`` inverts into headroom."""
+        with self._lock:
+            depth = sum(self._outstanding.values())
+        lat = self.registry.histogram("request_latency_seconds").snapshot()
+        delay = self._m_coal_delay.snapshot()
+        slots = max(1, len(self._fleet) * self._max_batch)
+        mean = lat["mean"]
+        return {
+            "backlog": depth,
+            "slots": slots,
+            "coalesce_window_s": self._window_s,
+            "coalesce_delay_p50_s": delay["p50"],
+            "mean_request_latency_s": mean,
+            "service_rate_rps": (slots / mean) if mean > 0 else None,
+            "cap_s": RETRY_AFTER_CAP,
+        }
+
     def retry_after_s(self, queue_full: bool = False) -> int:
         """The DERIVED ``Retry-After`` hint (satellite bugfix): floor +
         coalescing window + the median observed coalesce queue delay +
@@ -644,15 +670,10 @@ class Router:
         not an outage banner."""
         base = RETRY_AFTER_QUEUE_FULL if queue_full else RETRY_AFTER_SHED
         try:
-            with self._lock:
-                depth = sum(self._outstanding.values())
-            lat = self.registry.histogram(
-                "request_latency_seconds"
-            ).snapshot()
-            delay = self._m_coal_delay.snapshot()
-            slots = max(1, len(self._fleet) * self._max_batch)
-            wait = (self._window_s + delay["p50"]
-                    + depth * lat["mean"] / slots)
+            t = self.retry_terms()
+            wait = (t["coalesce_window_s"] + t["coalesce_delay_p50_s"]
+                    + t["backlog"] * t["mean_request_latency_s"]
+                    / t["slots"])
             return max(base, min(RETRY_AFTER_CAP, math.ceil(wait)))
         except Exception:
             return base  # a hint must never fail the error response

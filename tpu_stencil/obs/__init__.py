@@ -27,6 +27,10 @@ docs/OBSERVABILITY.md). Three pieces:
   ``degraded``, emits an event and triggers a flight dump.
 * :mod:`~tpu_stencil.obs.prof` — bounded on-demand ``jax.profiler``
   captures behind ``POST /debug/prof`` (404-clean without jax).
+* :mod:`~tpu_stencil.obs.ledger` — per-request resource ledgers
+  (device time amortized by pixel share, queue/coalesce/ingest waits,
+  H2D/D2H bytes) and the per-tenant metering behind
+  ``GET /debug/tenants`` / the ``X-Cost-*`` response headers.
 
 >>> from tpu_stencil import obs
 >>> obs.enable()
@@ -58,6 +62,7 @@ from tpu_stencil.obs import (
     exposition,
     flight,
     introspect,
+    ledger,
     prof,
     sentry,
     slo,
@@ -92,6 +97,7 @@ __all__ = [
     "exposition",
     "get_tracer",
     "introspect",
+    "ledger",
     "phase",
     "prof",
     "registry",

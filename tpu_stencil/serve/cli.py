@@ -126,6 +126,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "in-process too). Failures count "
                         "verify_failures_total in the report; the "
                         "closed loop fails fast on the first one")
+    p.add_argument("--tenant", default=None, metavar="NAME",
+                   help="stamp every request with this X-Tenant (needs "
+                        "--http; the network tiers meter per-tenant "
+                        "device-seconds and quota against it — "
+                        "docs/OBSERVABILITY.md 'Cost attribution'); the "
+                        "report gains a 'cost' rollup of the tier's "
+                        "X-Cost-* response headers")
     p.add_argument("--witness-rate", dest="witness_rate", type=float,
                    default=0.0, metavar="RATE",
                    help="fraction of completed requests the in-process "
@@ -319,6 +326,9 @@ def main(argv=None) -> int:
         parser.error("--verify crc needs --http: only the network "
                      "tiers stamp X-Result-Crc32c (use --verify golden "
                      "for an in-process server)")
+    if ns.tenant and not ns.http:
+        parser.error("--tenant needs --http: only the network tiers "
+                     "meter X-Tenant")
     if not ns.http:
         try:
             cfg = ServeConfig(
@@ -356,7 +366,8 @@ def main(argv=None) -> int:
             # The network-tier target: same loops, same report schema,
             # remote fleet. No in-process server (and no jax import)
             # on this path — the tier owns the engines.
-            target = loadgen.HttpTarget(ns.http, verify=ns.verify)
+            target = loadgen.HttpTarget(ns.http, verify=ns.verify,
+                                        tenant=ns.tenant)
             try:
                 report = loadgen.run(target, **loadgen_kwargs)
             finally:
@@ -424,6 +435,16 @@ def main(argv=None) -> int:
             f"verify ({report['verify']}): "
             f"{report['verify_failures_total']} failure(s) over "
             f"{report['completed']} completed"
+        )
+    if "cost" in report and report["cost"]["responses"]:
+        cost = report["cost"]
+        srcs = ", ".join(f"{k}={v}" for k, v in
+                         sorted(cost["by_source"].items()))
+        print(
+            f"cost (tenant {cost['tenant']}): "
+            f"{cost['device_seconds']:.4f}s device over "
+            f"{cost['responses']} costed response(s), "
+            f"queue {cost['queue_us'] / 1e6:.4f}s; source {srcs}"
         )
     if "zipf" in report:
         hr = report["cache_hit_ratio"]

@@ -49,6 +49,7 @@ from tpu_stencil.integrity import checksum as _checksum
 from tpu_stencil.integrity import witness as _witness_mod
 from tpu_stencil.obs import context as _obs_ctx
 from tpu_stencil.obs import flight as _obs_flight
+from tpu_stencil.obs import ledger as _obs_ledger
 from tpu_stencil.obs import introspect as _introspect
 from tpu_stencil.obs import span as _obs_span
 from tpu_stencil.obs import tracing as _obs_tracing
@@ -137,6 +138,11 @@ class Request:
     # moment the input still exists for owned requests) and the copy
     # rides here until the retire-side re-execution.
     witness_src: Optional[np.ndarray] = None
+    # Cost attribution (obs.ledger): the RequestLedger bound on the
+    # submitting thread, carried like trace_id so the worker credits
+    # queue wait and the batch's amortized device share without any
+    # contextvar crossing threads. None outside a metered edge.
+    ledger: Optional[_obs_ledger.RequestLedger] = None
 
 
 @dataclasses.dataclass
@@ -155,6 +161,7 @@ class GroupItem:
     trace_id: str = ""
     span_id: str = ""
     on_consumed: Optional[object] = None
+    ledger: Optional[_obs_ledger.RequestLedger] = None
 
 
 def _mask_valid(imgs, valid_h, valid_w):
@@ -481,6 +488,17 @@ class StencilServer:
         # land in its own registry).
         self._m_sharded = m.counter("sharded_requests_total")
         self._m_sharded_batches = m.counter("sharded_batches_total")
+        # Cost attribution (obs.ledger / docs/OBSERVABILITY.md "Cost
+        # attribution and capacity"): every retired batch's dispatch
+        # wall splits into exactly one of goodput (request-kind work)
+        # or overhead (warm/prewarm submits); witness re-executions add
+        # overhead on top and are sub-counted so the conservation
+        # equation stays solvable from a scrape.
+        self._m_goodput = m.counter("goodput_device_seconds_total")
+        self._m_overhead = m.counter("overhead_device_seconds_total")
+        self._m_witness_s = m.counter("witness_device_seconds_total")
+        self._m_h2d_bytes = m.counter("h2d_bytes_total")
+        self._m_d2h_bytes = m.counter("d2h_bytes_total")
         self._m_qwait = m.histogram("queue_wait_seconds")
         self._m_blat = m.histogram("batch_latency_seconds")
         self._m_rlat = m.histogram("request_latency_seconds")
@@ -672,6 +690,7 @@ class StencilServer:
             span_id=ctx.span_id if ctx is not None else "",
             shape=tuple(image.shape),
             on_consumed=on_consumed,
+            ledger=_obs_ledger.current(),
         )
         with _obs_span("serve.enqueue", "serve", req_id=req.req_id):
             with self._cond:
@@ -764,6 +783,7 @@ class StencilServer:
                 t_deadline=it.t_deadline, sharded=sharded,
                 trace_id=it.trace_id, span_id=it.span_id,
                 shape=tuple(image.shape), on_consumed=on_consumed,
+                ledger=it.ledger,
             ))
         with _obs_span("serve.enqueue_group", "serve", group=len(reqs)):
             with self._cond:
@@ -1006,6 +1026,8 @@ class StencilServer:
         )
         for r in batch:
             self._m_qwait.observe(t0 - r.t_submit)
+            if r.ledger is not None:
+                r.ledger.add_queue(t0 - r.t_submit)
         self._m_bsize.observe(len(batch))
         meta = {"sharded": True, "runner": runner,
                 "backend": runner.backend, "n_devices": n_dev}
@@ -1112,8 +1134,42 @@ class StencilServer:
         out_dev = exe(canvas_dev, vh_dev, vw_dev)
         for r in batch:
             self._m_qwait.observe(t0 - r.t_submit)
+            if r.ledger is not None:
+                r.ledger.add_queue(t0 - r.t_submit)
         self._m_bsize.observe(len(batch))
-        return batch, out_dev, (bh, bw, channels, nb, backend), t0
+        return (batch, out_dev,
+                (bh, bw, channels, nb, backend, int(canvas.nbytes)), t0)
+
+    def _credit_batch(self, batch, wall: float, h2d_bytes: int,
+                      d2h_bytes: int) -> None:
+        """Split one retired batch's device wall across its members by
+        pixel share and land each share in the member's ledger (when it
+        carries one) AND in exactly one of the goodput/overhead spend
+        counters — every second of measured batch wall is attributed
+        once, which is what makes the conservation check in the
+        acceptance tests solvable. Warm/prewarm submits (ledger
+        ``kind != "request"``) are overhead; a ledger-less request
+        (bare in-process serve) is goodput."""
+        self._m_h2d_bytes.inc(int(h2d_bytes))
+        self._m_d2h_bytes.inc(int(d2h_bytes))
+        px = [max(1, int(np.prod(r.shape))) for r in batch]
+        total = sum(px)
+        goodput = overhead = 0.0
+        for r, p in zip(batch, px):
+            frac = p / total
+            share = wall * frac
+            led = r.ledger
+            if led is not None:
+                led.add_device(share, h2d_bytes=int(h2d_bytes * frac),
+                               d2h_bytes=int(d2h_bytes * frac))
+            if led is not None and led.kind != "request":
+                overhead += share
+            else:
+                goodput += share
+        if goodput > 0:
+            self._m_goodput.inc(goodput)
+        if overhead > 0:
+            self._m_overhead.inc(overhead)
 
     def _retire(self, batch, out_dev, meta, t0) -> None:
         """Block on one in-flight batch, crop per-request outputs, resolve
@@ -1139,6 +1195,12 @@ class StencilServer:
         t1 = time.perf_counter()
         self._m_batches.inc()
         self._m_blat.observe(t1 - t0)
+        ph, pw = runner.padded_shape
+        ch = batch[0].shape[2] if len(batch[0].shape) == 3 else 1
+        self._credit_batch(
+            batch, t1 - t0, len(batch) * ph * pw * ch,
+            sum(int(np.asarray(o).nbytes) for o in results),
+        )
         witness_queue = []
         for r, out in zip(batch, results):
             res = np.ascontiguousarray(out)
@@ -1155,13 +1217,14 @@ class StencilServer:
             self._witness_one(r, res)
 
     def _retire_inner(self, batch, out_dev, meta, t0) -> None:
-        bh, bw, channels, nb, backend = meta
+        bh, bw, channels, nb, backend, h2d_bytes = meta
         if self._fault_d2h is not None:
             self._fault_d2h()
         out = np.asarray(out_dev)  # blocks until the device is done
         t1 = time.perf_counter()
         self._m_batches.inc()
         self._m_blat.observe(t1 - t0)
+        self._credit_batch(batch, t1 - t0, h2d_bytes, int(out.nbytes))
         reps = batch[0].reps
         if reps > 0:
             from tpu_stencil.runtime import roofline
@@ -1227,6 +1290,7 @@ class StencilServer:
         nor count as evidence against the replica."""
         if r.reps > _witness_mod.WITNESS_MAX_REPS:
             return  # see WITNESS_MAX_REPS: verification must stay cheap
+        t_w0 = time.perf_counter()
         try:
             with _obs_span("integrity.witness", "integrity",
                            req_id=r.req_id, reps=r.reps):
@@ -1238,6 +1302,13 @@ class StencilServer:
         except Exception:
             self.registry.counter("integrity_witness_errors_total").inc()
             return
+        # Witness re-execution is paid-for device time that produced no
+        # client byte: it lands in overhead, with its own sub-counter so
+        # the conservation check can avoid double-counting
+        # (witness ⊆ overhead).
+        wit_s = time.perf_counter() - t_w0
+        self._m_witness_s.inc(wit_s)
+        self._m_overhead.inc(wit_s)
         self._m_witness_total.inc()
         if not ok:
             self._m_witness_bad.inc()

@@ -95,13 +95,24 @@ class HttpTarget:
 
     def __init__(self, url: str, max_workers: int = 32,
                  timeout_s: float = 300.0,
-                 verify: Optional[str] = None) -> None:
+                 verify: Optional[str] = None,
+                 tenant: Optional[str] = None) -> None:
         if verify not in VERIFY_MODES:
             raise ValueError(
                 f"verify must be one of {VERIFY_MODES}, got {verify!r}"
             )
         self.url = url.rstrip("/")
         self._timeout = timeout_s
+        # --tenant: stamp X-Tenant on every request so the tier's cost
+        # ledger meters this run under one name; every 200's X-Cost-*
+        # headers roll up into cost_snapshot() (the report's "cost"
+        # key) — metering is drivable and assertable from the client.
+        self.tenant = tenant
+        self._cost_lock = threading.Lock()
+        self._cost: Dict = {
+            "responses": 0, "device_us": 0, "queue_us": 0,
+            "by_source": {},
+        }
         # --verify (docs/RESILIENCE.md "Integrity model"): any non-None
         # mode stamps each request with X-Content-Crc32c (exercising
         # the tier's ingest validation); "crc" additionally checks each
@@ -139,6 +150,8 @@ class HttpTarget:
         }
         if self.verify is not None:
             headers[_checksum.CRC_HEADER] = str(_checksum.crc32c(payload))
+        if self.tenant:
+            headers["X-Tenant"] = self.tenant
         if filter_name:
             headers["X-Filter"] = filter_name
         if deadline_s:
@@ -150,6 +163,7 @@ class HttpTarget:
         try:
             with urllib.request.urlopen(req, timeout=self._timeout) as r:
                 body = r.read()
+                self._tally_cost(r.headers)
                 if self.verify == "crc":
                     stamp = r.headers.get(_checksum.RESULT_HEADER)
                     # stamp_matches treats a missing OR malformed stamp
@@ -238,6 +252,39 @@ class HttpTarget:
                 )
 
         return self._pool.submit(task)
+
+    def _tally_cost(self, rh) -> None:
+        """Roll one 200's X-Cost-* headers into the run's cost tally
+        (absent headers — an older tier — tally nothing)."""
+        dev = rh.get("X-Cost-Device-Us")
+        if dev is None:
+            return
+        try:
+            d = int(dev)
+            q = int(rh.get("X-Cost-Queue-Us") or 0)
+        except ValueError:
+            return  # a malformed header is no measurement
+        src = rh.get("X-Cost-Source") or "unknown"
+        with self._cost_lock:
+            c = self._cost
+            c["responses"] += 1
+            c["device_us"] += d
+            c["queue_us"] += q
+            c["by_source"][src] = c["by_source"].get(src, 0) + 1
+
+    def cost_snapshot(self) -> Dict:
+        """The per-tenant cost rollup for the report: what this run's
+        responses said they cost, in the server's own X-Cost-*
+        vocabulary."""
+        with self._cost_lock:
+            return {
+                "tenant": self.tenant or "anon",
+                "responses": self._cost["responses"],
+                "device_us": self._cost["device_us"],
+                "device_seconds": self._cost["device_us"] / 1e6,
+                "queue_us": self._cost["queue_us"],
+                "by_source": dict(self._cost["by_source"]),
+            }
 
     def stats(self) -> dict:
         """The tier's net-registry snapshot, scraped from /statusz."""
@@ -636,6 +683,11 @@ def run(
             report["cache_hit_ratio"] = (
                 hits / total if total > 0 else 0.0
             )
+    cost_fn = getattr(server, "cost_snapshot", None)
+    if cost_fn is not None:
+        # HTTP targets: the run's cost rollup from the tier's X-Cost-*
+        # response headers, keyed by the stamped tenant.
+        report["cost"] = cost_fn()
     if per_request:
         report["per_request"] = done_recs
     if verify is not None:
