@@ -760,6 +760,122 @@ def _measure_stream_shard(platform: str, mesh_shape) -> dict:
     return line
 
 
+def _measure_stream_pipe(platform: str, stages: int) -> dict:
+    """Temporally-pipelined stream capture
+    (``TPU_STENCIL_BENCH_PIPE=K``): run a synthetic north-star-frame
+    stream with the rep loop split into K contiguous stages, each stage
+    pinned to a mesh slice and frames flowing systolically over ICI
+    (``StreamConfig.pipe_stages`` — docs/STREAMING.md "Temporal
+    pipeline"), and emit a versioned headline in wall seconds per frame
+    with the full topology folded into the metric name
+    (``..._stream_pipe<K>[_shard<R>x<C>][_mesh<G>]_depth<k>_wall_per_
+    frame`` — each composition is its own sentry series, never a false
+    regression against another). A warm-up stream pays the persistent
+    mesh program's compile; the cached runner serves the headline.
+
+    Combo riders compose the other two placement axes onto the same
+    capture: ``TPU_STENCIL_BENCH_PIPE_SHARD=RxC`` shards every
+    in-flight frame spatially inside each stage, and
+    ``TPU_STENCIL_BENCH_PIPE_MESH=G`` fans G independent pipeline
+    groups over frame lanes — one run then consumes G*K*R*C devices.
+
+    Knobs: ``TPU_STENCIL_BENCH_STREAM_FRAMES`` (default 16),
+    ``TPU_STENCIL_BENCH_STREAM_DEPTH`` (default 2)."""
+    import tempfile
+
+    import jax
+
+    from tpu_stencil.config import ImageType, StreamConfig
+    from tpu_stencil.stream.engine import run_stream
+
+    shard_env = os.environ.get("TPU_STENCIL_BENCH_PIPE_SHARD")
+    mesh_env = os.environ.get("TPU_STENCIL_BENCH_PIPE_MESH")
+    r, c = 1, 1
+    if shard_env:
+        rr, _, cc = shard_env.lower().partition("x")
+        r, c = int(rr), int(cc)
+    groups = int(mesh_env) if mesh_env else 1
+    need = groups * stages * r * c
+    if len(jax.devices()) < need:
+        raise RuntimeError(
+            f"pipeline topology mesh{groups} x pipe{stages} x shard"
+            f"{r}x{c} needs {need} devices, have {len(jax.devices())}"
+        )
+    n_frames = int(os.environ.get("TPU_STENCIL_BENCH_STREAM_FRAMES", "16"))
+    depth = int(os.environ.get("TPU_STENCIL_BENCH_STREAM_DEPTH", "2"))
+    backend = os.environ.get(
+        "TPU_STENCIL_BENCH_BACKENDS", "auto"
+    ).split(",")[0]
+    rng = np.random.default_rng(0)
+    with tempfile.TemporaryDirectory(prefix="bench_pipe_") as d:
+        clip = os.path.join(d, "clip.raw")
+        frame = rng.integers(0, 256, size=(H, W, C), dtype=np.uint8)
+        # Enough frames that the pipeline reaches steady state: the
+        # first K-1 headline frames are fill, so a stream shorter than
+        # ~2K would gate mostly on the ramp.
+        with open(clip, "wb") as f:
+            for _ in range(max(2 * stages, n_frames)):
+                f.write(frame.tobytes())
+
+        def cfg(frames):
+            kw = {}
+            if r * c > 1:
+                kw["shard_frames"] = (r, c)
+                kw["shard_min_pixels"] = 1
+            if groups > 1:
+                kw["mesh_frames"] = groups
+            return StreamConfig(
+                input=clip, width=W, height=H, repetitions=REPS,
+                image_type=ImageType.RGB, backend=backend,
+                output="null", frames=frames, pipeline_depth=depth,
+                pipe_stages=stages, **kw,
+            )
+
+        # Warm-up: the persistent whole-mesh tick program lands in the
+        # SHARED runner cache (plus one full fill/drain pass), so the
+        # headline measures the systolic steady state, not the compile.
+        run_stream(cfg(max(2, stages)))
+        res = run_stream(cfg(max(2 * stages, n_frames)))
+    per_frame = res.wall_seconds / max(1, res.frames)
+    shard_tag = f"_shard{r}x{c}" if r * c > 1 else ""
+    mesh_tag = f"_mesh{groups}" if groups > 1 else ""
+    log(f"stream pipe{stages}{shard_tag.replace('_', ' ')}"
+        f"{mesh_tag.replace('_', ' ')} depth={depth} [{res.backend}]: "
+        f"{res.frames_per_second:.2f} frames/s "
+        f"({per_frame * 1e3:.1f} ms/frame, {res.frames} frames)")
+    line = {
+        "metric": (
+            f"{W}x{H}_rgb_{REPS}reps_stream_pipe{stages}{shard_tag}"
+            f"{mesh_tag}_depth{depth}_wall_per_frame"
+        ),
+        "value": round(per_frame, 6),
+        "unit": "s",
+        "vs_baseline": round(BASELINE_S / per_frame, 2),
+        "backend": res.backend,
+        "platform": platform,
+        "frames_per_second": round(res.frames_per_second, 3),
+        "n_frames": res.frames,
+        "pipeline_depth": depth,
+        "pipe_stages": stages,
+        "n_devices": res.n_devices,
+        "stage_seconds": {
+            k: round(v, 6) for k, v in sorted(res.stage_seconds.items())
+        },
+        "shape": f"{W}x{H}",
+        "reps": REPS,
+        "filter": "gaussian",
+        "dtype": "uint8",
+        "schema_version": 1,
+        "ts": round(time.monotonic(), 6),
+    }
+    if r * c > 1:
+        line["shard_frames"] = [r, c]
+    if groups > 1:
+        line["mesh_frames"] = groups
+        line["per_device_frames"] = res.per_device_frames
+    return line
+
+
 def _measure_serve_meshfan(platform: str) -> dict:
     """Serve mesh-fan capture (``TPU_STENCIL_BENCH_SERVE_MESHFAN=1``):
     drive north-star-sized requests through the serving engine's
@@ -1513,6 +1629,16 @@ def child_main() -> int:
         }), flush=True)
         log(f"backend init failed: {type(e).__name__}: {e}")
         return 2
+
+    pipe_env = os.environ.get("TPU_STENCIL_BENCH_PIPE")
+    if pipe_env:
+        try:
+            result = _measure_stream_pipe(platform, int(pipe_env))
+        except Exception as e:
+            log(f"stream pipe: FAILED {type(e).__name__}: {e}")
+            return 1
+        print(json.dumps(result), flush=True)
+        return 0
 
     shard_env = os.environ.get("TPU_STENCIL_BENCH_STREAM_SHARD")
     if shard_env:

@@ -1,0 +1,426 @@
+"""Temporal pipeline parallelism (--pipe-stages K): bit-exact fill/drain
+fuzz vs the single-device golden, the three-axis composition
+(frame lane x temporal stage x spatial shard — including the
+fan-of-sharded-groups PR 15 left open), the runner-cache topology-key
+audit, the checkpoint 3-axis topology guard, the auto resolver's
+roofline-gate + never-enable-a-measured-loss discipline, and the
+roofline fill/drain model. Runs on the conftest's 8 virtual CPU
+devices."""
+
+import json
+
+import numpy as np
+import pytest
+
+import jax
+
+from tpu_stencil import driver, filters, obs
+from tpu_stencil.config import ImageType, JobConfig, StreamConfig
+from tpu_stencil.models.blur import IteratedConv2D
+from tpu_stencil.ops import stencil
+from tpu_stencil.parallel import pipeline as ppipe
+from tpu_stencil.parallel import sharded as psharded
+from tpu_stencil.runtime import autotune, roofline
+from tpu_stencil.runtime import checkpoint as ckpt
+from tpu_stencil.stream import cli as stream_cli
+from tpu_stencil.stream.engine import run_stream
+
+
+def _make_clip(path, n, h, w, ch, seed=0):
+    rng = np.random.default_rng(seed)
+    shape = (n, h, w) if ch == 1 else (n, h, w, ch)
+    clip = rng.integers(0, 256, size=shape, dtype=np.uint8)
+    clip.tofile(path)
+    return clip
+
+
+def _golden_frames(tmp_path, clip, reps, image_type, **job_kw):
+    """Each frame through an independent run_job; returns raw bytes."""
+    h, w = clip.shape[1:3]
+    out = []
+    for i in range(clip.shape[0]):
+        src = str(tmp_path / f"golden_in_{i}.raw")
+        dst = str(tmp_path / f"golden_out_{i}.raw")
+        clip[i].tofile(src)
+        driver.run_job(JobConfig(
+            image=src, width=w, height=h, repetitions=reps,
+            image_type=image_type, output=dst, **job_kw,
+        ))
+        out.append(open(dst, "rb").read())
+    return out
+
+
+def _cfg(tmp_path, clip_path, h, w, image_type, reps, **kw):
+    kw.setdefault("output", str(tmp_path / "pipe_out.raw"))
+    return StreamConfig(
+        input=str(clip_path), width=w, height=h, repetitions=reps,
+        image_type=image_type, **kw,
+    )
+
+
+# -- bit-exact fill/drain fuzz vs the single-device golden ------------
+
+@pytest.mark.parametrize("image_type,reps,stages,n", [
+    (ImageType.RGB, 5, 4, 7),    # reps % K != 0, steady state reached
+    (ImageType.GREY, 3, 4, 2),   # frames < stages: drain-dominated
+    (ImageType.GREY, 8, 4, 4),   # frames == stages: exactly one fill
+    (ImageType.RGB, 4, 2, 5),    # shallow pipeline
+    (ImageType.GREY, 2, 4, 1),   # single frame through a deep pipeline
+    (ImageType.GREY, 3, 1, 3),   # degenerate K=1: the plain engine
+])
+def test_pipeline_stream_matches_run_job(tmp_path, image_type, reps,
+                                         stages, n):
+    h, w, ch = 20, 16, image_type.channels
+    clip_path = tmp_path / "clip.raw"
+    clip = _make_clip(clip_path, n, h, w, ch, seed=stages * 10 + n)
+    golden = _golden_frames(tmp_path, clip, reps, image_type)
+    out = str(tmp_path / "out.raw")
+    res = run_stream(_cfg(
+        tmp_path, clip_path, h, w, image_type, reps, output=out,
+        frames=n, pipe_stages=stages,
+    ))
+    assert res.frames == n
+    assert res.pipe_stages == stages
+    blob = open(out, "rb").read()
+    fb = h * w * ch
+    for i in range(n):
+        assert blob[i * fb:(i + 1) * fb] == golden[i], f"frame {i}"
+
+
+def test_pipeline_reps_below_stage_count(tmp_path):
+    # reps < K: trailing stages apply zero reps (identity pass-through)
+    # and the output must still be bit-exact.
+    h, w, reps, stages, n = 16, 12, 2, 4, 3
+    clip_path = tmp_path / "clip.raw"
+    clip = _make_clip(clip_path, n, h, w, 1, seed=3)
+    out = str(tmp_path / "out.raw")
+    res = run_stream(_cfg(
+        tmp_path, clip_path, h, w, ImageType.GREY, reps, output=out,
+        frames=n, pipe_stages=stages,
+    ))
+    assert res.frames == n
+    f = filters.get_filter("gaussian")
+    blob = open(out, "rb").read()
+    for i in range(n):
+        want = stencil.reference_stencil_numpy(clip[i], f, reps)
+        assert blob[i * h * w:(i + 1) * h * w] == want.tobytes(), i
+
+
+# -- three-axis composition (and the PR-15 fan-of-sharded-groups) -----
+
+def test_three_axis_composition_bit_exact(tmp_path):
+    """mesh_frames=2 x pipe_stages=2 x shard_frames=(2,1): all eight
+    virtual devices under one placement model, output bit-exact."""
+    h, w, reps, n = 24, 20, 3, 5
+    clip_path = tmp_path / "clip.raw"
+    clip = _make_clip(clip_path, n, h, w, 1, seed=8)
+    golden = _golden_frames(tmp_path, clip, reps, ImageType.GREY)
+    out = str(tmp_path / "out.raw")
+    res = run_stream(_cfg(
+        tmp_path, clip_path, h, w, ImageType.GREY, reps, output=out,
+        frames=n, mesh_frames=2, pipe_stages=2, shard_frames=(2, 1),
+        shard_min_pixels=1,
+    ))
+    assert res.frames == n
+    assert res.n_devices == 8
+    assert res.pipe_stages == 2
+    blob = open(out, "rb").read()
+    fb = h * w
+    for i in range(n):
+        assert blob[i * fb:(i + 1) * fb] == golden[i], f"frame {i}"
+
+
+def test_fan_of_sharded_groups_bit_exact(tmp_path):
+    """mesh_frames=2 x shard_frames=(2,2) at K=1 — the composition
+    PR 15 explicitly left open, served by the same composed engine as
+    a degenerate (immediately-flushing) pipeline."""
+    h, w, reps, n = 24, 20, 2, 5
+    clip_path = tmp_path / "clip.raw"
+    clip = _make_clip(clip_path, n, h, w, 3, seed=9)
+    golden = _golden_frames(tmp_path, clip, reps, ImageType.RGB)
+    out = str(tmp_path / "out.raw")
+    res = run_stream(_cfg(
+        tmp_path, clip_path, h, w, ImageType.RGB, reps, output=out,
+        frames=n, mesh_frames=2, shard_frames=(2, 2),
+        shard_min_pixels=1,
+    ))
+    assert res.frames == n
+    assert res.n_devices == 8
+    blob = open(out, "rb").read()
+    fb = h * w * 3
+    for i in range(n):
+        assert blob[i * fb:(i + 1) * fb] == golden[i], f"frame {i}"
+
+
+# -- runner-cache topology-key audit ----------------------------------
+
+def test_runner_cache_never_shares_across_stage_counts():
+    """Two --pipe-stages values must never share a compiled program:
+    the key carries the temporal axis, and the process-shared LRU holds
+    one entry per stage count."""
+    model = IteratedConv2D("gaussian", backend="xla")
+    k2 = ppipe.pipeline_runner_key(model, (8, 8), 1, 2, (1, 1),
+                                   jax.devices()[:2])
+    k4 = ppipe.pipeline_runner_key(model, (8, 8), 1, 4, (1, 1),
+                                   jax.devices()[:4])
+    assert k2 != k4
+    # And against the spatial key-space: a 2x1 shard at K=1 is not a
+    # K=2 pipeline over the same two devices.
+    ks = psharded.runner_key(model, (8, 8), 1, (2, 1),
+                             jax.devices()[:2], "off")
+    assert ks != k2
+
+    psharded.clear_runner_cache()
+    r2 = ppipe.shared_pipeline_runner(model, (8, 8), 1, 2)
+    assert r2 is not None and psharded.runner_cache_len() == 1
+    assert ppipe.shared_pipeline_runner(model, (8, 8), 1, 2) is r2  # hit
+    assert psharded.runner_cache_len() == 1
+    r4 = ppipe.shared_pipeline_runner(model, (8, 8), 1, 4)
+    assert r4 is not None and r4 is not r2
+    assert psharded.runner_cache_len() == 2
+    psharded.clear_runner_cache()
+
+
+# -- checkpoint: the 3-axis topology guard ----------------------------
+
+def test_checkpoint_records_pipe_stages(tmp_path):
+    clip_path = tmp_path / "clip.raw"
+    _make_clip(clip_path, 4, 12, 10, 1, seed=7)
+    out = str(tmp_path / "out.raw")
+    cfg = _cfg(tmp_path, clip_path, 12, 10, ImageType.GREY, 1,
+               output=out, frames=4, pipe_stages=4,
+               checkpoint_every=2)
+    ckpt.save_stream_progress(cfg, 2, pipe_stages=4)
+    meta = json.load(open(out + ".stream.ckpt.json"))
+    assert meta["pipe_stages"] == 4
+    assert ckpt.restore_stream_progress(cfg, pipe_stages=4) == 2
+    with pytest.raises(ckpt.MeshCursorMismatch) as ei:
+        ckpt.restore_stream_progress(cfg, pipe_stages=2)
+    assert "4" in str(ei.value) and "--pipe-stages 2" in str(ei.value)
+    with pytest.raises(ckpt.MeshCursorMismatch):
+        ckpt.restore_stream_progress(cfg)  # single-device resume
+    # And a single-device sidecar refuses a pipelined resume.
+    ckpt.save_stream_progress(cfg, 2)
+    with pytest.raises(ckpt.MeshCursorMismatch):
+        ckpt.restore_stream_progress(cfg, pipe_stages=4)
+
+
+def test_checkpoint_records_full_composed_topology(tmp_path):
+    clip_path = tmp_path / "clip.raw"
+    _make_clip(clip_path, 4, 12, 10, 1, seed=7)
+    out = str(tmp_path / "out.raw")
+    cfg = _cfg(tmp_path, clip_path, 12, 10, ImageType.GREY, 1,
+               output=out, frames=4, mesh_frames=2, pipe_stages=2,
+               shard_frames=(2, 1), shard_min_pixels=1)
+    ckpt.save_stream_progress(cfg, 2, mesh_devices=2, cursors=[1, 1],
+                              shard_frames=(2, 1), pipe_stages=2)
+    meta = json.load(open(out + ".stream.ckpt.json"))
+    assert meta["mesh_devices"] == 2
+    assert meta["shard_frames"] == [2, 1]
+    assert meta["pipe_stages"] == 2
+    assert ckpt.restore_stream_progress(
+        cfg, mesh_devices=2, shard_frames=(2, 1), pipe_stages=2) == 2
+    # Any axis off by one fails typed.
+    for kw in (dict(mesh_devices=4, shard_frames=(2, 1), pipe_stages=2),
+               dict(mesh_devices=2, shard_frames=(1, 2), pipe_stages=2),
+               dict(mesh_devices=2, shard_frames=(2, 1), pipe_stages=4)):
+        with pytest.raises(ckpt.MeshCursorMismatch):
+            ckpt.restore_stream_progress(cfg, **kw)
+
+
+def test_pipe_resume_mid_stream(tmp_path):
+    """A checkpointed pipelined stream killed mid-run resumes at the
+    SAME K and completes bit-exact."""
+    h, w, reps, stages, n = 16, 12, 3, 2, 6
+    clip_path = tmp_path / "clip.raw"
+    clip = _make_clip(clip_path, n, h, w, 1, seed=11)
+    out = str(tmp_path / "out.raw")
+    golden = _golden_frames(tmp_path, clip, reps, ImageType.GREY)
+    cfg = _cfg(tmp_path, clip_path, h, w, ImageType.GREY, reps,
+               output=out, frames=n, pipe_stages=stages,
+               checkpoint_every=1)
+    # Simulate the kill: frames [0, 3) durably in the sink, sidecar
+    # recording the pipelined topology.
+    with open(out, "wb") as fh:
+        fh.write(golden[0] + golden[1] + golden[2])
+    ckpt.save_stream_progress(cfg, 3, pipe_stages=stages)
+    res = run_stream(cfg, resume=True)
+    assert res.skipped == 3 and res.frames == n - 3
+    blob = open(out, "rb").read()
+    fb = h * w
+    for i in range(n):
+        assert blob[i * fb:(i + 1) * fb] == golden[i], f"frame {i}"
+
+
+# -- resolver: explicit overflow, auto A/B, roofline gate -------------
+
+def test_explicit_pipe_stages_overflow_fails_loud(tmp_path):
+    clip_path = tmp_path / "clip.raw"
+    _make_clip(clip_path, 1, 10, 8, 1)
+    cfg = _cfg(tmp_path, clip_path, 10, 8, ImageType.GREY, 1,
+               frames=1, pipe_stages=16)
+    with pytest.raises(ValueError, match="16 devices.*have"):
+        run_stream(cfg)
+    # Composed budget overflows too: 2 * 4 * 2 * 1 = 16 > 8.
+    cfg = _cfg(tmp_path, clip_path, 10, 8, ImageType.GREY, 1,
+               frames=1, mesh_frames=2, pipe_stages=4,
+               shard_frames=(2, 1), shard_min_pixels=1)
+    with pytest.raises(ValueError, match="16 devices.*have"):
+        run_stream(cfg)
+
+
+def _auto_cfg(tmp_path, reps, frames=None):
+    return StreamConfig(
+        input="synthetic", width=64, height=64, repetitions=reps,
+        image_type=ImageType.GREY, output="null", frames=frames,
+        pipe_stages=0,
+    )
+
+
+def test_auto_pipe_never_enables_a_measured_loss(tmp_path):
+    # Long reps, until-EOF stream: the roofline gate passes and the
+    # measured A/B decides. A measured win enables; a loss or a TIE
+    # stays single (a tie is NOT a win).
+    cfg = _auto_cfg(tmp_path, reps=500)
+    devs = jax.devices()
+    win = ppipe.resolve_pipe_stages(cfg, devs,
+                                    measure=lambda *a: (1.0, 0.5))
+    assert win == len(devs)
+    assert ppipe.resolve_pipe_stages(
+        cfg, devs, measure=lambda *a: (0.5, 1.0)) == 1
+    assert ppipe.resolve_pipe_stages(
+        cfg, devs, measure=lambda *a: (1.0, 1.0)) == 1
+
+
+def test_auto_pipe_roofline_gate_skips_probe(tmp_path, capsys):
+    # A 3-frame stream at reps=1: the fill/drain factor and the
+    # per-tick ICI hand-off make the modeled pipeline a loss, so the
+    # probe must never even be paid.
+    cfg = _auto_cfg(tmp_path, reps=1, frames=3)
+    pick = ppipe.resolve_pipe_stages(
+        cfg, jax.devices(),
+        measure=lambda *a: pytest.fail("probed a modeled loss"))
+    assert pick == 1
+    assert "probe skipped" in capsys.readouterr().err
+
+
+def test_auto_pipe_warm_cache_pays_zero_probe_frames(tmp_path, capsys):
+    cfg = _auto_cfg(tmp_path, reps=500)
+    stages = len(jax.devices())
+    autotune.store_stream_verdict(
+        "pipeline", (64, 64, 1), 500, cfg.pipeline_depth,
+        f"pipe{stages}", {"pick": stages, "single_us": 2.0,
+                          "pipe_us": 1.0},
+        autotune.stream_cfg_token(cfg),
+    )
+    assert ppipe.resolve_pipe_stages(cfg, jax.devices()) == stages
+    assert "warm cache" in capsys.readouterr().err
+
+
+def test_stage_rep_counts_partition():
+    assert ppipe.stage_rep_counts(10, 4) == (3, 3, 2, 2)
+    assert ppipe.stage_rep_counts(4, 4) == (1, 1, 1, 1)
+    assert ppipe.stage_rep_counts(2, 4) == (1, 1, 0, 0)  # identity tail
+    for reps in (1, 3, 7, 40):
+        for k in (1, 2, 4, 8):
+            counts = ppipe.stage_rep_counts(reps, k)
+            assert sum(counts) == reps and len(counts) == k
+            assert max(counts) - min(counts) <= 1
+
+
+# -- roofline: fill/drain term and the modeled topology choice --------
+
+def test_pipeline_fill_drain_factor():
+    assert roofline.pipeline_fill_drain_factor(None, 4) == 1.0
+    assert roofline.pipeline_fill_drain_factor(1, 4) == pytest.approx(0.25)
+    assert roofline.pipeline_fill_drain_factor(10, 1) == 1.0
+    f = roofline.pipeline_fill_drain_factor
+    assert f(4, 4) < f(16, 4) < f(256, 4) <= 1.0
+
+
+def test_pipeline_roofline_bounds():
+    fb = 64 * 64
+    stages = roofline.pipeline_stream_stage_seconds(
+        fb, 400, "xla", "gaussian", 64, pipe_stages=4)
+    assert set(stages) >= {"h2d", "compute", "d2h"}
+    solo = roofline.pipeline_stream_stage_seconds(
+        fb, 400, "xla", "gaussian", 64, pipe_stages=1)
+    # The per-tick compute share shrinks with K (ceil(reps/K) reps).
+    assert stages["compute"] < solo["compute"]
+    # Large reps, long stream: the pipeline's modeled bound beats the
+    # single-device stream bound.
+    pipe = roofline.pipeline_stream_frames_per_second(
+        fb, 400, "xla", "gaussian", 64, pipe_stages=4)
+    single = roofline.stream_frames_per_second(
+        fb, 400, "xla", "gaussian", 64)
+    assert pipe > single
+    # Tiny reps, 2-frame stream: fill dominates, the model says loss.
+    assert roofline.pipeline_stream_frames_per_second(
+        fb, 1, "xla", "gaussian", 64, pipe_stages=4, frames=2,
+    ) < roofline.stream_frames_per_second(fb, 1, "xla", "gaussian", 64)
+
+
+def test_choose_stream_topology_never_pipeline_on_modeled_loss():
+    # Small reps / short stream: the pipeline arm's fill term makes it
+    # a modeled loss — it must never be the chosen topology.
+    for reps, frames in ((1, 2), (1, 4), (2, 3)):
+        pick = autotune.choose_stream_topology(
+            (64, 64, 1), reps, 2, 8, frames=frames)
+        assert pick != "pipeline", (reps, frames)
+    # Sanity: the chooser speaks the full vocabulary.
+    assert autotune.choose_stream_topology(
+        (64, 64, 1), 400, 2, 1) == "single"
+
+
+# -- CLI round-trip, observability ------------------------------------
+
+def test_cli_pipe_stream_end_to_end(tmp_path, capsys):
+    h, w, reps, n, stages = 16, 12, 2, 4, 2
+    clip_path = tmp_path / "clip.raw"
+    clip = _make_clip(clip_path, n, h, w, 1, seed=6)
+    out = str(tmp_path / "out.raw")
+    stats = str(tmp_path / "stats.json")
+    rc = stream_cli.main([
+        str(clip_path), str(w), str(h), str(reps), "grey",
+        "--frames", str(n), "--output", out,
+        "--pipe-stages", str(stages),
+        "--stats-json", stats,
+    ])
+    assert rc == 0
+    text = capsys.readouterr().out
+    assert f"pipe-stages={stages}" in text
+    payload = json.load(open(stats))
+    assert payload["pipe_stages"] == stages
+    assert payload["n_devices"] == stages
+    f = filters.get_filter("gaussian")
+    blob = open(out, "rb").read()
+    for i in range(n):
+        want = stencil.reference_stencil_numpy(clip[i], f, reps)
+        assert blob[i * h * w:(i + 1) * h * w] == want.tobytes(), i
+
+
+def test_pipe_gauge_reports_what_ran(tmp_path):
+    h, w, n = 16, 12, 3
+    clip_path = tmp_path / "clip.raw"
+    _make_clip(clip_path, n, h, w, 1, seed=4)
+    run_stream(_cfg(tmp_path, clip_path, h, w, ImageType.GREY, 2,
+                    output="null", frames=n, pipe_stages=2))
+    assert obs.snapshot()["gauges"]["stream_pipe_stages"]["value"] == 2
+    # Report-what-ran: a later single-device run clears the gauge.
+    run_stream(_cfg(tmp_path, clip_path, h, w, ImageType.GREY, 2,
+                    output="null", frames=n))
+    assert obs.snapshot()["gauges"]["stream_pipe_stages"]["value"] == 0
+
+
+# -- the measured steady-state A/B (wall-clock; excluded from tier 1) -
+
+@pytest.mark.timing
+def test_measured_pipeline_ab_probe(tmp_path):
+    cfg = StreamConfig(
+        input="synthetic", width=32, height=32, repetitions=8,
+        image_type=ImageType.GREY, output="null", frames=4,
+        pipe_stages=0,
+    )
+    t_single, t_pipe = ppipe.measure_pipeline_ab(
+        cfg, jax.devices()[:2], stages=2)
+    assert t_single > 0 and t_pipe > 0

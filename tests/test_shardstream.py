@@ -219,8 +219,15 @@ def test_config_validates_shard_frames():
         StreamConfig(**base, shard_frames=(0, 2))
     with pytest.raises(ValueError, match="shard_frames"):
         StreamConfig(**base, shard_frames=(2,))
-    with pytest.raises(ValueError, match="mutually exclusive"):
-        StreamConfig(**base, shard_frames=(2, 2), mesh_frames=2)
+    # Composition is legal when every active axis is explicit; any
+    # auto on a composed topology is refused (the probes cannot
+    # resolve one axis while another is live).
+    cfg = StreamConfig(**base, shard_frames=(2, 2), mesh_frames=2)
+    assert cfg.shard_frames == (2, 2) and cfg.mesh_frames == 2
+    with pytest.raises(ValueError, match="composed topologies must be"):
+        StreamConfig(**base, shard_frames=(0, 0), mesh_frames=2)
+    with pytest.raises(ValueError, match="composed topologies must be"):
+        StreamConfig(**base, shard_frames=(2, 2), pipe_stages=0)
     with pytest.raises(ValueError, match="shard_min_pixels"):
         StreamConfig(**base, shard_min_pixels=0)
     with pytest.raises(ValueError, match="overlap"):

@@ -236,9 +236,9 @@ class StreamConfig:
     # A/B enables sharding only when strictly faster, or without a
     # probe when the frame exceeds the per-device feasibility bound);
     # explicit (R, C) fails loudly when fewer than R*C devices exist.
-    # Mutually exclusive per-frame with mesh_frames: a frame either
-    # fans (whole-frame data parallelism) or shards (spatial), never
-    # both.
+    # Composes with mesh_frames and pipe_stages under the three-axis
+    # placement model (frame lane x temporal stage x spatial shard);
+    # composed topologies must be explicit — see pipe_stages.
     shard_frames: Optional[Tuple[int, int]] = None
     # Sharded-frame routing threshold (true pixels, H*W) — the serve
     # discipline (ServeConfig.shard_min_pixels) applied to the stream:
@@ -252,6 +252,22 @@ class StreamConfig:
     # rides the rep-loop carry (degenerate tiles degrade to "off"
     # in-runner, report-what-ran). Ignored without shard_frames.
     overlap: str = "edge"
+    # Temporal pipeline stages (tpu_stencil.parallel.pipeline): split
+    # the rep loop into K contiguous stages, each pinned to a mesh
+    # slice, and flow frames systolically stage-to-stage over ICI
+    # inside one persistent shard_map program — at steady state K
+    # frames are in flight and per-frame device time is ~reps/K of the
+    # loop (plus one ICI frame hand-off per stage). Fill/drain is
+    # explicit, so short streams (frames < K) stay bit-exact. 1 =
+    # off; K > 1 = explicit stage count (fails loudly when the device
+    # budget mesh_frames*K*R*C exceeds what exists); 0 = auto — the
+    # roofline fill/drain model gates a measured A/B probe that
+    # enables the pipeline only when strictly faster. Composes with
+    # mesh_frames (independent pipeline groups, frames dealt round-
+    # robin) and shard_frames (each stage spatially sharded RxC); a
+    # composed topology must be explicit on every active axis (auto
+    # resolves only a sole multi-device axis).
+    pipe_stages: int = 1
     checkpoint_every: int = 0  # frame-index checkpoint period (0 = off)
     progress_every: int = 0    # stderr frame-index heartbeat (0 = off)
     # Dispatch watchdog window (seconds) around the drain's compute
@@ -306,15 +322,34 @@ class StreamConfig:
                     f"(0, 0) for auto, got {self.shard_frames}"
                 )
             object.__setattr__(self, "shard_frames", sf)
-            if self.mesh_frames != 1:
-                # A frame either fans (one device computes it whole) or
-                # shards (the mesh computes it together) — the two
-                # compositions are mutually exclusive per frame.
+        if self.pipe_stages < 0:
+            raise ValueError(
+                f"pipe_stages must be >= 0 (0 = auto, 1 = off, K = stage "
+                f"count), got {self.pipe_stages}"
+            )
+        # Three-axis composition: any subset of (frame lane, temporal
+        # stage, spatial shard) may be active together, but a composed
+        # topology must be explicit on every active axis — the measured
+        # A/B auto probes resolve one axis against a single device, not
+        # a cross-product of topologies.
+        active = (
+            int(self.mesh_frames != 1)
+            + int(self.shard_frames is not None)
+            + int(self.pipe_stages != 1)
+        )
+        if active >= 2:
+            autos = []
+            if self.mesh_frames == 0:
+                autos.append("mesh_frames=0")
+            if self.shard_frames == (0, 0):
+                autos.append("shard_frames=(0, 0)")
+            if self.pipe_stages == 0:
+                autos.append("pipe_stages=0")
+            if autos:
                 raise ValueError(
-                    "shard_frames and mesh_frames are mutually exclusive "
-                    "per-frame: a frame either fans whole onto one device "
-                    "(--mesh-frames) or spatially shards over the mesh "
-                    "(--shard-frames), never both"
+                    "composed topologies must be explicit on every active "
+                    "axis (auto resolves only a sole multi-device axis); "
+                    "auto on: " + ", ".join(autos)
                 )
         if self.shard_min_pixels < 1:
             raise ValueError(

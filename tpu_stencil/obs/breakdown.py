@@ -223,12 +223,22 @@ def render_stream(tracer: Tracer, info: dict) -> str:
     from tpu_stencil.runtime import roofline
 
     shard = info.get("shard_frames")
+    pipe = info.get("pipe_stages") or 1
     if shard:
         model_stages = roofline.sharded_stream_stage_seconds(
             info["reps"], info["backend"],
             info["filter_name"], info["h_img"], info["w_img"],
             info.get("channels", 1), tuple(shard),
             halo=info.get("halo") or 1,
+            block_h=info.get("block_h"), fuse=info.get("fuse"),
+        )
+    elif pipe > 1:
+        # Temporal pipeline: the compute term is one stage's rep share
+        # plus the per-tick ICI frame hand-off (the fill/drain factor
+        # lands on the whole-stream bound below, not per stage).
+        model_stages = roofline.pipeline_stream_stage_seconds(
+            info["frame_bytes"], info["reps"], info["backend"],
+            info["filter_name"], info["h_img"], pipe,
             block_h=info.get("block_h"), fuse=info.get("fuse"),
         )
     else:
@@ -327,6 +337,26 @@ def render_stream(tracer: Tracer, info: dict) -> str:
             f"(tile {th}x{tw}/device, ICI ghost model "
             f"{ici / 1e3:.3f} KB/rep/device; host read/write measured, "
             f"not modeled)"
+        )
+        return "\n".join(lines) + "\n"
+    if pipe > 1:
+        # Temporal pipeline: steady-state max-stage bound discounted by
+        # the fill/drain factor F/(F+K-1) — short streams never reach
+        # full amortization, and the table must say so.
+        fps_pipe = roofline.pipeline_stream_frames_per_second(
+            info["frame_bytes"], info["reps"], info["backend"],
+            info["filter_name"], info["h_img"], pipe,
+            frames=n_frames or None,
+            block_h=info.get("block_h"), fuse=info.get("fuse"),
+            pipeline_depth=depth,
+        )
+        fill = roofline.pipeline_fill_drain_factor(
+            n_frames or None, pipe
+        )
+        lines.append(
+            f"{measured}modeled pipeline bound {fps_pipe:.2f} frames/s "
+            f"({pipe} stages, fill/drain factor {fill:.3f}; host "
+            f"read/write measured, not modeled)"
         )
         return "\n".join(lines) + "\n"
     fps_model = roofline.stream_frames_per_second(

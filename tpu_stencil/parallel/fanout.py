@@ -295,13 +295,16 @@ def _drainer(ctrl: _Control, cfg: StreamConfig, lane: _Lane,
 
 
 def _writer(ctrl: _Control, cfg: StreamConfig, sink, lanes: List[_Lane],
-            start_frame: int, done: list) -> None:
+            start_frame: int, done: list, save_progress=None) -> None:
     """In-order drain across devices: frame ``i`` is popped from lane
     ``(i - start) % n`` — a round-robin merge, no reordering buffer —
     then written, counted, and checkpointed with the per-device
     cursors. ``done[0]`` tracks frames fully written (global index).
     Retry semantics: the engines' shared
-    :func:`~tpu_stencil.stream.engine._make_write_frame`."""
+    :func:`~tpu_stencil.stream.engine._make_write_frame`.
+    ``save_progress`` (optional) overrides the checkpoint commit — the
+    pipelined engine passes a closure stamping its full three-axis
+    topology into the sidecar."""
     n = len(lanes)
     idx = start_frame
     write_frame = _sengine._make_write_frame(cfg, sink)
@@ -326,10 +329,13 @@ def _writer(ctrl: _Control, cfg: StreamConfig, sink, lanes: List[_Lane],
                 from tpu_stencil.runtime import checkpoint as ckpt
 
                 sink.flush()
-                ckpt.save_stream_progress(
-                    cfg, done[0], mesh_devices=n,
-                    cursors=device_cursors(done[0], start_frame, n),
-                )
+                if save_progress is not None:
+                    save_progress(done[0])
+                else:
+                    ckpt.save_stream_progress(
+                        cfg, done[0], mesh_devices=n,
+                        cursors=device_cursors(done[0], start_frame, n),
+                    )
             if cfg.progress_every and done[0] % cfg.progress_every == 0:
                 print(f"stream: frame {done[0]}", file=sys.stderr,
                       flush=True)

@@ -1,0 +1,486 @@
+"""Temporal pipeline parallelism: frames flow through rep-stages over ICI.
+
+The third mesh composition (``--pipe-stages K``): the rep loop is split
+into K contiguous stages, each stage pinned to a mesh slice, and frames
+flow systolically stage-to-stage through ONE persistent ``shard_map``
+program — the wafer-scale dataflow execution model of "Stencil
+Computations on Cerebras Wafer-Scale Engine" (arXiv 2605.07954) and the
+software-systolic framing of arXiv 1907.06154, mapped onto an ICI mesh.
+Per tick every stage applies its rep slice to its resident frame, then
+one ``lax.ppermute`` over the stages axis hands every frame to the next
+stage — no host round-trip between stages. At steady state K frames are
+in flight and per-frame device time is ``~reps/K`` of the loop plus one
+ICI frame hand-off.
+
+The placement model is three-axis: (frame lane) x (temporal stage) x
+(spatial shard). The mesh here is ``(stages, rows, cols)``; each stage's
+slice is an RxC spatial mesh running the SAME local step as
+:class:`~tpu_stencil.parallel.sharded.ShardedRunner` (``_local_step`` —
+halo exchange over rows/cols, the plan's kernel, pad re-zero), with R=C=1
+degrading to a plain zero-pad in-program
+(:func:`~tpu_stencil.parallel.halo.halo_exchange` at axis size 1), so one
+program text serves unsharded and sharded pipelines. Frame lanes
+(``--mesh-frames``) ride ABOVE this module: independent pipeline groups,
+each over its own device slice (:mod:`tpu_stencil.stream.pipelined`).
+
+Bit-exactness across stage counts holds by construction: the per-stage
+rep counts partition ``reps`` exactly (``sum over s of reps//K +
+(s < reps%K) == reps``) and every stage runs the identical local step,
+so composing K stage slices applies the same operator sequence as one
+device applying ``reps``. Fill/drain is the caller's contract: a stream
+of F frames takes ``F + K - 1`` ticks, the first ``K - 1`` outputs are
+discarded and ``K - 1`` trailing zero-frame ticks flush the tail.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import sys
+import time
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpu_stencil.parallel import partition
+from tpu_stencil.parallel.mesh import ROWS_AXIS, COLS_AXIS
+from tpu_stencil.parallel.sharded import (
+    _local_step,
+    cached_runner,
+    runner_key,
+    shard_map,
+)
+
+STAGES_AXIS = "stages"
+
+# Probe stream length for the auto A/B: long enough that a K-stage
+# pipeline reaches steady state and amortizes most of its fill
+# (resolve_pipe_stages widens it to 2*K when K is larger).
+PROBE_FRAMES = 4
+
+
+def stage_rep_counts(reps: int, stages: int) -> Tuple[int, ...]:
+    """The contiguous per-stage rep partition: ``reps // K`` everywhere,
+    with the first ``reps % K`` stages taking one extra — sums to
+    ``reps`` exactly for every (reps, K), including reps < K (trailing
+    stages then apply zero reps: identity pass-through)."""
+    base, extra = divmod(reps, stages)
+    return tuple(base + (1 if s < extra else 0) for s in range(stages))
+
+
+def build_pipeline_tick(mesh: Mesh, plan, channels: int,
+                        needs_mask: bool, boundary: str = "zero"):
+    """Compile-once builder for the persistent pipeline tick.
+
+    Returns ``fn(carry, inp, reps[, mask]) -> (carry, out)`` over the
+    3-axis mesh, jitted with the carry donated (the K resident frames
+    live on-device across the whole stream; the tick rewrites them in
+    place). Per tick, on each device:
+
+    1. merge: stage 0 adopts the newly fed input frame, every other
+       stage keeps its resident carry (its predecessor's output from
+       the previous tick);
+    2. run this stage's rep share of the loop on that frame
+       (``reps // K`` plus one remainder rep selected by stage index —
+       every device executes the SAME collective sequence, the
+       remainder rep is computed unconditionally and selected with
+       ``where``, so per-stage trip counts never diverge under the
+       rows/cols halo collectives);
+    3. ``ppermute`` the result one stage forward over ICI into the next
+       tick's carry (stage 0's next carry is the permute's fill — dead
+       state, always overwritten by the merge).
+
+    The tick's ``out`` is the full ``(K, ...)`` computed array; the host
+    reads only the LAST stage's shards — each frame's finished result,
+    K-1 ticks after it was fed (the frame fed at tick t is processed by
+    stage s during tick t+s). ``reps`` is traced (no recompiles).
+    """
+    k = mesh.shape[STAGES_AXIS]
+    r = mesh.shape[ROWS_AXIS]
+    c = mesh.shape[COLS_AXIS]
+    axes = ((ROWS_AXIS, r, 0), (COLS_AXIS, c, 1))
+    spec = (
+        P(STAGES_AXIS, ROWS_AXIS, COLS_AXIS) if channels == 1
+        else P(STAGES_AXIS, ROWS_AXIS, COLS_AXIS, None)
+    )
+    mask_spec = (
+        P(ROWS_AXIS, COLS_AXIS) if channels == 1
+        else P(ROWS_AXIS, COLS_AXIS, None)
+    )
+
+    def local_tick(carry, inp, reps, mask_tile):
+        s = lax.axis_index(STAGES_AXIS)
+        base = reps // k
+        extra = reps % k
+
+        def step(x):
+            return _local_step(x, plan, axes, mask_tile, boundary)
+
+        tile = jnp.where(s == 0, inp[0], carry[0]) if k > 1 else inp[0]
+        out = lax.fori_loop(0, base, lambda _, x: step(x), tile)
+        if k > 1:
+            # The remainder rep: computed on EVERY stage, kept only
+            # where s < extra — uniform collective sequences (see
+            # docstring) at the cost of one rep of throwaway compute.
+            out = jnp.where(s < extra, step(out), out)
+            new_carry = lax.ppermute(
+                out, STAGES_AXIS, [(i, i + 1) for i in range(k - 1)]
+            )
+        else:
+            out = lax.fori_loop(0, extra, lambda _, x: step(x), out)
+            new_carry = out
+        return new_carry[None], out[None]
+
+    if needs_mask:
+        mapped = shard_map(
+            local_tick, mesh=mesh,
+            in_specs=(spec, spec, P(), mask_spec), out_specs=(spec, spec),
+        )
+    else:
+        def no_mask(carry, inp, reps):
+            return local_tick(carry, inp, reps, None)
+
+        mapped = shard_map(
+            no_mask, mesh=mesh,
+            in_specs=(spec, spec, P()), out_specs=(spec, spec),
+        )
+    return jax.jit(mapped, donate_argnums=(0,))
+
+
+class PipelineRunner:
+    """Holds the 3-axis mesh, padding geometry, mask, zero-tile cache
+    and compiled persistent tick for one (image shape, K, RxC) — the
+    temporal sibling of :class:`~tpu_stencil.parallel.sharded.
+    ShardedRunner`. The pipeline program runs the XLA local step (the
+    one every other mesh composition is bit-exact against); a
+    Pallas-chunked stage body is a future extension, so ``backend`` is
+    reported as ``"xla"`` — report-what-ran."""
+
+    def __init__(
+        self,
+        model,
+        image_shape: Tuple[int, int],
+        channels: int,
+        stages: int,
+        shard_shape: Tuple[int, int] = (1, 1),
+        devices: Optional[Sequence[jax.Device]] = None,
+    ) -> None:
+        if stages < 1:
+            raise ValueError(f"pipe stages must be >= 1, got {stages}")
+        self.model = model
+        self.h, self.w = image_shape
+        self.channels = channels
+        self.stages = stages
+        r, c = shard_shape
+        self.shard_shape = (r, c)
+        need = stages * r * c
+        devices = list(devices) if devices is not None else jax.devices()
+        if len(devices) < need:
+            raise ValueError(
+                f"pipeline topology {stages} stage(s) x {r}x{c} shard "
+                f"needs {need} devices, have {len(devices)}"
+            )
+        dev_grid = np.array(devices[:need], dtype=object).reshape(
+            stages, r, c
+        )
+        self.mesh = Mesh(dev_grid, (STAGES_AXIS, ROWS_AXIS, COLS_AXIS))
+        ph, pw = partition.pad_amounts(self.h, self.w, (r, c))
+        self.padded_shape = (self.h + ph, self.w + pw)
+        tile = partition.tile_shape(self.h, self.w, (r, c))
+        self.tile = tile
+        self.boundary = getattr(model, "boundary", "zero")
+        if self.boundary == "periodic" and (ph or pw):
+            # Same refusal as ShardedRunner: the pad region would wrap
+            # into the opposite edge — silently wrong output.
+            raise NotImplementedError(
+                f"periodic boundaries need the image ({self.h}x{self.w}) "
+                f"to divide the shard grid {r}x{c}; pick a grid that "
+                "divides the image or run unsharded stages"
+            )
+        if (r > 1 or c > 1) and min(tile) < model.halo:
+            raise ValueError(
+                f"per-device tile {tile[0]}x{tile[1]} is smaller than "
+                f"the filter halo ({model.halo}); use a smaller shard "
+                "grid for this image"
+            )
+        self.backend = "xla"
+        self.schedule = None
+        self.needs_mask = bool(ph or pw)
+        spec = (
+            P(STAGES_AXIS, ROWS_AXIS, COLS_AXIS) if channels == 1
+            else P(STAGES_AXIS, ROWS_AXIS, COLS_AXIS, None)
+        )
+        self.sharding = NamedSharding(self.mesh, spec)
+        gshape = (stages,) + self.padded_shape
+        if channels != 1:
+            gshape = gshape + (channels,)
+        self.global_shape = gshape
+        self.local_shape = (1, tile[0], tile[1]) + (
+            (channels,) if channels != 1 else ()
+        )
+        self.stage0_devices = list(dev_grid[0].flat)
+        self.last_devices = list(dev_grid[-1].flat)
+        self._fn = build_pipeline_tick(
+            self.mesh, model.plan, channels, self.needs_mask,
+            boundary=self.boundary,
+        )
+        if self.needs_mask:
+            mask = np.zeros(self.padded_shape, np.uint8)
+            mask[: self.h, : self.w] = 1
+            if channels != 1:
+                mask = np.repeat(mask[..., None], channels, axis=-1)
+            mask_spec = (
+                P(ROWS_AXIS, COLS_AXIS) if channels == 1
+                else P(ROWS_AXIS, COLS_AXIS, None)
+            )
+            self._mask = jax.device_put(
+                mask, NamedSharding(self.mesh, mask_spec)
+            )
+        else:
+            self._mask = None
+        # Committed zero tiles, one per device: the input feed's filler
+        # for every stage past 0 (and for drain ticks). NEVER donated —
+        # only the carry (argnum 0) donates, so these buffers are safe
+        # to re-reference every tick.
+        zero = np.zeros(self.local_shape, np.uint8)
+        self._zero_tiles = {
+            d.id: jax.device_put(zero, d) for d in dev_grid.flat
+        }
+
+    def zero_input(self) -> jax.Array:
+        """The all-zero global input (drain ticks, and the base every
+        fed tick overrides at stage 0) — assembled from the cached
+        committed zero tiles, so no per-tick H2D."""
+        return jax.make_array_from_single_device_arrays(
+            self.global_shape, self.sharding,
+            [self._zero_tiles[d.id] for d in self.mesh.devices.flat],
+        )
+
+    def fresh_carry(self) -> jax.Array:
+        """A fresh all-zero carry. Distinct buffers from the zero-tile
+        cache: the carry is DONATED to the first tick, which would
+        invalidate any shared buffer."""
+        zero = np.zeros(self.local_shape, np.uint8)
+        return jax.make_array_from_single_device_arrays(
+            self.global_shape, self.sharding,
+            [jax.device_put(zero, d) for d in self.mesh.devices.flat],
+        )
+
+    def assemble_input(self, stage0_tiles: dict) -> jax.Array:
+        """The fed-tick input: ``stage0_tiles`` maps device id -> the
+        committed padded frame tile (local shape) for each stage-0
+        device; every other device rides its cached zero tile."""
+        arrays = [
+            stage0_tiles.get(d.id, self._zero_tiles[d.id])
+            for d in self.mesh.devices.flat
+        ]
+        return jax.make_array_from_single_device_arrays(
+            self.global_shape, self.sharding, arrays
+        )
+
+    def tick(self, carry: jax.Array, inp: jax.Array,
+             repetitions: int) -> Tuple[jax.Array, jax.Array]:
+        """One pipeline tick; donates ``carry``, returns
+        ``(new_carry, out)``. The finished frame (if any) lives in
+        ``out``'s last-stage shards."""
+        reps = jnp.int32(repetitions)
+        if self.needs_mask:
+            return self._fn(carry, inp, reps, self._mask)
+        return self._fn(carry, inp, reps)
+
+    def warm(self, repetitions: int) -> jax.Array:
+        """Compile-fence the tick on zero frames and return the warmed
+        initial carry — the fill state a stream starts from."""
+        carry, out = self.tick(self.fresh_carry(), self.zero_input(),
+                               repetitions)
+        jax.block_until_ready(out)
+        return carry
+
+
+def pipeline_runner_key(model, image_shape, channels, stages,
+                        shard_shape, devices):
+    """The shared-cache identity of one compiled pipeline program:
+    :func:`~tpu_stencil.parallel.sharded.runner_key` with the temporal
+    axis as its ``pipe_stages`` component — two stage counts over the
+    same devices never share an entry."""
+    return runner_key(model, image_shape, channels, shard_shape,
+                      devices, "off", pipe_stages=stages)
+
+
+def shared_pipeline_runner(model, image_shape, channels, stages,
+                           shard_shape=(1, 1), devices=None,
+                           registry=None):
+    """The cached :class:`PipelineRunner` for this topology, or None
+    when the geometry cannot serve it (same UNSERVABLE discipline as
+    :func:`~tpu_stencil.parallel.sharded.shared_runner`, against the
+    SAME process-shared LRU — stream groups and repeat runs never
+    compile the same pipeline program twice)."""
+    devices = list(devices) if devices is not None else jax.devices()
+    r, c = shard_shape
+    devs = devices[: stages * r * c]
+    key = pipeline_runner_key(model, tuple(image_shape), channels,
+                              stages, (r, c), devs)
+
+    def build():
+        return PipelineRunner(model, tuple(image_shape), channels,
+                              stages, shard_shape=(r, c), devices=devs)
+
+    return cached_runner(key, build, registry=registry)
+
+
+# --- --pipe-stages resolution (explicit / auto A/B) ---------------------
+
+def measure_pipeline_ab(cfg, devices, stages: int,
+                        frames: int = PROBE_FRAMES):
+    """Measured A/B probe for the auto knob: stream ``frames`` synthetic
+    frames through the single-device engine and through the K-stage
+    pipeline (same geometry, reps, depth), one warm run then one timed
+    run per arm, under a scratch metric registry (probe traffic never
+    pollutes the run's surface). Returns ``(t_single, t_pipe)``
+    wall-seconds."""
+    from tpu_stencil import obs
+    from tpu_stencil.stream import engine as _sengine
+    from tpu_stencil.stream import frames as frames_io
+
+    frames = max(frames, 2 * stages)
+
+    class _Synth(frames_io.FrameSource):
+        def __init__(self, n):
+            self.n = n
+            self.i = 0
+
+        def read_into(self, buf):
+            if self.i >= self.n:
+                return False
+            arr = np.frombuffer(buf, dtype=np.uint8)
+            arr[:] = (self.i * 37) % 251
+            self.i += 1
+            return True
+
+        def skip(self, n):
+            self.i += n
+
+        def close(self):
+            pass
+
+    def arm(pipe: int) -> float:
+        pcfg = dataclasses.replace(
+            cfg, frames=frames, pipe_stages=pipe, mesh_frames=1,
+            shard_frames=None, output="null", checkpoint_every=0,
+            progress_every=0,
+        )
+        with obs.scratch_registry():
+            _sengine.run_stream(  # warm (compiles fenced out)
+                pcfg, devices=list(devices), source=_Synth(frames),
+                sink=frames_io.NullSink(),
+            )
+            t0 = time.perf_counter()
+            _sengine.run_stream(
+                pcfg, devices=list(devices), source=_Synth(frames),
+                sink=frames_io.NullSink(),
+            )
+            return time.perf_counter() - t0
+
+    return arm(1), arm(stages)
+
+
+def resolve_pipe_stages(cfg, devices, measure=None) -> int:
+    """Resolve ``cfg.pipe_stages`` to the stage count that will run.
+
+    Explicit K is honored, failing loudly when the composed device
+    budget (``mesh_frames * K * R * C``) exceeds what exists. 0 = auto:
+    single-axis only (config enforces), candidate K = every available
+    device; gated FIRST by the roofline fill/drain model — when the
+    model predicts a loss (reps too small to amortize the fill and the
+    per-tick ICI hand-off) the probe is never even paid — then decided
+    by a measured A/B under the standing never-enable-a-measured-loss
+    discipline (a tie is NOT a win), with the verdict persisted
+    (kind ``"pipeline"``) so a warm cache pays zero probe frames."""
+    if cfg.pipe_stages == 1:
+        return 1
+    n_avail = len(devices) if devices is not None else len(jax.devices())
+    r, c = cfg.shard_frames if cfg.shard_frames else (1, 1)
+    groups = cfg.mesh_frames if cfg.mesh_frames > 1 else 1
+    if cfg.pipe_stages > 1:
+        need = groups * cfg.pipe_stages * r * c
+        if need > n_avail:
+            raise ValueError(
+                f"--pipe-stages {cfg.pipe_stages} with "
+                f"mesh_frames={groups} and shard {r}x{c} needs {need} "
+                f"devices, have {n_avail}"
+            )
+        return cfg.pipe_stages
+    # Auto: a sole multi-device axis (config refuses composed autos).
+    if n_avail < 2:
+        return 1
+    stages = n_avail
+    from tpu_stencil.runtime import autotune, roofline
+
+    geometry = (cfg.height, cfg.width, cfg.channels)
+    topo = f"pipe{stages}"
+    token = autotune.stream_cfg_token(cfg)
+    # Injected measures (tests) bypass the verdict cache entirely —
+    # same hermeticity discipline as the fanout/shard resolvers.
+    hit = None
+    if measure is None:
+        hit = autotune.cached_stream_verdict(
+            "pipeline", geometry, cfg.repetitions, cfg.pipeline_depth,
+            topo, token,
+        )
+    if hit is not None:
+        pick = int(hit["pick"])
+        print(
+            f"tpu-stencil stream: --pipe-stages auto verdict from warm "
+            f"cache: {'pipeline ' + str(pick) if pick > 1 else 'single'}"
+            " (zero probe frames)",
+            file=sys.stderr,
+        )
+        return pick if pick > 1 else 1
+    single_fps = roofline.stream_frames_per_second(
+        cfg.frame_bytes, cfg.repetitions, "xla", cfg.filter_name,
+        cfg.height, pipeline_depth=cfg.pipeline_depth,
+    )
+    pipe_fps = roofline.pipeline_stream_frames_per_second(
+        cfg.frame_bytes, cfg.repetitions, "xla", cfg.filter_name,
+        cfg.height, pipe_stages=stages, frames=cfg.frames,
+        pipeline_depth=cfg.pipeline_depth,
+    )
+    if not pipe_fps > single_fps:
+        # Model predicts a loss (or a tie — not a win): never pay the
+        # probe, and don't persist — a later longer-reps run at the
+        # same geometry gets its own decision.
+        print(
+            f"tpu-stencil stream: --pipe-stages auto: roofline model "
+            f"predicts no gain at reps={cfg.repetitions} "
+            f"(pipe {pipe_fps:.1f} <= single {single_fps:.1f} fps "
+            "modeled); staying single-device, probe skipped",
+            file=sys.stderr,
+        )
+        return 1
+    t_single, t_pipe = (measure or measure_pipeline_ab)(
+        cfg, devices, stages
+    )
+    pick = stages if t_pipe < t_single else 1
+    if measure is None:
+        autotune.store_stream_verdict(
+            "pipeline", geometry, cfg.repetitions, cfg.pipeline_depth,
+            topo,
+            {
+                "pick": pick,
+                "single_us": round(t_single * 1e6, 1),
+                "pipe_us": round(t_pipe * 1e6, 1),
+            },
+            token,
+        )
+    print(
+        f"tpu-stencil stream: --pipe-stages auto measured "
+        f"single={t_single * 1e3:.1f}ms pipe({stages})="
+        f"{t_pipe * 1e3:.1f}ms -> "
+        f"{'pipeline ' + str(stages) if pick > 1 else 'single'}",
+        file=sys.stderr,
+    )
+    return pick if pick > 1 else 1

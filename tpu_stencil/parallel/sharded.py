@@ -1060,10 +1060,15 @@ def _resolved_mesh_for_key(mesh_shape, devices, image_shape):
 
 
 def runner_key(model, image_shape, channels, mesh_shape, devices,
-               overlap: str):
+               overlap: str, pipe_stages: int = 1):
     """The cache identity of one compiled mesh program. Everything the
     compiled artifact depends on is in here; two callers whose keys
-    match would compile byte-identical programs."""
+    match would compile byte-identical programs. Every topology axis is
+    a key component: the spatial mesh shape, the device set, AND the
+    temporal stage count (``pipe_stages`` — a K-stage pipeline program
+    over the same devices is a different compiled artifact than the
+    K'-stage one, so two ``--pipe-stages`` values must never share an
+    entry)."""
     plan = model.plan
     taps = ";".join(",".join(str(v) for v in row) for row in plan.taps)
     return (
@@ -1077,6 +1082,7 @@ def runner_key(model, image_shape, channels, mesh_shape, devices,
         tuple(mesh_shape),
         tuple(d.id for d in devices),
         overlap,
+        int(pipe_stages),
     )
 
 
@@ -1097,6 +1103,22 @@ def shared_runner(model, image_shape, channels, mesh_shape=None,
     rshape, rdevs = _resolved_mesh_for_key(mesh_shape, devices,
                                            image_shape)
     key = runner_key(model, image_shape, channels, rshape, rdevs, overlap)
+
+    def build():
+        return ShardedRunner(model, tuple(image_shape), channels,
+                             mesh_shape=rshape, devices=rdevs,
+                             overlap=overlap)
+
+    return cached_runner(key, build, registry=registry,
+                         build_wrapper=build_wrapper)
+
+
+def cached_runner(key, build, registry=None, build_wrapper=None):
+    """Get-or-build against the ONE process-shared runner LRU. Any
+    compiled mesh-program holder participates (:class:`ShardedRunner`
+    here, the temporal :class:`~tpu_stencil.parallel.pipeline.
+    PipelineRunner` via its own key) — same cap, same counters, same
+    UNSERVABLE semantics for deterministic geometry refusals."""
     with _runner_cache_lock:
         hit = _runner_cache.get(key)
         if hit is not None:
@@ -1107,12 +1129,6 @@ def shared_runner(model, image_shape, channels, mesh_shape=None,
         return None if hit is _UNSERVABLE else hit
     if registry is not None:
         registry.counter("sharded_runner_misses_total").inc()
-
-    def build():
-        return ShardedRunner(model, tuple(image_shape), channels,
-                             mesh_shape=rshape, devices=rdevs,
-                             overlap=overlap)
-
     try:
         runner = build_wrapper(build) if build_wrapper else build()
     except (ValueError, NotImplementedError):

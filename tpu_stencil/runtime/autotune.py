@@ -80,7 +80,7 @@ def _entry_jax_version(key: str) -> Optional[str]:
     unparseable keys — those are garbage and get evicted."""
     parts = key.split("|")
     idx = 2 if parts and parts[0] in ("overlap", "fanout",
-                                      "shardstream") else 1
+                                      "shardstream", "pipeline") else 1
     return parts[idx] if len(parts) > idx else None
 
 
@@ -684,8 +684,10 @@ def cached_stream_verdict(kind: str, geometry: Tuple[int, int, int],
                           cfg_token: str = "") -> Optional[dict]:
     """The cached auto verdict for one stream mesh composition, or None
     (cache miss / malformed entry). ``kind`` is ``"fanout"``
-    (``--mesh-frames 0``) or ``"shardstream"`` (``--shard-frames 0``);
-    ``topo`` pins the decided-over topology (``ndev8`` / ``mesh2x4``)
+    (``--mesh-frames 0``), ``"shardstream"`` (``--shard-frames 0``) or
+    ``"pipeline"`` (``--pipe-stages 0``);
+    ``topo`` pins the decided-over topology (``ndev8`` / ``mesh2x4`` /
+    ``pipe4``)
     so a verdict never answers for a different device population, and
     ``cfg_token`` (:func:`stream_cfg_token`) pins the compute identity
     (filter/backend/schedule/geometry knobs/boundary)."""
@@ -707,6 +709,58 @@ def store_stream_verdict(kind: str, geometry: Tuple[int, int, int],
     store[_stream_verdict_key(kind, geometry, reps, depth, topo,
                               cfg_token)] = entry
     _store_cache(store)
+
+
+def choose_stream_topology(geometry: Tuple[int, int, int], reps: int,
+                           depth: int, n_devices: int,
+                           backend: str = "xla",
+                           filter_name: str = "gaussian",
+                           frames: Optional[int] = None,
+                           halo: int = 1) -> str:
+    """The MODELED best stream topology for one (geometry, reps, depth)
+    on ``n_devices`` — ``"single"``, ``"fanout"``, ``"shard"`` or
+    ``"pipeline"`` — ranked by the roofline's steady-state frames/s
+    bounds (:mod:`tpu_stencil.runtime.roofline`), with the pipeline arm
+    paying its fill/drain term for the given stream length. This is the
+    model HALF of the auto knobs' discipline: it gates which measured
+    A/B is worth probing at all, and a multi-device topology is chosen
+    only when its modeled bound STRICTLY beats single-device — a
+    modeled tie stays single, the same never-enable-a-loss rule the
+    measured verdicts enforce (so e.g. a reps count too small to
+    amortize the pipeline fill can never select the pipeline)."""
+    from tpu_stencil.runtime import roofline
+
+    h, w, channels = geometry
+    frame_bytes = h * w * channels
+    single = roofline.stream_frames_per_second(
+        frame_bytes, reps, backend, filter_name, h,
+        pipeline_depth=depth,
+    )
+    best, best_fps = "single", single
+    if n_devices >= 2:
+        fan = roofline.mesh_stream_frames_per_second(
+            frame_bytes, reps, backend, filter_name, h,
+            pipeline_depth=depth, n_devices=n_devices,
+        )
+        if fan > best_fps:
+            best, best_fps = "fanout", fan
+        grid = (n_devices, 1) if h >= w else (1, n_devices)
+        tile = roofline.shard_tile_shape(h, w, grid)
+        if min(tile) >= halo:
+            shard = roofline.sharded_stream_frames_per_second(
+                frame_bytes, reps, backend, filter_name, h, w,
+                channels, grid, halo=halo, pipeline_depth=depth,
+            )
+            if shard > best_fps:
+                best, best_fps = "shard", shard
+        pipe = roofline.pipeline_stream_frames_per_second(
+            frame_bytes, reps, backend, filter_name, h,
+            pipe_stages=n_devices, frames=frames,
+            pipeline_depth=depth,
+        )
+        if pipe > best_fps:
+            best, best_fps = "pipeline", pipe
+    return best
 
 
 def best_config(

@@ -22,6 +22,7 @@ Usage:
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 import time
 from typing import List, Optional
@@ -234,6 +235,47 @@ def _row(img, filter_name, mode, size_label, backend, budget_s, reps,
     }
 
 
+def _measure_pipe_per_frame_rep(
+    img: np.ndarray, filter_name: str, stages: int, budget_s: float,
+):
+    """Steady-state seconds per frame-repetition through a K-stage
+    temporal pipeline (docs/STREAMING.md "Temporal pipeline"): the rep
+    loop split over K mesh slices, the same frame fed every tick, each
+    steady-state tick completing one fully-processed frame. The fill
+    ticks run before the timer starts, so the number is the systolic
+    steady state — comparable to the batch row (both are us per
+    frame*rep), not to the single-frame latency rows."""
+    import jax
+
+    from tpu_stencil.models.blur import IteratedConv2D
+    from tpu_stencil.parallel.pipeline import PipelineRunner
+
+    model = IteratedConv2D(filter_name, backend="xla")
+    channels = img.shape[2] if img.ndim == 3 else 1
+    runner = PipelineRunner(
+        model, tuple(img.shape[:2]), channels, stages,
+        devices=jax.devices()[:stages],
+    )
+    tile = np.zeros(runner.local_shape, np.uint8)
+    tile[0, : img.shape[0], : img.shape[1]] = img
+    d0 = runner.stage0_devices[0]
+    inp = runner.assemble_input({d0.id: jax.device_put(tile, d0)})
+    reps = 40
+    carry = runner.warm(reps)
+    for _ in range(stages):  # fill: every stage holds a frame
+        carry, out = runner.tick(carry, inp, reps)
+    jax.block_until_ready(out)
+    n = 0
+    t0 = time.perf_counter()
+    while True:
+        carry, out = runner.tick(carry, inp, reps)
+        jax.block_until_ready(out)
+        n += 1
+        if n >= 3 and time.perf_counter() - t0 > budget_s:
+            break
+    return (time.perf_counter() - t0) / n / reps
+
+
 def run_sweep(
     quick: bool = False,
     stress: bool = False,
@@ -241,6 +283,7 @@ def run_sweep(
     csv_path: Optional[str] = None,
     backends: Optional[List[str]] = None,
     frames: int = 0,
+    pipe_stages: int = 1,
 ) -> List[dict]:
     filters = filters or ["gaussian"]
     backends = backends or ["xla"]
@@ -305,6 +348,40 @@ def run_sweep(
                 "total_s": round(per_fr * 40 * frames, 6),
                 "hbm_gbps": round(gbps, 1), "pct_hbm_peak": round(pct, 1),
                 "gtx970_40reps_s": _CUDA_40REPS[("rgb", 2520)] * frames,
+                "speedup_vs_gtx970": round(
+                    _CUDA_40REPS[("rgb", 2520)] / (per_fr * 40), 1
+                ),
+            })
+    if pipe_stages > 1:
+        import jax
+
+        from tpu_stencil.runtime import roofline
+
+        if len(jax.devices()) < pipe_stages:
+            print(
+                f"pipe row skipped: {pipe_stages} stages need "
+                f"{pipe_stages} devices, have {len(jax.devices())}",
+                file=sys.stderr, flush=True,
+            )
+        else:
+            img = rng.integers(0, 256, size=(2520, WIDTH, 3), dtype=np.uint8)
+            per_fr = _with_retries(
+                lambda: _measure_pipe_per_frame_rep(
+                    img, "gaussian", pipe_stages, budget_s
+                ),
+                f"pipe{pipe_stages} [xla]",
+            )
+            gbps, pct = roofline.achieved(
+                img.nbytes, per_fr, "xla", "gaussian", 2520
+            )
+            add({
+                "filter": "gaussian", "mode": "rgb",
+                "size": f"{WIDTH}x2520 pipe{pipe_stages}",
+                "backend": f"xla:pipe{pipe_stages}",
+                "us_per_rep": round(per_fr * 1e6, 1), "reps": 40,
+                "total_s": round(per_fr * 40, 6),
+                "hbm_gbps": round(gbps, 1), "pct_hbm_peak": round(pct, 1),
+                "gtx970_40reps_s": _CUDA_40REPS[("rgb", 2520)],
                 "speedup_vs_gtx970": round(
                     _CUDA_40REPS[("rgb", 2520)] / (per_fr * 40), 1
                 ),
@@ -377,6 +454,14 @@ def main(argv=None) -> int:
              "tall-image kernel); reports us per frame*rep",
     )
     p.add_argument(
+        "--pipe-stages", type=int, metavar="K",
+        default=int(os.environ.get("TPU_STENCIL_BENCH_PIPE") or 1),
+        help="also measure the K-stage temporal pipeline at the "
+             "north-star size (us per frame*rep, steady state; needs K "
+             "devices); defaults to TPU_STENCIL_BENCH_PIPE so a sentry "
+             "burst turns the row on with the same knob bench.py uses",
+    )
+    p.add_argument(
         "--platform", default=None, choices=["cpu", "tpu", "gpu"],
         help="force the JAX platform via the config API (same contract as "
              "the CLI flag — wins over a pinned JAX_PLATFORMS); rehearsal "
@@ -391,6 +476,7 @@ def main(argv=None) -> int:
         quick=ns.quick, stress=ns.stress,
         filters=ns.filters.split(","), csv_path=ns.csv,
         backends=ns.backends.split(","), frames=ns.frames,
+        pipe_stages=ns.pipe_stages,
     )
     print(emit_markdown(rows))
     return 0

@@ -325,23 +325,26 @@ def restore_sharded(cfg: JobConfig, sharding) -> Optional[Tuple[int, "object"]]:
 class MeshCursorMismatch(ValueError):
     """A ``--resume`` of a mesh-composed stream run under a different
     mesh topology than the one that wrote the checkpoint — the fan
-    width (``--mesh-frames`` device count) or the spatial shard
-    topology (``--shard-frames RxC``). The recorded cursor/scatter
-    layout is aligned to the writing run's topology, so silently
-    adopting it under another one would misattribute frames to devices
-    (fan) or mis-scatter tiles (shard); the resume must fail typed,
-    naming both topologies (the recorded one and the requested one).
+    width (``--mesh-frames`` device count), the spatial shard topology
+    (``--shard-frames RxC``), or the temporal stage count
+    (``--pipe-stages K``). The recorded cursor/scatter/fill layout is
+    aligned to the writing run's topology, so silently adopting it
+    under another one would misattribute frames to devices (fan),
+    mis-scatter tiles (shard) or mis-weave the deal (pipeline); the
+    resume must fail typed, naming both topologies (the recorded one
+    and the requested one).
 
     ``recorded``/``requested`` are device counts (ints) for the fan
-    guard, ``"RxC"`` strings for the spatial-shard guard."""
+    guard, descriptive topology strings for the spatial-shard and
+    pipeline guards."""
 
     def __init__(self, recorded, requested, path: str) -> None:
         if isinstance(recorded, str) or isinstance(requested, str):
             super().__init__(
-                f"stream checkpoint at {path} records spatial shard "
-                f"topology {recorded} (--shard-frames) but --resume is "
-                f"running {requested}; re-run at the recorded topology "
-                f"(or delete the checkpoint to start over)"
+                f"stream checkpoint at {path} records topology "
+                f"{recorded} but --resume is running {requested}; "
+                f"re-run at the recorded topology (or delete the "
+                f"checkpoint to start over)"
             )
         else:
             super().__init__(
@@ -385,7 +388,8 @@ def _stream_fingerprint(cfg) -> dict:
 def save_stream_progress(cfg, frames_done: int,
                          mesh_devices: int = 1,
                          cursors: Optional[list] = None,
-                         shard_frames: Optional[Tuple[int, int]] = None
+                         shard_frames: Optional[Tuple[int, int]] = None,
+                         pipe_stages: int = 1
                          ) -> None:
     """Atomically record that frames [0, frames_done) are durably in
     the sink. No frame payload — unlike the rep checkpoints, a stream's
@@ -407,7 +411,13 @@ def save_stream_progress(cfg, frames_done: int,
     topology instead — the scatter layout every staged tile of the
     writing run followed. A resume under a different topology (or
     under no topology at all) must refuse typed rather than silently
-    mis-scatter, the same contract as the fan's device count."""
+    mis-scatter, the same contract as the fan's device count.
+
+    Temporal-pipeline runs (``pipe_stages > 1``) record the stage
+    count too — the three axes together pin the writing run's full
+    placement, and a resume under any different axis value refuses
+    typed (the recorded deal/scatter/fill discipline is only
+    meaningful at the recorded topology)."""
     _checkpoint_fault(int(frames_done))
     path = _stream_paths(cfg)
     meta = dict(_stream_fingerprint(cfg), frames_done=int(frames_done))
@@ -417,6 +427,8 @@ def save_stream_progress(cfg, frames_done: int,
             meta["device_cursors"] = [int(c) for c in cursors]
     if shard_frames is not None:
         meta["shard_frames"] = [int(d) for d in shard_frames]
+    if pipe_stages > 1:
+        meta["pipe_stages"] = int(pipe_stages)
     _write_meta(path, meta)
 
 
@@ -425,7 +437,8 @@ def _topology_str(shard) -> str:
 
 
 def restore_stream_progress(cfg, mesh_devices: int = 1,
-                            shard_frames: Optional[Tuple[int, int]] = None
+                            shard_frames: Optional[Tuple[int, int]] = None,
+                            pipe_stages: int = 1
                             ) -> Optional[int]:
     """Frames already completed by a matching prior run, or None. A
     fingerprint mismatch raises (resuming a different job's sink would
@@ -456,9 +469,17 @@ def restore_stream_progress(cfg, mesh_devices: int = 1,
     req_shard = tuple(int(d) for d in shard_frames) if shard_frames else None
     if rec_shard != req_shard:
         raise MeshCursorMismatch(
-            _topology_str(rec_shard),
+            f"spatial shard {_topology_str(rec_shard)} (--shard-frames)",
             (f"--shard-frames {_topology_str(req_shard)}"
              if req_shard else "single-device"),
+            path,
+        )
+    rec_pipe = int(meta.get("pipe_stages", 1))
+    if rec_pipe != int(pipe_stages):
+        # The temporal-axis guard, same contract as the other two.
+        raise MeshCursorMismatch(
+            f"{rec_pipe} pipeline stage(s) (--pipe-stages)",
+            f"--pipe-stages {int(pipe_stages)}",
             path,
         )
     return int(meta["frames_done"])

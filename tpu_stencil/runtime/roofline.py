@@ -343,6 +343,78 @@ def mesh_stream_frames_per_second(frame_bytes: int, reps: int,
                pcie_contention_frames_per_second(frame_bytes))
 
 
+def pipeline_fill_drain_factor(frames: Optional[int],
+                               pipe_stages: int) -> float:
+    """The throughput fraction a K-stage temporal pipeline keeps after
+    paying its fill and drain: a stream of F frames needs ``F + K - 1``
+    ticks (the first ``K - 1`` outputs are fill garbage, the last
+    ``K - 1`` ticks push zero-frames through to drain), so the achieved
+    rate is ``F / (F + K - 1)`` of the steady-state tick rate. ``None``
+    frames (until-EOF streams of unknown length) model as an infinite
+    stream — factor 1.0; short explicit streams pay the full term, which
+    is exactly why the auto knob must never enable the pipeline for a
+    few-frame clip."""
+    if frames is None or frames <= 0:
+        return 1.0
+    k = max(1, pipe_stages)
+    return frames / float(frames + k - 1)
+
+
+def pipeline_stream_stage_seconds(frame_bytes: int, reps: int,
+                                  backend: str, filter_name: str,
+                                  h_img: int, pipe_stages: int,
+                                  block_h=None, fuse=None) -> dict:
+    """Modeled per-TICK seconds of the temporal pipeline's streaming
+    stages (``--pipe-stages K``): ``h2d``/``d2h`` still move one whole
+    frame across PCIe per tick (a frame enters at stage 0 and leaves at
+    stage K-1 every tick at steady state), while ``compute`` is one
+    stage's share of the rep loop — ``ceil(reps / K)`` repetitions (the
+    widest stage bounds the tick; contiguous slicing gives the early
+    stages the remainder) against the HBM roofline, plus one whole-frame
+    ICI hand-off to the next stage (the systolic shift every tick
+    performs, absent at K=1). Host ``read``/``write`` stay measured,
+    never modeled."""
+    per_rep = analytic_bytes_per_rep(
+        frame_bytes, backend, filter_name, h_img, block_h, fuse
+    )
+    k = max(1, pipe_stages)
+    stage_reps = -(-reps // k)
+    handoff = frame_bytes / (V5E_ICI_GBPS * 1e9) if k > 1 else 0.0
+    return {
+        "h2d": frame_bytes / (V5E_PCIE_GBPS * 1e9),
+        "compute": stage_reps * per_rep / (V5E_HBM_GBPS * 1e9) + handoff,
+        "d2h": frame_bytes / (V5E_PCIE_GBPS * 1e9),
+    }
+
+
+def pipeline_stream_frames_per_second(frame_bytes: int, reps: int,
+                                      backend: str, filter_name: str,
+                                      h_img: int, pipe_stages: int,
+                                      frames: Optional[int] = None,
+                                      block_h=None, fuse=None,
+                                      pipeline_depth: int = 2) -> float:
+    """The modeled frames/s bound of the temporal pipeline
+    (:mod:`tpu_stencil.stream.pipelined`): the steady-state tick rate —
+    max-stage of :func:`pipeline_stream_stage_seconds` at dispatch
+    depth >= 2, serial sum at depth 1 — discounted by the fill/drain
+    term :func:`pipeline_fill_drain_factor` for the stream length. At
+    large ``reps`` the compute stage shrinks by ~K and the pipeline
+    wins; at small ``reps`` the per-tick ICI hand-off plus the fill
+    cost make it a modeled loss, and the auto knob must then never even
+    probe it."""
+    stages = pipeline_stream_stage_seconds(
+        frame_bytes, reps, backend, filter_name, h_img, pipe_stages,
+        block_h=block_h, fuse=fuse,
+    )
+    bound = (
+        sum(stages.values()) if pipeline_depth <= 1
+        else max(stages.values())
+    )
+    if bound <= 0:
+        return float("inf")
+    return pipeline_fill_drain_factor(frames, pipe_stages) / bound
+
+
 def achieved_frames(frame_bytes: int, n_frames: int, per_rep_s: float,
                     backend: str, filter_name: str, h_img: int,
                     block_h=None, fuse=None) -> Tuple[float, float]:

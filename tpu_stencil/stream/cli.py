@@ -129,9 +129,30 @@ def build_parser() -> argparse.ArgumentParser:
              "cached; frames past the per-device HBM feasibility bound "
              "shard without a probe). Frames below --shard-min-pixels "
              "stay single-device (serve's routing discipline). "
-             "Mutually exclusive with --mesh-frames; bit-exact; "
-             "checkpoints record the topology, so --resume under a "
-             "different RxC fails typed",
+             "Composes with --mesh-frames and --pipe-stages (all "
+             "composed axes must be explicit); bit-exact; checkpoints "
+             "record the topology, so --resume under a different RxC "
+             "fails typed",
+    )
+    p.add_argument(
+        "--pipe-stages", dest="pipe_stages", type=int, default=1,
+        metavar="K",
+        help="temporal pipeline: split the rep loop into K contiguous "
+             "stages, each pinned to a mesh slice, frames flowing "
+             "systolically stage-to-stage over ICI inside ONE "
+             "persistent program — no host round-trip between stages "
+             "(docs/STREAMING.md 'Temporal pipeline'). 1 = off "
+             "(default); K > 1 fails loudly when the composed device "
+             "budget (mesh-frames x K x RxC) exceeds what exists; 0 = "
+             "auto — gated first by the roofline fill/drain model, "
+             "then a measured single-vs-pipeline A/B enables stages "
+             "only when strictly faster (verdict cached). Composes "
+             "with --mesh-frames (independent pipeline groups) and "
+             "--shard-frames (each stage an RxC spatial mesh) under "
+             "the three-axis placement model; fill/drain is explicit, "
+             "so short streams (frames < K) stay bit-exact; "
+             "checkpoints record the stage count, so --resume under a "
+             "different K fails typed",
     )
     p.add_argument(
         "--shard-min-pixels", dest="shard_min_pixels", type=int,
@@ -289,6 +310,7 @@ def main(argv=None) -> int:
             ring_buffers=ns.ring_buffers,
             mesh_frames=ns.mesh_frames,
             shard_frames=shard_frames,
+            pipe_stages=ns.pipe_stages,
             shard_min_pixels=ns.shard_min_pixels,
             overlap=ns.overlap,
             checkpoint_every=ns.checkpoint_every,
@@ -376,12 +398,15 @@ def main(argv=None) -> int:
         + (f" shard-frames={result.shard_frames[0]}x"
            f"{result.shard_frames[1]}"
            if result.shard_frames else "")
+        + (f" pipe-stages={result.pipe_stages}"
+           if result.pipe_stages > 1 else "")
         + (f" mesh-frames={result.n_devices}dev"
-           if result.n_devices > 1 and not result.shard_frames else "")
+           if result.n_devices > 1 and not result.shard_frames
+           and result.pipe_stages == 1 else "")
         + ")", file=report_out,
     )
-    if result.n_devices > 1 and not result.shard_frames \
-            and result.per_device_frames:
+    if result.per_device_frames and len(result.per_device_frames) > 1:
+        # Mesh fan lanes, or pipeline groups under a composed topology.
         print(
             "per-device frames: "
             + " ".join(f"dev{d}={c}"
@@ -410,6 +435,7 @@ def main(argv=None) -> int:
             "shard_frames": (
                 list(result.shard_frames) if result.shard_frames else None
             ),
+            "pipe_stages": result.pipe_stages,
             "output": out_spec,
         }
         text = json.dumps(payload, indent=2, sort_keys=True)
@@ -454,6 +480,7 @@ def _report_observability(ns, cfg: StreamConfig, result, out) -> None:
             "wall_seconds": result.wall_seconds,
             "n_devices": result.n_devices,
             "shard_frames": result.shard_frames,
+            "pipe_stages": result.pipe_stages,
             "halo": halo,
         }), end="", file=out)
         print(obs.breakdown.render_resilience(obs.snapshot()),

@@ -122,6 +122,12 @@ class StreamResult:
     # --shard-frames 0 and the shard_min_pixels routing discipline
     # resolve before this is set; n_devices is then R*C).
     shard_frames: Optional[Tuple[int, int]] = None
+    # Temporal pipeline (tpu_stencil.stream.pipelined): the stage count
+    # frames flowed through, 1 = no pipeline (report-what-ran —
+    # --pipe-stages 0 resolves by the roofline gate + measured A/B
+    # before this is set). Under composition n_devices is the full
+    # three-axis budget: groups * pipe_stages * R * C.
+    pipe_stages: int = 1
 
 
 class _Abort(Exception):
@@ -679,21 +685,39 @@ def run_stream(
     feasibility-forced auto verdict —
     :func:`tpu_stencil.stream.sharded.resolve_shard_frames`) and every
     restart re-shards at the SAME topology, so the checkpoint's
-    recorded scatter layout stays aligned."""
+    recorded scatter layout stays aligned.
+
+    Temporal pipeline (``cfg.pipe_stages != 1``): the stage count is
+    resolved ONCE per call (explicit K, or the roofline-gated measured
+    auto A/B — :func:`tpu_stencil.parallel.pipeline
+    .resolve_pipe_stages`). Stages compose with the other two axes
+    under the three-axis placement model: ``--mesh-frames G`` becomes G
+    independent pipeline groups and ``--shard-frames RxC`` shards each
+    stage spatially (:mod:`tpu_stencil.stream.pipelined` — also the
+    route for mesh-of-sharded-groups at K = 1); the composed topology
+    must be explicit on every active axis (the config contract), so no
+    auto probe ever races another axis's resolution."""
     restarts = 0
     n_mesh = None
+    pipe = None
     shard = _UNRESOLVED
     while True:
         try:
             if shard is _UNRESOLVED:
                 shard = _resolve_shard_frames(cfg, devices)
+            if pipe is None:
+                pipe = _resolve_pipe_stages(cfg, devices)
             if n_mesh is None:
-                n_mesh = (
-                    1 if shard is not None
-                    else _resolve_mesh_frames(cfg, devices)
-                )
+                if shard is not None or pipe > 1:
+                    # Composed run: mesh_frames is explicit (the config
+                    # refuses composed autos) — it is the group count,
+                    # never re-resolved against another axis's devices.
+                    n_mesh = cfg.mesh_frames if cfg.mesh_frames > 1 else 1
+                else:
+                    n_mesh = _resolve_mesh_frames(cfg, devices)
             result = _run_stream_once(cfg, devices, resume, source, sink,
-                                      n_mesh=n_mesh, shard=shard)
+                                      n_mesh=n_mesh, shard=shard,
+                                      pipe=pipe)
             result.restarts = restarts
             return result
         except StreamFailure as e:
@@ -727,7 +751,8 @@ def _finish_result(cfg: StreamConfig, resume: bool, t_start: float,
                    backend: str, schedule, out_spec: str,
                    n_devices: int = 1,
                    per_device_frames: Optional[list] = None,
-                   shard_frames: Optional[Tuple[int, int]] = None
+                   shard_frames: Optional[Tuple[int, int]] = None,
+                   pipe_stages: int = 1
                    ) -> StreamResult:
     """The shared run epilogue both engines (single-device and mesh
     fan-out) end in: sweep the progress sidecar of a completed run,
@@ -752,6 +777,7 @@ def _finish_result(cfg: StreamConfig, resume: bool, t_start: float,
         n_devices=n_devices,
         per_device_frames=per_device_frames,
         shard_frames=shard_frames,
+        pipe_stages=pipe_stages,
     )
 
 
@@ -790,6 +816,21 @@ def _resolve_shard_frames(cfg: StreamConfig, devices
     return shardstream.resolve_shard_frames(cfg, devs)
 
 
+def _resolve_pipe_stages(cfg: StreamConfig, devices) -> int:
+    """The temporal stage count this run pipelines over: 1 without
+    ``--pipe-stages`` (no jax import at all on that path), else the
+    pipeline resolver's verdict (explicit K under the composed device
+    budget, or the roofline-gated measured auto A/B)."""
+    if cfg.pipe_stages == 1:
+        return 1
+    import jax
+
+    from tpu_stencil.parallel import pipeline as ppipe
+
+    devs = devices if devices is not None else jax.devices()
+    return ppipe.resolve_pipe_stages(cfg, devs)
+
+
 def _close_io(own_source, source, own_sink, sink, failed: bool) -> None:
     """The mesh/shard-branch close discipline, in ONE place (the two
     branches used to carry verbatim copies): closing the source can
@@ -818,14 +859,18 @@ def _run_stream_once(
     sink: Optional[frames_io.FrameSink] = None,
     n_mesh: int = 1,
     shard: Optional[Tuple[int, int]] = None,
+    pipe: int = 1,
 ) -> StreamResult:
     """One pipeline lifetime (see :func:`run_stream`, which owns the
-    engine-restart loop around this). ``n_mesh`` > 1 routes the frame
-    loop through the mesh fan-out engine
-    (:mod:`tpu_stencil.parallel.fanout`); a resolved ``shard`` = (R, C)
-    routes it through the spatially-sharded engine
+    engine-restart loop around this). ``pipe`` > 1 — or a composed
+    ``n_mesh`` > 1 with a resolved ``shard`` — routes the frame loop
+    through the temporal-pipeline engine
+    (:mod:`tpu_stencil.stream.pipelined`, the three-axis composer);
+    otherwise ``n_mesh`` > 1 routes through the mesh fan-out engine
+    (:mod:`tpu_stencil.parallel.fanout`) and a resolved ``shard`` =
+    (R, C) through the spatially-sharded engine
     (:mod:`tpu_stencil.stream.sharded`) — resume/IO resolution, the
-    restart ladder, and result assembly stay shared here, so the three
+    restart ladder, and result assembly stay shared here, so the four
     engines can never drift on those contracts."""
     import jax
 
@@ -838,20 +883,31 @@ def _run_stream_once(
                            block_h=cfg.block_h, fuse=cfg.fuse)
     if devices is None:
         devices = jax.devices()
-    devices = devices[: shard[0] * shard[1]] if shard else devices[:n_mesh]
+    composed = pipe > 1 or (n_mesh > 1 and shard is not None)
+    if composed:
+        # The full three-axis budget: groups x stages x spatial shard.
+        r, c = shard if shard else (1, 1)
+        devices = devices[: n_mesh * pipe * r * c]
+    elif shard:
+        devices = devices[: shard[0] * shard[1]]
+    else:
+        devices = devices[:n_mesh]
     # Report-what-ran for THIS run, on every path — a single-device run
-    # after a mesh/sharded one must not keep exposing stale topology.
+    # after a mesh/sharded/pipelined one must not keep exposing stale
+    # topology.
     obs.registry().gauge("stream_mesh_devices").set(n_mesh)
     obs.registry().gauge("stream_shard_devices").set(
         shard[0] * shard[1] if shard else 0
     )
+    obs.registry().gauge("stream_pipe_stages").set(pipe if pipe > 1 else 0)
 
     start_frame = 0
     if resume:
         from tpu_stencil.runtime import checkpoint as ckpt
 
         restored = ckpt.restore_stream_progress(cfg, mesh_devices=n_mesh,
-                                                shard_frames=shard)
+                                                shard_frames=shard,
+                                                pipe_stages=pipe)
         if restored is not None:
             start_frame = restored
     elif cfg.checkpoint_every:
@@ -892,6 +948,28 @@ def _run_stream_once(
         if own_source:
             source.close()
         raise
+
+    if composed:
+        from tpu_stencil.stream import pipelined
+
+        failed = False
+        try:
+            pres = pipelined.run_pipelined_stream(
+                cfg, devices, n_mesh, pipe, shard, model, source, sink,
+                start_frame,
+            )
+        except BaseException:
+            failed = True
+            raise
+        finally:
+            _close_io(own_source, source, own_sink, sink, failed)
+        return _finish_result(
+            cfg, resume, t_start, start_frame, pres["frames"],
+            pres["stage_seconds"], pres["backend"], pres["schedule"],
+            out_spec, n_devices=pres["n_devices"],
+            per_device_frames=pres["per_device_frames"],
+            shard_frames=shard, pipe_stages=pipe,
+        )
 
     if shard is not None:
         from tpu_stencil.stream import sharded as shardstream

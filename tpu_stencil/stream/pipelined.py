@@ -1,0 +1,360 @@
+"""Pipelined frame streaming: the three-axis composed engine.
+
+This module drives :class:`tpu_stencil.parallel.pipeline.PipelineRunner`
+from the stream: frames flow systolically through K temporal rep-stages
+over ICI inside ONE persistent ``shard_map`` program — no host
+round-trip between stages — and composes all three placement axes in a
+single run:
+
+* **frame lanes** (``--mesh-frames G``): G independent pipeline groups,
+  frames dealt round-robin (the fan-out deal, frame ``i`` -> group
+  ``(i - start) % G``), merged in order by one writer;
+* **temporal stages** (``--pipe-stages K``): each group's rep loop is
+  split into K contiguous stage slices, one resident frame per stage,
+  one ``lax.ppermute`` hand-off per tick;
+* **spatial shards** (``--shard-frames RxC``): each stage is an RxC
+  spatial mesh running the shared local step (halo exchange inside the
+  loop body).
+
+One group consumes ``K * R * C`` devices; the run consumes
+``G * K * R * C``. ``K == 1`` with ``G > 1`` and ``R*C > 1`` is the
+fan-of-sharded-groups composition PR 15 left open — here it is just the
+degenerate pipeline (no fill, immediate flush).
+
+Shape of the machine (docs/STREAMING.md "Temporal pipeline"):
+
+* **one reader thread** — the fan-out reader verbatim
+  (:func:`tpu_stencil.parallel.fanout._reader`): round-robin onto
+  per-group lanes, CRC at ingest, witness sampling, chaos site.
+* **per-group dispatch thread** — owns the fill/drain bookkeeping:
+  scatter the staged frame into stage-0 spatial tiles (pad zeroed
+  once), fenced per-tile H2D, assemble the 3-axis global input (every
+  non-stage-0 device rides a cached committed zero tile — no per-tick
+  H2D for them), run one tick. A deque of pending frame indices maps
+  ticks to emerging frames: the frame fed at tick ``t`` emerges at tick
+  ``t + K - 1``, so the oldest pending frame is flushed to the drain
+  once ``ticks >= K``, and after EOF the dispatcher runs zero-input
+  drain ticks until the deque empties — short streams (F < K) still
+  produce every frame, bit-exact.
+* **per-group drain thread** — fences the tick in dispatch order
+  (watchdogged), then copies back ONLY the last stage's shards (each
+  frame's finished result) with per-shard ``d2h`` spans, cropping the
+  pad off into the output frame.
+* **one writer thread** — the fan-out writer with a ``save_progress``
+  closure stamping the FULL three-axis topology into the checkpoint
+  sidecar, so a ``--resume`` under any different (G, K, RxC) fails
+  typed instead of silently mis-weaving the deal.
+
+Failure semantics, fault sites, stage spans/clocks and the
+engine-restart ladder are the engines' shared vocabulary
+(:mod:`tpu_stencil.stream.engine` owns the restart loop). Every path is
+bit-exact against the golden model: the per-stage rep counts partition
+``reps`` exactly and every stage runs the identical local step
+(``tests/test_pipeline.py`` fuzzes fill/drain edges — F < K, F == K,
+reps % K != 0 — against per-frame golden results).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from tpu_stencil import obs
+from tpu_stencil.config import StreamConfig
+from tpu_stencil.integrity import checksum as _checksum
+from tpu_stencil.integrity import witness as _witness_mod
+from tpu_stencil.resilience import deadline as _deadline
+from tpu_stencil.resilience import faults as _faults
+from tpu_stencil.stream import frames as frames_io
+# Module-level by design, like parallel/fanout.py and stream/sharded.py:
+# stream.engine only imports this module lazily inside run_stream, so
+# there is no cycle, and all engines share one _Abort/_StageSpan/
+# StreamFailure vocabulary.
+from tpu_stencil.stream import engine as _sengine
+from tpu_stencil.parallel import fanout as _fanout
+
+# The lanes/reader/writer are the fan-out machinery verbatim — one
+# deal, one merge, one EOF protocol across both multi-lane engines.
+_EOF = _fanout._EOF
+_Control = _fanout._Control
+_Lane = _fanout._Lane
+
+
+class _GroupPlumbing:
+    """One pipeline group's device-side state: the cached runner, the
+    stage-0 scatter layout derived from the RUNNER'S OWN sharding (the
+    staging views can never drift from what the compiled program
+    expects), and the last-stage gather map."""
+
+    def __init__(self, cfg: StreamConfig, runner) -> None:
+        self.runner = runner
+        gshape = runner.global_shape
+        imap = runner.sharding.devices_indices_map(gshape)
+        # Spatial tile specs, in stage-0 flat order. dims 1/2 of the
+        # (K, Hp, Wp[, C]) global are the padded spatial plane; every
+        # stage slice shares the SAME spatial layout, so these specs
+        # serve both the stage-0 scatter and the last-stage gather.
+        specs = []
+        for dev in runner.stage0_devices:
+            idx = imap[dev]
+            rows = slice(*idx[1].indices(gshape[1])[:2])
+            cols = slice(*idx[2].indices(gshape[2])[:2])
+            specs.append((rows, cols))
+        self.scatter = frames_io.TileScatter(cfg.frame_shape, specs)
+        self.stage0 = list(runner.stage0_devices)
+        self.last_to_tile = {
+            d.id: i for i, d in enumerate(runner.last_devices)
+        }
+
+
+def _dispatch(ctrl: _Control, cfg: StreamConfig, lane: _Lane,
+              pb: _GroupPlumbing, g: int) -> None:
+    """One group's tick loop, owning the fill/drain state machine.
+
+    ``pending`` holds the fed-but-not-yet-emerged frame indices in feed
+    order; its head is exactly the frame the current tick's last-stage
+    output contains once ``ticks >= K``. After the lane's EOF, drain
+    ticks feed the cached zero input until ``pending`` empties — the
+    explicit flush that makes F < K streams complete."""
+    import jax
+
+    runner = pb.runner
+    k = runner.stages
+    nsp = len(pb.stage0)
+    idx, stage = -1, "compute"  # bootstrap failures are compile/compute
+    fault_h2d = _faults.site("h2d")
+    fault_compute = _faults.site("compute")
+    try:
+        # Warm-up: the persistent tick's compile lands before the first
+        # real frame (reps is a traced scalar, so the zero-frame
+        # program IS the production program); its returned carry is the
+        # stream's initial fill state.
+        carry = runner.warm(cfg.repetitions)
+        zero = runner.zero_input()
+        pending: deque = deque()
+        ticks = 0
+        while True:
+            item = ctrl.get(lane.filled_q)
+            if item is _EOF:
+                break
+            idx, bi, crc, wit = item
+            stage = "h2d"
+            if fault_h2d is not None:
+                fault_h2d(idx)
+            # The shared H2D-boundary re-verification (ring slot), then
+            # per staged tile — ingest integrity per shard.
+            _sengine._verify_staged(lane.ring[bi], crc, idx)
+            tiles = pb.scatter.scatter(lane.ring[bi])
+            lane.free_q.put(bi)  # scatter consumed the ring slot
+            tile_crcs = (
+                [_checksum.crc32c(t) for t in tiles]
+                if cfg.verify_ingest else [None] * len(tiles)
+            )
+            stage0_map = {}
+            for d, (tile, dev) in enumerate(zip(tiles, pb.stage0)):
+                _sengine._verify_staged(tile, tile_crcs[d], idx)
+                with ctrl.stage("h2d", idx, dev=g * nsp + d) as s:
+                    # Fenced per tile: the span holds only THIS tile's
+                    # PCIe copy; the pipeline keeps ticking. The [None]
+                    # view adds the unit stages dim of the local shape —
+                    # and MUST be snapshotted: device_put zero-copy
+                    # aliases host views on the CPU backend, and the
+                    # scatter reuses this staging tile on the next
+                    # frame, which would rewrite an in-flight tick's
+                    # input under it.
+                    stage0_map[dev.id] = s.fence(
+                        jax.device_put(np.array(tile[None]), dev)
+                    )
+            inp = runner.assemble_input(stage0_map)
+            stage = "compute"
+            if fault_compute is not None:
+                fault_compute(idx)
+            t_disp = time.perf_counter()
+            carry, out = runner.tick(carry, inp, cfg.repetitions)
+            pending.append((idx, wit, t_disp))
+            ticks += 1
+            if ticks >= k:
+                fidx, fwit, ft = pending.popleft()
+                ctrl.put(lane.inflight_q, (fidx, out, ft, fwit))
+        # EOF: drain ticks on zero input until every fed frame has
+        # emerged from the last stage (K - 1 ticks on a long stream;
+        # up to K - 1 + fed on a short one — same loop either way).
+        stage = "compute"
+        while pending:
+            carry, out = runner.tick(carry, zero, cfg.repetitions)
+            ticks += 1
+            if ticks >= k:
+                fidx, fwit, ft = pending.popleft()
+                ctrl.put(lane.inflight_q, (fidx, out, ft, fwit))
+        ctrl.put(lane.inflight_q, _EOF)
+    except _sengine._Abort:
+        pass
+    except BaseException as e:
+        ctrl.fail(stage, max(idx, 0), e)
+
+
+def _drainer(ctrl: _Control, cfg: StreamConfig, lane: _Lane,
+             pb: _GroupPlumbing, g: int,
+             meter: "_fanout._InflightMeter") -> None:
+    """Fence one group's tick in dispatch order (watchdogged), copy
+    back ONLY the last stage's shards — each frame's finished result —
+    crop the pad off, hand off to the writer's merge."""
+    idx, stage = -1, "compute"
+    fault_d2h = _faults.site("d2h")
+    fault_corrupt = _faults.site("integrity.corrupt_result")
+    timeout_s = _deadline.resolve(cfg.dispatch_timeout_s)
+    try:
+        while True:
+            item = ctrl.get(lane.inflight_q)
+            if item is _EOF:
+                ctrl.put(lane.done_q, _EOF)
+                return
+            idx, out_dev, t_disp, wit = item
+            stage = "compute"
+            with ctrl.stage("compute", idx, t0=t_disp, dev=g):
+                _deadline.fence(
+                    out_dev, timeout_s,
+                    f"stream.compute[frame={idx},pipe-group={g}]",
+                )
+            stage = "d2h"
+            frame = np.empty(cfg.frame_shape, np.uint8)
+            for shard in out_dev.addressable_shards:
+                d = pb.last_to_tile.get(shard.device.id)
+                if d is None:
+                    continue  # not a last-stage shard: still in flight
+                with ctrl.stage("d2h", idx, dev=g * len(pb.stage0) + d):
+                    if fault_d2h is not None:
+                        fault_d2h(idx)
+                    piece = np.asarray(shard.data)
+                pb.scatter.gather_into(frame, [(d, piece[0])])
+            if fault_corrupt is not None and _checksum.fired(
+                    fault_corrupt, idx):
+                _checksum.corrupt_array(frame)
+            meter.dec()
+            ctrl.put(lane.done_q, (idx, frame, wit))
+    except _sengine._Abort:
+        pass
+    except BaseException as e:
+        ctrl.fail(stage, max(idx, 0), e)
+
+
+def run_pipelined_stream(cfg: StreamConfig, devices, groups: int,
+                         stages: int, shard: Optional[Tuple[int, int]],
+                         model, source, sink, start_frame: int) -> dict:
+    """One pipelined-stream lifetime over the composed
+    (``groups`` x ``stages`` x RxC) topology. The caller
+    (:func:`tpu_stencil.stream.engine._run_stream_once`) owns
+    source/sink lifecycle, resume resolution and result assembly; this
+    returns ``{"frames", "stage_seconds", "per_device_frames",
+    "backend", "schedule", "n_devices"}`` or raises
+    :class:`~tpu_stencil.stream.engine.StreamFailure`. Each group's
+    persistent tick program comes from the PROCESS-SHARED runner cache
+    (:func:`tpu_stencil.parallel.pipeline.shared_pipeline_runner`) —
+    groups over identical shapes share one trace, and repeat runs never
+    recompile."""
+    from tpu_stencil.parallel import pipeline as _ppipe
+
+    r, c = shard if shard else (1, 1)
+    per_group = stages * r * c
+    need = groups * per_group
+    devices = list(devices)
+    if len(devices) < need:
+        raise ValueError(
+            f"pipelined topology {groups} group(s) x {stages} stage(s) "
+            f"x {r}x{c} shard needs {need} devices, have {len(devices)}"
+        )
+    plumbing: List[_GroupPlumbing] = []
+    for g in range(groups):
+        runner = _ppipe.shared_pipeline_runner(
+            model, (cfg.height, cfg.width), cfg.channels, stages,
+            shard_shape=(r, c),
+            devices=devices[g * per_group: (g + 1) * per_group],
+            registry=obs.registry(),
+        )
+        if runner is None:
+            # An explicitly requested topology the mesh cannot serve
+            # fails loudly, naming the constraint — no silent fallback
+            # mid-stream (the run_shard_stream discipline).
+            raise ValueError(
+                f"--pipe-stages {stages} with shard {r}x{c} cannot "
+                f"serve a {cfg.height}x{cfg.width} frame: the "
+                f"per-device tile is smaller than the filter halo (or "
+                f"the boundary refuses padding); use a smaller shard "
+                f"grid or a larger frame"
+            )
+        plumbing.append(_GroupPlumbing(cfg, runner))
+    ctrl = _Control()
+    lanes = [_Lane(cfg) for _ in range(groups)]
+    done = [start_frame]
+    meter = _fanout._InflightMeter()
+    witness = (
+        _witness_mod.WitnessSampler(cfg.witness_rate,
+                                    seed=cfg.witness_seed)
+        if (cfg.witness_rate > 0
+            and cfg.repetitions <= _witness_mod.WITNESS_MAX_REPS)
+        else None
+    )
+
+    def save_progress(frames_done: int) -> None:
+        from tpu_stencil.runtime import checkpoint as ckpt
+
+        ckpt.save_stream_progress(
+            cfg, frames_done, mesh_devices=groups,
+            cursors=(_fanout.device_cursors(frames_done, start_frame,
+                                            groups)
+                     if groups > 1 else None),
+            shard_frames=shard, pipe_stages=stages,
+        )
+
+    threads = [
+        threading.Thread(
+            target=_fanout._reader,
+            args=(ctrl, cfg, source, lanes, start_frame, meter, witness),
+            name="pipelined-reader", daemon=True,
+        ),
+        threading.Thread(
+            target=_fanout._writer,
+            args=(ctrl, cfg, sink, lanes, start_frame, done,
+                  save_progress),
+            name="pipelined-writer", daemon=True,
+        ),
+    ]
+    for g, (lane, pb) in enumerate(zip(lanes, plumbing)):
+        threads.append(threading.Thread(
+            target=_dispatch, args=(ctrl, cfg, lane, pb, g),
+            name=f"pipelined-dispatch-{g}", daemon=True,
+        ))
+        threads.append(threading.Thread(
+            target=_drainer, args=(ctrl, cfg, lane, pb, g, meter),
+            name=f"pipelined-drain-{g}", daemon=True,
+        ))
+    try:
+        for t in threads:
+            t.start()
+        # Clean runs end via the sentinel cascade; failed runs via the
+        # stop flag. Like the other engines, never wait indefinitely on
+        # a reader parked in a blocking pipe read.
+        for t in threads:
+            while t.is_alive() and not ctrl.stop.is_set():
+                t.join(timeout=0.1)
+    finally:
+        ctrl.stop.set()
+        for t in threads:
+            t.join(timeout=1.0)
+        meter.zero()  # aborted in-flight frames never pass dec()
+    if ctrl.failure is not None:
+        stage, frame_index, cause = ctrl.failure
+        raise _sengine.StreamFailure(stage, frame_index, cause) from cause
+    runner0 = plumbing[0].runner
+    return {
+        "frames": done[0] - start_frame,
+        "stage_seconds": dict(ctrl.stage_seconds),
+        "per_device_frames": [lane.frames for lane in lanes],
+        "backend": runner0.backend,
+        "schedule": runner0.schedule,
+        "n_devices": need,
+    }
