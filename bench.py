@@ -111,6 +111,17 @@ versioned ``..._fed<N>_wall_per_request`` headline with a
 arxiv 2605.07954 >=0.8x-linear acceptance bar one hop above meshfan
 (``TPU_STENCIL_BENCH_FED_REQUESTS`` tunes the run).
 
+Elastic mode: ``TPU_STENCIL_BENCH_FED_ELASTIC=1`` runs the control
+plane's subprocess provider against an in-process fed: one host serves
+the first load phase, a second host is launched (warm-started over
+``/admin/warmstate``) WHILE the middle phase runs, and the
+``..._fed_elastic_wall_per_request`` headline carries a
+``resize_window_p99_s`` rider — the client-side p99 of exactly the
+requests in flight during the resize, the number the elastic
+acceptance bar watches (same REQUESTS/MEMBER_PLATFORM knobs as the
+federation mode; scale-in drains before stop, so ``clean_drain`` rides
+too).
+
 Exit codes: 0 = capture landed (even partial-only); 1 = nothing
 parseable; 2 = the requested backend is unavailable (init failed — the
 parent does NOT retry: a 4-attempt backoff loop against a dead backend
@@ -123,6 +134,7 @@ warn|off softens the gate.
 from __future__ import annotations
 
 import json
+import math
 import os
 import subprocess
 import sys
@@ -1539,6 +1551,169 @@ def _measure_fed(platform: str) -> dict:
     }
 
 
+def _measure_fed_elastic(platform: str) -> dict:
+    """Elastic capture (``TPU_STENCIL_BENCH_FED_ELASTIC=1``): the
+    control plane's subprocess provider under load. One member host
+    serves phase A; DURING phase B a second host is launched through
+    the actuator (self-registers, warm-starts its executables from the
+    fleet over ``/admin/warmstate``); phase C runs on the grown fleet.
+    Emits ``..._fed_elastic_wall_per_request`` with a
+    ``resize_window_p99_s`` rider — the client-side p99 of exactly the
+    requests in flight while the resize ran (a warm-started joiner
+    must not cost the tail a compile), plus ``clean_drain`` (scale-in
+    drained every host to a rc-0 exit) and the joiner's warm-start
+    counters scraped off the fed's member fold."""
+    import concurrent.futures
+    import urllib.request
+
+    from tpu_stencil.config import CtrlConfig, FedConfig
+    from tpu_stencil.ctrl.actuator import Actuator, SubprocessProvider
+    from tpu_stencil.fed.http import FedFrontend
+
+    n_req = int(os.environ.get("TPU_STENCIL_BENCH_FED_REQUESTS", "8"))
+    member_platform = os.environ.get(
+        "TPU_STENCIL_BENCH_FED_MEMBER_PLATFORM", "cpu"
+    )
+    rng = np.random.default_rng(0)
+    img = rng.integers(0, 256, size=(H, W, C), dtype=np.uint8)
+    body = img.tobytes()
+
+    fed = FedFrontend(FedConfig(
+        port=0, heartbeat_interval_s=0.5, reoffer_s=1.0,
+    )).start()
+    cfg = CtrlConfig(
+        fed_url=fed.url, min_hosts=1, max_hosts=2,
+        member_platform=member_platform,
+        launch_timeout_s=CHILD_TIMEOUT, drain_timeout_s=120.0,
+        warm_from=fed.url,
+    )
+    act = Actuator(cfg, SubprocessProvider(
+        fed_url=fed.url, platform=member_platform,
+        warm_from=fed.url, launch_timeout_s=cfg.launch_timeout_s,
+        drain_timeout_s=cfg.drain_timeout_s,
+    ))
+
+    def routable() -> int:
+        with urllib.request.urlopen(fed.url + "/statusz",
+                                    timeout=30) as r:
+            doc = json.loads(r.read())
+        return sum(1 for m in doc.get("members", [])
+                   if m.get("state") in ("healthy", "suspect"))
+
+    def wait_routable(k: int, timeout_s: float = 120.0) -> None:
+        deadline = time.perf_counter() + timeout_s
+        while time.perf_counter() < deadline:
+            if routable() >= k:
+                return
+            time.sleep(0.2)
+        raise RuntimeError(f"fed never saw {k} routable member(s)")
+
+    lat_lock = threading.Lock()
+    lats = []  # (t_completed, elapsed_s)
+
+    def post() -> None:
+        req = urllib.request.Request(
+            fed.url + f"/v1/blur?w={W}&h={H}&reps={REPS}"
+                      f"&channels={C}",
+            data=body, method="POST",
+        )
+        t_req = time.perf_counter()
+        with urllib.request.urlopen(req, timeout=CHILD_TIMEOUT) as r:
+            r.read()
+        with lat_lock:
+            lats.append((time.perf_counter(), time.perf_counter() - t_req))
+
+    def run_phase(k_req: int) -> None:
+        with concurrent.futures.ThreadPoolExecutor(2) as p:
+            for f in [p.submit(post) for _ in range(k_req)]:
+                f.result(timeout=CHILD_TIMEOUT)
+
+    try:
+        if not act.scale_out(1):
+            raise RuntimeError("first member host failed to launch")
+        wait_routable(1)
+        post()  # warm the one-host fleet outside the timed window
+        with lat_lock:
+            lats.clear()
+        t0 = time.perf_counter()
+        run_phase(n_req)  # phase A: one host
+        # Phase B: the resize runs CONCURRENTLY with this load — the
+        # joiner registers, pulls warm state, and flips ready while
+        # requests flow; its cost must show up in this window's p99
+        # or (warm-start working) not at all.
+        resize_t0 = time.perf_counter()
+        grow = threading.Thread(target=lambda: act.scale_out(1))
+        grow.start()
+        run_phase(n_req)
+        grow.join(timeout=CHILD_TIMEOUT)
+        wait_routable(2)
+        resize_t1 = time.perf_counter()
+        run_phase(n_req)  # phase C: the grown fleet
+        wall = time.perf_counter() - t0
+        # metrics_snapshot (not registry.snapshot): the joiner's
+        # warm-start counters live in ITS serve registry and only
+        # reach the fed through the fleet_<host>_<name> fold.
+        counters = fed.metrics_snapshot()["counters"]
+        warm_imported = sum(
+            v for k, v in counters.items()
+            if k.startswith("fleet_")
+            and k.endswith("ctrl_warmstart_imported_total")
+        )
+        warm_fallbacks = sum(
+            v for k, v in counters.items()
+            if k.startswith("fleet_")
+            and k.endswith("ctrl_warmstart_fallbacks_total")
+        )
+    finally:
+        clean = act.close()
+        fed.close()
+
+    total = 3 * n_req
+    per_req = wall / max(1, total)
+    with lat_lock:
+        window = sorted(
+            e for (t_done, e) in lats
+            if resize_t0 <= t_done <= resize_t1
+        )
+    resize_p99 = (
+        window[max(0, int(math.ceil(0.99 * len(window))) - 1)]
+        if window else 0.0
+    )
+    log(f"fed elastic: {per_req * 1e3:.1f} ms/request over {total} "
+        f"requests (resize window {resize_t1 - resize_t0:.1f}s, "
+        f"p99 {resize_p99 * 1e3:.1f} ms; warm imported "
+        f"{warm_imported}, fallbacks {warm_fallbacks}; "
+        f"clean drain {clean})")
+    return {
+        "metric": f"{W}x{H}_rgb_{REPS}reps_fed_elastic"
+                  f"_wall_per_request",
+        "value": round(per_req, 6),
+        "unit": "s",
+        "vs_baseline": round(BASELINE_S / per_req, 2),
+        "backend": "fed",
+        "platform": platform,
+        "member_platform": member_platform,
+        "hosts_start": 1,
+        "hosts_end": 2,
+        "requests": total,
+        "requests_per_second": round(total / wall, 3) if wall > 0
+        else 0.0,
+        "resize_window_p99_s": round(resize_p99, 6),
+        "resize_window_seconds": round(resize_t1 - resize_t0, 3),
+        "warmstart_imported": warm_imported,
+        "warmstart_fallbacks": warm_fallbacks,
+        "clean_drain": bool(clean),
+        "hedges_total": counters.get("hedges_total", 0),
+        "reroutes_total": counters.get("reroutes_total", 0),
+        "shape": f"{W}x{H}",
+        "reps": REPS,
+        "filter": "gaussian",
+        "dtype": "uint8",
+        "schema_version": 1,
+        "ts": round(time.monotonic(), 6),
+    }
+
+
 def _measure_schedule_headlines(schedules, platform: str) -> list:
     """Per-schedule headline mode (``TPU_STENCIL_BENCH_SCHEDULE=s1,s2``):
     one versioned capture line PER named Pallas schedule, the schedule
@@ -1689,6 +1864,15 @@ def child_main() -> int:
         # (the stdout contract: last line = most complete capture).
         for line in lines:
             print(json.dumps(line), flush=True)
+        return 0
+
+    if os.environ.get("TPU_STENCIL_BENCH_FED_ELASTIC") == "1":
+        try:
+            result = _measure_fed_elastic(platform)
+        except Exception as e:
+            log(f"fed elastic: FAILED {type(e).__name__}: {e}")
+            return 1
+        print(json.dumps(result), flush=True)
         return 0
 
     if int(os.environ.get("TPU_STENCIL_BENCH_FED") or 0) > 0:

@@ -526,6 +526,48 @@ def test_admin_drain_is_sticky_against_heartbeat_healing():
         m2.close()
 
 
+def test_reregistration_resets_breaker_and_hedge_state():
+    # A host re-registering after an eviction or drain is a NEW
+    # process on a reused netloc: it must not inherit the dead one's
+    # open circuit breaker or its forward-latency tail in the hedge
+    # p99 trigger.
+    m1 = _make_member()
+    fed = _make_fed([m1], breaker_threshold=2, hedge_min_s=0.05)
+    try:
+        from tpu_stencil.fed import host_id_for
+
+        hid = host_id_for(m1.url)
+        # Learned state from the dying process: an open breaker and a
+        # pathological latency tail driving the hedge trigger.
+        fed.breakers.record_failure(hid)
+        fed.breakers.record_failure(hid)
+        assert fed.breakers.get(hid).state == "open"
+        for _ in range(8):
+            fed.router._observe_forward(hid, 7.5)
+        assert fed.router._hedge_after() == pytest.approx(7.5)
+
+        fed.membership.mark_draining(hid, pinned=True)
+        fed.membership.register(m1.url)  # the restarted host announces
+
+        assert fed.breakers.get(hid).state == "closed"
+        assert fed.router._hedge_after() == pytest.approx(0.05)
+        snap = fed.registry.snapshot()["counters"]
+        assert snap["reregister_resets_total"] == 1
+        # A plain re-registration of a HEALTHY member is NOT a
+        # resurrection: learned state survives, no reset counted.
+        fed.breakers.record_failure(hid)
+        fed.router._observe_forward(hid, 3.0)
+        fed.membership.register(m1.url)
+        snap = fed.registry.snapshot()["counters"]
+        assert snap["reregister_resets_total"] == 1
+        b = fed.breakers.get(hid).snapshot()
+        assert b["consecutive_failures"] == 1
+        assert fed.router._hedge_after() == pytest.approx(3.0)
+    finally:
+        fed.close()
+        m1.close()
+
+
 def test_breaker_opens_after_consecutive_failures(rng):
     # One member, killed: requests classify connect/reset, the breaker
     # opens at the threshold, and the next request fails typed

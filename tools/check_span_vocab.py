@@ -60,7 +60,7 @@ SECTION = "## Span vocabulary"
 #: docs/OBSERVABILITY.md — two deliberate edits, no drive-by prefixes.
 KNOWN_TIERS = frozenset({
     "serve", "sharded", "stream", "net", "fed", "cache",
-    "integrity", "resilience", "iterate",
+    "integrity", "resilience", "iterate", "ctrl",
 })
 
 _CALL_RE = re.compile(

@@ -13,8 +13,10 @@ inference service), ``python -m tpu_stencil net ...`` (the network
 serving tier: HTTP frontend + per-device replica fleet,
 docs/SERVING.md "Network tier"), ``python -m tpu_stencil fed ...``
 (the federation front router over many net hosts, docs/DEPLOY.md
-"Federation runbook"), ``python -m tpu_stencil stream ...`` (the
-pipelined multi-frame streaming engine, docs/STREAMING.md) and
+"Federation runbook"), ``python -m tpu_stencil ctrl ...`` (the elastic
+control plane over a federation, docs/DEPLOY.md "Elastic fleet
+runbook"), ``python -m tpu_stencil stream ...`` (the pipelined
+multi-frame streaming engine, docs/STREAMING.md) and
 ``python -m tpu_stencil perf {log,check,report}`` (the perf-regression
 sentry, docs/OBSERVABILITY.md).
 """
@@ -56,6 +58,14 @@ def main(argv=None) -> int:
         from tpu_stencil.fed import cli as fed_cli
 
         return fed_cli.main(argv[1:])
+    if argv and argv[0] == "ctrl":
+        # The elastic control plane: hysteresis autoscaling +
+        # preemption-aware drain + warm-start member launches over a
+        # federation (docs/DEPLOY.md "Elastic fleet runbook"). The
+        # controller itself is jax-free; its launched members are not.
+        from tpu_stencil.ctrl import cli as ctrl_cli
+
+        return ctrl_cli.main(argv[1:])
     if argv and argv[0] == "perf":
         # The perf-regression sentry (log/check/report) is jax-free by
         # design: a history query must exit without backend bring-up.

@@ -1020,6 +1020,130 @@ def _validate_telemetry(cfg) -> None:
         )
 
 
+@dataclasses.dataclass(frozen=True)
+class CtrlConfig:
+    """Configuration for the elastic control plane
+    (:mod:`tpu_stencil.ctrl`): the loop that polls the federation's
+    live capacity signals, runs them through the hysteresis planner,
+    and actuates scale-out / scale-in / replacement through a host
+    provider. Jax-free — the controller never touches a device; it
+    only reads scrapes and starts/stops member processes.
+
+    The planner mirrors the SLO engine's fast+slow enter/hold
+    discipline: scale-out requires EVERY sample in the fast window
+    and a majority of the slow window to show pressure (utilization
+    past ``scale_out_utilization`` or time-to-saturation under
+    ``saturation_horizon_s``); once entered, pressure holds until the
+    fast window's mean utilization falls below ``hold_utilization``.
+    Scale-in is the slow symmetric case: every slow-window sample
+    idle. A decision is never taken from one sample, and each
+    actuation arms a ``cooldown_samples``-poll cooldown so the fleet
+    resizes at most once per observed settling window. Replacement
+    (an owned host's process died, or a member was preempted) is a
+    discrete event and bypasses hysteresis entirely."""
+
+    fed_url: str = "http://127.0.0.1:8090"
+    poll_interval_s: float = 1.0
+    capacity_window_s: float = 10.0  # window= passed to /debug/capacity
+    # Fleet bounds (owned hosts, not counting hand-registered members).
+    min_hosts: int = 1
+    max_hosts: int = 4
+    # Hysteresis windows, in SAMPLES (polls), not wall seconds — the
+    # planner is deterministic under synthetic signal feeds.
+    fast_samples: int = 3
+    slow_samples: int = 9
+    scale_out_utilization: float = 0.85
+    hold_utilization: float = 0.70
+    scale_in_utilization: float = 0.30
+    saturation_horizon_s: float = 30.0
+    cooldown_samples: int = 5
+    # Actuation budgets.
+    launch_timeout_s: float = 120.0
+    drain_timeout_s: float = 60.0
+    # Subprocess provider knobs (the CI/bench provider; real fleets
+    # implement tpu_stencil.ctrl.actuator.HostProvider instead).
+    member_platform: Optional[str] = "cpu"
+    replicas_per_host: int = 1
+    # Warm-start: launched members pull /admin/warmstate from this URL
+    # (default: the fed front itself) before flipping ready; None
+    # launches them cold.
+    warm_from: Optional[str] = None
+
+    def __post_init__(self) -> None:
+        if not self.fed_url.startswith(("http://", "https://")):
+            raise ValueError(
+                f"fed_url must start with http:// or https://, got "
+                f"{self.fed_url!r}"
+            )
+        if self.poll_interval_s <= 0:
+            raise ValueError(
+                f"poll_interval_s must be > 0, got {self.poll_interval_s}"
+            )
+        if self.capacity_window_s <= 0:
+            raise ValueError(
+                f"capacity_window_s must be > 0, got "
+                f"{self.capacity_window_s}"
+            )
+        if self.min_hosts < 0:
+            raise ValueError(
+                f"min_hosts must be >= 0, got {self.min_hosts}"
+            )
+        if self.max_hosts < max(1, self.min_hosts):
+            raise ValueError(
+                f"max_hosts must be >= max(1, min_hosts="
+                f"{self.min_hosts}), got {self.max_hosts}"
+            )
+        if self.fast_samples < 1:
+            raise ValueError(
+                f"fast_samples must be >= 1, got {self.fast_samples}"
+            )
+        if self.slow_samples < self.fast_samples:
+            raise ValueError(
+                f"slow_samples must be >= fast_samples "
+                f"({self.fast_samples}), got {self.slow_samples}"
+            )
+        if not (0.0 < self.scale_in_utilization
+                < self.hold_utilization
+                <= self.scale_out_utilization <= 1.0):
+            raise ValueError(
+                f"utilization thresholds must satisfy 0 < scale_in "
+                f"< hold <= scale_out <= 1, got "
+                f"scale_in={self.scale_in_utilization} "
+                f"hold={self.hold_utilization} "
+                f"scale_out={self.scale_out_utilization}"
+            )
+        if self.saturation_horizon_s < 0:
+            raise ValueError(
+                f"saturation_horizon_s must be >= 0 (0 = ignore "
+                f"time-to-saturation), got {self.saturation_horizon_s}"
+            )
+        if self.cooldown_samples < 0:
+            raise ValueError(
+                f"cooldown_samples must be >= 0, got "
+                f"{self.cooldown_samples}"
+            )
+        if self.launch_timeout_s <= 0:
+            raise ValueError(
+                f"launch_timeout_s must be > 0, got "
+                f"{self.launch_timeout_s}"
+            )
+        if self.drain_timeout_s <= 0:
+            raise ValueError(
+                f"drain_timeout_s must be > 0, got {self.drain_timeout_s}"
+            )
+        if self.replicas_per_host < 1:
+            raise ValueError(
+                f"replicas_per_host must be >= 1, got "
+                f"{self.replicas_per_host}"
+            )
+        if self.warm_from is not None and not self.warm_from.startswith(
+                ("http://", "https://")):
+            raise ValueError(
+                f"warm_from must start with http:// or https://, got "
+                f"{self.warm_from!r}"
+            )
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="tpu_stencil",

@@ -1,0 +1,38 @@
+"""tpu_stencil.ctrl — the elastic control plane.
+
+The tiers below this one only *measure*: the net edge derives its
+Retry-After from live queue state (PR 14), ``/debug/capacity`` reports
+headroom and time-to-saturation (PR 18), and the federation survives
+host loss (PR 11) — but capacity itself stays static and
+hand-operated.  This package closes the measure→decide→act loop:
+
+- :mod:`tpu_stencil.ctrl.planner` — hysteresis capacity planner: fed
+  scrape signals in, typed scale-out / scale-in / replace decisions
+  out, never flapping on one sample.
+- :mod:`tpu_stencil.ctrl.actuator` — the act half: a pluggable
+  :class:`~tpu_stencil.ctrl.actuator.HostProvider` (subprocess
+  provider for CI/bench) starting and stopping ``net`` member hosts
+  against the fed's ``/admin/register`` and sticky-drain machinery.
+  Scale-in always drains before stop; a preemption notice is a
+  *planned* drain with the replacement started before the victim
+  exits.
+- :mod:`tpu_stencil.ctrl.warmstart` — AOT executable shipping via
+  ``jax.export``: warm members serialize their executable-cache
+  entries, a joining host imports them before flipping ready, so its
+  first real request is already compiled (the PR-10 sibling-warming
+  discipline one hop up; the federation analog of arxiv 2406.08923's
+  never-re-pay-a-tune rule).
+- :mod:`tpu_stencil.ctrl.cli` — ``python -m tpu_stencil ctrl``.
+
+Everything except :mod:`~tpu_stencil.ctrl.warmstart` is jax-free.
+"""
+
+from tpu_stencil.ctrl.planner import (  # noqa: F401
+    HOLD,
+    REPLACE,
+    SCALE_IN,
+    SCALE_OUT,
+    CapacityPlanner,
+    CapacitySignal,
+    Decision,
+)

@@ -1,0 +1,255 @@
+"""Warm-start AOT executable shipping (``jax.export``).
+
+A joining host pays every compile cold today — the PR-10
+sibling-warming discipline stops at the process boundary.  This
+module carries it across hosts: a warm member serializes its
+executable-cache entries (keyed on the same autotune-derived cache
+key the engine already uses), a joiner pulls the envelope over
+``GET /admin/warmstate`` and imports it *before* its HTTP listener
+starts answering ``/healthz``, so the first real request it accepts
+runs an already-compiled program — the federation analog of arxiv
+2406.08923's never-re-pay-a-tune rule.
+
+**Degradation is the contract, not the exception.**  Every failure
+mode — a jaxlib without ``jax.export``, a version- or
+platform-skewed artifact, a truncated or corrupt payload, a key the
+importer cannot reconstruct argument shapes for — falls back to the
+existing cold-compile path, typed per entry in the returned summary
+and counted in ``ctrl_warmstart_fallbacks_total``.  Import NEVER
+raises for a bad artifact and NEVER makes the server wrong: a seeded
+entry is the same jitted callable contract the engine builds itself,
+and a skipped one just compiles on first use exactly as before.
+
+Sharded (``shard_map``) entries are skipped on export: their
+executables bake in this host's mesh, which a joiner need not share.
+
+Imported entries are seeded into the cache WITHOUT touching the
+hit/miss counters (``_ExecutableCache.seed``), and each is warm-called
+once with a zero canvas so XLA compiles it before the joiner flips
+ready — the acceptance assertion "first request, zero compile-cache
+misses" is counter-exact.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+from typing import Any, Optional, Tuple
+
+from tpu_stencil.obs import span as _obs_span
+
+#: Envelope schema version; a mismatch degrades the whole payload.
+SCHEMA_VERSION = 1
+
+#: Fallback reasons (the typed vocabulary the summary dict reports).
+FALLBACK_REASONS = (
+    "payload_unavailable",   # pull failed / no payload at all
+    "schema_mismatch",       # wrong envelope schema_version
+    "exporter_unsupported",  # the warm member had no jax.export
+    "no_jax_export",         # THIS jaxlib has no usable jax.export
+    "version_skew",          # jax version differs from the exporter's
+    "platform_skew",         # exporter ran on a different backend
+    "malformed_key",         # cache key did not round-trip
+    "deserialize_failed",    # truncated/corrupt artifact, bad call
+)
+
+
+def _jax_export_mod():
+    """The usable ``jax.export`` module, or None when this jaxlib
+    cannot ship executables (old jax, trimmed install) — gated, never
+    assumed, per the no-new-deps rule."""
+    try:
+        from jax import export as jax_export
+    except Exception:  # noqa: BLE001 - any import failure = unsupported
+        return None
+    if not (hasattr(jax_export, "export")
+            and hasattr(jax_export, "deserialize")):
+        return None
+    return jax_export
+
+
+# -- cache-key wire format ---------------------------------------------
+
+
+def _key_to_wire(key: tuple) -> list:
+    """Nested tuples → nested lists (JSON has no tuple)."""
+    return [_key_to_wire(k) if isinstance(k, tuple) else k for k in key]
+
+
+def _key_from_wire(obj: Any) -> tuple:
+    if not isinstance(obj, list):
+        raise ValueError(f"cache key must be a list, got {type(obj)}")
+    return tuple(
+        _key_from_wire(k) if isinstance(k, list) else k for k in obj
+    )
+
+
+def _key_geometry(key: tuple) -> Optional[Tuple[int, ...]]:
+    """The batch-canvas shape ``(nb, bh, bw[, c])`` an executable
+    keyed ``(filter, (bh, bw), channels, dtype, backend, reps, nb)``
+    was built for, or None for keys this module does not ship
+    (sharded entries, unknown layouts, non-uint8 dtypes)."""
+    if len(key) != 7 or "sharded" in key:
+        return None
+    _fname, bucket, channels, dtype, _backend, _reps, nb = key
+    if dtype != "uint8":
+        return None
+    if (not isinstance(bucket, tuple) or len(bucket) != 2
+            or not all(isinstance(v, int) for v in bucket)
+            or not isinstance(channels, int) or not isinstance(nb, int)):
+        return None
+    bh, bw = bucket
+    return (nb, bh, bw) + ((channels,) if channels > 1 else ())
+
+
+# -- export ------------------------------------------------------------
+
+
+def export_server(server) -> dict:
+    """Serialize one :class:`~tpu_stencil.serve.engine.StencilServer`'s
+    executable-cache entries into the warm-state envelope.  Entries
+    that refuse to serialize are skipped and counted
+    (``ctrl_warmstart_export_skips_total``) — a warm member never
+    fails a scrape over one stubborn program."""
+    import jax
+
+    exported_c = server.registry.counter("ctrl_warmstart_exported_total")
+    skips_c = server.registry.counter("ctrl_warmstart_export_skips_total")
+    envelope: dict = {
+        "schema_version": SCHEMA_VERSION,
+        "jax": jax.__version__,
+        "platform": jax.default_backend(),
+        "entries": [],
+    }
+    mod = _jax_export_mod()
+    if mod is None:
+        envelope["unsupported"] = "jax.export unavailable in this jaxlib"
+        return envelope
+    import jax.numpy as jnp
+
+    with _obs_span("ctrl.warmstart_export", "ctrl"):
+        for key in server.warm_keys():
+            shape = _key_geometry(key)
+            if shape is None:
+                continue  # sharded / unknown layout: never shipped
+            exe = server.warm_entry(key)
+            if exe is None:
+                continue  # evicted between listing and read
+            nb = shape[0]
+            args = (
+                jax.ShapeDtypeStruct(shape, jnp.uint8),
+                jax.ShapeDtypeStruct((nb,), jnp.int32),
+                jax.ShapeDtypeStruct((nb,), jnp.int32),
+            )
+            try:
+                blob = mod.export(exe)(*args).serialize()
+            except Exception:  # noqa: BLE001 - skip, never fail the scrape
+                skips_c.inc()
+                continue
+            envelope["entries"].append({
+                "key": _key_to_wire(key),
+                "artifact": base64.b64encode(blob).decode("ascii"),
+            })
+            exported_c.inc()
+    return envelope
+
+
+# -- import ------------------------------------------------------------
+
+
+def import_server(server, payload: Optional[dict]) -> dict:
+    """Import a warm-state envelope into one server's executable
+    cache.  Returns ``{"imported": n, "fallbacks": n, "reasons":
+    {reason: count}}``; every skipped entry (and an unusable payload
+    as a whole) counts one typed fallback in
+    ``ctrl_warmstart_fallbacks_total`` and leaves the cold-compile
+    path exactly as it was.  Never raises on artifact content."""
+    fallbacks_c = server.registry.counter("ctrl_warmstart_fallbacks_total")
+    imported_c = server.registry.counter("ctrl_warmstart_imported_total")
+    summary: dict = {"imported": 0, "fallbacks": 0, "reasons": {}}
+
+    def fall(reason: str, n: int = 1) -> None:
+        fallbacks_c.inc(n)
+        summary["fallbacks"] += n
+        summary["reasons"][reason] = summary["reasons"].get(reason, 0) + n
+
+    if not isinstance(payload, dict):
+        fall("payload_unavailable")
+        return summary
+    if payload.get("schema_version") != SCHEMA_VERSION:
+        fall("schema_mismatch")
+        return summary
+    if payload.get("unsupported"):
+        fall("exporter_unsupported")
+        return summary
+    entries = payload.get("entries") or []
+    if not entries:
+        return summary  # a cold exporter: nothing to degrade FROM
+    mod = _jax_export_mod()
+    if mod is None:
+        fall("no_jax_export", len(entries))
+        return summary
+    import jax
+    import numpy as np
+
+    if payload.get("jax") != jax.__version__:
+        # jax.export carries its own serialization versioning, but a
+        # cross-version executable is exactly the artifact we must
+        # never trust into a bit-exactness-contracted cache.
+        fall("version_skew", len(entries))
+        return summary
+    if payload.get("platform") != jax.default_backend():
+        fall("platform_skew", len(entries))
+        return summary
+
+    pin = None
+    if server.cfg.device_index is not None:
+        devices = jax.local_devices()
+        if server.cfg.device_index < len(devices):
+            pin = devices[server.cfg.device_index]
+
+    with _obs_span("ctrl.warmstart_import", "ctrl",
+                   entries=len(entries)):
+        for e in entries:
+            try:
+                key = _key_from_wire(e["key"])
+                shape = _key_geometry(key)
+                if shape is None:
+                    raise ValueError("unshippable key")
+            except Exception:  # noqa: BLE001
+                fall("malformed_key")
+                continue
+            try:
+                blob = base64.b64decode(e["artifact"], validate=True)
+                exported = mod.deserialize(blob)
+                fn = jax.jit(exported.call)
+                # Warm-call NOW, before the joiner is ready: the
+                # deserialized program still compiles on first call,
+                # and that call must not be a client's.
+                nb = shape[0]
+                zeros = jax.device_put(np.zeros(shape, np.uint8), pin)
+                vh = jax.device_put(np.zeros(nb, np.int32), pin)
+                vw = jax.device_put(np.zeros(nb, np.int32), pin)
+                jax.block_until_ready(fn(zeros, vh, vw))
+            except Exception:  # noqa: BLE001 - truncated/corrupt/alien
+                fall("deserialize_failed")
+                continue
+            if server.warm_seed(key, fn):
+                imported_c.inc()
+                summary["imported"] += 1
+            # A locally compiled entry already under this key wins;
+            # not a fallback — nothing degraded.
+    return summary
+
+
+def dumps(envelope: dict) -> bytes:
+    return json.dumps(envelope).encode("utf-8")
+
+
+def loads(data: bytes) -> Optional[dict]:
+    """Parse an envelope; None (→ ``payload_unavailable``) on garbage."""
+    try:
+        doc = json.loads(data)
+    except Exception:  # noqa: BLE001
+        return None
+    return doc if isinstance(doc, dict) else None

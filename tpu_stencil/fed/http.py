@@ -204,6 +204,8 @@ class _FedHandler(BaseHTTPRequestHandler):
                            sort_keys=True).encode(),
                 content_type="application/json",
             )
+        elif path == "/admin/warmstate":
+            self._admin_warmstate()
         elif path == "/debug/timeseries":
             self._debug_timeseries(parse_qs(urlsplit(self.path).query))
         elif path == "/debug/capacity":
@@ -289,6 +291,8 @@ class _FedHandler(BaseHTTPRequestHandler):
             self._register(parse_qs(split.query))
         elif split.path == "/admin/drain":
             self._drain(parse_qs(split.query))
+        elif split.path == "/admin/preempt":
+            self._preempt(parse_qs(split.query))
         elif split.path == "/debug/prof":
             self._consume_body()
             self._error(404, "no device profiler on the federation "
@@ -333,6 +337,38 @@ class _FedHandler(BaseHTTPRequestHandler):
             self._error(404, f"no such member host: {host}")
             return
         self._respond(200, json.dumps(result).encode(),
+                      content_type="application/json")
+
+    def _preempt(self, query: dict) -> None:
+        """``POST /admin/preempt?host=ID`` — a TPU-preemption notice
+        for one member: a *planned* drain (``Member.pinned_draining``,
+        never the eviction path).  The member leaves routing
+        immediately but keeps its in-flight work; the control plane
+        sees the pinned drain in ``/statusz`` and starts the
+        replacement BEFORE the victim exits (docs/DEPLOY.md 'Elastic
+        fleet runbook')."""
+        self._consume_body()
+        host = (query.get("host") or [None])[0]
+        if not host:
+            self._error(400, "missing host=<member host id>")
+            return
+        result = self.fe.preempt_member(host)
+        if result is None:
+            self._error(404, f"no such member host: {host}")
+            return
+        self._respond(200, json.dumps(result).encode(),
+                      content_type="application/json")
+
+    def _admin_warmstate(self) -> None:
+        """``GET /admin/warmstate`` — proxy the warm-state envelope
+        from a warm member, so a joiner needs only the fed URL.  503
+        typed when no member can answer (the joiner starts cold)."""
+        payload = self.fe.warmstate()
+        if payload is None:
+            self._error(503, "no routable member answered "
+                             "/admin/warmstate; start cold")
+            return
+        self._respond(200, json.dumps(payload).encode(),
                       content_type="application/json")
 
     def _blur(self, query: dict) -> None:
@@ -543,6 +579,13 @@ class FedFrontend:
         )
         self.router = FedRouter(cfg, self.membership, self.breakers,
                                 self.registry)
+        # The re-registration reset (the reused-netloc bugfix): a host
+        # announcing back after an eviction or drain is a NEW process —
+        # drop the dead one's open breaker and hedge-p99 reservoir, or
+        # the fresh host starts life unroutable behind stale state.
+        self.registry.counter("reregister_resets_total")
+        self.registry.counter("preemptions_total")
+        self.membership.on_resurrect = self._on_member_resurrect
         self._httpd: Optional[_FedHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         self._drain_report: Optional[Dict[str, bool]] = None
@@ -657,6 +700,58 @@ class FedFrontend:
             "draining": True,
             "member_response": member_resp,
         }
+
+    def _on_member_resurrect(self, host_id: str) -> None:
+        """A host re-registered after an eviction or drain: the new
+        process must not inherit the dead one's open circuit breaker
+        or its forward-latency tail in the hedge p99."""
+        self.breakers.drop(host_id)
+        self.router.reset_host(host_id)
+        self.registry.counter("reregister_resets_total").inc()
+
+    def preempt_member(self, host_id: str) -> Optional[dict]:
+        """A TPU-preemption notice: a PLANNED drain, never an
+        eviction.  The member leaves routing now (pinned — heartbeat
+        200s must not re-admit it) but keeps serving its in-flight
+        work; the replacement is the control plane's job, started
+        before the victim exits (``tpu_stencil ctrl`` watches
+        ``/statusz`` for pinned drains it owns).  Unlike
+        :meth:`drain_member`, the victim's own drain is NOT driven
+        here — capacity must arrive first."""
+        m = self.membership.get(host_id)
+        if m is None:
+            return None
+        self.membership.mark_draining(host_id, pinned=True)
+        self.registry.counter("preemptions_total").inc()
+        with _obs_span("fed.preempt", "fed", host=host_id):
+            pass  # zero-duration marker: the notice moment
+        m = self.membership.get(host_id)
+        return {
+            "host_id": host_id,
+            "preempted": True,
+            "state": m.state if m is not None else "unknown",
+            "pinned_draining": bool(m and m.pinned_draining),
+        }
+
+    def warmstate(self) -> Optional[dict]:
+        """The warm-state envelope, pulled from a warm member: the
+        routable member with entries wins; a member that answers
+        without entries is the fallback; None when nobody answers."""
+        best: Optional[dict] = None
+        for m in self.membership.routable():
+            try:
+                with urllib.request.urlopen(
+                        m.url + "/admin/warmstate", timeout=10.0) as r:
+                    doc = json.loads(r.read())
+            except Exception:  # noqa: BLE001 - try the next member
+                continue
+            if not isinstance(doc, dict):
+                continue
+            if doc.get("entries"):
+                return doc
+            if best is None:
+                best = doc
+        return best
 
     def close(self) -> None:
         if self.sampler is not None:

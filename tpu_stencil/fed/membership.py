@@ -40,7 +40,7 @@ import threading
 import time
 import urllib.error
 import urllib.request
-from typing import Dict, List, Optional
+from typing import Callable, Dict, List, Optional
 
 from tpu_stencil.config import FedConfig
 from tpu_stencil.obs import span as _obs_span
@@ -118,6 +118,11 @@ class Membership:
         self._m_evictions = registry.counter("evictions_total")
         self._m_misses = registry.counter("heartbeat_misses_total")
         self._m_beats = registry.counter("heartbeats_total")
+        # Fired (outside the lock) when a host re-registers after an
+        # eviction or a drain: the frontend hooks this to drop the
+        # dead process's breaker and forward-latency state — a fresh
+        # process on a reused netloc must not inherit either.
+        self.on_resurrect: Optional[Callable[[str], None]] = None
         for s in _STATES:
             registry.gauge(f"members_{s}").set(0)
 
@@ -168,6 +173,12 @@ class Membership:
             if m is None:
                 m = Member(host_id=hid, url=url, registered_at=now)
                 self._members[hid] = m
+            # A host coming back from the dead (evicted, or any form
+            # of drain): the process behind the netloc is NEW — its
+            # learned per-host state (breaker, hedge-p99 latency) died
+            # with the old one and must be reset, not inherited.
+            resurrected = (m.state in (DRAINING, EVICTED)
+                           or m.pinned_draining)
             # Re-registration (or a seed re-announcing itself):
             # resurrect with a clean window whatever the prior state —
             # including an admin drain, which registration explicitly
@@ -178,6 +189,11 @@ class Membership:
             m.pinned_draining = False
             m.last_ok = now if check else m.last_ok
         self._m_registrations.inc()
+        if resurrected and self.on_resurrect is not None:
+            try:
+                self.on_resurrect(m.host_id)
+            except Exception:  # noqa: BLE001 - reset hooks never block
+                pass           # registration (routing heals regardless)
         self._refresh_gauges()
         return m
 

@@ -66,7 +66,9 @@ Jax-free, like the whole federation tier.
 
 from __future__ import annotations
 
+import collections
 import http.client
+import math
 import queue
 import threading
 import time
@@ -97,6 +99,11 @@ PREMIUM_HEADROOM = 1.25
 
 #: The tenant a request without an X-Tenant header is accounted to.
 DEFAULT_TENANT = "anon"
+
+#: Per-host forward-latency reservoir depth (hedge-trigger feed): deep
+#: enough that a p99 over a few hosts is meaningful, bounded so a
+#: long-lived router forgets ancient latency regimes on its own.
+_FWD_RESERVOIR = 512
 
 
 class TenantQuotaExceeded(RuntimeError):
@@ -358,6 +365,13 @@ class FedRouter:
         self._m_inflight = m.gauge("inflight_bytes")
         self._g_tenants = m.gauge("tenants_active")
         self._h_fwd = m.histogram("forward_latency_seconds")
+        # Per-host forward-latency reservoirs feeding the hedge
+        # trigger. The GLOBAL forward_latency_seconds histogram stays
+        # the metric surface (monotonic by contract), but it cannot
+        # forget ONE host's samples — and a host that re-registers
+        # after dying must not poison the p99 with its predecessor's
+        # death throes. reset_host() drops exactly one reservoir.
+        self._host_fwd: Dict[str, "collections.deque"] = {}
         m.histogram("request_bytes")
         m.gauge("draining").set(0)
 
@@ -595,12 +609,39 @@ class FedRouter:
                 return m
         return None
 
+    def _observe_forward(self, host_id: str, elapsed: float) -> None:
+        """One winning forward's latency: into the global histogram
+        (the metric surface) AND the winner's bounded per-host
+        reservoir (the hedge-trigger feed)."""
+        self._h_fwd.observe(elapsed)
+        with self._lock:
+            d = self._host_fwd.get(host_id)
+            if d is None:
+                d = self._host_fwd[host_id] = collections.deque(
+                    maxlen=_FWD_RESERVOIR
+                )
+            d.append(elapsed)
+
+    def reset_host(self, host_id: str) -> None:
+        """Forget one host's learned forward-latency reservoir — the
+        re-registration reset: a fresh process on a reused netloc must
+        not inherit the dead one's tail in the hedge p99 (its breaker
+        is dropped by the same hook; see FedFrontend)."""
+        with self._lock:
+            self._host_fwd.pop(host_id, None)
+
     def _hedge_after(self) -> float:
-        """The hedge trigger: the observed p99 forward latency,
-        floored by ``hedge_min_s`` (an empty histogram reads 0.0, so
-        the floor carries the cold start)."""
-        return max(self.cfg.hedge_min_s,
-                   self._h_fwd.percentile(99))
+        """The hedge trigger: the observed p99 forward latency over
+        the LIVE per-host reservoirs (nearest-rank, matching the
+        histogram's percentile), floored by ``hedge_min_s`` (empty
+        reservoirs read 0.0, so the floor carries the cold start)."""
+        with self._lock:
+            samples = [s for d in self._host_fwd.values() for s in d]
+        if not samples:
+            return self.cfg.hedge_min_s
+        samples.sort()
+        idx = max(0, math.ceil(0.99 * len(samples)) - 1)
+        return max(self.cfg.hedge_min_s, samples[idx])
 
     # -- the forward race ----------------------------------------------
 
@@ -726,7 +767,7 @@ class FedRouter:
                 if status == 200:
                     cancel_rest()
                     if att.elapsed is not None:
-                        self._h_fwd.observe(att.elapsed)
+                        self._observe_forward(m.host_id, att.elapsed)
                     self._m_forwarded.inc()
                     if att.is_hedge:
                         self._m_hedge_wins.inc()

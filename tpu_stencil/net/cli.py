@@ -187,6 +187,17 @@ def build_parser() -> argparse.ArgumentParser:
                    help="the URL to register (default "
                         "http://<host>:<bound port>; set it when this "
                         "host binds 0.0.0.0 or sits behind NAT)")
+    p.add_argument("--warm-from", dest="warm_from", default=None,
+                   metavar="URL",
+                   help="warm-start: GET URL/admin/warmstate (a fed "
+                        "front or a warm member) and import the "
+                        "serialized executables into every replica "
+                        "BEFORE the HTTP listener starts, so the first "
+                        "accepted request is already compiled; any "
+                        "unusable artifact degrades to cold compile, "
+                        "typed and counted "
+                        "(ctrl_warmstart_fallbacks_total), never fatal "
+                        "(docs/DEPLOY.md 'Elastic fleet runbook')")
     p.add_argument("--metrics-text", default=None, metavar="PATH",
                    help="after the drain, write the fleet-wide metrics "
                         "(the /metrics exposition) to PATH ('-' = stdout)")
@@ -234,6 +245,35 @@ def _register_with_fed(fed_url: str, advertise: str) -> None:
                      daemon=True).start()
 
 
+def _pull_warm_state(fe, url: str) -> None:
+    """Warm-start pull (ctrl/warmstart.py): fetch the serialized
+    executable-cache envelope from ``url`` and import it into every
+    replica BEFORE the HTTP listener exists — ``/healthz`` never
+    answers until the imports (and their compiles) are done, so the
+    first request this host accepts runs warm.  Every failure — the
+    pull itself, or any artifact inside — degrades to cold start,
+    typed and counted, never fatal."""
+    import urllib.request
+
+    from tpu_stencil.ctrl import warmstart as _warmstart
+
+    payload = None
+    try:
+        with urllib.request.urlopen(
+                url.rstrip("/") + "/admin/warmstate", timeout=30.0) as r:
+            payload = _warmstart.loads(r.read())
+    except Exception as e:  # noqa: BLE001 - typed cold start, not fatal
+        print(f"net: warm-state pull from {url} failed "
+              f"({type(e).__name__}: {e}); starting cold", flush=True)
+    # Build the fleet now (NetFrontend.start() will find it built —
+    # start() is idempotent on a started fleet) and seed the caches.
+    fe.fleet.start()
+    summary = fe.fleet.warmstate_import(payload)
+    print(f"net: warm-start imported {summary['imported']} "
+          f"executable(s), {summary['fallbacks']} fallback(s) "
+          f"from {url}", flush=True)
+
+
 def main(argv=None) -> int:
     parser = build_parser()
     ns = parser.parse_args(argv)
@@ -271,7 +311,13 @@ def main(argv=None) -> int:
 
     from tpu_stencil.net.http import NetFrontend
 
-    fe = NetFrontend(cfg).start()
+    fe = NetFrontend(cfg)
+    if ns.warm_from:
+        # Import BEFORE start(): the listener (and with it /healthz
+        # ready) only exists once every shipped executable is seeded
+        # and compiled — the joiner's first request is already warm.
+        _pull_warm_state(fe, ns.warm_from)
+    fe.start()
     stop = threading.Event()
 
     def _on_signal(signum, _frame) -> None:

@@ -289,3 +289,42 @@ class ReplicaFleet:
         """Per-replica ``StencilServer.stats()`` snapshots, in device
         order (the ``/statusz`` payload)."""
         return [rep.stats() for rep in list(self.replicas)]
+
+    # -- warm-start plane (tpu_stencil.ctrl.warmstart) -----------------
+
+    def warmstate_export(self) -> dict:
+        """This host's warm-state envelope: replica 0's envelope plus
+        any keys only later replicas hold (first writer wins — per key
+        the artifacts are interchangeable, every replica builds from
+        the same plan)."""
+        import json as _json
+
+        envelope = None
+        seen = set()
+        for rep in list(self.replicas):
+            doc = rep.export_warm_state()
+            if envelope is None:
+                envelope = doc
+                seen = {_json.dumps(e["key"]) for e in doc.get(
+                    "entries", [])}
+                continue
+            for e in doc.get("entries", []):
+                k = _json.dumps(e["key"])
+                if k not in seen:
+                    seen.add(k)
+                    envelope["entries"].append(e)
+        if envelope is None:
+            envelope = {"schema_version": 1, "entries": []}
+        return envelope
+
+    def warmstate_import(self, payload) -> dict:
+        """Import one envelope into EVERY replica (each compiles its
+        own copy on its pinned device).  Aggregated summary; per-entry
+        failures degrade typed inside each replica, never raise."""
+        out: dict = {"imported": 0, "fallbacks": 0, "replicas": []}
+        for rep in list(self.replicas):
+            r = rep.import_warm_state(payload)
+            out["imported"] += r["imported"]
+            out["fallbacks"] += r["fallbacks"]
+            out["replicas"].append(r)
+        return out

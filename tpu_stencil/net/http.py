@@ -427,6 +427,8 @@ class _Handler(BaseHTTPRequestHandler):
                           content_type="application/json")
         elif path == "/admin/cache":
             self._admin_cache(parse_qs(split.query))
+        elif path == "/admin/warmstate":
+            self._admin_warmstate()
         elif path == "/debug/timeseries":
             self._debug_timeseries(parse_qs(split.query))
         elif path == "/debug/capacity":
@@ -682,6 +684,17 @@ class _Handler(BaseHTTPRequestHandler):
             self._error(
                 400, f"action must be clear|stats, got {action!r}"
             )
+
+    def _admin_warmstate(self) -> None:
+        """``GET /admin/warmstate``: this host's serialized
+        executable-cache entries (the ctrl/warmstart.py envelope) for
+        a joining host to import before it flips ready — the PR-10
+        sibling-warming discipline one hop up.  Always 200: a cold or
+        export-less host answers an empty/unsupported envelope and the
+        joiner degrades typed."""
+        payload = self.fe.fleet.warmstate_export()
+        self._respond(200, json.dumps(payload).encode(),
+                      content_type="application/json")
 
     def _restart(self, query: dict) -> None:
         # Consume any request body first: an unread body corrupts the

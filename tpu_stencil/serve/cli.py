@@ -77,6 +77,22 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--zipf-keys", type=int, default=16, metavar="K",
                    help="distinct frames in the --zipf pool "
                         "(default 16)")
+    p.add_argument("--ramp", default=None,
+                   metavar="START_FPS:END_FPS:SECONDS",
+                   help="ramped open-loop profile: sweep the offered "
+                        "frame rate linearly from START_FPS to END_FPS "
+                        "over SECONDS, stepped across --ramp-phases "
+                        "equal metronome phases (arrivals due on "
+                        "schedule regardless of completions — the "
+                        "elastic-fleet acceptance load, docs/DEPLOY.md "
+                        "'Elastic fleet runbook'); forces --mode open, "
+                        "overrides --requests with the schedule's own "
+                        "count, and reports per-phase achieved fps + "
+                        "p99 from client-side records. Seeded; "
+                        "exclusive with --rate-fps and --burst > 1")
+    p.add_argument("--ramp-phases", type=int, default=4, metavar="N",
+                   help="equal-duration phases the --ramp window is "
+                        "stepped across (default 4)")
     p.add_argument("--rate-fps", type=float, default=None, metavar="FPS",
                    help="open-loop fixed-frame-rate mode: one frame due "
                         "every 1/FPS seconds regardless of completions "
@@ -353,6 +369,29 @@ def main(argv=None) -> int:
             parser.error(f"--zipf must be >= 0, got {ns.zipf}")
         if ns.zipf_keys < 1:
             parser.error(f"--zipf-keys must be >= 1, got {ns.zipf_keys}")
+        ramp = None
+        if ns.ramp is not None:
+            try:
+                parts = ns.ramp.split(":")
+                if len(parts) != 3:
+                    raise ValueError
+                ramp = tuple(float(v) for v in parts)
+                if not all(v > 0 for v in ramp):
+                    raise ValueError
+            except ValueError:
+                parser.error(
+                    f"--ramp must be START_FPS:END_FPS:SECONDS with "
+                    f"three positive numbers, got {ns.ramp!r}"
+                )
+            if ns.rate_fps is not None:
+                parser.error("--ramp and --rate-fps are exclusive "
+                             "arrival laws (the ramp sweeps the rate)")
+            if ns.burst > 1:
+                parser.error("--ramp is a metronome profile; "
+                             "--burst > 1 is not supported with it")
+            if ns.ramp_phases < 1:
+                parser.error(f"--ramp-phases must be >= 1, "
+                             f"got {ns.ramp_phases}")
         loadgen_kwargs = dict(
             mode=ns.mode, requests=ns.requests,
             concurrency=ns.concurrency, rate=ns.rate, reps=ns.reps,
@@ -361,6 +400,7 @@ def main(argv=None) -> int:
             verify=ns.verify, verify_filter=ns.filter_name,
             per_request=ns.per_request,
             zipf=ns.zipf, zipf_keys=ns.zipf_keys,
+            ramp=ramp, ramp_phases=ns.ramp_phases,
         )
         if ns.http:
             # The network-tier target: same loops, same report schema,
@@ -461,6 +501,19 @@ def main(argv=None) -> int:
             f"offered {report['offered_fps']:.2f} fps, "
             f"achieved {report['achieved_fps']:.2f} fps"
         )
+    if "ramp" in report:
+        r = report["ramp"]
+        print(
+            f"ramp {r['start_fps']:g}->{r['end_fps']:g} fps over "
+            f"{r['seconds']:g}s ({len(r['phases'])} phase(s)):"
+        )
+        for pi, ph in enumerate(r["phases"]):
+            print(
+                f"  phase {pi}: {ph['fps']:8.2f} fps requested, "
+                f"{ph['achieved_fps']:8.2f} achieved "
+                f"({ph['completed']}/{ph['requests']}), "
+                f"p99={ph['p99_s'] * 1e3:.2f}ms"
+            )
     if ns.perf_log is not False:
         # One sentry record per loadgen run: p50 request latency. The
         # load model (mode, per-request reps, and the closed-loop
@@ -476,6 +529,10 @@ def main(argv=None) -> int:
         ran_mode = report["mode"]
         if ran_mode == "closed":
             load = f"c{ns.concurrency}"
+        elif ramp is not None:
+            # A swept rate changes what p50 means phase to phase —
+            # the whole profile is its own sentry series.
+            load = f"ramp{ramp[0]:g}-{ramp[1]:g}"
         elif ns.rate_fps is not None:
             load = f"fps{ns.rate_fps:g}"
         else:

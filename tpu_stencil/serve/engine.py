@@ -240,15 +240,21 @@ class _ExecutableCache:
     def __init__(self, registry: Registry, cap: int) -> None:
         self._entries: "collections.OrderedDict" = collections.OrderedDict()
         self._cap = cap
+        # The worker owns the hot path; the lock exists for the
+        # warm-start plane (keys/peek/seed run on HTTP threads while
+        # the worker dispatches) and is uncontended otherwise.
+        self._lock = threading.Lock()
         self._hits = registry.counter("cache_hits_total")
         self._misses = registry.counter("cache_misses_total")
         self._evictions = registry.counter("cache_evictions_total")
 
     def get(self, key, builder):
-        entry = self._entries.get(key)
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is not None:
+                self._hits.inc()
+                self._entries.move_to_end(key)
         if entry is not None:
-            self._hits.inc()
-            self._entries.move_to_end(key)
             with _obs_span("serve.cache_hit", "serve"):
                 pass  # zero-duration marker: this dispatch reused a program
             return entry
@@ -257,11 +263,39 @@ class _ExecutableCache:
         # key costs (jit wrapper construction; first-call compile lands
         # inside the batch's execute span).
         with _obs_span("serve.cache_miss", "serve"):
-            entry = self._entries[key] = builder()
-        while len(self._entries) > self._cap:
-            self._entries.popitem(last=False)
-            self._evictions.inc()
+            entry = builder()
+        with self._lock:
+            self._entries[key] = entry
+            while len(self._entries) > self._cap:
+                self._entries.popitem(last=False)
+                self._evictions.inc()
         return entry
+
+    def keys(self) -> list:
+        with self._lock:
+            return list(self._entries.keys())
+
+    def peek(self, key):
+        """Read an entry without hit/miss accounting or LRU movement
+        (the warm-state exporter is not a consumer)."""
+        with self._lock:
+            return self._entries.get(key)
+
+    def seed(self, key, entry) -> bool:
+        """Insert a PRE-BUILT entry without touching the hit/miss
+        counters — the warm-start import path: the joiner's first real
+        request must land as a counted HIT, and the import itself must
+        never read as a compile paid.  An existing key is left alone
+        (a locally built program always beats a shipped one); the LRU
+        cap still holds."""
+        with self._lock:
+            if key in self._entries:
+                return False
+            self._entries[key] = entry
+            while len(self._entries) > self._cap:
+                self._entries.popitem(last=False)
+                self._evictions.inc()
+        return True
 
     def __len__(self) -> int:
         return len(self._entries)
@@ -843,6 +877,36 @@ class StencilServer:
         return [r for r in _introspect.records()
                 if r.get("site") == "serve.bucket"
                 and r.get("meta", {}).get("server") == self._serial]
+
+    # -- warm-start plane (tpu_stencil.ctrl.warmstart) -----------------
+
+    def warm_keys(self) -> list:
+        """This server's executable-cache keys, for the exporter."""
+        return self._cache.keys()
+
+    def warm_entry(self, key):
+        """One cached executable, without hit/miss/LRU side effects."""
+        return self._cache.peek(key)
+
+    def warm_seed(self, key, entry) -> bool:
+        """Seed one pre-built executable (counter-silent; see
+        ``_ExecutableCache.seed``)."""
+        return self._cache.seed(key, entry)
+
+    def export_warm_state(self) -> dict:
+        """Serialize this server's executable cache into the
+        warm-state envelope (ctrl/warmstart.py) for a joining host."""
+        from tpu_stencil.ctrl import warmstart as _warmstart
+
+        return _warmstart.export_server(self)
+
+    def import_warm_state(self, payload) -> dict:
+        """Import a warm-state envelope; every unusable artifact
+        degrades to cold compile, typed and counted
+        (``ctrl_warmstart_fallbacks_total``), never an error."""
+        from tpu_stencil.ctrl import warmstart as _warmstart
+
+        return _warmstart.import_server(self, payload)
 
     # -- scheduler / worker --------------------------------------------
 

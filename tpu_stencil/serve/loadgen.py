@@ -27,6 +27,7 @@ is reproducible on CPU in tier-1 and on TPU via bench_sweep.
 from __future__ import annotations
 
 import concurrent.futures
+import math
 import threading
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -375,6 +376,8 @@ def run(
     burst: int = 1,
     zipf: Optional[float] = None,
     zipf_keys: int = 16,
+    ramp: Optional[Tuple[float, float, float]] = None,
+    ramp_phases: int = 4,
 ) -> Dict:
     """Drive ``server`` with synthetic load; return the report dict.
 
@@ -436,7 +439,50 @@ def run(
     misses from the target's own registry; ``None`` when the target
     has no result cache). Deterministic: the same seed replays the
     identical key sequence.
+
+    ``ramp`` (``--ramp START_FPS:END_FPS:SECONDS``): the ramped
+    open-loop profile — the total window is split into ``ramp_phases``
+    equal phases, each a metronome at a frame rate stepped linearly
+    from START to END, arrivals due on schedule regardless of
+    completions (the same non-negotiable arrival law as ``rate_fps``,
+    swept instead of held).  Forces ``mode='open'`` and overrides
+    ``requests`` with the schedule's own count (≈ mean fps × seconds);
+    the report gains ``ramp.phases`` — one ``{fps, seconds, requests,
+    completed, achieved_fps, p99_s}`` row per phase, achieved fps and
+    nearest-rank p99 both from the client-side per-request records so
+    a resize mid-ramp shows up in exactly the phase it happened.
+    Seeded like every other profile: the same ``(ramp, seed, shapes,
+    channels)`` replays the identical request stream.  Mutually
+    exclusive with ``rate_fps`` and ``burst > 1``.
     """
+    ramp_plan: Optional[List[Tuple[float, float, int]]] = None
+    if ramp is not None:
+        start_fps, end_fps, ramp_secs = (float(v) for v in ramp)
+        if not (start_fps > 0 and end_fps > 0 and ramp_secs > 0):
+            raise ValueError(
+                f"ramp needs positive START_FPS, END_FPS and SECONDS, "
+                f"got {ramp!r}"
+            )
+        if rate_fps is not None:
+            raise ValueError("ramp and rate_fps are exclusive arrival "
+                             "laws (ramp sweeps the rate)")
+        if burst > 1:
+            raise ValueError("ramp is a metronome profile; burst > 1 "
+                             "is not supported with it")
+        if ramp_phases < 1:
+            raise ValueError(
+                f"ramp_phases must be >= 1, got {ramp_phases}"
+            )
+        mode = "open"
+        nphase = int(ramp_phases)
+        ramp_plan = []
+        for p in range(nphase):
+            frac = p / (nphase - 1) if nphase > 1 else 0.0
+            fps_p = start_fps + (end_fps - start_fps) * frac
+            dur_p = ramp_secs / nphase
+            ramp_plan.append((fps_p, dur_p,
+                              max(1, int(round(fps_p * dur_p)))))
+        requests = sum(n for _, _, n in ramp_plan)
     if rate_fps is not None:
         if not rate_fps > 0:
             raise ValueError(f"rate_fps must be > 0, got {rate_fps!r}")
@@ -571,24 +617,9 @@ def run(
         period = 1.0 / rate if rate > 0 else 0.0
         futures = []
         offered = 0
-        # Bursty mode: ticks of `burst` back-to-back submissions, the
-        # NEXT tick due an exponentially distributed gap later (seeded:
-        # a run replays exactly). The mean inter-REQUEST period is
-        # unchanged — a tick of N requests earns an N-period mean gap —
-        # so `rate` keeps meaning requests/second across modes.
-        jrng = (np.random.default_rng(seed ^ 0xB5457)
-                if burst > 1 else None)
-        t_due = t_start
-        for i in range(requests):
-            if i % burst == 0:
-                if i > 0:
-                    t_due += (
-                        jrng.exponential(period * burst)
-                        if jrng is not None else period * burst
-                    )
-                delay = t_due - time.perf_counter()
-                if delay > 0:
-                    time.sleep(delay)
+
+        def _offer(i: int) -> None:
+            nonlocal offered
             offered += 1
             try:
                 # The request index rides with the future: a shed
@@ -611,6 +642,46 @@ def run(
                 futures.append((i, f))
             except QueueFull:
                 pass  # counted by the server; open loops shed, not wait
+
+        if ramp_plan is not None:
+            # Ramp profile: each phase is its own metronome at the
+            # stepped rate, due times anchored to the PHASE start so a
+            # slow server never compresses the later (faster) phases.
+            phase_bounds: List[Tuple[int, int]] = []
+            phase_walls: List[float] = []
+            i = 0
+            for fps_p, _dur_p, n_p in ramp_plan:
+                t_phase = time.perf_counter()
+                period_p = 1.0 / fps_p
+                for k in range(n_p):
+                    delay = t_phase + k * period_p - time.perf_counter()
+                    if delay > 0:
+                        time.sleep(delay)
+                    _offer(i)
+                    i += 1
+                phase_walls.append(time.perf_counter() - t_phase)
+                phase_bounds.append((i - n_p, i))
+        else:
+            # Bursty mode: ticks of `burst` back-to-back submissions,
+            # the NEXT tick due an exponentially distributed gap later
+            # (seeded: a run replays exactly). The mean inter-REQUEST
+            # period is unchanged — a tick of N requests earns an
+            # N-period mean gap — so `rate` keeps meaning
+            # requests/second across modes.
+            jrng = (np.random.default_rng(seed ^ 0xB5457)
+                    if burst > 1 else None)
+            t_due = t_start
+            for i in range(requests):
+                if i % burst == 0:
+                    if i > 0:
+                        t_due += (
+                            jrng.exponential(period * burst)
+                            if jrng is not None else period * burst
+                        )
+                    delay = t_due - time.perf_counter()
+                    if delay > 0:
+                        time.sleep(delay)
+                _offer(i)
         offer_wall = time.perf_counter() - t_start
         deadline = time.perf_counter() + timeout
         shed_in_flight = 0
@@ -710,4 +781,30 @@ def run(
             offered / offer_window if offer_window > 0 else 0.0
         )
         report["achieved_fps"] = completed / wall if wall > 0 else 0.0
+    if ramp_plan is not None:
+        # Per-phase achieved fps + p99 from the CLIENT-side records —
+        # the phase a completion belongs to is the phase its request
+        # was offered in, so a mid-ramp resize (the elastic acceptance
+        # run) shows its cost in exactly the right row.
+        phases_rep = []
+        for (fps_p, dur_p, n_p), (lo, hi), wall_p in zip(
+            ramp_plan, phase_bounds, phase_walls
+        ):
+            lats = sorted(
+                r["latency_s"] for r in done_recs
+                if lo <= r["i"] < hi and r["ok"]
+            )
+            p99 = (lats[max(0, math.ceil(0.99 * len(lats)) - 1)]
+                   if lats else 0.0)
+            phases_rep.append({
+                "fps": fps_p, "seconds": dur_p, "requests": n_p,
+                "completed": len(lats),
+                "achieved_fps": len(lats) / wall_p if wall_p > 0
+                else 0.0,
+                "p99_s": p99,
+            })
+        report["ramp"] = {
+            "start_fps": float(ramp[0]), "end_fps": float(ramp[1]),
+            "seconds": float(ramp[2]), "phases": phases_rep,
+        }
     return report
